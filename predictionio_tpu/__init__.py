@@ -1,0 +1,39 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+Capability-equivalent rebuild of actionml/PredictionIO (reference mounted at
+/root/reference; see SURVEY.md for the layer map) designed TPU-first:
+
+- Event ingestion REST server + pluggable event store (append-only columnar
+  log replacing HBase/Elasticsearch as system-of-record).
+- DASE engine abstraction (DataSource, Preparator, Algorithm, Serving,
+  Evaluation) — reference: core/src/main/scala/io/prediction/controller/.
+- Training workflow executing algorithms as JAX/XLA/Pallas programs sharded
+  over a `jax.sharding.Mesh` via GSPMD, replacing Spark MLlib clusters.
+- Deploy path serving /queries.json from a resident jitted inference loop.
+- Engine templates: ALS recommendation, classification, similar-product,
+  CCO Universal Recommender, text classification.
+"""
+
+__version__ = "0.1.0"
+
+from predictionio_tpu.controller import (  # noqa: F401
+    Algorithm,
+    AverageMetric,
+    AverageServing,
+    DataSource,
+    EmptyParams,
+    Engine,
+    EngineFactory,
+    EngineParams,
+    Evaluation,
+    FirstServing,
+    IdentityPreparator,
+    Metric,
+    MetricEvaluator,
+    OptionAverageMetric,
+    Params,
+    PersistentModel,
+    Preparator,
+    Serving,
+    SumMetric,
+)

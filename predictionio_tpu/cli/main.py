@@ -1,0 +1,324 @@
+"""`pio` command-line console.
+
+Reference: tools/src/main/scala/io/prediction/tools/console/Console.scala and
+bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
+
+  app new|list|show|delete|data-delete    application management
+  accesskey new|list|delete               access keys
+  channel new|delete                      channels
+  train / deploy / eval                   DASE workflow (workflow module)
+  import / export                         event batch files
+  status                                  storage + env sanity report
+  version
+
+Where the reference shells out to spark-submit, this dispatches in-process to
+the JAX workflow runner (predictionio_tpu/workflow/) — there is no cluster
+launcher boundary on a TPU VM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from predictionio_tpu import __version__
+from predictionio_tpu.storage import AccessKey, App, Channel, get_storage
+
+
+def _cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def _cmd_status(args) -> int:
+    st = get_storage()
+    print("PredictionIO-TPU status:")
+    print(f"  version: {__version__}")
+    for repo, source in st.config.repositories.items():
+        spec = st.config.sources[source]
+        print(f"  {repo.lower()}: source={source} type={spec.get('type')} path={spec.get('path', '-')}")
+    try:
+        apps = st.apps.get_all()
+        print(f"  apps: {len(apps)}")
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"  storage ERROR: {e}")
+        return 1
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"  jax devices: {len(devs)} ({devs[0].platform})")
+    except Exception as e:
+        print(f"  jax unavailable: {e}")
+    print("(sanity check: all storage repositories reachable)")
+    return 0
+
+
+def _cmd_app(args) -> int:
+    st = get_storage()
+    if args.app_command == "new":
+        app_id = st.apps.insert(App(args.id or 0, args.name, args.description or ""))
+        if app_id is None:
+            print(f"Error: app {args.name!r} already exists.", file=sys.stderr)
+            return 1
+        st.l_events.init(app_id)
+        key = st.access_keys.insert(AccessKey("", app_id, []))
+        print(f"Created app {args.name!r} with id {app_id}.")
+        print(f"Access key: {key}")
+        return 0
+    if args.app_command == "list":
+        for a in sorted(st.apps.get_all(), key=lambda a: a.id):
+            print(f"  {a.id}  {a.name}  {a.description}")
+        return 0
+    if args.app_command == "show":
+        app = st.apps.get_by_name(args.name)
+        if app is None:
+            print(f"Error: app {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        print(f"  id: {app.id}\n  name: {app.name}\n  description: {app.description}")
+        for k in st.access_keys.get_by_app_id(app.id):
+            events = ",".join(k.events) if k.events else "(all)"
+            print(f"  access key: {k.key}  events: {events}")
+        for c in st.channels.get_by_app_id(app.id):
+            print(f"  channel: {c.id} {c.name}")
+        return 0
+    if args.app_command == "delete":
+        app = st.apps.get_by_name(args.name)
+        if app is None:
+            print(f"Error: app {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        for k in st.access_keys.get_by_app_id(app.id):
+            st.access_keys.delete(k.key)
+        for c in st.channels.get_by_app_id(app.id):
+            st.l_events.remove(app.id, c.id)
+            st.channels.delete(c.id)
+        st.l_events.remove(app.id)
+        st.apps.delete(app.id)
+        print(f"Deleted app {args.name!r}.")
+        return 0
+    if args.app_command == "data-delete":
+        app = st.apps.get_by_name(args.name)
+        if app is None:
+            print(f"Error: app {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        st.l_events.remove(app.id)
+        st.l_events.init(app.id)
+        print(f"Deleted all events of app {args.name!r}.")
+        return 0
+    raise AssertionError(args.app_command)
+
+
+def _resolve_app(st, name: str):
+    app = st.apps.get_by_name(name)
+    if app is None:
+        print(f"Error: app {name!r} does not exist.", file=sys.stderr)
+    return app
+
+
+def _cmd_accesskey(args) -> int:
+    st = get_storage()
+    if args.ak_command == "new":
+        app = _resolve_app(st, args.app_name)
+        if app is None:
+            return 1
+        key = st.access_keys.insert(AccessKey("", app.id, args.events or []))
+        print(f"Created access key: {key}")
+        return 0
+    if args.ak_command == "list":
+        app = _resolve_app(st, args.app_name)
+        if app is None:
+            return 1
+        for k in st.access_keys.get_by_app_id(app.id):
+            events = ",".join(k.events) if k.events else "(all)"
+            print(f"  {k.key}  events: {events}")
+        return 0
+    if args.ak_command == "delete":
+        ok = st.access_keys.delete(args.key)
+        print("Deleted." if ok else "Error: key not found.")
+        return 0 if ok else 1
+    raise AssertionError(args.ak_command)
+
+
+def _cmd_channel(args) -> int:
+    st = get_storage()
+    app = _resolve_app(st, args.app_name)
+    if app is None:
+        return 1
+    if args.ch_command == "new":
+        cid = st.channels.insert(Channel(0, args.name, app.id))
+        if cid is None:
+            print(f"Error: channel {args.name!r} already exists.", file=sys.stderr)
+            return 1
+        st.l_events.init(app.id, cid)
+        print(f"Created channel {args.name!r} with id {cid}.")
+        return 0
+    if args.ch_command == "delete":
+        chan = next((c for c in st.channels.get_by_app_id(app.id) if c.name == args.name), None)
+        if chan is None:
+            print(f"Error: channel {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        st.l_events.remove(app.id, chan.id)
+        st.channels.delete(chan.id)
+        print(f"Deleted channel {args.name!r}.")
+        return 0
+    raise AssertionError(args.ch_command)
+
+
+def _cmd_import(args) -> int:
+    """Reference: tools Import — bulk load a JSON-lines event file."""
+    from predictionio_tpu.events.event import Event
+
+    st = get_storage()
+    app = st.apps.get(args.appid) if args.appid else _resolve_app(st, args.app_name)
+    if app is None:
+        print("Error: app not found.", file=sys.stderr)
+        return 1
+    count = 0
+    batch = []
+    with open(args.input) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            batch.append(Event.from_json(json.loads(line)))
+            if len(batch) >= 10000:
+                st.l_events.insert_batch(batch, app.id)
+                count += len(batch)
+                batch = []
+    if batch:
+        st.l_events.insert_batch(batch, app.id)
+        count += len(batch)
+    print(f"Imported {count} events to app {app.id}.")
+    return 0
+
+
+def _cmd_export(args) -> int:
+    st = get_storage()
+    app = st.apps.get(args.appid) if args.appid else _resolve_app(st, args.app_name)
+    if app is None:
+        print("Error: app not found.", file=sys.stderr)
+        return 1
+    count = 0
+    with open(args.output, "w") as f:
+        for e in st.p_events.find(app.id):
+            f.write(e.to_json_line() + "\n")
+            count += 1
+    print(f"Exported {count} events from app {app.id} to {args.output}.")
+    return 0
+
+
+def _cmd_train(args) -> int:
+    from predictionio_tpu.workflow.create_workflow import run_train_from_args
+
+    return run_train_from_args(args)
+
+
+def _cmd_deploy(args) -> int:
+    from predictionio_tpu.workflow.create_server import run_server_from_args
+
+    return run_server_from_args(args)
+
+
+def _cmd_eval(args) -> int:
+    from predictionio_tpu.workflow.create_workflow import run_eval_from_args
+
+    return run_eval_from_args(args)
+
+
+def _cmd_eventserver(args) -> int:
+    from predictionio_tpu.api.event_server import run_event_server
+
+    return run_event_server(host=args.ip, port=args.port)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pio", description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(func=_cmd_version)
+    sub.add_parser("status").set_defaults(func=_cmd_status)
+
+    app = sub.add_parser("app")
+    app_sub = app.add_subparsers(dest="app_command", required=True)
+    ap_new = app_sub.add_parser("new")
+    ap_new.add_argument("name")
+    ap_new.add_argument("--id", type=int, default=0)
+    ap_new.add_argument("--description", default="")
+    for name in ("list",):
+        app_sub.add_parser(name)
+    for name in ("show", "delete", "data-delete"):
+        sp = app_sub.add_parser(name)
+        sp.add_argument("name")
+    app.set_defaults(func=_cmd_app)
+
+    ak = sub.add_parser("accesskey")
+    ak_sub = ak.add_subparsers(dest="ak_command", required=True)
+    ak_new = ak_sub.add_parser("new")
+    ak_new.add_argument("app_name")
+    ak_new.add_argument("events", nargs="*")
+    ak_list = ak_sub.add_parser("list")
+    ak_list.add_argument("app_name")
+    ak_del = ak_sub.add_parser("delete")
+    ak_del.add_argument("key")
+    ak.set_defaults(func=_cmd_accesskey)
+
+    ch = sub.add_parser("channel")
+    ch_sub = ch.add_subparsers(dest="ch_command", required=True)
+    for name in ("new", "delete"):
+        sp = ch_sub.add_parser(name)
+        sp.add_argument("app_name")
+        sp.add_argument("name")
+    ch.set_defaults(func=_cmd_channel)
+
+    imp = sub.add_parser("import")
+    imp.add_argument("--appid", type=int, default=0)
+    imp.add_argument("--app-name", default=None)
+    imp.add_argument("--input", required=True)
+    imp.set_defaults(func=_cmd_import)
+
+    exp = sub.add_parser("export")
+    exp.add_argument("--appid", type=int, default=0)
+    exp.add_argument("--app-name", default=None)
+    exp.add_argument("--output", required=True)
+    exp.set_defaults(func=_cmd_export)
+
+    tr = sub.add_parser("train")
+    tr.add_argument("--engine-json", default="engine.json")
+    tr.add_argument("--engine-id", default=None)
+    tr.add_argument("--engine-version", default="1")
+    tr.add_argument("--variant", default="default")
+    tr.set_defaults(func=_cmd_train)
+
+    dp = sub.add_parser("deploy")
+    dp.add_argument("--engine-json", default="engine.json")
+    dp.add_argument("--engine-id", default=None)
+    dp.add_argument("--engine-version", default="1")
+    dp.add_argument("--variant", default="default")
+    dp.add_argument("--ip", default="0.0.0.0")
+    dp.add_argument("--port", type=int, default=8000)
+    dp.add_argument("--engine-instance-id", default=None)
+    dp.add_argument("--feedback", action="store_true")
+    dp.set_defaults(func=_cmd_deploy)
+
+    ev = sub.add_parser("eval")
+    ev.add_argument("evaluation_class")
+    ev.add_argument("--engine-json", default="engine.json")
+    ev.set_defaults(func=_cmd_eval)
+
+    es = sub.add_parser("eventserver")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.set_defaults(func=_cmd_eventserver)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+from predictionio_tpu.controller.dase import (  # noqa: F401
+    Algorithm,
+    AverageServing,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    LAlgorithm,
+    LDataSource,
+    LPreparator,
+    LServing,
+    P2LAlgorithm,
+    PAlgorithm,
+    PDataSource,
+    PersistentModel,
+    PPreparator,
+    Preparator,
+    Serving,
+)
+from predictionio_tpu.controller.engine import (  # noqa: F401
+    Engine,
+    EngineFactory,
+    EngineParams,
+)
+from predictionio_tpu.controller.evaluation import (  # noqa: F401
+    AverageMetric,
+    Evaluation,
+    Metric,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    OptionAverageMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.controller.params import EmptyParams, Params  # noqa: F401

@@ -1,0 +1,93 @@
+"""User-facing DASE component classes (reference: core/.../controller/).
+
+These are the classes engine templates subclass.  The reference's
+P/L/P2L split (PAlgorithm vs LAlgorithm vs P2LAlgorithm etc.) collapses to a
+single variant under JAX — see predictionio_tpu/core/base.py for rationale.
+Aliases ``PAlgorithm``/``LAlgorithm``/``P2LAlgorithm`` (and P/L data sources
+and preparators) are provided for naming parity so reference templates map
+1:1 onto this API.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional, Sequence
+
+from predictionio_tpu.core.base import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+)
+
+
+class DataSource(BaseDataSource):
+    """Reads training (and optionally evaluation) data from the event store."""
+
+
+class Preparator(BasePreparator):
+    """Transforms TrainingData into the algorithm-ready PreparedData."""
+
+
+class IdentityPreparator(Preparator):
+    """Reference: IdentityPreparator / PIdentityPreparator."""
+
+    def prepare(self, training_data):
+        return training_data
+
+
+class Algorithm(BaseAlgorithm):
+    """train(prepared_data) -> model; predict(model, query) -> prediction."""
+
+
+class Serving(BaseServing):
+    """Combines/post-processes algorithm predictions for a query."""
+
+
+class FirstServing(Serving):
+    """Reference: FirstServing — returns the first algorithm's prediction."""
+
+    def serve(self, query: Any, predictions: Sequence[Any]) -> Any:
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Reference: AverageServing — averages numeric predictions."""
+
+    def serve(self, query: Any, predictions: Sequence[Any]) -> Any:
+        return sum(predictions) / len(predictions)
+
+
+# -- persistence ------------------------------------------------------------
+
+
+class PersistentModel:
+    """Models that manage their own persistence
+    (reference: PersistentModel / PersistentModelLoader).
+
+    Default implementation pickles the whole object; large array-valued
+    models override save/load to use the orbax-backed model store
+    (predictionio_tpu/workflow/persistence.py) instead.
+    """
+
+    def save(self) -> bytes:
+        return pickle.dumps(self)
+
+    @classmethod
+    def load(cls, blob: bytes) -> "PersistentModel":
+        obj = pickle.loads(blob)
+        if not isinstance(obj, cls):
+            raise TypeError(f"model blob holds {type(obj).__name__}, expected {cls.__name__}")
+        return obj
+
+
+# -- naming-parity aliases ---------------------------------------------------
+
+PDataSource = DataSource
+LDataSource = DataSource
+PPreparator = Preparator
+LPreparator = Preparator
+PAlgorithm = Algorithm
+LAlgorithm = Algorithm
+P2LAlgorithm = Algorithm
+LServing = Serving

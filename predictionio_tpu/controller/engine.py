@@ -1,0 +1,222 @@
+"""Engine abstraction (reference: core/.../controller/Engine.scala).
+
+An ``Engine`` wires one DataSource, one Preparator, a named set of
+Algorithms, and one Serving class.  ``Engine.train`` chains
+``read_training → prepare → algorithm.train`` per algorithm
+(reference: Engine.train calling trainBase over algo list);
+``Engine.eval`` runs the DASE chain over eval folds.
+
+``EngineParams`` carries the per-component params (bound from engine.json);
+``EngineFactory`` is the user entry point named in engine.json's
+``engineFactory`` key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from predictionio_tpu.controller.params import EmptyParams, Params
+from predictionio_tpu.core.base import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BaseEngine,
+    BasePreparator,
+    BaseServing,
+    doer_name,
+)
+
+
+@dataclasses.dataclass
+class EngineParams:
+    """Per-component parameter bundle (reference: EngineParams in Engine.scala)."""
+
+    data_source_params: Params = dataclasses.field(default_factory=EmptyParams)
+    preparator_params: Params = dataclasses.field(default_factory=EmptyParams)
+    algorithm_params_list: List[Tuple[str, Params]] = dataclasses.field(default_factory=list)
+    serving_params: Params = dataclasses.field(default_factory=EmptyParams)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "dataSourceParams": self.data_source_params.to_json(),
+            "preparatorParams": self.preparator_params.to_json(),
+            "algorithmParamsList": [
+                {"name": name, "params": p.to_json()} for name, p in self.algorithm_params_list
+            ],
+            "servingParams": self.serving_params.to_json(),
+        }
+
+
+class Engine(BaseEngine):
+    """DASE engine (reference: Engine.scala).
+
+    ``algorithm_classes`` maps algorithm names (referenced from engine.json's
+    ``algorithms[].name``) to BaseAlgorithm subclasses.
+    """
+
+    def __init__(
+        self,
+        data_source_class: Type[BaseDataSource],
+        preparator_class: Type[BasePreparator],
+        algorithm_classes: Dict[str, Type[BaseAlgorithm]],
+        serving_class: Type[BaseServing],
+    ):
+        self.data_source_class = data_source_class
+        self.preparator_class = preparator_class
+        self.algorithm_classes = dict(algorithm_classes)
+        self.serving_class = serving_class
+
+    # -- component instantiation --------------------------------------------
+
+    def make_components(
+        self, engine_params: EngineParams
+    ) -> Tuple[BaseDataSource, BasePreparator, List[BaseAlgorithm], BaseServing]:
+        data_source = self.data_source_class(engine_params.data_source_params)
+        preparator = self.preparator_class(engine_params.preparator_params)
+        algorithms: List[BaseAlgorithm] = []
+        for name, params in engine_params.algorithm_params_list or self._default_algo_list():
+            if name not in self.algorithm_classes:
+                raise ValueError(
+                    f"unknown algorithm {name!r}; engine defines {sorted(self.algorithm_classes)}"
+                )
+            algorithms.append(self.algorithm_classes[name](params))
+        serving = self.serving_class(engine_params.serving_params)
+        return data_source, preparator, algorithms, serving
+
+    def _default_algo_list(self) -> List[Tuple[str, Params]]:
+        return [
+            (name, cls.params_class())
+            for name, cls in list(self.algorithm_classes.items())[:1]
+        ]
+
+    # -- train ---------------------------------------------------------------
+
+    def train(self, engine_params: EngineParams) -> List[Any]:
+        """Run D→P→A over all algorithms; returns the list of trained models.
+
+        Reference: Engine.train — readTraining, prepare, then trainBase per
+        algorithm (order preserved; serving combines their predictions).
+        """
+        data_source, preparator, algorithms, _ = self.make_components(engine_params)
+        td = data_source.read_training()
+        pd = preparator.prepare(td)
+        return [algo.train(pd) for algo in algorithms]
+
+    # -- eval ----------------------------------------------------------------
+
+    def eval(self, engine_params: EngineParams) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        """Run evaluation folds.
+
+        Returns per-fold ``(eval_info, [(query, prediction, actual), ...])``
+        matching the reference's ``Engine.eval`` RDD of (Q, P, A) triples.
+        """
+        data_source, preparator, algorithms, serving = self.make_components(engine_params)
+        results = []
+        for fold in data_source.read_eval():
+            td, eval_info, qa_pairs = _unpack_fold(fold)
+            pd = preparator.prepare(td)
+            models = [algo.train(pd) for algo in algorithms]
+            queries = [q for q, _ in qa_pairs]
+            per_algo_preds = [
+                algo.batch_predict(model, queries) for algo, model in zip(algorithms, models)
+            ]
+            qpa = []
+            for i, (q, a) in enumerate(qa_pairs):
+                preds = [per_algo_preds[j][i] for j in range(len(algorithms))]
+                qpa.append((q, serving.serve(q, preds), a))
+            results.append((eval_info, qpa))
+        return results
+
+    # -- serving -------------------------------------------------------------
+
+    def predictor(
+        self, engine_params: EngineParams, models: Sequence[Any]
+    ) -> Callable[[Any], Any]:
+        """Build the deploy-time query→prediction function.
+
+        Reference: CreateServer's ServerActor closing over (engine, models);
+        each query runs every algorithm's predict then serving.serve.
+        """
+        _, _, algorithms, serving = self.make_components(engine_params)
+        if len(models) != len(algorithms):
+            raise ValueError(
+                f"{len(models)} model(s) for {len(algorithms)} algorithm(s)"
+            )
+
+        def predict(query: Any) -> Any:
+            preds = [algo.predict(model, query) for algo, model in zip(algorithms, models)]
+            return serving.serve(query, preds)
+
+        return predict
+
+    # -- params binding (engine.json) ----------------------------------------
+
+    def engine_params_from_variant(self, variant: Dict[str, Any]) -> EngineParams:
+        """Bind an engine.json document to typed EngineParams.
+
+        Reference: WorkflowUtils/JsonExtractor extracting dataSourceParams /
+        preparatorParams / algorithms[] / servingParams blocks.
+        """
+        dsp = self.data_source_class.params_class.from_json(
+            _params_block(variant.get("datasource"))
+        )
+        pp = self.preparator_class.params_class.from_json(
+            _params_block(variant.get("preparator"))
+        )
+        algo_list: List[Tuple[str, Params]] = []
+        for entry in variant.get("algorithms", []):
+            name = entry.get("name")
+            if name not in self.algorithm_classes:
+                raise ValueError(
+                    f"engine.json names unknown algorithm {name!r}; "
+                    f"engine defines {sorted(self.algorithm_classes)}"
+                )
+            algo_list.append(
+                (name, self.algorithm_classes[name].params_class.from_json(entry.get("params", {})))
+            )
+        sp = self.serving_class.params_class.from_json(_params_block(variant.get("serving")))
+        return EngineParams(dsp, pp, algo_list, sp)
+
+
+def _params_block(block: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    if block is None:
+        return {}
+    # engine.json wraps component params as {"params": {...}}; tolerate bare maps.
+    if "params" in block and isinstance(block["params"], dict):
+        return block["params"]
+    return block
+
+
+def _unpack_fold(fold: Any) -> Tuple[Any, Any, List[Tuple[Any, Any]]]:
+    """Accept (td, qa_pairs) or (td, eval_info, qa_pairs) fold shapes."""
+    if len(fold) == 2:
+        td, qa = fold
+        return td, None, list(qa)
+    td, info, qa = fold
+    return td, info, list(qa)
+
+
+class EngineFactory:
+    """User entry point named by engine.json's ``engineFactory``
+    (reference: EngineFactory trait). Subclass and override ``apply``."""
+
+    @classmethod
+    def apply(cls) -> Engine:
+        raise NotImplementedError
+
+    @classmethod
+    def engine_id(cls) -> str:
+        return doer_name(cls)
+
+
+def serialize_engine_params(engine_params: EngineParams) -> Dict[str, str]:
+    """Stringify params for EngineInstance metadata records."""
+    return {
+        "data_source_params": json.dumps(engine_params.data_source_params.to_json()),
+        "preparator_params": json.dumps(engine_params.preparator_params.to_json()),
+        "algorithms_params": json.dumps(
+            [{"name": n, "params": p.to_json()} for n, p in engine_params.algorithm_params_list]
+        ),
+        "serving_params": json.dumps(engine_params.serving_params.to_json()),
+    }

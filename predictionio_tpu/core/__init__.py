@@ -1,0 +1,9 @@
+from predictionio_tpu.core.base import (  # noqa: F401
+    BaseAlgorithm,
+    BaseDataSource,
+    BaseEngine,
+    BaseEvaluator,
+    BasePreparator,
+    BaseServing,
+    Doer,
+)

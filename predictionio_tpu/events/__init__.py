@@ -1,0 +1,9 @@
+from predictionio_tpu.events.event import (  # noqa: F401
+    DataMap,
+    Event,
+    PropertyMap,
+    aggregate_properties,
+    SET_EVENT,
+    UNSET_EVENT,
+    DELETE_EVENT,
+)

@@ -1,0 +1,12 @@
+from predictionio_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    create_mesh,
+    default_mesh,
+    host_staging_iterator,
+)
+from predictionio_tpu.parallel.sharding import (  # noqa: F401
+    named_sharding,
+    pad_to_multiple,
+    replicated,
+    shard_rows,
+)

@@ -1,0 +1,104 @@
+"""Device-mesh management.
+
+The reference's parallel substrate is Spark: RDD partitions + Netty shuffle +
+Akka control (SURVEY.md §2 'Parallelism & comms').  The TPU-native substrate
+is a `jax.sharding.Mesh` over the chip slice: GSPMD inserts XLA collectives
+(all-reduce / all-gather / reduce-scatter / all-to-all) over ICI within a
+slice and DCN across slices, driven purely by sharding annotations.
+
+Axis convention used across the framework:
+- ``dp``   — batch/data parallelism (events, users, queries)
+- ``mp``   — model parallelism (item/feature dimension of factor matrices)
+
+For classical-ML workloads (ALS, CCO, logreg) a 2-D ``(dp, mp)`` mesh covers
+everything; templates reshape it as needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; resolved against available devices."""
+
+    dp: int = -1  # -1 = fill with remaining devices
+    mp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int]:
+        mp = self.mp if self.mp > 0 else 1
+        if n_devices % mp != 0:
+            raise ValueError(f"mp={mp} does not divide device count {n_devices}")
+        dp = self.dp if self.dp > 0 else n_devices // mp
+        if dp * mp != n_devices:
+            raise ValueError(f"mesh {dp}x{mp} != {n_devices} devices")
+        return dp, mp
+
+
+def create_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Tuple[str, str] = ("dp", "mp"),
+) -> Mesh:
+    """Build a 2-D mesh over the given (default: all) devices.
+
+    On multi-host slices, `jax.devices()` already enumerates the global
+    device set after `jax.distributed.initialize()`; mesh axes laid out so
+    that `dp` is the outer (DCN-crossing) axis and `mp` stays within a host's
+    ICI domain where possible — collectives on `mp` ride ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dp, mp = (spec or MeshSpec()).resolve(len(devices))
+    arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, axis_names)
+
+
+def default_mesh() -> Mesh:
+    """Process-wide default mesh: all devices on a (dp, mp=1) mesh, with the
+    shape overridable via PIO_MESH (e.g. 'dp=4,mp=2')."""
+    conf = os.environ.get("PIO_MESH", "")
+    spec = MeshSpec()
+    if conf:
+        kv = dict(part.split("=") for part in conf.split(",") if "=" in part)
+        spec = MeshSpec(dp=int(kv.get("dp", -1)), mp=int(kv.get("mp", 1)))
+    return create_mesh(spec)
+
+
+def host_staging_iterator(
+    arrays: Iterable[np.ndarray],
+    mesh: Mesh,
+    axis: str = "dp",
+) -> Iterator[jax.Array]:
+    """Double-buffered host→device staging of row-sharded batches.
+
+    Replaces the reference's HBase-scan→RDD ingest (HBPEvents via
+    TableInputFormat): each numpy batch is placed row-sharded over ``axis``
+    while the previous batch is being consumed, overlapping H2D DMA with
+    compute (device dispatch is async in JAX).
+    """
+    from predictionio_tpu.parallel.sharding import shard_rows
+
+    pending: Optional[jax.Array] = None
+    for arr in arrays:
+        staged = jax.device_put(arr, shard_rows(mesh, axis, arr.ndim))
+        if pending is not None:
+            yield pending
+        pending = staged
+    if pending is not None:
+        yield pending
+
+
+def pad_rows_for_mesh(n_rows: int, mesh: Mesh, axis: str = "dp", multiple: int = 8) -> int:
+    """Rows padded so each shard is a multiple of `multiple` (MXU-friendly)."""
+    shards = mesh.shape[axis]
+    per = math.ceil(n_rows / shards)
+    per = ((per + multiple - 1) // multiple) * multiple
+    return per * shards

@@ -1,0 +1,45 @@
+"""Sharding helpers shared by templates and the workflow."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(mesh: Mesh, axis: str = "dp", ndim: int = 2) -> NamedSharding:
+    """Rows over `axis`, everything else replicated."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def pad_to_multiple(
+    arr: np.ndarray, multiple: int, axis: int = 0, fill: Union[int, float] = 0
+) -> np.ndarray:
+    """Pad `axis` up to a multiple (static shapes keep XLA happy; pad rows are
+    masked out downstream)."""
+    n = arr.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(arr, pad_width, constant_values=fill)
+
+
+def device_put_sharded_rows(
+    arr: np.ndarray, mesh: Mesh, axis: str = "dp"
+) -> jax.Array:
+    """Pad rows to the dp extent and place row-sharded on the mesh."""
+    dp = mesh.shape[axis]
+    arr = pad_to_multiple(arr, dp, axis=0)
+    return jax.device_put(arr, shard_rows(mesh, axis, arr.ndim))

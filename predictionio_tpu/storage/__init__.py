@@ -1,0 +1,21 @@
+from predictionio_tpu.storage.base import (  # noqa: F401
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EvaluationInstance,
+    EvaluationInstances,
+    LEvents,
+    Models,
+    PEvents,
+)
+from predictionio_tpu.storage.locator import (  # noqa: F401
+    Storage,
+    StorageConfig,
+    get_storage,
+    set_storage,
+)

@@ -1,0 +1,340 @@
+"""Storage interfaces (reference: data/src/main/scala/io/prediction/data/storage/).
+
+The reference defines repository interfaces — ``LEvents``, ``PEvents``,
+``Models``, ``EngineInstances``, ``EvaluationInstances``, ``Apps``,
+``AccessKeys``, ``Channels`` — each implemented by HBase/Elasticsearch/JDBC/
+localfs backends and located via ``Storage.scala`` from ``PIO_STORAGE_*`` env
+config.  This module defines the same repository surface as Python ABCs.
+
+TPU-first design note: ``PEvents`` in the reference returns Spark RDDs; here
+``find_batches`` yields columnar ``EventBatch`` blocks (numpy arrays + string
+dictionaries) sized for host→device staging, which is what the JAX training
+workflow consumes instead of RDD partitions.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.events.event import Event, PropertyMap
+
+
+# ---------------------------------------------------------------------------
+# Metadata records (reference: Apps.scala, AccessKeys.scala, Channels.scala,
+# EngineInstances.scala, EvaluationInstances.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class App:
+    id: int
+    name: str
+    description: str = ""
+
+
+@dataclass
+class AccessKey:
+    key: str
+    app_id: int
+    events: List[str] = field(default_factory=list)  # empty = all events allowed
+
+    @staticmethod
+    def generate() -> str:
+        return secrets.token_urlsafe(32)
+
+
+@dataclass
+class Channel:
+    id: int
+    name: str
+    app_id: int
+
+
+@dataclass
+class EngineInstance:
+    id: str
+    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    env: Dict[str, str] = field(default_factory=dict)
+    spark_conf: Dict[str, str] = field(default_factory=dict)  # kept for config parity; holds mesh/runtime conf
+    data_source_params: str = "{}"
+    preparator_params: str = "{}"
+    algorithms_params: str = "[]"
+    serving_params: str = "{}"
+
+
+@dataclass
+class EvaluationInstance:
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    evaluation_class: str
+    engine_params_generator_class: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Repository interfaces
+# ---------------------------------------------------------------------------
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> Optional[str]: ...
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        """Latest COMPLETED instance for an engine triple (reference:
+        EngineInstances.getLatestCompleted) — what `pio deploy` binds to."""
+        candidates = [
+            i
+            for i in self.get_all()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda i: i.start_time)
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]: ...
+
+
+class Models(abc.ABC):
+    """Serialized model blobs keyed by engine-instance id (reference: Models.scala)."""
+
+    @abc.abstractmethod
+    def insert(self, instance_id: str, blob: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+# ---------------------------------------------------------------------------
+# Event repositories
+# ---------------------------------------------------------------------------
+
+
+class LEvents(abc.ABC):
+    """Serving/ingest-time event CRUD (reference: LEvents.scala).
+
+    The reference exposes future-based async ops over HBase; here the ops are
+    synchronous (backends are local/embedded) and the REST layer provides
+    concurrency.
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool: ...
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool: ...
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str: ...
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]: ...
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> Dict[str, PropertyMap]:
+        from predictionio_tpu.events.event import aggregate_properties
+
+        evs = self.find(
+            app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+        )
+        return aggregate_properties(evs)
+
+
+def match_filters(
+    e: Event,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    entity_type: Optional[str] = None,
+    entity_id: Optional[str] = None,
+    event_names: Optional[Sequence[str]] = None,
+    target_entity_type: Optional[str] = None,
+    target_entity_id: Optional[str] = None,
+) -> bool:
+    """Shared event-filter predicate used by all backends (reference semantics
+    of HBEventsUtil.createScan's column filters)."""
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in event_names:
+        return False
+    if target_entity_type is not None and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not None and e.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+class PEvents(abc.ABC):
+    """Bulk training-time reads (reference: PEvents.scala returns RDD[Event]).
+
+    TPU-native shape: iterate columnar batches ready for host→device staging.
+    """
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+    ) -> Iterator[Event]: ...
+
+    def scan(self, app_id: int, **filters: Any) -> Iterator[Event]:
+        """Unordered streaming bulk scan. Backends whose ``find`` must sort
+        (and therefore materialize) override this with a true stream; the
+        training path never needs time ordering."""
+        return self.find(app_id, **filters)
+
+    def find_batches(
+        self,
+        app_id: int,
+        batch_size: int = 1 << 20,
+        **filters: Any,
+    ) -> Iterator["EventBatch"]:
+        from predictionio_tpu.store.columnar import EventBatch
+
+        buf: List[Event] = []
+        for e in self.scan(app_id, **filters):
+            buf.append(e)
+            if len(buf) >= batch_size:
+                yield EventBatch.from_events(buf)
+                buf = []
+        if buf:
+            yield EventBatch.from_events(buf)

@@ -1,0 +1,465 @@
+"""Local-filesystem storage backend.
+
+System-of-record replacing the reference's HBase/Elasticsearch/JDBC backends
+(data/.../storage/{hbase,elasticsearch,jdbc}/ per SURVEY.md §2) with a layout
+designed for the TPU ingest path:
+
+- **Events**: append-only JSON-lines segments per (app, channel), rotated at
+  a size threshold (``events/app_<id>/<channel>/seg-NNNNN.jsonl``).  Segments
+  are immutable once rotated, so bulk training scans are sharded sequential
+  reads — the unit the native C++ scanner (``predictionio_tpu/native``) and
+  the columnar staging path parallelise over.  Deletes are tombstones in a
+  sidecar so the log stays append-only.
+- **Metadata** (apps/keys/channels/instances): single JSON documents under
+  ``meta/`` written atomically (tmp+rename).
+- **Models**: blobs under ``models/<instance_id>.bin``.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import threading
+import uuid
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from predictionio_tpu.events.event import Event
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+)
+
+SEGMENT_MAX_BYTES = 64 << 20  # rotate segments at 64 MiB
+DEFAULT_CHANNEL = "_default"
+
+
+def _atomic_write(path: Path, text: str) -> None:
+    tmp = path.with_suffix(path.suffix + f".tmp{os.getpid()}")
+    tmp.write_text(text)
+    tmp.replace(path)
+
+
+class _JsonDoc:
+    """A JSON document on disk with atomic replace and an in-process lock."""
+
+    def __init__(self, path: Path, default):
+        self.path = path
+        self.lock = threading.Lock()
+        self.default = default
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def read(self):
+        if not self.path.exists():
+            return json.loads(json.dumps(self.default))
+        return json.loads(self.path.read_text())
+
+    def write(self, obj) -> None:
+        _atomic_write(self.path, json.dumps(obj, indent=1, sort_keys=True))
+
+
+def _dt_to_json(t: Optional[_dt.datetime]) -> Optional[str]:
+    return t.isoformat() if t else None
+
+
+def _dt_from_json(s: Optional[str]) -> Optional[_dt.datetime]:
+    return _dt.datetime.fromisoformat(s) if s else None
+
+
+class FSApps(base.Apps):
+    def __init__(self, root: Path):
+        self._doc = _JsonDoc(root / "meta" / "apps.json", {"next_id": 1, "apps": []})
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._doc.lock:
+            d = self._doc.read()
+            if any(a["name"] == app.name for a in d["apps"]):
+                return None
+            if app.id <= 0 or any(a["id"] == app.id for a in d["apps"]):
+                app.id = d["next_id"]
+            d["next_id"] = max(d["next_id"], app.id) + 1
+            d["apps"].append({"id": app.id, "name": app.name, "description": app.description})
+            self._doc.write(d)
+            return app.id
+
+    def _all(self) -> List[App]:
+        return [App(a["id"], a["name"], a.get("description", "")) for a in self._doc.read()["apps"]]
+
+    def get(self, app_id: int) -> Optional[App]:
+        return next((a for a in self._all() if a.id == app_id), None)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return next((a for a in self._all() if a.name == name), None)
+
+    def get_all(self) -> List[App]:
+        return self._all()
+
+    def update(self, app: App) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            for a in d["apps"]:
+                if a["id"] == app.id:
+                    a["name"], a["description"] = app.name, app.description
+                    self._doc.write(d)
+                    return True
+            return False
+
+    def delete(self, app_id: int) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            n = len(d["apps"])
+            d["apps"] = [a for a in d["apps"] if a["id"] != app_id]
+            self._doc.write(d)
+            return len(d["apps"]) < n
+
+
+class FSAccessKeys(base.AccessKeys):
+    def __init__(self, root: Path):
+        self._doc = _JsonDoc(root / "meta" / "access_keys.json", {"keys": []})
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        with self._doc.lock:
+            if not access_key.key:
+                access_key.key = AccessKey.generate()
+            d = self._doc.read()
+            d["keys"].append({"key": access_key.key, "appid": access_key.app_id, "events": access_key.events})
+            self._doc.write(d)
+            return access_key.key
+
+    def _all(self) -> List[AccessKey]:
+        return [AccessKey(k["key"], k["appid"], k.get("events", [])) for k in self._doc.read()["keys"]]
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return next((k for k in self._all() if k.key == key), None)
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [k for k in self._all() if k.app_id == app_id]
+
+    def delete(self, key: str) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            n = len(d["keys"])
+            d["keys"] = [k for k in d["keys"] if k["key"] != key]
+            self._doc.write(d)
+            return len(d["keys"]) < n
+
+
+class FSChannels(base.Channels):
+    def __init__(self, root: Path):
+        self._doc = _JsonDoc(root / "meta" / "channels.json", {"next_id": 1, "channels": []})
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        with self._doc.lock:
+            d = self._doc.read()
+            if any(c["name"] == channel.name and c["appid"] == channel.app_id for c in d["channels"]):
+                return None
+            channel.id = d["next_id"]
+            d["next_id"] += 1
+            d["channels"].append({"id": channel.id, "name": channel.name, "appid": channel.app_id})
+            self._doc.write(d)
+            return channel.id
+
+    def _all(self) -> List[Channel]:
+        return [Channel(c["id"], c["name"], c["appid"]) for c in self._doc.read()["channels"]]
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return next((c for c in self._all() if c.id == channel_id), None)
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        return [c for c in self._all() if c.app_id == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            n = len(d["channels"])
+            d["channels"] = [c for c in d["channels"] if c["id"] != channel_id]
+            self._doc.write(d)
+            return len(d["channels"]) < n
+
+
+def _ei_to_json(i: EngineInstance) -> Dict:
+    return {
+        "id": i.id, "status": i.status,
+        "startTime": _dt_to_json(i.start_time), "endTime": _dt_to_json(i.end_time),
+        "engineId": i.engine_id, "engineVersion": i.engine_version,
+        "engineVariant": i.engine_variant, "engineFactory": i.engine_factory,
+        "env": i.env, "sparkConf": i.spark_conf,
+        "dataSourceParams": i.data_source_params, "preparatorParams": i.preparator_params,
+        "algorithmsParams": i.algorithms_params, "servingParams": i.serving_params,
+    }
+
+
+def _ei_from_json(d: Dict) -> EngineInstance:
+    return EngineInstance(
+        id=d["id"], status=d["status"],
+        start_time=_dt_from_json(d["startTime"]), end_time=_dt_from_json(d.get("endTime")),
+        engine_id=d["engineId"], engine_version=d["engineVersion"],
+        engine_variant=d["engineVariant"], engine_factory=d["engineFactory"],
+        env=d.get("env", {}), spark_conf=d.get("sparkConf", {}),
+        data_source_params=d.get("dataSourceParams", "{}"),
+        preparator_params=d.get("preparatorParams", "{}"),
+        algorithms_params=d.get("algorithmsParams", "[]"),
+        serving_params=d.get("servingParams", "{}"),
+    )
+
+
+class FSEngineInstances(base.EngineInstances):
+    def __init__(self, root: Path):
+        self._doc = _JsonDoc(root / "meta" / "engine_instances.json", {"instances": []})
+
+    def insert(self, instance: EngineInstance) -> str:
+        with self._doc.lock:
+            if not instance.id:
+                instance.id = uuid.uuid4().hex
+            d = self._doc.read()
+            d["instances"].append(_ei_to_json(instance))
+            self._doc.write(d)
+            return instance.id
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        return next((_ei_from_json(i) for i in self._doc.read()["instances"] if i["id"] == instance_id), None)
+
+    def update(self, instance: EngineInstance) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            for k, i in enumerate(d["instances"]):
+                if i["id"] == instance.id:
+                    d["instances"][k] = _ei_to_json(instance)
+                    self._doc.write(d)
+                    return True
+            return False
+
+    def get_all(self) -> List[EngineInstance]:
+        return [_ei_from_json(i) for i in self._doc.read()["instances"]]
+
+    def delete(self, instance_id: str) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            n = len(d["instances"])
+            d["instances"] = [i for i in d["instances"] if i["id"] != instance_id]
+            self._doc.write(d)
+            return len(d["instances"]) < n
+
+
+class FSEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, root: Path):
+        self._doc = _JsonDoc(root / "meta" / "evaluation_instances.json", {"instances": []})
+
+    @staticmethod
+    def _to_json(i: EvaluationInstance) -> Dict:
+        return {
+            "id": i.id, "status": i.status,
+            "startTime": _dt_to_json(i.start_time), "endTime": _dt_to_json(i.end_time),
+            "evaluationClass": i.evaluation_class,
+            "engineParamsGeneratorClass": i.engine_params_generator_class,
+            "env": i.env, "evaluatorResults": i.evaluator_results,
+            "evaluatorResultsHTML": i.evaluator_results_html,
+            "evaluatorResultsJSON": i.evaluator_results_json,
+        }
+
+    @staticmethod
+    def _from_json(d: Dict) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=d["id"], status=d["status"],
+            start_time=_dt_from_json(d["startTime"]), end_time=_dt_from_json(d.get("endTime")),
+            evaluation_class=d["evaluationClass"],
+            engine_params_generator_class=d.get("engineParamsGeneratorClass", ""),
+            env=d.get("env", {}),
+            evaluator_results=d.get("evaluatorResults", ""),
+            evaluator_results_html=d.get("evaluatorResultsHTML", ""),
+            evaluator_results_json=d.get("evaluatorResultsJSON", ""),
+        )
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        with self._doc.lock:
+            if not instance.id:
+                instance.id = uuid.uuid4().hex
+            d = self._doc.read()
+            d["instances"].append(self._to_json(instance))
+            self._doc.write(d)
+            return instance.id
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        return next((self._from_json(i) for i in self._doc.read()["instances"] if i["id"] == instance_id), None)
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            for k, i in enumerate(d["instances"]):
+                if i["id"] == instance.id:
+                    d["instances"][k] = self._to_json(instance)
+                    self._doc.write(d)
+                    return True
+            return False
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        return [self._from_json(i) for i in self._doc.read()["instances"] if i["status"] == "EVALCOMPLETED"]
+
+
+class FSModels(base.Models):
+    """Reference: data/.../storage/localfs/LocalFSModels.scala."""
+
+    def __init__(self, root: Path):
+        self._dir = root / "models"
+        self._dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, instance_id: str) -> Path:
+        if not instance_id.replace("-", "").replace("_", "").isalnum():
+            raise ValueError(f"invalid model id {instance_id!r}")
+        return self._dir / f"{instance_id}.bin"
+
+    def insert(self, instance_id: str, blob: bytes) -> None:
+        tmp = self._path(instance_id).with_suffix(f".tmp{os.getpid()}")
+        tmp.write_bytes(blob)
+        tmp.replace(self._path(instance_id))
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        p = self._path(instance_id)
+        return p.read_bytes() if p.exists() else None
+
+    def delete(self, instance_id: str) -> bool:
+        p = self._path(instance_id)
+        if p.exists():
+            p.unlink()
+            return True
+        return False
+
+
+class FSEvents(base.LEvents, base.PEvents):
+    """Append-only segmented JSONL event log."""
+
+    def __init__(self, root: Path):
+        self._root = Path(root) / "events"
+        self._lock = threading.Lock()
+
+    # -- layout --------------------------------------------------------------
+
+    def _chan_dir(self, app_id: int, channel_id: Optional[int]) -> Path:
+        chan = DEFAULT_CHANNEL if channel_id is None else f"channel_{channel_id}"
+        return self._root / f"app_{app_id}" / chan
+
+    def segment_paths(self, app_id: int, channel_id: Optional[int] = None) -> List[Path]:
+        d = self._chan_dir(app_id, channel_id)
+        if not d.exists():
+            return []
+        return sorted(d.glob("seg-*.jsonl"))
+
+    def _active_segment(self, d: Path) -> Path:
+        segs = sorted(d.glob("seg-*.jsonl"))
+        if segs and segs[-1].stat().st_size < SEGMENT_MAX_BYTES:
+            return segs[-1]
+        n = int(segs[-1].stem.split("-")[1]) + 1 if segs else 0
+        return d / f"seg-{n:05d}.jsonl"
+
+    def _tombstones(self, d: Path) -> set:
+        p = d / "tombstones.txt"
+        if not p.exists():
+            return set()
+        return set(p.read_text().split())
+
+    # -- LEvents -------------------------------------------------------------
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._chan_dir(app_id, channel_id).mkdir(parents=True, exist_ok=True)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        import shutil
+
+        d = self._chan_dir(app_id, channel_id)
+        if d.exists():
+            shutil.rmtree(d)
+            return True
+        return False
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        d = self._chan_dir(app_id, channel_id)
+        d.mkdir(parents=True, exist_ok=True)
+        lines = "".join(e.to_json_line() + "\n" for e in events)
+        with self._lock:
+            seg = self._active_segment(d)
+            with open(seg, "a") as f:
+                f.write(lines)
+        return [e.event_id for e in events]
+
+    def _iter_raw(self, app_id: int, channel_id: Optional[int]) -> Iterator[Event]:
+        d = self._chan_dir(app_id, channel_id)
+        dead = self._tombstones(d)
+        for seg in self.segment_paths(app_id, channel_id):
+            with open(seg) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    e = Event.from_json(json.loads(line))
+                    if e.event_id not in dead:
+                        yield e
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        return next((e for e in self._iter_raw(app_id, channel_id) if e.event_id == event_id), None)
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        d = self._chan_dir(app_id, channel_id)
+        with self._lock:
+            # Single pass under the lock: confirm the id is live, then tombstone.
+            if not any(e.event_id == event_id for e in self._iter_raw(app_id, channel_id)):
+                return False
+            with open(d / "tombstones.txt", "a") as f:
+                f.write(event_id + "\n")
+        return True
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        matched = (
+            e
+            for e in self._iter_raw(app_id, channel_id)
+            if base.match_filters(
+                e, start_time, until_time, entity_type, entity_id,
+                event_names, target_entity_type, target_entity_id,
+            )
+        )
+        ordered = sorted(matched, key=lambda e: (e.event_time, e.creation_time), reverse=reversed_order)
+        if limit is not None and limit >= 0:
+            ordered = ordered[:limit]
+        yield from ordered
+
+    def scan(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+    ) -> Iterator[Event]:
+        """Streaming unordered scan over segments — O(segment) memory, unlike
+        ``find`` which must sort. This is the bulk-training read path."""
+        for e in self._iter_raw(app_id, channel_id):
+            if base.match_filters(
+                e, start_time, until_time, entity_type, None,
+                event_names, target_entity_type, None,
+            ):
+                yield e

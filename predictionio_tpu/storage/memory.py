@@ -1,0 +1,223 @@
+"""In-memory storage backend (test/dev analogue of the reference's embedded
+backends used by LEventsSpec/PEventsSpec — SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.events.event import Event
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+)
+
+
+class MemApps(base.Apps):
+    def __init__(self):
+        self._apps: Dict[int, App] = {}
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._lock:
+            if any(a.name == app.name for a in self._apps.values()):
+                return None
+            if app.id in self._apps or app.id <= 0:
+                app.id = self._next
+            self._next = max(self._next, app.id) + 1
+            self._apps[app.id] = app
+            return app.id
+
+    def get(self, app_id: int) -> Optional[App]:
+        return self._apps.get(app_id)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return next((a for a in self._apps.values() if a.name == name), None)
+
+    def get_all(self) -> List[App]:
+        return list(self._apps.values())
+
+    def update(self, app: App) -> bool:
+        if app.id not in self._apps:
+            return False
+        self._apps[app.id] = app
+        return True
+
+    def delete(self, app_id: int) -> bool:
+        return self._apps.pop(app_id, None) is not None
+
+
+class MemAccessKeys(base.AccessKeys):
+    def __init__(self):
+        self._keys: Dict[str, AccessKey] = {}
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        if not access_key.key:
+            access_key.key = AccessKey.generate()
+        self._keys[access_key.key] = access_key
+        return access_key.key
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return self._keys.get(key)
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [k for k in self._keys.values() if k.app_id == app_id]
+
+    def delete(self, key: str) -> bool:
+        return self._keys.pop(key, None) is not None
+
+
+class MemChannels(base.Channels):
+    def __init__(self):
+        self._channels: Dict[int, Channel] = {}
+        self._next = 1
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if any(c.name == channel.name and c.app_id == channel.app_id for c in self._channels.values()):
+            return None
+        channel.id = self._next
+        self._next += 1
+        self._channels[channel.id] = channel
+        return channel.id
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return self._channels.get(channel_id)
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        return [c for c in self._channels.values() if c.app_id == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        return self._channels.pop(channel_id, None) is not None
+
+
+class MemEngineInstances(base.EngineInstances):
+    def __init__(self):
+        self._instances: Dict[str, EngineInstance] = {}
+
+    def insert(self, instance: EngineInstance) -> str:
+        if not instance.id:
+            instance.id = uuid.uuid4().hex
+        self._instances[instance.id] = instance
+        return instance.id
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        return self._instances.get(instance_id)
+
+    def update(self, instance: EngineInstance) -> bool:
+        if instance.id not in self._instances:
+            return False
+        self._instances[instance.id] = instance
+        return True
+
+    def get_all(self) -> List[EngineInstance]:
+        return list(self._instances.values())
+
+    def delete(self, instance_id: str) -> bool:
+        return self._instances.pop(instance_id, None) is not None
+
+
+class MemEvaluationInstances(base.EvaluationInstances):
+    def __init__(self):
+        self._instances: Dict[str, EvaluationInstance] = {}
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        if not instance.id:
+            instance.id = uuid.uuid4().hex
+        self._instances[instance.id] = instance
+        return instance.id
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        return self._instances.get(instance_id)
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        if instance.id not in self._instances:
+            return False
+        self._instances[instance.id] = instance
+        return True
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        return [i for i in self._instances.values() if i.status == "EVALCOMPLETED"]
+
+
+class MemModels(base.Models):
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+
+    def insert(self, instance_id: str, blob: bytes) -> None:
+        self._blobs[instance_id] = blob
+
+    def get(self, instance_id: str) -> Optional[bytes]:
+        return self._blobs.get(instance_id)
+
+    def delete(self, instance_id: str) -> bool:
+        return self._blobs.pop(instance_id, None) is not None
+
+
+class MemEvents(base.LEvents, base.PEvents):
+    """Thread-safe in-memory event store keyed by (app_id, channel_id)."""
+
+    def __init__(self):
+        self._events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        self._lock = threading.Lock()
+
+    def _bucket(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
+        key = (app_id, channel_id)
+        with self._lock:
+            return self._events.setdefault(key, {})
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._bucket(app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            return self._events.pop((app_id, channel_id), None) is not None
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        bucket = self._bucket(app_id, channel_id)
+        with self._lock:
+            bucket[event.event_id] = event
+        return event.event_id
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        return self._bucket(app_id, channel_id).get(event_id)
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        bucket = self._bucket(app_id, channel_id)
+        with self._lock:
+            return bucket.pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        with self._lock:
+            events = list(self._events.get((app_id, channel_id), {}).values())
+        events.sort(key=lambda e: (e.event_time, e.creation_time), reverse=reversed_order)
+        n = 0
+        for e in events:
+            if base.match_filters(
+                e, start_time, until_time, entity_type, entity_id,
+                event_names, target_entity_type, target_entity_id,
+            ):
+                if limit is not None and 0 <= limit <= n:
+                    return
+                yield e
+                n += 1

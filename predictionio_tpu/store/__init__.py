@@ -1,0 +1,2 @@
+from predictionio_tpu.store.columnar import EventBatch, IdDict  # noqa: F401
+from predictionio_tpu.store.event_store import LEventStore, PEventStore  # noqa: F401

@@ -1,0 +1,173 @@
+"""Columnar event batches — the host→device staging format.
+
+The reference's training path scans HBase into Spark ``RDD[Event]`` partitions
+(reference: data/.../storage/hbase/HBPEvents.scala via TableInputFormat).  A
+TPU has no use for row-objects: the analogous structure here is a
+struct-of-arrays block — integer-coded entity/event columns plus string
+dictionaries — that can be staged to device HBM as dense ``int32`` arrays and
+consumed by jitted programs without further host processing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.events.event import Event
+
+
+class IdDict:
+    """Bidirectional string↔dense-int dictionary (SURVEY.md §7 hard part (c)).
+
+    Used to map external entity ids ("u123", item SKUs, event verbs) to dense
+    int32 codes suitable for device-side gathers/segment ops.
+    """
+
+    __slots__ = ("_to_id", "_to_str")
+
+    def __init__(self, items: Optional[Sequence[str]] = None):
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[str] = []
+        if items:
+            for s in items:
+                self.add(s)
+
+    def add(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def id(self, s: str) -> Optional[int]:
+        return self._to_id.get(s)
+
+    def str(self, i: int) -> str:
+        return self._to_str[i]
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_id
+
+    def strings(self) -> List[str]:
+        return list(self._to_str)
+
+    def encode(self, values: Sequence[str]) -> np.ndarray:
+        return np.fromiter((self.add(v) for v in values), dtype=np.int32, count=len(values))
+
+    def to_state(self) -> List[str]:
+        return self._to_str
+
+    @classmethod
+    def from_state(cls, strings: Sequence[str]) -> "IdDict":
+        d = cls()
+        d._to_str = list(strings)
+        d._to_id = {s: i for i, s in enumerate(d._to_str)}
+        return d
+
+
+@dataclass
+class EventBatch:
+    """Struct-of-arrays block of events.
+
+    Columns are parallel arrays of length N; string columns are dictionary
+    encoded.  ``target_ids`` rows with no target are -1.
+    """
+
+    event_codes: np.ndarray      # int32 [N] → event_dict
+    entity_type_codes: np.ndarray  # int32 [N] → entity_type_dict
+    entity_ids: np.ndarray       # int32 [N] → entity_dict
+    target_ids: np.ndarray       # int32 [N] → target_dict (or -1)
+    times_us: np.ndarray         # int64 [N] epoch microseconds
+    ratings: np.ndarray          # float32 [N] numeric 'rating' property (NaN if absent)
+    event_dict: IdDict
+    entity_type_dict: IdDict
+    entity_dict: IdDict
+    target_dict: IdDict
+
+    def __len__(self) -> int:
+        return int(self.event_codes.shape[0])
+
+    @classmethod
+    def from_events(
+        cls,
+        events: Sequence[Event],
+        entity_dict: Optional[IdDict] = None,
+        target_dict: Optional[IdDict] = None,
+        event_dict: Optional[IdDict] = None,
+    ) -> "EventBatch":
+        n = len(events)
+        event_dict = event_dict if event_dict is not None else IdDict()
+        entity_type_dict = IdDict()
+        entity_dict = entity_dict if entity_dict is not None else IdDict()
+        target_dict = target_dict if target_dict is not None else IdDict()
+        ev = np.empty(n, np.int32)
+        et = np.empty(n, np.int32)
+        ei = np.empty(n, np.int32)
+        ti = np.full(n, -1, np.int32)
+        ts = np.empty(n, np.int64)
+        rt = np.full(n, np.nan, np.float32)
+        for k, e in enumerate(events):
+            ev[k] = event_dict.add(e.event)
+            et[k] = entity_type_dict.add(e.entity_type)
+            ei[k] = entity_dict.add(e.entity_id)
+            if e.target_entity_id is not None:
+                ti[k] = target_dict.add(e.target_entity_id)
+            ts[k] = int(e.event_time.timestamp() * 1e6)
+            r = e.properties.get("rating")
+            if isinstance(r, (int, float)):
+                rt[k] = float(r)
+        return cls(ev, et, ei, ti, ts, rt, event_dict, entity_type_dict, entity_dict, target_dict)
+
+    @classmethod
+    def concat(cls, batches: Sequence["EventBatch"]) -> "EventBatch":
+        """Concatenate batches, re-coding each batch's codes into shared dicts."""
+        if len(batches) == 1:
+            return batches[0]
+        event_dict, entity_type_dict = IdDict(), IdDict()
+        entity_dict, target_dict = IdDict(), IdDict()
+        cols: Dict[str, List[np.ndarray]] = {k: [] for k in ("ev", "et", "ei", "ti", "ts", "rt")}
+        for b in batches:
+            ev_map = np.fromiter((event_dict.add(s) for s in b.event_dict.strings()), np.int32,
+                                 count=len(b.event_dict)) if len(b.event_dict) else np.empty(0, np.int32)
+            et_map = np.fromiter((entity_type_dict.add(s) for s in b.entity_type_dict.strings()), np.int32,
+                                 count=len(b.entity_type_dict)) if len(b.entity_type_dict) else np.empty(0, np.int32)
+            ei_map = np.fromiter((entity_dict.add(s) for s in b.entity_dict.strings()), np.int32,
+                                 count=len(b.entity_dict)) if len(b.entity_dict) else np.empty(0, np.int32)
+            ti_map = np.fromiter((target_dict.add(s) for s in b.target_dict.strings()), np.int32,
+                                 count=len(b.target_dict)) if len(b.target_dict) else np.empty(0, np.int32)
+            cols["ev"].append(ev_map[b.event_codes] if len(b) else b.event_codes)
+            cols["et"].append(et_map[b.entity_type_codes] if len(b) else b.entity_type_codes)
+            cols["ei"].append(ei_map[b.entity_ids] if len(b) else b.entity_ids)
+            has_t = b.target_ids >= 0
+            ti = np.full(len(b), -1, np.int32)
+            if len(b) and len(ti_map):
+                ti[has_t] = ti_map[b.target_ids[has_t]]
+            cols["ti"].append(ti)
+            cols["ts"].append(b.times_us)
+            cols["rt"].append(b.ratings)
+        return cls(
+            np.concatenate(cols["ev"]) if cols["ev"] else np.empty(0, np.int32),
+            np.concatenate(cols["et"]) if cols["et"] else np.empty(0, np.int32),
+            np.concatenate(cols["ei"]) if cols["ei"] else np.empty(0, np.int32),
+            np.concatenate(cols["ti"]) if cols["ti"] else np.empty(0, np.int32),
+            np.concatenate(cols["ts"]) if cols["ts"] else np.empty(0, np.int64),
+            np.concatenate(cols["rt"]) if cols["rt"] else np.empty(0, np.float32),
+            event_dict, entity_type_dict, entity_dict, target_dict,
+        )
+
+    def select_events(self, names: Sequence[str]) -> "EventBatch":
+        """Filter to rows whose event verb is in ``names`` (dicts shared)."""
+        codes = [self.event_dict.id(n) for n in names]
+        codes = [c for c in codes if c is not None]
+        mask = np.isin(self.event_codes, np.asarray(codes, np.int32))
+        return EventBatch(
+            self.event_codes[mask], self.entity_type_codes[mask], self.entity_ids[mask],
+            self.target_ids[mask], self.times_us[mask], self.ratings[mask],
+            self.event_dict, self.entity_type_dict, self.entity_dict, self.target_dict,
+        )

@@ -1,0 +1,143 @@
+"""Template-facing event read API.
+
+Reference: data/src/main/scala/io/prediction/data/store/{PEventStore,
+LEventStore,Common}.scala — ``PEventStore.find/aggregateProperties`` for
+training reads (RDD-valued there; columnar here) and ``LEventStore`` for
+low-latency serving-time reads (e.g. the Universal Recommender fetching a
+user's recent history inside ``predict``).
+
+App names are resolved to ids through the metadata store, exactly like the
+reference's ``Common.appNameToId``.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.events.event import Event, PropertyMap
+from predictionio_tpu.storage.locator import Storage, get_storage
+from predictionio_tpu.store.columnar import EventBatch
+
+
+def _app_channel_ids(
+    app_name: str, channel_name: Optional[str], storage: Storage
+) -> Tuple[int, Optional[int]]:
+    app = storage.apps.get_by_name(app_name)
+    if app is None:
+        raise ValueError(f"app {app_name!r} does not exist; create it first (pio app new)")
+    channel_id: Optional[int] = None
+    if channel_name is not None:
+        chan = next(
+            (c for c in storage.channels.get_by_app_id(app.id) if c.name == channel_name), None
+        )
+        if chan is None:
+            raise ValueError(f"channel {channel_name!r} does not exist for app {app_name!r}")
+        channel_id = chan.id
+    return app.id, channel_id
+
+
+class PEventStore:
+    """Bulk training-time reads (reference: PEventStore.scala)."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        storage: Optional[Storage] = None,
+    ) -> Iterator[Event]:
+        storage = storage or get_storage()
+        app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
+        return storage.p_events.find(
+            app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+
+    @staticmethod
+    def batch(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        storage: Optional[Storage] = None,
+    ) -> EventBatch:
+        """Read matching events as ONE columnar batch (device-staging format)."""
+        events = list(
+            PEventStore.find(
+                app_name,
+                channel_name=channel_name,
+                event_names=event_names,
+                entity_type=entity_type,
+                start_time=start_time,
+                until_time=until_time,
+                storage=storage,
+            )
+        )
+        return EventBatch.from_events(events)
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        storage: Optional[Storage] = None,
+    ) -> Dict[str, PropertyMap]:
+        storage = storage or get_storage()
+        app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
+        return storage.l_events.aggregate_properties(
+            app_id,
+            entity_type,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+        )
+
+
+class LEventStore:
+    """Low-latency serving-time reads (reference: LEventStore.scala)."""
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+        time_window: Optional[_dt.timedelta] = None,
+        storage: Optional[Storage] = None,
+    ) -> List[Event]:
+        storage = storage or get_storage()
+        app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
+        start_time = None
+        if time_window is not None:
+            start_time = _dt.datetime.now(_dt.timezone.utc) - time_window
+        return list(
+            storage.l_events.find(
+                app_id,
+                channel_id=channel_id,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                limit=limit,
+                reversed_order=latest,
+                start_time=start_time,
+            )
+        )

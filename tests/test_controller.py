@@ -1,0 +1,160 @@
+"""DASE controller tests (reference analogues: EngineTest, EvaluationTest,
+MetricEvaluatorTest — SURVEY.md §4). Uses toy identity-style components like
+the reference's FakeWorkflow fixtures."""
+
+import dataclasses
+from typing import List, Tuple
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    AverageMetric,
+    DataSource,
+    EmptyParams,
+    Engine,
+    EngineParams,
+    FirstServing,
+    MetricEvaluator,
+    Params,
+    Preparator,
+    Serving,
+)
+
+
+@dataclasses.dataclass
+class DSParams(Params):
+    n: int = 10
+    folds: int = 2
+
+
+class ToyDataSource(DataSource):
+    params_class = DSParams
+
+    def read_training(self):
+        return list(range(self.params.n))
+
+    def read_eval(self):
+        folds = []
+        for f in range(self.params.folds):
+            td = [x for x in range(self.params.n) if x % self.params.folds != f]
+            qa = [(x, x * 2) for x in range(self.params.n) if x % self.params.folds == f]
+            folds.append((td, {"fold": f}, qa))
+        return folds
+
+
+class ToyPreparator(Preparator):
+    def prepare(self, td):
+        return {"sum": sum(td), "data": td}
+
+
+@dataclasses.dataclass
+class AlgoParams(Params):
+    mult: float = 2.0
+
+
+class ToyAlgorithm(Algorithm):
+    params_class = AlgoParams
+
+    def train(self, pd):
+        return {"mult": self.params.mult, "seen": len(pd["data"])}
+
+    def predict(self, model, query):
+        return query * model["mult"]
+
+
+class ToyServing(Serving):
+    def serve(self, query, predictions):
+        return max(predictions)
+
+
+def make_engine():
+    return Engine(ToyDataSource, ToyPreparator,
+                  {"toy": ToyAlgorithm, "toy2": ToyAlgorithm}, ToyServing)
+
+
+def test_engine_train_chains_dase():
+    engine = make_engine()
+    ep = EngineParams(
+        data_source_params=DSParams(n=5),
+        algorithm_params_list=[("toy", AlgoParams(mult=3.0))],
+    )
+    models = engine.train(ep)
+    assert models == [{"mult": 3.0, "seen": 5}]
+
+
+def test_engine_multiple_algorithms_and_serving():
+    engine = make_engine()
+    ep = EngineParams(
+        algorithm_params_list=[("toy", AlgoParams(mult=2.0)), ("toy2", AlgoParams(mult=5.0))],
+    )
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    assert predict(3) == 15.0  # serving takes max over the two algorithms
+
+
+def test_engine_unknown_algorithm_rejected():
+    engine = make_engine()
+    ep = EngineParams(algorithm_params_list=[("nope", AlgoParams())])
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        engine.train(ep)
+
+
+def test_engine_eval_produces_qpa_triples():
+    engine = make_engine()
+    ep = EngineParams(
+        data_source_params=DSParams(n=6, folds=2),
+        algorithm_params_list=[("toy", AlgoParams(mult=2.0))],
+    )
+    results = engine.eval(ep)
+    assert len(results) == 2
+    info, qpa = results[0]
+    assert info == {"fold": 0}
+    for q, p, a in qpa:
+        assert p == q * 2.0 and a == q * 2
+
+
+class AbsErrorMetric(AverageMetric):
+    higher_is_better = False
+
+    def score_one(self, q, p, a):
+        return abs(p - a)
+
+
+def test_metric_evaluator_picks_best_params():
+    engine = make_engine()
+    candidates = [
+        EngineParams(data_source_params=DSParams(n=6),
+                     algorithm_params_list=[("toy", AlgoParams(mult=m))])
+        for m in (1.0, 2.0, 3.5)
+    ]
+    result = MetricEvaluator(AbsErrorMetric()).evaluate(engine, candidates)
+    # actual = 2*q, so mult=2.0 has zero error and must win
+    assert result.best_index == 1
+    assert result.best_score == 0.0
+    assert result.best_engine_params.algorithm_params_list[0][1].mult == 2.0
+
+
+def test_engine_params_from_variant_json():
+    engine = make_engine()
+    variant = {
+        "id": "default",
+        "engineFactory": "whatever.Factory",
+        "datasource": {"params": {"n": 7}},
+        "algorithms": [{"name": "toy", "params": {"mult": 4.0}}],
+    }
+    ep = engine.engine_params_from_variant(variant)
+    assert ep.data_source_params.n == 7
+    assert ep.algorithm_params_list[0][1].mult == 4.0
+    models = engine.train(ep)
+    assert models == [{"mult": 4.0, "seen": 7}]
+
+
+def test_params_binding_strictness():
+    with pytest.raises(ValueError, match="unknown parameter"):
+        DSParams.from_json({"bogus": 1})
+    with pytest.raises(TypeError):
+        AlgoParams.from_json({"mult": "not-a-number"})
+    assert AlgoParams.from_json({"mult": 3}).mult == 3.0  # int→float coercion
+    assert DSParams.from_json(None).n == 10
+    assert EmptyParams.from_json({}) == EmptyParams()

@@ -1,0 +1,100 @@
+"""Event model tests (reference analogues: DataMapSpec, EventJson4sSupport
+round-trip tests, LEventAggregator tests — SURVEY.md §4)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.events import (
+    DataMap,
+    Event,
+    aggregate_properties,
+)
+
+
+def ts(h):
+    return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
+
+
+def test_event_json_roundtrip():
+    e = Event(
+        event="buy",
+        entity_type="user",
+        entity_id="u1",
+        target_entity_type="item",
+        target_entity_id="i9",
+        properties=DataMap({"price": 3.5, "cat": ["a", "b"]}),
+        event_time=ts(5),
+        tags=("t1",),
+        pr_id="pr-1",
+    )
+    e2 = Event.from_json(e.to_json())
+    assert e2.event == "buy"
+    assert e2.entity_id == "u1"
+    assert e2.target_entity_id == "i9"
+    assert e2.properties["price"] == 3.5
+    assert e2.event_time == e.event_time
+    assert e2.event_id == e.event_id
+    assert e2.tags == ("t1",)
+
+
+def test_event_validation():
+    with pytest.raises(ValueError):
+        Event(event="", entity_type="user", entity_id="u1")
+    with pytest.raises(ValueError):
+        Event(event="$set", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1")
+    with pytest.raises(ValueError):
+        Event(event="$unset", entity_type="user", entity_id="u1")
+    with pytest.raises(ValueError):
+        Event(event="$bogus", entity_type="user", entity_id="u1")
+    with pytest.raises(ValueError):
+        Event.from_json({"event": "buy", "entityType": "user", "entityId": "u1",
+                         "bogusField": 1})
+
+
+def test_datamap_typed_getters():
+    d = DataMap({"a": 1, "b": "x", "c": 2.5})
+    assert d.get_as("a", int) == 1
+    assert d.get_as("a", float) == 1.0
+    assert d.get_as("c", float) == 2.5
+    assert d.get_opt("zz", 7) == 7
+    with pytest.raises(KeyError):
+        d.get_as("zz", int)
+    with pytest.raises(TypeError):
+        d.get_as("b", int)
+
+
+def test_aggregate_properties_set_unset_delete():
+    events = [
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"a": 1, "b": 2}), event_time=ts(1)),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"b": 3, "c": 4}), event_time=ts(2)),
+        Event(event="$unset", entity_type="user", entity_id="u1",
+              properties=DataMap({"a": None}), event_time=ts(3)),
+        Event(event="$set", entity_type="user", entity_id="u2",
+              properties=DataMap({"x": 1}), event_time=ts(1)),
+        Event(event="$delete", entity_type="user", entity_id="u3",
+              event_time=ts(2)),
+        Event(event="$set", entity_type="user", entity_id="u3",
+              properties=DataMap({"y": 1}), event_time=ts(1)),
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1", event_time=ts(2)),
+    ]
+    snap = aggregate_properties(events)
+    assert snap["u1"] == {"b": 3, "c": 4}
+    assert snap["u1"].first_updated == ts(1)
+    assert snap["u1"].last_updated == ts(3)
+    assert snap["u2"] == {"x": 1}
+    assert "u3" not in snap  # $delete at ts(2) wins over $set at ts(1)
+
+
+def test_aggregate_orders_by_event_time_not_arrival():
+    events = [
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"v": "late"}), event_time=ts(5)),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"v": "early"}), event_time=ts(1)),
+    ]
+    assert aggregate_properties(events)["u1"]["v"] == "late"

@@ -1,0 +1,150 @@
+"""Storage backend tests (reference analogues: LEventsSpec, PEventsSpec,
+metadata specs — SURVEY.md §4). Both backends run through the same suite."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.events import DataMap, Event
+from predictionio_tpu.storage import AccessKey, App, Channel, EngineInstance
+from predictionio_tpu.storage.locator import Storage, StorageConfig
+
+
+def ts(h):
+    return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
+
+
+@pytest.fixture(params=["memory", "localfs"])
+def storage(request, tmp_path):
+    if request.param == "memory":
+        cfg = StorageConfig(
+            sources={"S": {"type": "memory"}},
+            repositories={"METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S"},
+        )
+    else:
+        cfg = StorageConfig(
+            sources={"S": {"type": "localfs", "path": str(tmp_path / "store")}},
+            repositories={"METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S"},
+        )
+    return Storage(cfg)
+
+
+def test_apps_crud(storage):
+    app_id = storage.apps.insert(App(0, "myapp", "desc"))
+    assert app_id is not None
+    assert storage.apps.get(app_id).name == "myapp"
+    assert storage.apps.get_by_name("myapp").id == app_id
+    assert storage.apps.insert(App(0, "myapp")) is None  # duplicate name
+    app2 = storage.apps.insert(App(0, "other"))
+    assert app2 != app_id
+    assert {a.name for a in storage.apps.get_all()} == {"myapp", "other"}
+    assert storage.apps.delete(app2)
+    assert storage.apps.get(app2) is None
+
+
+def test_access_keys_and_channels(storage):
+    app_id = storage.apps.insert(App(0, "a1"))
+    key = storage.access_keys.insert(AccessKey("", app_id, ["buy"]))
+    assert storage.access_keys.get(key).app_id == app_id
+    assert storage.access_keys.get(key).events == ["buy"]
+    assert len(storage.access_keys.get_by_app_id(app_id)) == 1
+
+    ch = storage.channels.insert(Channel(0, "backfill", app_id))
+    assert storage.channels.get(ch).name == "backfill"
+    assert storage.channels.insert(Channel(0, "backfill", app_id)) is None
+    assert storage.channels.get_by_app_id(app_id)[0].id == ch
+
+
+def test_events_crud_and_filters(storage):
+    ev = storage.l_events
+    ev.init(1)
+    events = [
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1", event_time=ts(1)),
+        Event(event="buy", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2", event_time=ts(2)),
+        Event(event="view", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i1", event_time=ts(3)),
+        Event(event="$set", entity_type="item", entity_id="i1",
+              properties=DataMap({"cat": "x"}), event_time=ts(4)),
+    ]
+    ids = ev.insert_batch(events, 1)
+    assert len(ids) == 4
+    got = ev.get(ids[0], 1)
+    assert got.event == "view" and got.target_entity_id == "i1"
+
+    assert len(list(ev.find(1))) == 4
+    assert len(list(ev.find(1, event_names=["view"]))) == 2
+    assert len(list(ev.find(1, entity_type="user", entity_id="u1"))) == 2
+    assert len(list(ev.find(1, start_time=ts(2), until_time=ts(4)))) == 2
+    assert [e.event for e in ev.find(1, reversed_order=True)][0] == "$set"
+    assert len(list(ev.find(1, limit=2))) == 2
+    assert len(list(ev.find(1, target_entity_id="i1"))) == 2
+
+    # channel isolation
+    ev.insert(Event(event="view", entity_type="user", entity_id="u9",
+                    event_time=ts(1)), 1, channel_id=7)
+    assert len(list(ev.find(1))) == 4
+    assert len(list(ev.find(1, channel_id=7))) == 1
+
+    # delete
+    assert ev.delete(ids[1], 1)
+    assert not ev.delete(ids[1], 1) or storage is None  # second delete may be False
+    assert len(list(ev.find(1))) == 3
+    assert ev.get(ids[1], 1) is None
+
+
+def test_aggregate_via_storage(storage):
+    ev = storage.l_events
+    ev.init(2)
+    ev.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                    properties=DataMap({"a": 1}), event_time=ts(1)), 2)
+    ev.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                    properties=DataMap({"b": 2}), event_time=ts(2)), 2)
+    ev.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                    properties=DataMap({"z": 3}), event_time=ts(1)), 2)
+    snap = ev.aggregate_properties(2, "item")
+    assert snap == {"i1": {"a": 1, "b": 2}}
+
+
+def test_engine_instances(storage):
+    inst = EngineInstance(
+        id="", status="INIT", start_time=ts(1), end_time=None,
+        engine_id="e1", engine_version="1", engine_variant="default",
+        engine_factory="f",
+    )
+    iid = storage.engine_instances.insert(inst)
+    got = storage.engine_instances.get(iid)
+    assert got.status == "INIT"
+    got.status = "COMPLETED"
+    got.end_time = ts(2)
+    assert storage.engine_instances.update(got)
+    latest = storage.engine_instances.get_latest_completed("e1", "1", "default")
+    assert latest is not None and latest.id == iid
+    # a later completed instance wins
+    inst2 = EngineInstance(
+        id="", status="COMPLETED", start_time=ts(5), end_time=ts(6),
+        engine_id="e1", engine_version="1", engine_variant="default",
+        engine_factory="f",
+    )
+    iid2 = storage.engine_instances.insert(inst2)
+    assert storage.engine_instances.get_latest_completed("e1", "1", "default").id == iid2
+
+
+def test_models_blob_store(storage):
+    storage.models.insert("abc123", b"\x00\x01binary")
+    assert storage.models.get("abc123") == b"\x00\x01binary"
+    assert storage.models.delete("abc123")
+    assert storage.models.get("abc123") is None
+
+
+def test_pevents_find_batches(storage):
+    ev = storage.l_events
+    ev.init(3)
+    for k in range(10):
+        ev.insert(Event(event="view", entity_type="user", entity_id=f"u{k % 3}",
+                        target_entity_type="item", target_entity_id=f"i{k % 4}",
+                        event_time=ts(k % 23)), 3)
+    batches = list(storage.p_events.find_batches(3, batch_size=4))
+    assert [len(b) for b in batches] == [4, 4, 2]
+    assert all(b.target_ids.min() >= 0 for b in batches)
