@@ -1,0 +1,73 @@
+"""Benchmark driver — prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline: ALS training throughput on a MovieLens-100K-shaped workload
+(943 users x 1682 items, 100k ratings, rank 10, 10 sweeps) — BASELINE.md
+config #1.  "value" is rating-updates/sec = ratings x sweeps / wall.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+comparison point is a documented assumption pending a measured Spark run:
+Spark MLlib ALS on ML-100K (rank 10, 10 iters) takes ~20 s end-to-end on a
+modern multicore node => ~50k rating-updates/sec.  BASELINE_ASSUMED below;
+replace with a measured number when the reference can actually be run.
+
+--smoke: tiny shapes, CPU-safe, for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ASSUMED_UPDATES_PER_SEC = 50_000.0
+
+
+def synth_ml100k(n_users=943, n_items=1682, n_ratings=100_000, seed=0):
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n_users, n_ratings).astype(np.int32)
+    i = rng.integers(0, n_items, n_ratings).astype(np.int32)
+    r = (rng.integers(1, 6, n_ratings)).astype(np.float32)
+    return u, i, r
+
+
+def bench_als(smoke: bool = False) -> dict:
+    import jax
+
+    from predictionio_tpu.ops.als import als_train, prepare_als_data
+
+    if smoke:
+        n_users, n_items, n_ratings, rank, iters = 50, 40, 2_000, 8, 3
+    else:
+        n_users, n_items, n_ratings, rank, iters = 943, 1682, 100_000, 10, 10
+    u, i, r = synth_ml100k(n_users, n_items, n_ratings)
+    data = prepare_als_data(u, i, r, n_users, n_items, dp=1)
+    # warm-up: compile
+    als_train(data, k=rank, reg=0.05, iterations=1)
+    t0 = time.perf_counter()
+    X, Y = als_train(data, k=rank, reg=0.05, iterations=iters)
+    wall = time.perf_counter() - t0
+    assert np.isfinite(X).all()
+    updates_per_sec = n_ratings * iters / wall
+    return {
+        "metric": "als_ml100k_rating_updates_per_sec",
+        "value": round(updates_per_sec, 1),
+        "unit": "updates/s",
+        "vs_baseline": round(updates_per_sec / BASELINE_ASSUMED_UPDATES_PER_SEC, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
+    args = ap.parse_args()
+    result = bench_als(smoke=args.smoke)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

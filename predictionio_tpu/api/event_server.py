@@ -1,0 +1,217 @@
+"""Event Server — REST ingestion.
+
+Reference: data/src/main/scala/io/prediction/data/api/EventServer.scala
+(spray-can ``EventServiceActor``; SURVEY.md §3 'Event ingestion' stack):
+
+  POST   /events.json?accessKey=K[&channel=C]         single event  → 201
+  POST   /batch/events.json?accessKey=K               ≤50 events, per-item status
+  GET    /events.json?accessKey=K&...filters           query events
+  GET    /events/<id>.json?accessKey=K                 fetch one
+  DELETE /events/<id>.json?accessKey=K                 tombstone one
+  GET    /                                             {"status": "alive"}
+  GET    /stats.json?accessKey=K                       per-app event counts
+
+Auth matches the reference: the access key names the app; a key with a
+non-empty ``events`` list may only write those event types; channels resolve
+by name per app.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.events.event import Event, parse_time
+from predictionio_tpu.storage.base import AccessKey
+from predictionio_tpu.storage.locator import Storage, get_storage
+
+log = logging.getLogger("pio.eventserver")
+
+MAX_BATCH = 50  # reference: EventServer batch limit
+
+
+class EventServerState:
+    def __init__(self, storage: Optional[Storage] = None, stats: bool = True):
+        self.storage = storage or get_storage()
+        self.stats_enabled = stats
+        self.counts: Dict[int, Dict[str, int]] = {}
+
+    def record(self, app_id: int, event_name: str) -> None:
+        if self.stats_enabled:
+            per_app = self.counts.setdefault(app_id, {})
+            per_app[event_name] = per_app.get(event_name, 0) + 1
+
+    def auth(self, query: Dict[str, str]) -> Tuple[Optional[AccessKey], Optional[int], Optional[str]]:
+        """Returns (access_key, channel_id, error)."""
+        key = query.get("accessKey")
+        if not key:
+            return None, None, "missing accessKey parameter"
+        ak = self.storage.access_keys.get(key)
+        if ak is None:
+            return None, None, "invalid accessKey"
+        channel_id: Optional[int] = None
+        chan_name = query.get("channel")
+        if chan_name:
+            chan = next(
+                (c for c in self.storage.channels.get_by_app_id(ak.app_id) if c.name == chan_name),
+                None,
+            )
+            if chan is None:
+                return None, None, f"invalid channel {chan_name!r}"
+            channel_id = chan.id
+        return ak, channel_id, None
+
+
+def make_handler(state: EventServerState):
+    class EventHandler(JsonHandler):
+        def do_GET(self):
+            path, query = self.route
+            if path == "/":
+                self.send_json({"status": "alive"})
+                return
+            ak, channel_id, err = state.auth(query)
+            if err:
+                self.send_error_json(401, err)
+                return
+            if path == "/events.json":
+                self._find(ak, channel_id, query)
+            elif path == "/stats.json":
+                self.send_json({"appId": ak.app_id, "counts": state.counts.get(ak.app_id, {})})
+            elif path.startswith("/events/") and path.endswith(".json"):
+                event_id = path[len("/events/"):-len(".json")]
+                e = state.storage.l_events.get(event_id, ak.app_id, channel_id)
+                if e is None:
+                    self.send_error_json(404, f"event {event_id} not found")
+                else:
+                    self.send_json(e.to_json())
+            else:
+                self.send_error_json(404, "not found")
+
+        def do_POST(self):
+            path, query = self.route
+            ak, channel_id, err = state.auth(query)
+            if err:
+                self.send_error_json(401, err)
+                return
+            try:
+                body = self.read_json()
+            except json.JSONDecodeError as e:
+                self.send_error_json(400, f"invalid JSON: {e}")
+                return
+            if path == "/events.json":
+                self._insert_one(ak, channel_id, body)
+            elif path == "/batch/events.json":
+                self._insert_batch(ak, channel_id, body)
+            else:
+                self.send_error_json(404, "not found")
+
+        def do_DELETE(self):
+            path, query = self.route
+            ak, channel_id, err = state.auth(query)
+            if err:
+                self.send_error_json(401, err)
+                return
+            if path.startswith("/events/") and path.endswith(".json"):
+                event_id = path[len("/events/"):-len(".json")]
+                ok = state.storage.l_events.delete(event_id, ak.app_id, channel_id)
+                if ok:
+                    self.send_json({"message": "Found"})
+                else:
+                    self.send_error_json(404, f"event {event_id} not found")
+            else:
+                self.send_error_json(404, "not found")
+
+        # -- impl ------------------------------------------------------------
+
+        def _check_allowed(self, ak: AccessKey, event_name: str) -> Optional[str]:
+            if ak.events and event_name not in ak.events:
+                return f"accessKey is not allowed to write event {event_name!r}"
+            return None
+
+        def _insert_one(self, ak, channel_id, body):
+            if not isinstance(body, dict):
+                self.send_error_json(400, "event must be a JSON object")
+                return
+            try:
+                event = Event.from_json(body)
+            except (ValueError, KeyError, TypeError) as e:
+                self.send_error_json(400, str(e))
+                return
+            err = self._check_allowed(ak, event.event)
+            if err:
+                self.send_error_json(403, err)
+                return
+            event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
+            state.record(ak.app_id, event.event)
+            self.send_json({"eventId": event_id}, status=201)
+
+        def _insert_batch(self, ak, channel_id, body):
+            if not isinstance(body, list):
+                self.send_error_json(400, "batch body must be a JSON array")
+                return
+            if len(body) > MAX_BATCH:
+                self.send_error_json(400, f"batch size {len(body)} exceeds limit {MAX_BATCH}")
+                return
+            results = []
+            for item in body:
+                try:
+                    event = Event.from_json(item)
+                    err = self._check_allowed(ak, event.event)
+                    if err:
+                        results.append({"status": 403, "message": err})
+                        continue
+                    event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
+                    state.record(ak.app_id, event.event)
+                    results.append({"status": 201, "eventId": event_id})
+                except (ValueError, KeyError, TypeError) as e:
+                    results.append({"status": 400, "message": str(e)})
+            self.send_json(results)
+
+        def _find(self, ak, channel_id, query):
+            kwargs: Dict[str, Any] = {}
+            if "startTime" in query:
+                kwargs["start_time"] = parse_time(query["startTime"])
+            if "untilTime" in query:
+                kwargs["until_time"] = parse_time(query["untilTime"])
+            if "entityType" in query:
+                kwargs["entity_type"] = query["entityType"]
+            if "entityId" in query:
+                kwargs["entity_id"] = query["entityId"]
+            if "event" in query:
+                kwargs["event_names"] = [query["event"]]
+            if "targetEntityType" in query:
+                kwargs["target_entity_type"] = query["targetEntityType"]
+            if "targetEntityId" in query:
+                kwargs["target_entity_id"] = query["targetEntityId"]
+            limit = int(query.get("limit", 20))
+            reversed_order = query.get("reversed", "false").lower() == "true"
+            events = state.storage.l_events.find(
+                ak.app_id, channel_id=channel_id, limit=limit,
+                reversed_order=reversed_order, **kwargs,
+            )
+            self.send_json([e.to_json() for e in events])
+
+    return EventHandler
+
+
+def run_event_server(
+    host: str = "0.0.0.0",
+    port: int = 7070,
+    storage: Optional[Storage] = None,
+    background: bool = False,
+):
+    state = EventServerState(storage)
+    httpd = start_server(make_handler(state), host, port, background=background)
+    log.info("Event server listening on %s:%d", host, httpd.server_address[1])
+    if background:
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0

@@ -1,0 +1,58 @@
+"""Minimal shared HTTP plumbing for the REST servers (stdlib-only — the image
+has no FastAPI; reference servers are spray-can actors, SURVEY.md §2)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Base handler with JSON request/response helpers; quiet logging."""
+
+    server_version = "pio-tpu"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to logging, not stderr
+        import logging
+
+        logging.getLogger("pio.http").debug(fmt, *args)
+
+    # -- helpers -------------------------------------------------------------
+
+    @property
+    def route(self) -> Tuple[str, Dict[str, str]]:
+        parsed = urlparse(self.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        return parsed.path, query
+
+    def read_json(self) -> Any:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            return None
+        raw = self.rfile.read(length)
+        return json.loads(raw)
+
+    def send_json(self, obj: Any, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def send_error_json(self, status: int, message: str) -> None:
+        self.send_json({"message": message}, status=status)
+
+
+def start_server(
+    handler_cls, host: str, port: int, background: bool = False
+) -> ThreadingHTTPServer:
+    httpd = ThreadingHTTPServer((host, port), handler_cls)
+    if background:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+    return httpd

@@ -31,6 +31,9 @@ class Params:
         if not isinstance(data, Mapping):
             raise TypeError(f"{cls.__name__} params must be a JSON object, got {type(data).__name__}")
         fields = {f.name: f for f in dataclasses.fields(cls)}
+        # The reference's engine.json uses camelCase keys ("appName",
+        # "numIterations", "lambda"); accept both spellings.
+        data = {_match_key(k, fields, cls.__name__): v for k, v in data.items()}
         unknown = set(data) - set(fields)
         if unknown:
             raise ValueError(f"{cls.__name__}: unknown parameter(s) {sorted(unknown)}")
@@ -58,6 +61,28 @@ class Params:
 @dataclasses.dataclass
 class EmptyParams(Params):
     """Reference: EmptyParams — for components that take no parameters."""
+
+
+def _snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _match_key(key: str, fields: Mapping[str, Any], cls_name: str) -> str:
+    if key in fields:
+        return key
+    snake = _snake(key)
+    if snake in fields:
+        return snake
+    if snake + "_" in fields:  # reserved words: lambda -> lambda_
+        return snake + "_"
+    return key
 
 
 def _coerce(value: Any, annot: Any, where: str) -> Any:

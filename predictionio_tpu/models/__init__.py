@@ -1,0 +1,18 @@
+"""Engine templates (reference: separate template repos — SURVEY.md §2).
+
+Each subpackage is a complete DASE engine matching a BASELINE.json config:
+
+- ``recommendation``        — ALS matrix factorization (MLlib ALS analogue)
+- ``classification``        — logistic regression / naive bayes
+- ``similar_product``       — item-item cooccurrence / ALS item factors
+- ``universal_recommender`` — CCO cross-occurrence (ActionML UR analogue)
+- ``text``                  — text classification (tf-idf + classifier)
+"""
+
+ENGINE_FACTORIES = {
+    "recommendation": "predictionio_tpu.models.recommendation.RecommendationEngine",
+    "classification": "predictionio_tpu.models.classification.ClassificationEngine",
+    "similar_product": "predictionio_tpu.models.similar_product.SimilarProductEngine",
+    "universal_recommender": "predictionio_tpu.models.universal_recommender.UniversalRecommenderEngine",
+    "text": "predictionio_tpu.models.text.TextClassificationEngine",
+}

@@ -1,0 +1,11 @@
+from predictionio_tpu.models.recommendation.engine import (  # noqa: F401
+    ALSAlgorithm,
+    ALSModel,
+    RecommendationEngine,
+    RecoDataSource,
+    RecoPreparator,
+    RecoQuery,
+    RecoServing,
+    ItemScore,
+    PredictedResult,
+)

@@ -1,0 +1,246 @@
+"""Alternating Least Squares matrix factorization, TPU-first.
+
+Replaces the reference Recommendation template's call into Spark MLlib
+``ALS.train`` (template repo's ALSAlgorithm.scala; MLlib implements block
+ALS over a users×products grid of RDD partitions — SURVEY.md §2).
+
+TPU design (not a translation of MLlib's shuffle pattern):
+
+- Interactions are COO triples ``(user, item, rating)``, dictionary-encoded.
+- The mesh's ``dp`` axis owns both sides: user ``u`` lives on shard
+  ``u % dp``, item ``i`` on shard ``i % dp``.  The host prepares TWO padded
+  layouts of the same events — grouped by user shard and by item shard —
+  so each half-step is pure local compute after one ``all_gather`` of the
+  opposite factor block (the collective rides ICI; this replaces MLlib's
+  shuffle of in/out-link blocks).
+- Each half-step forms per-entity normal equations with one
+  ``segment_sum`` of rank-1 outer products (MXU-batched) and solves the
+  K×K systems with a batched Cholesky — no data-dependent shapes, one
+  compiled program for the whole training run (`lax.fori_loop` over
+  sweeps).
+
+Memory: A-blocks are [rows_per_shard, K, K] f32; events are padded to the
+max per-shard count. f32 throughout the solves (K ≤ a few hundred);
+gathers/matmuls stay f32 for numerical parity with MLlib.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ALSData:
+    """Host-prepared dual-layout interaction data for a mesh of size dp.
+
+    Layout invariant: global entity ``e`` maps to (shard ``e % dp``, local row
+    ``e // dp``); factor blocks are stored as [dp * rows, K] arrays whose
+    flat index is ``shard * rows + local_row``.
+    """
+
+    dp: int
+    n_users: int
+    n_items: int
+    user_rows: int   # padded users per shard
+    item_rows: int   # padded items per shard
+    # by-user layout: [dp, E_u]
+    u_user_local: np.ndarray   # local user row on the owning shard
+    u_item_flat: np.ndarray    # flat index into item factor blocks
+    u_rating: np.ndarray
+    u_mask: np.ndarray         # f32 validity mask
+    # by-item layout: [dp, E_i]
+    i_item_local: np.ndarray
+    i_user_flat: np.ndarray
+    i_rating: np.ndarray
+    i_mask: np.ndarray
+
+
+def _group_by_shard(
+    owner: np.ndarray, other_flat: np.ndarray, rating: np.ndarray, dp: int, pad_multiple: int = 8
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Bucket events by ``owner % dp``; pad buckets to a common length."""
+    shard = owner % dp
+    order = np.argsort(shard, kind="stable")
+    owner_s, other_s, rating_s, shard_s = owner[order], other_flat[order], rating[order], shard[order]
+    counts = np.bincount(shard_s, minlength=dp)
+    width = max(int(counts.max()) if len(owner) else 1, 1)
+    width = ((width + pad_multiple - 1) // pad_multiple) * pad_multiple
+    local = np.zeros((dp, width), np.int32)
+    other = np.zeros((dp, width), np.int32)
+    rat = np.zeros((dp, width), np.float32)
+    mask = np.zeros((dp, width), np.float32)
+    start = 0
+    for s in range(dp):
+        c = int(counts[s])
+        sl = slice(start, start + c)
+        local[s, :c] = owner_s[sl] // dp
+        other[s, :c] = other_s[sl]
+        rat[s, :c] = rating_s[sl]
+        mask[s, :c] = 1.0
+        start += c
+    return local, other, rat, mask
+
+
+def prepare_als_data(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    rating: np.ndarray,
+    n_users: int,
+    n_items: int,
+    dp: int,
+) -> ALSData:
+    user_idx = np.asarray(user_idx, np.int32)
+    item_idx = np.asarray(item_idx, np.int32)
+    rating = np.asarray(rating, np.float32)
+    user_rows = max(math.ceil(n_users / dp), 1)
+    item_rows = max(math.ceil(n_items / dp), 1)
+    # flat index of the OTHER side's factor row: shard * rows + local_row
+    item_flat = (item_idx % dp) * item_rows + item_idx // dp
+    user_flat = (user_idx % dp) * user_rows + user_idx // dp
+    uu, ui, ur, um = _group_by_shard(user_idx, item_flat, rating, dp)
+    ii, iu, ir, im = _group_by_shard(item_idx, user_flat, rating, dp)
+    return ALSData(
+        dp=dp, n_users=n_users, n_items=n_items,
+        user_rows=user_rows, item_rows=item_rows,
+        u_user_local=uu, u_item_flat=ui, u_rating=ur, u_mask=um,
+        i_item_local=ii, i_user_flat=iu, i_rating=ir, i_mask=im,
+    )
+
+
+def _half_step(
+    other_full: jnp.ndarray,   # [dp*other_rows, K] gathered opposite factors
+    local_idx: jnp.ndarray,    # [E] rows to solve for (this shard)
+    other_flat: jnp.ndarray,   # [E] flat gather index into other_full
+    rating: jnp.ndarray,       # [E]
+    mask: jnp.ndarray,         # [E]
+    rows: int,
+    reg: float,
+) -> jnp.ndarray:
+    """Solve per-row normal equations (YtCY + λ n_e I) x = Ytr on one shard."""
+    k = other_full.shape[-1]
+    y = other_full[other_flat] * mask[:, None]            # [E, K]
+    # A: segment-summed outer products, MXU-batched as [E, K, K] contributions
+    outer = y[:, :, None] * y[:, None, :]
+    A = jax.ops.segment_sum(outer, local_idx, num_segments=rows)
+    b = jax.ops.segment_sum(y * rating[:, None], local_idx, num_segments=rows)
+    n_e = jax.ops.segment_sum(mask, local_idx, num_segments=rows)
+    # λ·n_e ridge (MLlib's ALS-WR weighting) + ε guard for empty rows
+    lam = reg * jnp.maximum(n_e, 1.0) + 1e-6
+    A = A + lam[:, None, None] * jnp.eye(k, dtype=A.dtype)
+    cho = jax.scipy.linalg.cho_factor(A)
+    return jax.scipy.linalg.cho_solve(cho, b[..., None])[..., 0]  # [rows, K]
+
+
+def als_train(
+    data: ALSData,
+    k: int,
+    reg: float,
+    iterations: int,
+    mesh: Optional[Mesh] = None,
+    seed: int = 7,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Run ALS sweeps; returns (X [n_users, K], Y [n_items, K]) on host.
+
+    With a mesh, factors live block-sharded over ``dp`` and each half-step
+    all-gathers the opposite blocks (ICI); without, the same program runs on
+    one device with dp=1.
+    """
+    dp = data.dp
+    key = jax.random.PRNGKey(seed)
+    y0 = jax.random.normal(key, (dp, data.item_rows, k), jnp.float32) * 0.1
+    x0 = jnp.zeros((dp, data.user_rows, k), jnp.float32)
+    args = (
+        jnp.asarray(data.u_user_local), jnp.asarray(data.u_item_flat),
+        jnp.asarray(data.u_rating), jnp.asarray(data.u_mask),
+        jnp.asarray(data.i_item_local), jnp.asarray(data.i_user_flat),
+        jnp.asarray(data.i_rating), jnp.asarray(data.i_mask),
+    )
+
+    if mesh is None:
+        # Single-program path: identical math, vmapped over the shard axis.
+        def sweep(_, carry):
+            x, y, uu, ui, ur, um, ii, iu, ir, im = carry
+            y_full = y.reshape(dp * data.item_rows, k)
+            x = jax.vmap(
+                lambda lo, ot, rr, mm: _half_step(y_full, lo, ot, rr, mm, data.user_rows, reg)
+            )(uu, ui, ur, um)
+            x_full = x.reshape(dp * data.user_rows, k)
+            y = jax.vmap(
+                lambda lo, ot, rr, mm: _half_step(x_full, lo, ot, rr, mm, data.item_rows, reg)
+            )(ii, iu, ir, im)
+            return (x, y, uu, ui, ur, um, ii, iu, ir, im)
+
+        @jax.jit
+        def run(x0_, y0_, *a):
+            out = jax.lax.fori_loop(0, iterations, sweep, (x0_, y0_, *a))
+            return out[0], out[1]
+
+        x, y = run(x0, y0, *args)
+    else:
+        shard_map = jax.shard_map
+
+        if mesh.shape.get("dp", 1) != dp:
+            raise ValueError(f"ALSData prepared for dp={dp}, mesh has dp={mesh.shape.get('dp')}")
+
+        def per_shard_sweep(_, carry):
+            # Every array here is this shard's block: factors [1, rows, K],
+            # events [1, E].  all_gather pulls the opposite side's blocks
+            # over ICI — the only communication in the sweep.
+            x, y, uu, ui, ur, um, ii, iu, ir, im = carry
+            y_full = jax.lax.all_gather(y[0], "dp", tiled=True)  # [dp*item_rows, K]
+            x = _half_step(y_full, uu[0], ui[0], ur[0], um[0], data.user_rows, reg)[None]
+            x_full = jax.lax.all_gather(x[0], "dp", tiled=True)
+            y = _half_step(x_full, ii[0], iu[0], ir[0], im[0], data.item_rows, reg)[None]
+            return (x, y, uu, ui, ur, um, ii, iu, ir, im)
+
+        def per_shard(x0_, y0_, *a):
+            out = jax.lax.fori_loop(0, iterations, per_shard_sweep, (x0_, y0_, *a))
+            return out[0], out[1]
+
+        spec = P("dp")
+        sharded = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(spec,) * 10, out_specs=(spec, spec),
+        )
+        sharding = NamedSharding(mesh, P("dp"))
+        x0 = jax.device_put(x0, sharding)
+        y0 = jax.device_put(y0, sharding)
+        x, y = jax.jit(sharded)(x0, y0, *args)
+
+    # De-interleave [dp, rows, K] back to global [n, K]: global e = shard + dp*row.
+    x = np.asarray(x).transpose(1, 0, 2).reshape(-1, k)[: data.n_users]
+    y_arr = np.asarray(y).transpose(1, 0, 2).reshape(-1, k)[: data.n_items]
+    return x, y_arr
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def recommend_scores(
+    user_vec: jnp.ndarray,        # [K]
+    item_factors: jnp.ndarray,    # [n_items, K]
+    seen_mask: jnp.ndarray,       # [n_items] 1.0 where already interacted
+    top_k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-K item scores for one user; seen items pushed to -inf."""
+    scores = item_factors @ user_vec
+    scores = jnp.where(seen_mask > 0, -jnp.inf, scores)
+    return jax.lax.top_k(scores, top_k)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def recommend_batch(
+    user_vecs: jnp.ndarray,       # [B, K]
+    item_factors: jnp.ndarray,    # [n_items, K]
+    seen_mask: jnp.ndarray,       # [B, n_items]
+    top_k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    scores = user_vecs @ item_factors.T
+    scores = jnp.where(seen_mask > 0, -jnp.inf, scores)
+    return jax.lax.top_k(scores, top_k)

@@ -1,0 +1,8 @@
+from predictionio_tpu.workflow.core_workflow import (  # noqa: F401
+    run_eval,
+    run_train,
+)
+from predictionio_tpu.workflow.create_workflow import (  # noqa: F401
+    load_engine_variant,
+    resolve_engine_factory,
+)

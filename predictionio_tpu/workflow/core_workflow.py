@@ -1,0 +1,130 @@
+"""Training/evaluation orchestration.
+
+Reference: core/.../workflow/CoreWorkflow.scala — ``runTrain`` records an
+EngineInstance (INIT→TRAINING→COMPLETED/FAILED), runs Engine.train, persists
+models; ``runEval`` runs the Evaluation and records an EvaluationInstance.
+The spark-submit process boundary of the reference collapses to an in-process
+call on the TPU VM (SURVEY.md §3 'pio train' stack).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import traceback
+from typing import Any, List, Optional
+
+from predictionio_tpu.controller.engine import Engine, EngineParams, serialize_engine_params
+from predictionio_tpu.controller.evaluation import Evaluation, MetricEvaluatorResult
+from predictionio_tpu.core.base import doer_name
+from predictionio_tpu.storage.base import EngineInstance, EvaluationInstance
+from predictionio_tpu.storage.locator import Storage, get_storage
+from predictionio_tpu.workflow import persistence
+
+log = logging.getLogger("pio.workflow")
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_id: str,
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    storage: Optional[Storage] = None,
+) -> EngineInstance:
+    """Train and persist: returns the COMPLETED EngineInstance (or raises,
+    leaving a FAILED instance recorded)."""
+    storage = storage or get_storage()
+    params_json = serialize_engine_params(engine_params)
+    instance = EngineInstance(
+        id="",
+        status="INIT",
+        start_time=_now(),
+        end_time=None,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory or engine_id,
+        data_source_params=params_json["data_source_params"],
+        preparator_params=params_json["preparator_params"],
+        algorithms_params=params_json["algorithms_params"],
+        serving_params=params_json["serving_params"],
+    )
+    instance_id = storage.engine_instances.insert(instance)
+    instance.status = "TRAINING"
+    storage.engine_instances.update(instance)
+    try:
+        log.info("training engine %s (instance %s)", engine_id, instance_id)
+        models = engine.train(engine_params)
+        persistence.save_models(storage, instance_id, models)
+        instance.status = "COMPLETED"
+        instance.end_time = _now()
+        storage.engine_instances.update(instance)
+        log.info("training done: instance %s COMPLETED", instance_id)
+        return instance
+    except Exception:
+        instance.status = "FAILED"
+        instance.end_time = _now()
+        storage.engine_instances.update(instance)
+        log.error("training FAILED: %s", traceback.format_exc())
+        raise
+
+
+def load_latest_models(
+    engine_id: str,
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    storage: Optional[Storage] = None,
+) -> tuple:
+    """(instance, models) for the latest COMPLETED engine instance —
+    the deploy-time lookup (reference: CreateServer resolving EngineInstance)."""
+    storage = storage or get_storage()
+    instance = storage.engine_instances.get_latest_completed(
+        engine_id, engine_version, engine_variant
+    )
+    if instance is None:
+        raise LookupError(
+            f"no COMPLETED engine instance for {engine_id} v{engine_version} ({engine_variant}); "
+            "run `pio train` first"
+        )
+    models = persistence.load_models(storage, instance.id)
+    return instance, models
+
+
+def run_eval(
+    evaluation: Evaluation,
+    evaluation_class: str = "",
+    storage: Optional[Storage] = None,
+) -> MetricEvaluatorResult:
+    """Run an Evaluation, record the EvaluationInstance, return the result."""
+    storage = storage or get_storage()
+    instance = EvaluationInstance(
+        id="",
+        status="EVALRUNNING",
+        start_time=_now(),
+        end_time=None,
+        evaluation_class=evaluation_class or doer_name(evaluation),
+    )
+    instance_id = storage.evaluation_instances.insert(instance)
+    try:
+        result = evaluation.run()
+        instance.status = "EVALCOMPLETED"
+        instance.end_time = _now()
+        instance.evaluator_results = (
+            f"{result.metric_header}: best={result.best_score:.6f} "
+            f"(candidate {result.best_index + 1}/{len(result.engine_params_scores)})"
+        )
+        instance.evaluator_results_json = json.dumps(result.to_json())
+        storage.evaluation_instances.update(instance)
+        return result
+    except Exception:
+        instance.status = "EVALFAILED"
+        instance.end_time = _now()
+        storage.evaluation_instances.update(instance)
+        raise

@@ -1,0 +1,232 @@
+"""Query server — `pio deploy`.
+
+Reference: core/.../workflow/CreateServer.scala — ``MasterActor`` resolves the
+latest COMPLETED EngineInstance, loads models, and spawns the spray
+``ServerActor`` serving:
+
+  POST /queries.json   query → predict → serve → JSON prediction
+  GET  /               engine-instance info
+  GET  /reload         hot-swap to the newest COMPLETED instance
+  GET  /stop           shut down (reference web UI's stop)
+
+The feedback loop (reference: ServerActor writing prediction events back to
+the event store with ``prId`` when feedback is enabled) is implemented via
+``--feedback``: every answered query logs a ``predict`` event.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import sys
+import threading
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.storage.locator import Storage, get_storage
+from predictionio_tpu.workflow import core_workflow
+from predictionio_tpu.workflow.create_workflow import engine_from_variant, load_engine_variant
+
+log = logging.getLogger("pio.queryserver")
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if hasattr(obj, "to_json"):
+        return obj.to_json()
+    if isinstance(obj, (dict, list, str, int, float, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "__dict__"):
+        return obj.__dict__
+    return str(obj)
+
+
+class QueryServerState:
+    """Holds the deployed engine + models; supports hot reload
+    (reference: MasterActor hot-swapping engine instances)."""
+
+    def __init__(
+        self,
+        engine,
+        engine_params,
+        query_class,
+        engine_id: str,
+        engine_version: str,
+        engine_variant: str,
+        storage: Optional[Storage] = None,
+        feedback: bool = False,
+        feedback_app_name: str = "",
+    ):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.query_class = query_class
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+        self.storage = storage or get_storage()
+        self.feedback = feedback
+        self.feedback_app_name = feedback_app_name
+        self._lock = threading.Lock()
+        self.instance = None
+        self.predictor: Optional[Callable] = None
+        self.query_count = 0
+        self.started = _dt.datetime.now(_dt.timezone.utc)
+        self.reload()
+
+    def reload(self) -> str:
+        with self._lock:
+            instance, models = core_workflow.load_latest_models(
+                self.engine_id, self.engine_version, self.engine_variant, self.storage
+            )
+            self.predictor = self.engine.predictor(self.engine_params, models)
+            self.instance = instance
+            return instance.id
+
+    def parse_query(self, body: Dict) -> Any:
+        if self.query_class is not None and hasattr(self.query_class, "from_json"):
+            return self.query_class.from_json(body)
+        return body
+
+    def predict(self, body: Dict) -> Any:
+        query = self.parse_query(body)
+        with self._lock:
+            predictor = self.predictor
+        prediction = predictor(query)
+        self.query_count += 1
+        if self.feedback and self.feedback_app_name:
+            self._log_feedback(body, prediction)
+        return prediction
+
+    def _log_feedback(self, query_body: Dict, prediction: Any) -> None:
+        """Write the served prediction back as a `predict` event (prId links
+        follow-up reward events to this prediction, as in the reference)."""
+        from predictionio_tpu.events.event import DataMap, Event
+
+        app = self.storage.apps.get_by_name(self.feedback_app_name)
+        if app is None:
+            return
+        self.storage.l_events.insert(
+            Event(
+                event="predict",
+                entity_type="pio_pr",
+                entity_id=uuid.uuid4().hex,
+                properties=DataMap(
+                    {"query": query_body, "prediction": _to_jsonable(prediction)}
+                ),
+                pr_id=uuid.uuid4().hex,
+            ),
+            app.id,
+        )
+
+    def info(self) -> Dict:
+        return {
+            "status": "alive",
+            "engineId": self.engine_id,
+            "engineVersion": self.engine_version,
+            "variant": self.engine_variant,
+            "engineInstanceId": self.instance.id if self.instance else None,
+            "trainedAt": self.instance.start_time.isoformat() if self.instance else None,
+            "queryCount": self.query_count,
+            "startedAt": self.started.isoformat(),
+        }
+
+
+def make_handler(state: QueryServerState):
+    class QueryHandler(JsonHandler):
+        def do_GET(self):
+            path, _query = self.route
+            if path == "/":
+                self.send_json(state.info())
+            elif path == "/reload":
+                try:
+                    iid = state.reload()
+                    self.send_json({"reloaded": True, "engineInstanceId": iid})
+                except Exception as e:
+                    self.send_error_json(500, f"reload failed: {e}")
+            elif path == "/stop":
+                self.send_json({"stopping": True})
+                threading.Thread(target=self.server.shutdown, daemon=True).start()
+            else:
+                self.send_error_json(404, "not found")
+
+        def do_POST(self):
+            path, _query = self.route
+            if path != "/queries.json":
+                self.send_error_json(404, "not found")
+                return
+            try:
+                body = self.read_json()
+            except json.JSONDecodeError as e:
+                self.send_error_json(400, f"invalid JSON: {e}")
+                return
+            if not isinstance(body, dict):
+                self.send_error_json(400, "query must be a JSON object")
+                return
+            try:
+                prediction = state.predict(body)
+            except (KeyError, ValueError, TypeError) as e:
+                self.send_error_json(400, f"bad query: {e}")
+                return
+            except Exception as e:  # engine failure
+                log.exception("prediction failed")
+                self.send_error_json(500, f"prediction failed: {e}")
+                return
+            self.send_json(_to_jsonable(prediction))
+
+    return QueryHandler
+
+
+def deploy(
+    engine_json: str = "engine.json",
+    variant: str = "default",
+    engine_id: Optional[str] = None,
+    engine_version: str = "1",
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    feedback: bool = False,
+    storage: Optional[Storage] = None,
+    background: bool = False,
+):
+    """Programmatic deploy; returns the HTTPServer (background=True) or blocks."""
+    doc = load_engine_variant(engine_json, variant)
+    factory, engine, engine_params = engine_from_variant(doc)
+    eid = engine_id or doc.get("id") or factory.engine_id()
+    query_class = getattr(factory, "query_class", None)
+    feedback_app = ""
+    if feedback:
+        ds_params = getattr(engine_params.data_source_params, "app_name", "")
+        feedback_app = ds_params
+    state = QueryServerState(
+        engine, engine_params, query_class, eid, engine_version, variant,
+        storage=storage, feedback=feedback, feedback_app_name=feedback_app,
+    )
+    httpd = start_server(make_handler(state), host, port, background=background)
+    log.info("Query server for %s listening on %s:%d", eid, host, httpd.server_address[1])
+    httpd.pio_state = state  # handle for tests/tools
+    if background:
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+def run_server_from_args(args) -> int:
+    try:
+        result = deploy(
+            engine_json=args.engine_json,
+            variant=args.variant,
+            engine_id=args.engine_id,
+            engine_version=args.engine_version,
+            host=args.ip,
+            port=args.port,
+            feedback=args.feedback,
+        )
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    return 0 if result == 0 else 0

@@ -1,0 +1,106 @@
+"""CLI→workflow glue (reference: core/.../workflow/CreateWorkflow.scala +
+WorkflowUtils engine-variant parsing).
+
+Resolves the engine factory named in engine.json (dotted import path or a
+built-in template shortname from models.ENGINE_FACTORIES), binds the variant's
+params blocks to typed EngineParams, and dispatches to CoreWorkflow.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import sys
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple, Type
+
+from predictionio_tpu.controller.engine import Engine, EngineFactory, EngineParams
+from predictionio_tpu.models import ENGINE_FACTORIES
+from predictionio_tpu.workflow import core_workflow
+
+log = logging.getLogger("pio.workflow")
+
+
+def resolve_engine_factory(name: str) -> Type[EngineFactory]:
+    """Import the EngineFactory class for a dotted path or template shortname."""
+    dotted = ENGINE_FACTORIES.get(name, name)
+    module_name, _, cls_name = dotted.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"engineFactory {name!r} is not a dotted path or known template "
+            f"({sorted(ENGINE_FACTORIES)})"
+        )
+    # engine.json lives next to user code; make its directory importable the
+    # way the reference adds the engine assembly jar to the classpath.
+    module = importlib.import_module(module_name)
+    factory = getattr(module, cls_name)
+    if not (isinstance(factory, type) and issubclass(factory, EngineFactory)):
+        raise TypeError(f"{dotted} is not an EngineFactory subclass")
+    return factory
+
+
+def load_engine_variant(engine_json: str, variant_id: str = "default") -> Dict[str, Any]:
+    """Load engine.json; supports both a single variant document and the
+    reference's ``engineFactory`` + per-variant files."""
+    path = Path(engine_json)
+    if not path.exists():
+        raise FileNotFoundError(f"engine variant file {engine_json!r} not found")
+    doc = json.loads(path.read_text())
+    if "engineFactory" not in doc:
+        raise ValueError(f"{engine_json}: missing required key 'engineFactory'")
+    return doc
+
+
+def engine_from_variant(
+    variant: Dict[str, Any]
+) -> Tuple[Type[EngineFactory], Engine, EngineParams]:
+    factory = resolve_engine_factory(variant["engineFactory"])
+    engine = factory.apply()
+    engine_params = engine.engine_params_from_variant(variant)
+    return factory, engine, engine_params
+
+
+def run_train_from_args(args) -> int:
+    """`pio train` entry (reference: Console.train → RunWorkflow →
+    CreateWorkflow.main)."""
+    try:
+        variant = load_engine_variant(args.engine_json, args.variant)
+        factory, engine, engine_params = engine_from_variant(variant)
+        engine_id = args.engine_id or variant.get("id") or factory.engine_id()
+        instance = core_workflow.run_train(
+            engine,
+            engine_params,
+            engine_id=engine_id,
+            engine_version=args.engine_version,
+            engine_variant=args.variant,
+            engine_factory=variant["engineFactory"],
+        )
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Training completed. Engine instance id: {instance.id}")
+    return 0
+
+
+def run_eval_from_args(args) -> int:
+    """`pio eval` entry — evaluation_class is a dotted path to an Evaluation
+    subclass or instance (reference: Console.eval → EvaluationWorkflow)."""
+    from predictionio_tpu.controller.evaluation import Evaluation
+
+    try:
+        module_name, _, attr = args.evaluation_class.rpartition(".")
+        if not module_name:
+            raise ValueError(f"evaluation class {args.evaluation_class!r} must be a dotted path")
+        obj = getattr(importlib.import_module(module_name), attr)
+        evaluation = obj() if isinstance(obj, type) else obj
+        if not isinstance(evaluation, Evaluation):
+            raise TypeError(f"{args.evaluation_class} is not an Evaluation")
+        result = core_workflow.run_eval(evaluation, evaluation_class=args.evaluation_class)
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Evaluation completed: {result.metric_header} best={result.best_score:.6f}")
+    print("Best engine params:")
+    print(json.dumps(result.best_engine_params.to_json(), indent=2))
+    return 0

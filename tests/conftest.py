@@ -7,10 +7,17 @@ before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Force CPU regardless of the ambient platform config (the TPU VM's
+# sitecustomize programmatically sets jax_platforms, so env vars alone are
+# ignored). Set PIO_TEST_TPU=1 to run the suite against real hardware.
+if not os.environ.get("PIO_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

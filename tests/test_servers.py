@@ -1,0 +1,195 @@
+"""REST server tests: event ingestion + query serving over real HTTP
+(reference analogues: EventServiceSpec and the integration harness's
+deploy/query loop — SURVEY.md §4)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.api.event_server import run_event_server
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.storage import AccessKey, App
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def event_server(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "esapp"))
+    key = mem_storage.access_keys.insert(AccessKey("", app_id, []))
+    restricted = mem_storage.access_keys.insert(AccessKey("", app_id, ["view"]))
+    httpd = run_event_server(host="127.0.0.1", port=0, storage=mem_storage,
+                             background=True)
+    port = httpd.server_address[1]
+    yield {"base": f"http://127.0.0.1:{port}", "key": key,
+           "restricted": restricted, "app_id": app_id, "storage": mem_storage}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_event_server_alive(event_server):
+    status, body = http("GET", event_server["base"] + "/")
+    assert status == 200 and body == {"status": "alive"}
+
+
+def test_post_and_get_event(event_server):
+    base, key = event_server["base"], event_server["key"]
+    status, body = http("POST", f"{base}/events.json?accessKey={key}", {
+        "event": "buy", "entityType": "user", "entityId": "u1",
+        "targetEntityType": "item", "targetEntityId": "i1",
+        "properties": {"price": 9.99},
+    })
+    assert status == 201 and "eventId" in body
+    eid = body["eventId"]
+    status, got = http("GET", f"{base}/events/{eid}.json?accessKey={key}")
+    assert status == 200 and got["event"] == "buy" and got["properties"]["price"] == 9.99
+    # find with filters
+    status, found = http("GET", f"{base}/events.json?accessKey={key}&event=buy")
+    assert status == 200 and len(found) == 1
+    status, none = http("GET", f"{base}/events.json?accessKey={key}&event=view")
+    assert status == 200 and none == []
+    # delete
+    status, _ = http("DELETE", f"{base}/events/{eid}.json?accessKey={key}")
+    assert status == 200
+    status, _ = http("GET", f"{base}/events/{eid}.json?accessKey={key}")
+    assert status == 404
+
+
+def test_auth_rejections(event_server):
+    base = event_server["base"]
+    status, body = http("POST", f"{base}/events.json", {"event": "x"})
+    assert status == 401
+    status, body = http("POST", f"{base}/events.json?accessKey=WRONG", {"event": "x"})
+    assert status == 401
+    # restricted key may only write "view"
+    rk = event_server["restricted"]
+    status, _ = http("POST", f"{base}/events.json?accessKey={rk}", {
+        "event": "buy", "entityType": "user", "entityId": "u1"})
+    assert status == 403
+    status, _ = http("POST", f"{base}/events.json?accessKey={rk}", {
+        "event": "view", "entityType": "user", "entityId": "u1"})
+    assert status == 201
+
+
+def test_malformed_event_rejected(event_server):
+    base, key = event_server["base"], event_server["key"]
+    status, body = http("POST", f"{base}/events.json?accessKey={key}", {
+        "event": "$set", "entityType": "user", "entityId": "u1",
+        "targetEntityType": "item", "targetEntityId": "i1"})
+    assert status == 400
+    status, body = http("POST", f"{base}/events.json?accessKey={key}", {
+        "entityType": "user", "entityId": "u1"})
+    assert status == 400
+
+
+def test_batch_events(event_server):
+    base, key = event_server["base"], event_server["key"]
+    batch = [
+        {"event": "view", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": "i1"}
+        for i in range(3)
+    ]
+    batch.append({"entityType": "user", "entityId": "broken"})  # missing event
+    status, results = http("POST", f"{base}/batch/events.json?accessKey={key}", batch)
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 201, 201, 400]
+    # over-limit batch rejected
+    status, _ = http("POST", f"{base}/batch/events.json?accessKey={key}",
+                     [batch[0]] * 51)
+    assert status == 400
+
+
+def test_stats(event_server):
+    base, key = event_server["base"], event_server["key"]
+    for _ in range(2):
+        http("POST", f"{base}/events.json?accessKey={key}", {
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 5}})
+    status, body = http("GET", f"{base}/stats.json?accessKey={key}")
+    assert status == 200 and body["counts"].get("rate") == 2
+
+
+@pytest.fixture()
+def deployed_engine(tmp_path, mem_storage):
+    """Full loop: ingest ratings → pio-style train → deploy → HTTP query."""
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import deploy
+    from predictionio_tpu.models.recommendation import RecommendationEngine
+    from predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithmParams, DataSourceParams,
+    )
+    from predictionio_tpu.controller.engine import EngineParams
+
+    app_id = mem_storage.apps.insert(App(0, "qsapp"))
+    events = []
+    rng = np.random.default_rng(2)
+    for u in range(12):
+        for i in range(8):
+            liked = (u < 6) == (i < 4)
+            if rng.random() < 0.9:
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0 if liked else 1.0})))
+    mem_storage.l_events.insert_batch(events, app_id)
+
+    variant = {
+        "id": "qs-engine",
+        "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "qsapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "numIterations": 6, "lambda": 0.05,
+                                   "meshDp": 1}}],
+    }
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps(variant))
+
+    engine = RecommendationEngine.apply()
+    ep = engine.engine_params_from_variant(variant)
+    core_workflow.run_train(engine, ep, engine_id="qs-engine", storage=mem_storage)
+
+    httpd = deploy(engine_json=str(engine_json), host="127.0.0.1", port=0,
+                   storage=mem_storage, background=True)
+    port = httpd.server_address[1]
+    yield {"base": f"http://127.0.0.1:{port}", "storage": mem_storage,
+           "engine_json": engine_json}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_query_server_predicts(deployed_engine):
+    base = deployed_engine["base"]
+    status, info = http("GET", base + "/")
+    assert status == 200 and info["engineId"] == "qs-engine"
+    status, res = http("POST", base + "/queries.json", {"user": "u1", "num": 3})
+    assert status == 200
+    items = [s["item"] for s in res["itemScores"]]
+    assert len(items) == 3
+    assert all(int(i[1:]) < 4 for i in items), items
+
+
+def test_query_server_bad_requests(deployed_engine):
+    base = deployed_engine["base"]
+    status, _ = http("POST", base + "/queries.json", {"num": 3})  # missing user
+    assert status == 400
+    status, _ = http("POST", base + "/nope.json", {"user": "u1"})
+    assert status == 404
+
+
+def test_query_server_reload(deployed_engine):
+    base = deployed_engine["base"]
+    status, body = http("GET", base + "/reload")
+    assert status == 200 and body["reloaded"]
