@@ -1,0 +1,7 @@
+from predictionio_tpu.models.classification.engine import (  # noqa: F401
+    ClassificationEngine,
+    ClassificationQuery,
+    ClassifiedResult,
+    LogisticRegressionAlgorithm,
+    NaiveBayesAlgorithm,
+)

@@ -1,0 +1,248 @@
+"""Classification engine template.
+
+Capability parity with the reference Classification template (template repo:
+DataSource reads per-entity ``$set`` properties "attr0..attrN" + "label" via
+PEventStore.aggregateProperties; algorithms: MLlib
+LogisticRegressionWithLBFGS / NaiveBayes — SURVEY.md §2 'Classification').
+
+Wire format (reference template):
+  query    {"attr0": 2.0, "attr1": 0.0, "attr2": 1.0}   (by attribute name)
+  response {"label": "spam"}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator,
+)
+from predictionio_tpu.ops import logreg as lr_ops
+from predictionio_tpu.ops import naive_bayes as nb_ops
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.store.event_store import PEventStore
+
+
+@dataclasses.dataclass
+class ClassificationQuery:
+    features: Dict[str, float]
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "ClassificationQuery":
+        return cls(features={k: float(v) for k, v in d.items()})
+
+
+@dataclasses.dataclass
+class ClassifiedResult:
+    label: str
+
+    def to_json(self) -> Dict:
+        return {"label": self.label}
+
+
+@dataclasses.dataclass
+class ClassificationDSParams(Params):
+    app_name: str = "default"
+    entity_type: str = "user"
+    attributes: List[str] = dataclasses.field(
+        default_factory=lambda: ["attr0", "attr1", "attr2"]
+    )
+    label: str = "label"
+    eval_k: int = 0
+    seed: int = 3
+
+
+@dataclasses.dataclass
+class LabeledData:
+    x: np.ndarray              # [n, d] float32
+    y: np.ndarray              # [n] int32
+    labels: List[str]          # class id -> label string
+    attributes: List[str]
+
+
+class ClassificationDataSource(DataSource):
+    params_class = ClassificationDSParams
+
+    def read_training(self) -> LabeledData:
+        props = PEventStore.aggregate_properties(
+            self.params.app_name, self.params.entity_type
+        )
+        attrs = list(self.params.attributes)
+        labels: List[str] = []
+        label_of: Dict[str, int] = {}
+        rows, ys = [], []
+        for _entity, pm in sorted(props.items()):
+            if self.params.label not in pm:
+                continue
+            try:
+                row = [float(pm.get_as(a, float)) for a in attrs]
+            except (KeyError, TypeError):
+                continue
+            lab = str(pm[self.params.label])
+            if lab not in label_of:
+                label_of[lab] = len(labels)
+                labels.append(lab)
+            rows.append(row)
+            ys.append(label_of[lab])
+        if not rows:
+            raise ValueError(
+                f"no labeled '{self.params.entity_type}' entities with attributes "
+                f"{attrs} + '{self.params.label}' in app {self.params.app_name!r}"
+            )
+        return LabeledData(
+            x=np.asarray(rows, np.float32),
+            y=np.asarray(ys, np.int32),
+            labels=labels,
+            attributes=attrs,
+        )
+
+    def read_eval(self):
+        data = self.read_training()
+        k = self.params.eval_k
+        if k <= 1:
+            return []
+        rng = np.random.default_rng(self.params.seed)
+        fold_of = rng.integers(0, k, size=len(data.y))
+        folds = []
+        for f in range(k):
+            tr, te = fold_of != f, fold_of == f
+            td = LabeledData(data.x[tr], data.y[tr], data.labels, data.attributes)
+            qa = [
+                (
+                    ClassificationQuery(dict(zip(data.attributes, data.x[i].tolist()))),
+                    data.labels[int(data.y[i])],
+                )
+                for i in np.nonzero(te)[0]
+            ]
+            folds.append((td, {"fold": f}, qa))
+        return folds
+
+
+class ClassificationPreparator(Preparator):
+    def prepare(self, td: LabeledData) -> LabeledData:
+        return td
+
+
+class _ClassifierModelBase:
+    def __init__(self, labels: List[str], attributes: List[str]):
+        self.labels = labels
+        self.attributes = attributes
+
+    def featurize(self, query: ClassificationQuery) -> np.ndarray:
+        return np.asarray(
+            [[float(query.features.get(a, 0.0)) for a in self.attributes]], np.float32
+        )
+
+
+class LogRegModel(_ClassifierModelBase):
+    def __init__(self, w, b, labels, attributes):
+        super().__init__(labels, attributes)
+        self.w = w
+        self.b = b
+
+
+@dataclasses.dataclass
+class LogRegParams(Params):
+    iterations: int = 100
+    l2: float = 1e-4
+    optimizer: str = "lbfgs"
+    learning_rate: float = 0.1
+    mesh_dp: int = 0
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    params_class = LogRegParams
+
+    def train(self, td: LabeledData) -> LogRegModel:
+        import jax
+
+        mesh = None
+        dp = self.params.mesh_dp or len(jax.devices())
+        if dp > 1:
+            mesh = create_mesh(MeshSpec(dp=dp, mp=1))
+        w, b = lr_ops.logreg_train(
+            td.x, td.y, n_classes=len(td.labels),
+            l2=self.params.l2, iterations=self.params.iterations,
+            optimizer=self.params.optimizer, learning_rate=self.params.learning_rate,
+            mesh=mesh,
+        )
+        return LogRegModel(w, b, td.labels, td.attributes)
+
+    def predict(self, model: LogRegModel, query: ClassificationQuery) -> ClassifiedResult:
+        pred = lr_ops.logreg_predict(model.w, model.b, model.featurize(query))
+        return ClassifiedResult(label=model.labels[int(pred[0])])
+
+    def batch_predict(self, model: LogRegModel, queries: Sequence[ClassificationQuery]):
+        if not queries:
+            return []
+        x = np.concatenate([model.featurize(q) for q in queries])
+        preds = lr_ops.logreg_predict(model.w, model.b, x)
+        return [ClassifiedResult(label=model.labels[int(p)]) for p in preds]
+
+
+class NBModel(_ClassifierModelBase):
+    def __init__(self, inner, labels, attributes):
+        super().__init__(labels, attributes)
+        self.inner = inner
+
+
+@dataclasses.dataclass
+class NaiveBayesParams(Params):
+    model_type: str = "gaussian"  # gaussian | multinomial
+    alpha: float = 1.0            # multinomial smoothing (reference: lambda)
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    params_class = NaiveBayesParams
+
+    def train(self, td: LabeledData) -> NBModel:
+        if self.params.model_type == "gaussian":
+            inner = nb_ops.gaussian_nb_train(td.x, td.y, len(td.labels))
+        elif self.params.model_type == "multinomial":
+            inner = nb_ops.multinomial_nb_train(td.x, td.y, len(td.labels), self.params.alpha)
+        else:
+            raise ValueError(f"unknown model_type {self.params.model_type!r}")
+        return NBModel(inner, td.labels, td.attributes)
+
+    def predict(self, model: NBModel, query: ClassificationQuery) -> ClassifiedResult:
+        x = model.featurize(query)
+        if isinstance(model.inner, nb_ops.GaussianNBModel):
+            pred = nb_ops.gaussian_nb_predict(model.inner, x)
+        else:
+            pred = nb_ops.multinomial_nb_predict(model.inner, x)
+        return ClassifiedResult(label=model.labels[int(pred[0])])
+
+    def batch_predict(self, model: NBModel, queries: Sequence[ClassificationQuery]):
+        if not queries:
+            return []
+        x = np.concatenate([model.featurize(q) for q in queries])
+        if isinstance(model.inner, nb_ops.GaussianNBModel):
+            preds = nb_ops.gaussian_nb_predict(model.inner, x)
+        else:
+            preds = nb_ops.multinomial_nb_predict(model.inner, x)
+        return [ClassifiedResult(label=model.labels[int(p)]) for p in preds]
+
+
+class ClassificationEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=ClassificationDataSource,
+            preparator_class=ClassificationPreparator,
+            algorithm_classes={
+                "logreg": LogisticRegressionAlgorithm,
+                "naivebayes": NaiveBayesAlgorithm,
+            },
+            serving_class=FirstServing,
+        )
+
+    query_class = ClassificationQuery

@@ -1,0 +1,8 @@
+from predictionio_tpu.models.universal_recommender.engine import (  # noqa: F401
+    URAlgorithm,
+    URDataSource,
+    URModel,
+    URPreparator,
+    URQuery,
+    UniversalRecommenderEngine,
+)

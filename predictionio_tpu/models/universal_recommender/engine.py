@@ -1,0 +1,438 @@
+"""Universal Recommender engine template (CCO).
+
+Capability parity with ActionML's UR (repo actionml/universal-recommender:
+URAlgorithm.scala / URModel.scala / EsClient.scala, per SURVEY.md §2): the
+reference computes LLR-thresholded cross-occurrence indicators with
+Mahout-Samsara on Spark and serves by sending the user's recent history as an
+Elasticsearch boolean-OR query over indicator fields, with business rules,
+blacklists and a popularity fallback.
+
+TPU-native redesign (SURVEY.md §7.5): indicators come from
+``predictionio_tpu.ops.cco`` (blocked MXU matmuls + LLR + top-k on device);
+serving replaces Elasticsearch with a resident jitted scorer — the user's
+history becomes a multi-hot vector per indicator type and scoring is one
+gather+reduce over the [n_items, top_k] indicator table.
+
+Wire format (UR):
+  query    {"user": "u1", "num": 10}
+           {"item": "i1"}                              (item-similarity)
+           {"user": "u1", "fields": [{"name": "category",
+             "values": ["phones"], "bias": -1}],        (-1 filter, >0 boost)
+            "blacklistItems": ["i3"]}
+  response {"itemScores": [{"item": "i5", "score": 2.1}, ...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    PersistentModel,
+    Preparator,
+)
+from predictionio_tpu.ops import cco as cco_ops
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.store.event_store import LEventStore, PEventStore
+
+
+# -- query / result ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FieldRule:
+    name: str
+    values: List[str]
+    bias: float  # -1 => hard filter; >0 => multiplicative boost
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "FieldRule":
+        return cls(name=str(d["name"]), values=[str(v) for v in d["values"]],
+                   bias=float(d.get("bias", 1.0)))
+
+
+@dataclasses.dataclass
+class URQuery:
+    user: Optional[str] = None
+    item: Optional[str] = None
+    num: int = 20
+    fields: List[FieldRule] = dataclasses.field(default_factory=list)
+    blacklist_items: List[str] = dataclasses.field(default_factory=list)
+    return_self: bool = False
+
+    def __post_init__(self):
+        self.fields = [
+            f if isinstance(f, FieldRule) else FieldRule.from_json(f) for f in self.fields
+        ]
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "URQuery":
+        return cls(
+            user=str(d["user"]) if d.get("user") is not None else None,
+            item=str(d["item"]) if d.get("item") is not None else None,
+            num=int(d.get("num", 20)),
+            fields=[FieldRule.from_json(f) for f in d.get("fields", [])],
+            blacklist_items=[str(b) for b in d.get("blacklistItems", [])],
+            return_self=bool(d.get("returnSelf", False)),
+        )
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+    def to_json(self) -> Dict:
+        return {"item": self.item, "score": self.score}
+
+
+@dataclasses.dataclass
+class URResult:
+    item_scores: List[ItemScore]
+
+    def to_json(self) -> Dict:
+        return {"itemScores": [s.to_json() for s in self.item_scores]}
+
+
+# -- DASE: data source -------------------------------------------------------
+
+
+@dataclasses.dataclass
+class URDataSourceParams(Params):
+    app_name: str = "default"
+    event_names: List[str] = dataclasses.field(default_factory=lambda: ["purchase", "view"])
+    item_entity_type: str = "item"
+
+
+@dataclasses.dataclass
+class URTrainingData:
+    """Per-event-type COO with a shared user dictionary.
+
+    interactions[event_name] = (user_idx, item_idx, item_dict); the primary
+    event is event_names[0] and defines the recommendable item space.
+    """
+
+    event_names: List[str]
+    user_dict: IdDict
+    interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict]]
+    item_properties: Dict[str, Dict[str, Any]]  # item id -> property map
+
+
+class URDataSource(DataSource):
+    params_class = URDataSourceParams
+
+    def read_training(self) -> URTrainingData:
+        user_dict = IdDict()
+        interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict]] = {}
+        for name in self.params.event_names:
+            item_dict = IdDict()
+            users: List[int] = []
+            items: List[int] = []
+            for e in PEventStore.find(self.params.app_name, event_names=[name]):
+                if e.target_entity_id is None:
+                    continue
+                users.append(user_dict.add(e.entity_id))
+                items.append(item_dict.add(e.target_entity_id))
+            interactions[name] = (
+                np.asarray(users, np.int32),
+                np.asarray(items, np.int32),
+                item_dict,
+            )
+        props = PEventStore.aggregate_properties(
+            self.params.app_name, self.params.item_entity_type
+        )
+        return URTrainingData(
+            event_names=list(self.params.event_names),
+            user_dict=user_dict,
+            interactions=interactions,
+            item_properties={k: dict(v) for k, v in props.items()},
+        )
+
+
+class URPreparator(Preparator):
+    """Identity — dedup/blocking happens in the algorithm where the mesh
+    shape is known (reference URPreparator builds Mahout IndexedDatasets)."""
+
+    def prepare(self, td: URTrainingData) -> URTrainingData:
+        return td
+
+
+# -- model -------------------------------------------------------------------
+
+
+class URModel(PersistentModel):
+    """Indicator tables per event type + popularity + item properties.
+
+    For event type t: ``indicator_idx[t]`` [I_p, K] holds correlated item ids
+    in t's item space (-1 padding), ``indicator_llr[t]`` the LLR strengths.
+    """
+
+    def __init__(
+        self,
+        primary_event: str,
+        item_dict: IdDict,
+        user_dict: IdDict,
+        indicator_idx: Dict[str, np.ndarray],
+        indicator_llr: Dict[str, np.ndarray],
+        event_item_dicts: Dict[str, IdDict],
+        popularity: np.ndarray,
+        item_properties: Dict[str, Dict[str, Any]],
+        user_seen: Dict[int, np.ndarray],
+    ):
+        self.primary_event = primary_event
+        self.item_dict = item_dict
+        self.user_dict = user_dict
+        self.indicator_idx = indicator_idx
+        self.indicator_llr = indicator_llr
+        self.event_item_dicts = event_item_dicts
+        self.popularity = popularity
+        self.item_properties = item_properties
+        self.user_seen = user_seen
+
+    def __getstate__(self):
+        return {
+            "primary_event": self.primary_event,
+            "items": self.item_dict.to_state(),
+            "users": self.user_dict.to_state(),
+            "indicator_idx": self.indicator_idx,
+            "indicator_llr": self.indicator_llr,
+            "event_items": {k: d.to_state() for k, d in self.event_item_dicts.items()},
+            "popularity": self.popularity,
+            "item_properties": self.item_properties,
+            "user_seen": self.user_seen,
+        }
+
+    def __setstate__(self, s):
+        self.primary_event = s["primary_event"]
+        self.item_dict = IdDict.from_state(s["items"])
+        self.user_dict = IdDict.from_state(s["users"])
+        self.indicator_idx = s["indicator_idx"]
+        self.indicator_llr = s["indicator_llr"]
+        self.event_item_dicts = {k: IdDict.from_state(v) for k, v in s["event_items"].items()}
+        self.popularity = s["popularity"]
+        self.item_properties = s["item_properties"]
+        self.user_seen = s["user_seen"]
+
+
+@partial(jax.jit, static_argnames=())
+def _indicator_score(idx: jnp.ndarray, llr: jnp.ndarray, hist: jnp.ndarray, use_llr: jnp.ndarray):
+    """score[i] = Σ_k hist[idx[i,k]] · w[i,k] with -1 padding masked."""
+    valid = idx >= 0
+    matched = hist[jnp.where(valid, idx, 0)] * valid
+    w = jnp.where(use_llr, jnp.where(valid, llr, 0.0), 1.0)
+    return (matched * w).sum(-1)
+
+
+# -- algorithm ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class URAlgorithmParams(Params):
+    app_name: str = "default"
+    event_names: List[str] = dataclasses.field(default_factory=list)  # default: data source's
+    max_correlators_per_item: int = 50
+    min_llr: float = 0.0
+    max_query_events: int = 100
+    num: int = 20
+    user_block: int = 1024
+    item_tile: int = 4096
+    mesh_dp: int = 0
+    use_llr_weights: bool = False
+    blacklist_events: List[str] = dataclasses.field(default_factory=list)  # default: primary
+    backfill_type: str = "popular"  # popular | trending(unsupported yet) | none
+    indicator_weights: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+class URAlgorithm(Algorithm):
+    params_class = URAlgorithmParams
+
+    def train(self, td: URTrainingData) -> URModel:
+        primary = td.event_names[0]
+        p_user, p_item, p_item_dict = td.interactions[primary]
+        n_users = len(td.user_dict)
+        n_items = len(p_item_dict)
+        if n_items == 0:
+            raise ValueError(f"no {primary!r} events to train on")
+        dp = self.params.mesh_dp or len(jax.devices())
+        mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
+        block = self.params.user_block
+        p_blocked = cco_ops.block_interactions(
+            p_user, p_item, n_users, n_items, user_block=block
+        )
+        p_counts = _distinct_counts(p_blocked)
+        indicator_idx: Dict[str, np.ndarray] = {}
+        indicator_llr: Dict[str, np.ndarray] = {}
+        event_item_dicts: Dict[str, IdDict] = {}
+        for name in td.event_names:
+            u, i, item_dict = td.interactions[name]
+            if name == primary:
+                blocked, counts = p_blocked, p_counts
+            else:
+                if len(item_dict) == 0:
+                    continue
+                blocked = cco_ops.block_interactions(
+                    u, i, n_users, len(item_dict), user_block=block
+                )
+                counts = _distinct_counts(blocked)
+            scores, idx = cco_ops.cco_indicators(
+                p_blocked, blocked, p_counts, counts, n_users,
+                top_k=self.params.max_correlators_per_item,
+                llr_threshold=self.params.min_llr,
+                item_tile=self.params.item_tile,
+                mesh=mesh,
+                exclude_self=(name == primary),
+            )
+            indicator_idx[name] = idx.astype(np.int32)
+            indicator_llr[name] = np.where(np.isfinite(scores), scores, 0.0).astype(np.float32)
+            event_item_dicts[name] = item_dict
+        popularity = p_counts.astype(np.float32)
+        user_seen: Dict[int, np.ndarray] = {}
+        for u_id in np.unique(p_user) if len(p_user) else []:
+            user_seen[int(u_id)] = np.unique(p_item[p_user == u_id])
+        return URModel(
+            primary_event=primary,
+            item_dict=p_item_dict,
+            user_dict=td.user_dict,
+            indicator_idx=indicator_idx,
+            indicator_llr=indicator_llr,
+            event_item_dicts=event_item_dicts,
+            popularity=popularity,
+            item_properties=td.item_properties,
+            user_seen=user_seen,
+        )
+
+    # -- serving -------------------------------------------------------------
+
+    def _user_history(self, model: URModel, user: str) -> Dict[str, np.ndarray]:
+        """Recent item ids per event type, from the live event store
+        (reference: URAlgorithm.predict reading LEventStore)."""
+        hist: Dict[str, np.ndarray] = {}
+        for name, item_dict in model.event_item_dicts.items():
+            try:
+                events = LEventStore.find_by_entity(
+                    self.params.app_name, "user", user,
+                    event_names=[name], limit=self.params.max_query_events,
+                )
+            except ValueError:
+                events = []
+            ids = [
+                item_dict.id(e.target_entity_id)
+                for e in events
+                if e.target_entity_id is not None and item_dict.id(e.target_entity_id) is not None
+            ]
+            hist[name] = np.asarray(sorted(set(ids)), np.int32)
+        return hist
+
+    def predict(self, model: URModel, query: URQuery) -> URResult:
+        n_items = len(model.item_dict)
+        if n_items == 0:
+            return URResult([])
+        scores = np.zeros(n_items, np.float32)
+        have_signal = False
+        if query.item is not None:
+            iid = model.item_dict.id(query.item)
+            if iid is not None:
+                idx = model.indicator_idx.get(model.primary_event)
+                llr = model.indicator_llr.get(model.primary_event)
+                if idx is not None:
+                    for k_, j in enumerate(idx[iid]):
+                        if j >= 0:
+                            scores[j] += llr[iid, k_] if self.params.use_llr_weights else 1.0
+                    have_signal = bool((idx[iid] >= 0).any())
+        elif query.user is not None:
+            hist = self._user_history(model, query.user)
+            use_llr = jnp.asarray(self.params.use_llr_weights)
+            for name, idx in model.indicator_idx.items():
+                h_ids = hist.get(name)
+                if h_ids is None or len(h_ids) == 0:
+                    continue
+                hvec = np.zeros(max(len(model.event_item_dicts[name]), 1), np.float32)
+                hvec[h_ids] = 1.0
+                s = _indicator_score(
+                    jnp.asarray(idx), jnp.asarray(model.indicator_llr[name]),
+                    jnp.asarray(hvec), use_llr,
+                )
+                weight = float(self.params.indicator_weights.get(name, 1.0))
+                scores += weight * np.asarray(s)
+                have_signal = have_signal or bool(len(h_ids))
+        if not have_signal and self.params.backfill_type == "popular":
+            pop = model.popularity
+            scores = pop / max(float(pop.max()), 1.0)
+        # business rules
+        mask = self._field_mask(model, query.fields)
+        scores = scores * mask
+        # blacklist: query items + the user's own primary-event items + self
+        black = set(query.blacklist_items)
+        if query.user is not None:
+            uid = model.user_dict.id(query.user)
+            if uid is not None and uid in model.user_seen:
+                blacklist_events = self.params.blacklist_events or [model.primary_event]
+                if model.primary_event in blacklist_events:
+                    for j in model.user_seen[uid]:
+                        scores[j] = -np.inf
+        if query.item is not None and not query.return_self:
+            black.add(query.item)
+        for b in black:
+            bid = model.item_dict.id(b)
+            if bid is not None:
+                scores[bid] = -np.inf
+        num = min(query.num, n_items)
+        top = np.argpartition(-np.nan_to_num(scores, neginf=-1e30), min(num, n_items - 1))[:num]
+        top = top[np.argsort(-scores[top], kind="stable")]
+        return URResult(
+            [
+                ItemScore(model.item_dict.str(int(j)), float(scores[j]))
+                for j in top
+                if np.isfinite(scores[j]) and scores[j] > 0
+            ]
+        )
+
+    def _field_mask(self, model: URModel, rules: List[FieldRule]) -> np.ndarray:
+        n_items = len(model.item_dict)
+        mask = np.ones(n_items, np.float32)
+        for rule in rules:
+            match = np.zeros(n_items, bool)
+            for j in range(n_items):
+                props = model.item_properties.get(model.item_dict.str(j), {})
+                v = props.get(rule.name)
+                if v is None:
+                    continue
+                vals = v if isinstance(v, list) else [v]
+                if any(str(x) in rule.values for x in vals):
+                    match[j] = True
+            if rule.bias < 0:
+                mask *= match.astype(np.float32)  # hard filter
+            else:
+                mask *= np.where(match, rule.bias, 1.0).astype(np.float32)
+        return mask
+
+
+class UniversalRecommenderEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=URDataSource,
+            preparator_class=URPreparator,
+            algorithm_classes={"ur": URAlgorithm},
+            serving_class=FirstServing,
+        )
+
+    query_class = URQuery
+
+
+def _distinct_counts(blocked: cco_ops.BlockedInteractions) -> np.ndarray:
+    counts = np.zeros(blocked.n_items, np.float32)
+    np.add.at(counts, blocked.item[blocked.mask > 0], 1)
+    return counts

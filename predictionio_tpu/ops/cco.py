@@ -1,0 +1,309 @@
+"""Correlated Cross-Occurrence (CCO) — the Universal Recommender's core op.
+
+Reference: ActionML's URAlgorithm delegates to Mahout-Samsara
+``SimilarityAnalysis.cooccurrencesIDSs`` (Spark DRM block matmuls of
+``P'ᵀ·A_t`` + Dunning LLR + per-row top-k; SURVEY.md §2 'Universal
+Recommender').  TPU-first re-expression (SURVEY.md §7.5):
+
+- Interactions arrive as dedup'd COO (user, item) pairs per event type.
+- Users are processed in fixed-size blocks: each block densifies to
+  0/1 matrices ``P_b [B, I_p]`` / ``A_b [B, I_t]`` by scatter, then
+  ``C += P_bᵀ @ A_b`` — a bf16×bf16→f32 matmul (exact for 0/1 inputs,
+  full MXU rate).  ``lax.scan`` over blocks keeps it one compiled program.
+- Item columns are processed in tiles; each tile's LLR scores merge into a
+  running per-row top-k (concat + ``lax.top_k``), so the full I_p×I_t count
+  matrix is never materialized.
+- Multi-device: user blocks are sharded over the mesh's ``dp`` axis; the
+  per-tile count matrix is ``psum``'d over ICI before LLR (counts are the
+  only cross-device quantity).
+
+LLR is Dunning's G² exactly as Mahout's ``LogLikelihood.logLikelihoodRatio``
+computes it (entropy formulation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# ---------------------------------------------------------------------------
+# host-side layout
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BlockedInteractions:
+    """COO pairs grouped into fixed-size user blocks, padded to equal length.
+
+    local_u[b, e] is the in-block user row (or 0 with mask 0), item[b, e] the
+    item id.  Block b covers global users [b*block, (b+1)*block).
+    """
+
+    local_u: np.ndarray   # int32 [n_blocks, E]
+    item: np.ndarray      # int32 [n_blocks, E]
+    mask: np.ndarray      # f32   [n_blocks, E]
+    n_users: int
+    n_items: int
+    user_block: int
+
+    @property
+    def n_blocks(self) -> int:
+        return self.local_u.shape[0]
+
+
+def block_interactions(
+    user: np.ndarray,
+    item: np.ndarray,
+    n_users: int,
+    n_items: int,
+    user_block: int = 1024,
+    pad_multiple: int = 8,
+) -> BlockedInteractions:
+    user = np.asarray(user, np.int64)
+    item = np.asarray(item, np.int64)
+    # dedup (user, item) pairs — CCO is binary occurrence
+    if len(user):
+        flat = user * n_items + item
+        flat = np.unique(flat)
+        user, item = (flat // n_items).astype(np.int32), (flat % n_items).astype(np.int32)
+    else:
+        user, item = user.astype(np.int32), item.astype(np.int32)
+    n_blocks = max(math.ceil(n_users / user_block), 1)
+    blk = user // user_block
+    order = np.argsort(blk, kind="stable")
+    user, item, blk = user[order], item[order], blk[order]
+    counts = np.bincount(blk, minlength=n_blocks)
+    width = max(int(counts.max()) if len(user) else 1, 1)
+    width = ((width + pad_multiple - 1) // pad_multiple) * pad_multiple
+    lu = np.zeros((n_blocks, width), np.int32)
+    it = np.zeros((n_blocks, width), np.int32)
+    mk = np.zeros((n_blocks, width), np.float32)
+    start = 0
+    for b in range(n_blocks):
+        c = int(counts[b])
+        sl = slice(start, start + c)
+        lu[b, :c] = user[sl] % user_block
+        it[b, :c] = item[sl]
+        mk[b, :c] = 1.0
+        start += c
+    return BlockedInteractions(lu, it, mk, n_users, n_items, user_block)
+
+
+def interaction_counts(item: np.ndarray, n_items: int) -> np.ndarray:
+    """Distinct-user count per item (column counts for the LLR table)."""
+    return np.bincount(item, minlength=n_items).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# LLR
+# ---------------------------------------------------------------------------
+
+
+def _xlogx(x):
+    return jnp.where(x > 0, x * jnp.log(jnp.maximum(x, 1e-30)), 0.0)
+
+
+def _entropy2(a, b):
+    return _xlogx(a + b) - _xlogx(a) - _xlogx(b)
+
+
+def _entropy4(a, b, c, d):
+    return _xlogx(a + b + c + d) - _xlogx(a) - _xlogx(b) - _xlogx(c) - _xlogx(d)
+
+
+def llr_score(k11, k12, k21, k22):
+    """Dunning G² (Mahout LogLikelihood.logLikelihoodRatio, entropy form):
+    2·(H(row marginals) + H(col marginals) − H(cells)) with H(ks) =
+    xlogx(Σks) − Σxlogx(k)."""
+    row = _entropy2(k11 + k12, k21 + k22)
+    col = _entropy2(k11 + k21, k12 + k22)
+    mat = _entropy4(k11, k12, k21, k22)
+    return jnp.maximum(2.0 * (row + col - mat), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+# ---------------------------------------------------------------------------
+
+
+def _densify(local_u, item_local, mask, block: int, width: int):
+    """0/1 matrix [block, width] from in-block COO (scatter-max)."""
+    m = jnp.zeros((block, width), jnp.float32)
+    vals = mask  # 1.0 for real entries, 0.0 padding (scatter of 0 is harmless)
+    return m.at[local_u, item_local].max(vals)
+
+
+def _cooccurrence_tile(
+    p_lu, p_it, p_mk,        # primary blocks [n_blocks, E_p]
+    a_lu, a_it, a_mk,        # other blocks   [n_blocks, E_a]
+    block: int,
+    n_items_p: int,
+    tile_start,
+    tile: int,
+    axis_name: Optional[str] = None,
+):
+    """C_tile [I_p, tile] = Σ_blocks P_bᵀ A_b[:, tile_start:tile_start+tile]."""
+
+    def body(carry, xs):
+        plu, pit, pmk, alu, ait, amk = xs
+        pb = _densify(plu, pit, pmk, block, n_items_p)
+        a_local = ait - tile_start
+        in_tile = (a_local >= 0) & (a_local < tile)
+        ab = _densify(alu, jnp.where(in_tile, a_local, 0), amk * in_tile, block, tile)
+        # bf16 inputs, f32 accumulation: exact for 0/1 values, MXU rate.
+        c = jax.lax.dot_general(
+            pb.astype(jnp.bfloat16), ab.astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return carry + c, None
+
+    init = jnp.zeros((n_items_p, tile), jnp.float32)
+    if axis_name is not None:
+        # under shard_map the carry varies per dp shard
+        init = jax.lax.pvary(init, (axis_name,))
+    out, _ = jax.lax.scan(body, init, (p_lu, p_it, p_mk, a_lu, a_it, a_mk))
+    return out
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block", "n_items_p", "tile", "top_k", "axis_name"),
+)
+def _cco_tile_step(
+    p_lu, p_it, p_mk, a_lu, a_it, a_mk,
+    row_counts, col_counts, n_total,
+    best_scores, best_idx,
+    tile_start,
+    block: int, n_items_p: int, tile: int, top_k: int,
+    llr_threshold: float,
+    axis_name: Optional[str] = None,
+):
+    """Process one item tile: cooccurrence counts → LLR → merge into top-k."""
+    c = _cooccurrence_tile(
+        p_lu, p_it, p_mk, a_lu, a_it, a_mk, block, n_items_p, tile_start, tile, axis_name
+    )
+    if axis_name is not None:
+        c = jax.lax.psum(c, axis_name)
+    k11 = c                                            # users doing both
+    k12 = row_counts[:, None] - c                      # primary-only
+    k21 = jax.lax.dynamic_slice_in_dim(col_counts, tile_start, tile)[None, :] - c
+    k22 = n_total - k11 - k12 - k21
+    scores = llr_score(k11, k12, k21, k22)
+    scores = jnp.where(c > 0, scores, -jnp.inf)        # no cooccurrence → no indicator
+    scores = jnp.where(scores >= llr_threshold, scores, -jnp.inf)
+    # self-pairs excluded by the caller via diagonal masking when P == A
+    tile_idx = tile_start + jnp.arange(tile, dtype=jnp.int32)[None, :]
+    all_scores = jnp.concatenate([best_scores, scores], axis=1)
+    all_idx = jnp.concatenate([best_idx, jnp.broadcast_to(tile_idx, scores.shape)], axis=1)
+    new_scores, pos = jax.lax.top_k(all_scores, top_k)
+    new_idx = jnp.take_along_axis(all_idx, pos, axis=1)
+    return new_scores, new_idx
+
+
+def cco_indicators(
+    primary: BlockedInteractions,
+    other: BlockedInteractions,
+    primary_item_counts: np.ndarray,
+    other_item_counts: np.ndarray,
+    n_total_users: int,
+    top_k: int = 50,
+    llr_threshold: float = 0.0,
+    item_tile: int = 4096,
+    mesh: Optional[Mesh] = None,
+    exclude_self: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Compute per-primary-item indicator lists against ``other``'s items.
+
+    Returns ``(scores [I_p, top_k], indices [I_p, top_k])``; entries with
+    score == -inf are padding (fewer than top_k significant correlators).
+    ``exclude_self=True`` masks the diagonal (self-similarity) when primary
+    and other are the same event type.
+    """
+    if primary.n_blocks != other.n_blocks or primary.user_block != other.user_block:
+        raise ValueError("primary/other must be blocked with the same user layout")
+    n_items_p, n_items_t = primary.n_items, other.n_items
+    tile = min(item_tile, max(n_items_t, 1))
+    n_tiles = math.ceil(n_items_t / tile)
+    padded_items_t = n_tiles * tile
+    col_counts = np.zeros(padded_items_t, np.float32)
+    col_counts[:n_items_t] = other_item_counts
+    row_counts = jnp.asarray(primary_item_counts, jnp.float32)
+    col_counts = jnp.asarray(col_counts)
+
+    best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)
+    best_idx = jnp.zeros((n_items_p, top_k), jnp.int32)
+
+    if mesh is None:
+        args = (
+            jnp.asarray(primary.local_u), jnp.asarray(primary.item), jnp.asarray(primary.mask),
+            jnp.asarray(other.local_u), jnp.asarray(other.item), jnp.asarray(other.mask),
+        )
+        for t in range(n_tiles):
+            best_scores, best_idx = _cco_tile_step(
+                *args, row_counts, col_counts, float(n_total_users),
+                best_scores, best_idx, t * tile,
+                block=primary.user_block, n_items_p=n_items_p,
+                tile=tile, top_k=top_k, llr_threshold=llr_threshold,
+            )
+    else:
+        dp = mesh.shape["dp"]
+        nb = primary.n_blocks
+        pad_blocks = (-nb) % dp
+
+        def pad(a):
+            if pad_blocks == 0:
+                return a
+            return np.concatenate([a, np.zeros((pad_blocks, *a.shape[1:]), a.dtype)])
+
+        spec = P("dp")
+        rep = P()
+        shard = NamedSharding(mesh, spec)
+        args = tuple(
+            jax.device_put(pad(np.asarray(a)), shard)
+            for a in (
+                primary.local_u, primary.item, primary.mask,
+                other.local_u, other.item, other.mask,
+            )
+        )
+
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(spec,) * 6 + (rep,) * 4 + (rep,),
+            out_specs=(rep, rep),
+        )
+        def tile_step_sharded(plu, pit, pmk, alu, ait, amk, rc, cc, bs, bi, ts):
+            return _cco_tile_step(
+                plu, pit, pmk, alu, ait, amk, rc, cc, float(n_total_users),
+                bs, bi, ts,
+                block=primary.user_block, n_items_p=n_items_p,
+                tile=tile, top_k=top_k, llr_threshold=llr_threshold,
+                axis_name="dp",
+            )
+
+        for t in range(n_tiles):
+            best_scores, best_idx = tile_step_sharded(
+                *args, row_counts, col_counts, best_scores, best_idx,
+                jnp.int32(t * tile),
+            )
+
+    scores = np.asarray(best_scores)
+    idx = np.asarray(best_idx)
+    if exclude_self:
+        self_mask = idx == np.arange(n_items_p)[:, None]
+        scores = np.where(self_mask, -np.inf, scores)
+    # drop padded item columns that slipped in with -inf already; re-sort after masking
+    order = np.argsort(-scores, axis=1, kind="stable")
+    scores = np.take_along_axis(scores, order, axis=1)
+    idx = np.take_along_axis(idx, order, axis=1)
+    valid = scores > -np.inf
+    idx = np.where(valid, idx, -1)
+    return scores, idx

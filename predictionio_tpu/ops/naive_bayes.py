@@ -1,0 +1,90 @@
+"""Naive Bayes classifiers — closed-form, one pass of segment sums.
+
+Reference analogues: MLlib ``NaiveBayes`` (Classification template option)
+and e2's ``CategoricalNaiveBayes`` (e2/.../engine/ — SURVEY.md §2).  Both are
+count aggregations: on TPU they reduce to ``segment_sum`` over the class id,
+no iterations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class GaussianNBModel:
+    class_log_prior: np.ndarray  # [C]
+    mean: np.ndarray             # [C, d]
+    var: np.ndarray              # [C, d]
+
+
+def gaussian_nb_train(x: np.ndarray, y: np.ndarray, n_classes: int, eps: float = 1e-6) -> GaussianNBModel:
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.int32)
+
+    @jax.jit
+    def fit(x, y):
+        ones = jnp.ones_like(y, jnp.float32)
+        counts = jax.ops.segment_sum(ones, y, num_segments=n_classes)
+        sums = jax.ops.segment_sum(x, y, num_segments=n_classes)
+        sq = jax.ops.segment_sum(x * x, y, num_segments=n_classes)
+        denom = jnp.maximum(counts, 1.0)[:, None]
+        mean = sums / denom
+        var = sq / denom - mean * mean + eps
+        prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
+        return prior, mean, var
+
+    prior, mean, var = fit(x, y)
+    return GaussianNBModel(np.asarray(prior), np.asarray(mean), np.asarray(var))
+
+
+@jax.jit
+def _gaussian_nb_scores(prior, mean, var, x):
+    # log N(x | mean, var) summed over features, per class
+    x = x[:, None, :]  # [n, 1, d]
+    ll = -0.5 * (jnp.log(2 * jnp.pi * var) + (x - mean) ** 2 / var)
+    return prior + ll.sum(-1)  # [n, C]
+
+
+def gaussian_nb_predict(model: GaussianNBModel, x: np.ndarray) -> np.ndarray:
+    scores = _gaussian_nb_scores(
+        jnp.asarray(model.class_log_prior), jnp.asarray(model.mean),
+        jnp.asarray(model.var), jnp.asarray(x, jnp.float32),
+    )
+    return np.asarray(jnp.argmax(scores, axis=-1))
+
+
+@dataclass
+class MultinomialNBModel:
+    class_log_prior: np.ndarray   # [C]
+    feature_log_prob: np.ndarray  # [C, d]
+
+
+def multinomial_nb_train(
+    x: np.ndarray, y: np.ndarray, n_classes: int, alpha: float = 1.0
+) -> MultinomialNBModel:
+    """x holds non-negative counts (e.g. token counts / tf-idf)."""
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.int32)
+
+    @jax.jit
+    def fit(x, y):
+        ones = jnp.ones_like(y, jnp.float32)
+        counts = jax.ops.segment_sum(ones, y, num_segments=n_classes)
+        feat = jax.ops.segment_sum(x, y, num_segments=n_classes) + alpha
+        log_prob = jnp.log(feat) - jnp.log(feat.sum(-1, keepdims=True))
+        prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
+        return prior, log_prob
+
+    prior, log_prob = fit(x, y)
+    return MultinomialNBModel(np.asarray(prior), np.asarray(log_prob))
+
+
+def multinomial_nb_predict(model: MultinomialNBModel, x: np.ndarray) -> np.ndarray:
+    scores = jnp.asarray(model.class_log_prior) + jnp.asarray(x, jnp.float32) @ jnp.asarray(model.feature_log_prob).T
+    return np.asarray(jnp.argmax(scores, axis=-1))

@@ -1,0 +1,134 @@
+"""CCO op tests: cooccurrence counts, LLR correctness vs a naive reference,
+tile streaming, and mesh parity."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.cco import (
+    block_interactions,
+    cco_indicators,
+    interaction_counts,
+    llr_score,
+)
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+
+
+def naive_llr(k11, k12, k21, k22):
+    def xlogx(x):
+        return x * np.log(x) if x > 0 else 0.0
+
+    def ent(*ks):
+        return xlogx(sum(ks)) - sum(xlogx(k) for k in ks)
+
+    return max(2.0 * (ent(k11 + k12, k21 + k22) + 0 - 0 + ent(k11 + k21, k12 + k22) - ent(k11, k12, k21, k22)), 0.0)
+
+
+def naive_cco(pu, pi, ou, oi, n_users, n_ip, n_it):
+    P = np.zeros((n_users, n_ip))
+    A = np.zeros((n_users, n_it))
+    P[pu, pi] = 1
+    A[ou, oi] = 1
+    C = P.T @ A
+    row = P.sum(0)
+    col = A.sum(0)
+    llr = np.zeros_like(C)
+    for i in range(n_ip):
+        for j in range(n_it):
+            k11 = C[i, j]
+            k12 = row[i] - k11
+            k21 = col[j] - k11
+            k22 = n_users - k11 - k12 - k21
+            llr[i, j] = naive_llr(k11, k12, k21, k22) if k11 > 0 else -np.inf
+    return C, llr
+
+
+def random_interactions(n_users, n_items, n_events, seed):
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n_users, n_events).astype(np.int32)
+    i = rng.integers(0, n_items, n_events).astype(np.int32)
+    return u, i
+
+
+def test_llr_matches_naive_formula():
+    import jax.numpy as jnp
+
+    cases = [(10, 5, 3, 100), (1, 0, 0, 50), (7, 7, 7, 7), (0, 3, 4, 10)]
+    for k in cases:
+        got = float(llr_score(*map(jnp.float32, k)))
+        want = naive_llr(*k)
+        assert abs(got - want) < 1e-3, (k, got, want)
+
+
+@pytest.mark.parametrize("user_block,item_tile", [(64, 64), (16, 8), (1024, 4096)])
+def test_cco_matches_naive(user_block, item_tile):
+    n_users, n_ip, n_it = 50, 20, 15
+    pu, pi = random_interactions(n_users, n_ip, 300, 1)
+    ou, oi = random_interactions(n_users, n_it, 400, 2)
+    # dedup for the naive side
+    C, llr = naive_cco(pu, pi, ou, oi, n_users, n_ip, n_it)
+
+    p = block_interactions(pu, pi, n_users, n_ip, user_block=user_block)
+    o = block_interactions(ou, oi, n_users, n_it, user_block=user_block)
+    # distinct-user counts from dedup'd blocked data
+    rc = np.zeros(n_ip, np.float32)
+    np.add.at(rc, p.item[p.mask > 0], 1)
+    cc = np.zeros(n_it, np.float32)
+    np.add.at(cc, o.item[o.mask > 0], 1)
+    assert np.allclose(rc, C.sum(1) * 0 + (np.zeros((n_users, n_ip)) + _dense(pu, pi, n_users, n_ip)).sum(0))
+
+    scores, idx = cco_indicators(p, o, rc, cc, n_users, top_k=n_it, item_tile=item_tile)
+    for i in range(n_ip):
+        got = {int(j): float(s) for s, j in zip(scores[i], idx[i]) if j >= 0}
+        want = {j: llr[i, j] for j in range(n_it) if np.isfinite(llr[i, j]) and llr[i, j] >= 0}
+        assert set(got) == set(want), (i, got, want)
+        for j, s in got.items():
+            assert abs(s - want[j]) < 1e-2, (i, j, s, want[j])
+
+
+def _dense(u, i, n_users, n_items):
+    M = np.zeros((n_users, n_items))
+    M[u, i] = 1
+    return M
+
+
+def test_cco_top_k_and_threshold():
+    n_users, n_ip, n_it = 40, 10, 12
+    pu, pi = random_interactions(n_users, n_ip, 200, 3)
+    ou, oi = random_interactions(n_users, n_it, 250, 4)
+    p = block_interactions(pu, pi, n_users, n_ip)
+    o = block_interactions(ou, oi, n_users, n_it)
+    rc = _dense(pu, pi, n_users, n_ip).sum(0).astype(np.float32)
+    cc = _dense(ou, oi, n_users, n_it).sum(0).astype(np.float32)
+    scores, idx = cco_indicators(p, o, rc, cc, n_users, top_k=3)
+    assert scores.shape == (n_ip, 3)
+    # scores sorted descending per row
+    finite = np.where(np.isfinite(scores), scores, -1e30)
+    assert (np.diff(finite, axis=1) <= 1e-6).all()
+    # high threshold kills everything
+    s2, i2 = cco_indicators(p, o, rc, cc, n_users, top_k=3, llr_threshold=1e9)
+    assert (i2 == -1).all()
+
+
+def test_cco_exclude_self():
+    n_users, n_items = 30, 8
+    u, i = random_interactions(n_users, n_items, 150, 5)
+    b = block_interactions(u, i, n_users, n_items)
+    counts = _dense(u, i, n_users, n_items).sum(0).astype(np.float32)
+    scores, idx = cco_indicators(b, b, counts, counts, n_users, top_k=4, exclude_self=True)
+    for row in range(n_items):
+        assert row not in idx[row][idx[row] >= 0]
+
+
+def test_cco_mesh_matches_single():
+    n_users, n_ip, n_it = 64, 12, 10
+    pu, pi = random_interactions(n_users, n_ip, 300, 6)
+    ou, oi = random_interactions(n_users, n_it, 300, 7)
+    p = block_interactions(pu, pi, n_users, n_ip, user_block=8)
+    o = block_interactions(ou, oi, n_users, n_it, user_block=8)
+    rc = _dense(pu, pi, n_users, n_ip).sum(0).astype(np.float32)
+    cc = _dense(ou, oi, n_users, n_it).sum(0).astype(np.float32)
+    s1, i1 = cco_indicators(p, o, rc, cc, n_users, top_k=5)
+    mesh = create_mesh(MeshSpec(dp=8, mp=1))
+    s8, i8 = cco_indicators(p, o, rc, cc, n_users, top_k=5, mesh=mesh)
+    assert np.allclose(np.where(np.isfinite(s1), s1, -1), np.where(np.isfinite(s8), s8, -1), atol=1e-3)
+    assert (i1 == i8).all()

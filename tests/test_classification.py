@@ -1,0 +1,110 @@
+"""Classification template tests: logreg + naive bayes over $set-aggregated
+entity properties, eval folds, and the dp-sharded logreg path."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.controller.evaluation import AverageMetric, MetricEvaluator
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.classification import (
+    ClassificationEngine,
+    ClassificationQuery,
+)
+from predictionio_tpu.models.classification.engine import (
+    ClassificationDSParams,
+    LogRegParams,
+    NaiveBayesParams,
+)
+from predictionio_tpu.storage import App
+
+
+def seed_labeled_app(storage, n=120, seed=0):
+    """Two gaussian blobs in 3-D => linearly separable labels."""
+    app_id = storage.apps.insert(App(0, "clfapp"))
+    rng = np.random.default_rng(seed)
+    events = []
+    for j in range(n):
+        label = j % 2
+        center = np.array([2.0, 2.0, 2.0]) if label else np.array([-2.0, -2.0, -2.0])
+        v = center + rng.normal(size=3)
+        events.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{j}",
+            properties=DataMap({
+                "attr0": float(v[0]), "attr1": float(v[1]), "attr2": float(v[2]),
+                "label": "pos" if label else "neg",
+            })))
+    storage.l_events.insert_batch(events, app_id)
+    return storage
+
+
+@pytest.fixture()
+def clf_app(mem_storage):
+    return seed_labeled_app(mem_storage)
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("logreg", LogRegParams(iterations=50, mesh_dp=1)),
+    ("logreg", LogRegParams(iterations=60, optimizer="adam", learning_rate=0.3, mesh_dp=1)),
+    ("naivebayes", NaiveBayesParams(model_type="gaussian")),
+])
+def test_classification_train_predict(clf_app, algo, params):
+    engine = ClassificationEngine.apply()
+    ep = EngineParams(
+        data_source_params=ClassificationDSParams(app_name="clfapp"),
+        algorithm_params_list=[(algo, params)],
+    )
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    assert predict(ClassificationQuery({"attr0": 3, "attr1": 2, "attr2": 2})).label == "pos"
+    assert predict(ClassificationQuery({"attr0": -3, "attr1": -2, "attr2": -2})).label == "neg"
+
+
+def test_logreg_mesh_sharded(clf_app):
+    engine = ClassificationEngine.apply()
+    ep = EngineParams(
+        data_source_params=ClassificationDSParams(app_name="clfapp"),
+        algorithm_params_list=[("logreg", LogRegParams(iterations=40, mesh_dp=8))],
+    )
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    assert predict(ClassificationQuery({"attr0": 3, "attr1": 3, "attr2": 3})).label == "pos"
+
+
+def test_multinomial_nb():
+    from predictionio_tpu.ops.naive_bayes import multinomial_nb_predict, multinomial_nb_train
+
+    x = np.array([[5, 0, 1], [4, 1, 0], [0, 5, 2], [1, 4, 3]], np.float32)
+    y = np.array([0, 0, 1, 1], np.int32)
+    model = multinomial_nb_train(x, y, 2)
+    assert multinomial_nb_predict(model, np.array([[6, 0, 1]], np.float32))[0] == 0
+    assert multinomial_nb_predict(model, np.array([[0, 6, 2]], np.float32))[0] == 1
+
+
+class Accuracy(AverageMetric):
+    def score_one(self, q, p, a):
+        return 1.0 if p.label == a else 0.0
+
+
+def test_eval_picks_better_hyperparams(clf_app):
+    engine = ClassificationEngine.apply()
+    candidates = [
+        EngineParams(
+            data_source_params=ClassificationDSParams(app_name="clfapp", eval_k=3),
+            algorithm_params_list=[("logreg", LogRegParams(iterations=it, mesh_dp=1))],
+        )
+        for it in (1, 50)
+    ]
+    result = MetricEvaluator(Accuracy()).evaluate(engine, candidates)
+    assert result.best_score > 0.9
+
+
+def test_missing_label_raises(mem_storage):
+    mem_storage.apps.insert(App(0, "emptyclf"))
+    engine = ClassificationEngine.apply()
+    ep = EngineParams(
+        data_source_params=ClassificationDSParams(app_name="emptyclf"),
+        algorithm_params_list=[("logreg", LogRegParams(mesh_dp=1))],
+    )
+    with pytest.raises(ValueError, match="no labeled"):
+        engine.train(ep)

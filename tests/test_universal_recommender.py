@@ -1,0 +1,169 @@
+"""Universal Recommender template tests: multi-event CCO train, user/item
+queries, business rules, blacklist, popularity fallback."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.universal_recommender import (
+    UniversalRecommenderEngine,
+    URQuery,
+)
+from predictionio_tpu.models.universal_recommender.engine import (
+    URAlgorithmParams,
+    URDataSourceParams,
+)
+from predictionio_tpu.storage import App
+
+
+@pytest.fixture()
+def ur_app(mem_storage):
+    """Synthetic 2-cluster commerce data: electronics fans (u0-u14) buy/view
+    e-items; book fans (u15-u29) buy/view b-items.  Plus item category
+    properties for business-rule tests."""
+    app_id = mem_storage.apps.insert(App(0, "urapp"))
+    rng = np.random.default_rng(11)
+    events = []
+    e_items = [f"e{i}" for i in range(6)]
+    b_items = [f"b{i}" for i in range(6)]
+    for u in range(30):
+        mine, other = (e_items, b_items) if u < 15 else (b_items, e_items)
+        for it in mine:
+            if rng.random() < 0.7:
+                events.append(Event(event="purchase", entity_type="user",
+                                    entity_id=f"u{u}", target_entity_type="item",
+                                    target_entity_id=it))
+            if rng.random() < 0.9:
+                events.append(Event(event="view", entity_type="user",
+                                    entity_id=f"u{u}", target_entity_type="item",
+                                    target_entity_id=it))
+        # a little cross-cluster noise (odd users only, so the even probe
+        # users u2/u20 have clean in-cluster histories)
+        if u % 2 == 1 and rng.random() < 0.4:
+            events.append(Event(event="view", entity_type="user",
+                                entity_id=f"u{u}", target_entity_type="item",
+                                target_entity_id=other[0]))
+    for it in e_items:
+        events.append(Event(event="$set", entity_type="item", entity_id=it,
+                            properties=DataMap({"category": "electronics"})))
+    for it in b_items:
+        events.append(Event(event="$set", entity_type="item", entity_id=it,
+                            properties=DataMap({"category": "books"})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+def make_ep(**algo_over):
+    algo = dict(app_name="urapp", mesh_dp=1, max_correlators_per_item=8,
+                min_llr=2.0)
+    algo.update(algo_over)
+    return EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="urapp", event_names=["purchase", "view"]
+        ),
+        algorithm_params_list=[("ur", URAlgorithmParams(**algo))],
+    )
+
+
+@pytest.fixture()
+def trained(ur_app):
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    return engine, ep, models
+
+
+def test_user_recs_stay_in_cluster(trained):
+    """In-cluster items must dominate: weak cross-cluster associations from
+    the noise views are legitimate CCO output, but their scores must be far
+    below the in-cluster scores."""
+    engine, ep, models = trained
+    predict = engine.predictor(ep, models)
+    for user, prefix in (("u2", "e"), ("u20", "b")):
+        res = predict(URQuery(user=user, num=4))
+        assert res.item_scores, f"expected recommendations for {user}"
+        assert res.item_scores[0].item.startswith(prefix), res.item_scores
+        in_cluster = [s.score for s in res.item_scores if s.item.startswith(prefix)]
+        out_cluster = [s.score for s in res.item_scores if not s.item.startswith(prefix)]
+        assert in_cluster, res.item_scores
+        if out_cluster:
+            assert max(in_cluster) >= 5 * max(out_cluster), res.item_scores
+
+
+def test_user_recs_exclude_purchased(trained):
+    engine, ep, models = trained
+    predict = engine.predictor(ep, models)
+    model = models[0]
+    uid = model.user_dict.id("u2")
+    purchased = {model.item_dict.str(int(j)) for j in model.user_seen.get(uid, [])}
+    res = predict(URQuery(user="u2", num=6))
+    assert purchased.isdisjoint({s.item for s in res.item_scores})
+
+
+def test_item_similarity_query(trained):
+    engine, ep, models = trained
+    predict = engine.predictor(ep, models)
+    res = predict(URQuery(item="e1", num=3))
+    assert res.item_scores and all(s.item.startswith("e") for s in res.item_scores)
+    assert "e1" not in [s.item for s in res.item_scores]  # returnSelf default false
+
+
+def test_unknown_user_gets_popularity_fallback(trained):
+    engine, ep, models = trained
+    predict = engine.predictor(ep, models)
+    res = predict(URQuery(user="stranger", num=5))
+    assert len(res.item_scores) == 5
+    pop = models[0].popularity
+    top_pop = models[0].item_dict.str(int(np.argmax(pop)))
+    assert res.item_scores[0].item == top_pop
+
+
+def test_field_filter_and_boost(trained):
+    engine, ep, models = trained
+    predict = engine.predictor(ep, models)
+    res = predict(URQuery(user="u2", num=6, fields=[
+        {"name": "category", "values": ["books"], "bias": -1}]))
+    # electronics user hard-filtered to books: only book recs (may be empty
+    # but any result must be books)
+    assert all(s.item.startswith("b") for s in res.item_scores)
+    res2 = predict(URQuery(user="stranger", num=6, fields=[
+        {"name": "category", "values": ["books"], "bias": -1}]))
+    assert res2.item_scores and all(s.item.startswith("b") for s in res2.item_scores)
+
+
+def test_blacklist_items(trained):
+    engine, ep, models = trained
+    predict = engine.predictor(ep, models)
+    # pick any user who has at least one recommendation (a user may have
+    # purchased every in-cluster item, leaving nothing above threshold)
+    user, base = None, None
+    for u in range(30):
+        r = predict(URQuery(user=f"u{u}", num=3))
+        if r.item_scores:
+            user, base = f"u{u}", r
+            break
+    assert base is not None, "no user with recommendations"
+    banned = base.item_scores[0].item
+    res = predict(URQuery(user=user, num=3, blacklist_items=[banned]))
+    assert banned not in [s.item for s in res.item_scores]
+
+
+def test_query_json_roundtrip():
+    q = URQuery.from_json({
+        "user": "u1", "num": 7,
+        "fields": [{"name": "category", "values": ["books"], "bias": -1}],
+        "blacklistItems": ["i1"],
+    })
+    assert q.user == "u1" and q.num == 7
+    assert q.fields[0].bias == -1 and q.blacklist_items == ["i1"]
+
+
+def test_ur_mesh_training_matches(ur_app):
+    engine = UniversalRecommenderEngine.apply()
+    models1 = engine.train(make_ep(mesh_dp=1))
+    models8 = engine.train(make_ep(mesh_dp=8, user_block=8))
+    m1, m8 = models1[0], models8[0]
+    for name in m1.indicator_idx:
+        assert (m1.indicator_idx[name] == m8.indicator_idx[name]).all()
+        assert np.allclose(m1.indicator_llr[name], m8.indicator_llr[name], atol=1e-3)
