@@ -1,0 +1,4 @@
+from predictionio_tpu.models.similar_product.engine import (  # noqa: F401
+    SimilarProductEngine,
+    SimilarProductQuery,
+)

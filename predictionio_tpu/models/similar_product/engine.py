@@ -1,0 +1,284 @@
+"""Similar-Product engine template.
+
+Capability parity with the reference Similar Product template (template repo;
+SURVEY.md §2 'Similar-Product': item-item similarity from view events via
+ALS item factors, with category/white/black-list filters) and its
+cooccurrence variant.
+
+Wire format (reference template):
+  query    {"items": ["i1", "i2"], "num": 4,
+            "categories": ["c"], "whiteList": [...], "blackList": [...]}
+  response {"itemScores": [{"item": "i5", "score": 0.9}, ...]}
+
+Algorithms:
+- "als":          implicit-feedback ALS on (user, item) views; similarity =
+                  cosine over item factors, computed as one jitted matmul.
+- "cooccurrence": LLR item-item cooccurrence via ops.cco (exclude_self).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    PersistentModel,
+    Preparator,
+)
+from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
+from predictionio_tpu.ops import als as als_ops
+from predictionio_tpu.ops import cco as cco_ops
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.store.event_store import PEventStore
+
+
+@dataclasses.dataclass
+class SimilarProductQuery:
+    items: List[str]
+    num: int = 10
+    categories: Optional[List[str]] = None
+    white_list: Optional[List[str]] = None
+    black_list: Optional[List[str]] = None
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "SimilarProductQuery":
+        return cls(
+            items=[str(i) for i in d["items"]],
+            num=int(d.get("num", 10)),
+            categories=[str(c) for c in d["categories"]] if d.get("categories") else None,
+            white_list=[str(i) for i in d["whiteList"]] if d.get("whiteList") else None,
+            black_list=[str(i) for i in d["blackList"]] if d.get("blackList") else None,
+        )
+
+
+@dataclasses.dataclass
+class SPDataSourceParams(Params):
+    app_name: str = "default"
+    event_names: List[str] = dataclasses.field(default_factory=lambda: ["view"])
+    item_entity_type: str = "item"
+
+
+@dataclasses.dataclass
+class SPTrainingData:
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    user_dict: IdDict
+    item_dict: IdDict
+    item_categories: Dict[str, List[str]]
+
+
+class SPDataSource(DataSource):
+    params_class = SPDataSourceParams
+
+    def read_training(self) -> SPTrainingData:
+        user_dict, item_dict = IdDict(), IdDict()
+        users, items = [], []
+        for e in PEventStore.find(self.params.app_name, event_names=list(self.params.event_names)):
+            if e.target_entity_id is None:
+                continue
+            users.append(user_dict.add(e.entity_id))
+            items.append(item_dict.add(e.target_entity_id))
+        props = PEventStore.aggregate_properties(
+            self.params.app_name, self.params.item_entity_type
+        )
+        cats = {}
+        for item, pm in props.items():
+            v = pm.get("categories")
+            if v is not None:
+                cats[item] = [str(c) for c in (v if isinstance(v, list) else [v])]
+        return SPTrainingData(
+            user_idx=np.asarray(users, np.int32),
+            item_idx=np.asarray(items, np.int32),
+            user_dict=user_dict,
+            item_dict=item_dict,
+            item_categories=cats,
+        )
+
+
+class SPPreparator(Preparator):
+    def prepare(self, td: SPTrainingData) -> SPTrainingData:
+        return td
+
+
+class SPModel(PersistentModel):
+    """Either item factors (als) or an indicator table (cooccurrence);
+    scoring normalizes both to an item->similar-items lookup."""
+
+    def __init__(self, kind, item_dict, item_categories,
+                 item_factors=None, indicator_idx=None, indicator_llr=None):
+        self.kind = kind
+        self.item_dict = item_dict
+        self.item_categories = item_categories
+        self.item_factors = item_factors
+        self.indicator_idx = indicator_idx
+        self.indicator_llr = indicator_llr
+
+    def __getstate__(self):
+        return {
+            "kind": self.kind, "items": self.item_dict.to_state(),
+            "cats": self.item_categories, "factors": self.item_factors,
+            "idx": self.indicator_idx, "llr": self.indicator_llr,
+        }
+
+    def __setstate__(self, s):
+        self.kind = s["kind"]
+        self.item_dict = IdDict.from_state(s["items"])
+        self.item_categories = s["cats"]
+        self.item_factors = s["factors"]
+        self.indicator_idx = s["idx"]
+        self.indicator_llr = s["llr"]
+
+
+@partial(jax.jit, static_argnames=())
+def _cosine_scores(factors: jnp.ndarray, query_vec: jnp.ndarray) -> jnp.ndarray:
+    norms = jnp.linalg.norm(factors, axis=1) * jnp.maximum(jnp.linalg.norm(query_vec), 1e-8)
+    return (factors @ query_vec) / jnp.maximum(norms, 1e-8)
+
+
+@dataclasses.dataclass
+class SPALSParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    seed: int = 7
+    mesh_dp: int = 0
+
+
+class SPALSAlgorithm(Algorithm):
+    params_class = SPALSParams
+
+    def train(self, td: SPTrainingData) -> SPModel:
+        n_users, n_items = len(td.user_dict), len(td.item_dict)
+        if n_items == 0:
+            return SPModel("als", td.item_dict, td.item_categories,
+                           item_factors=np.zeros((0, self.params.rank), np.float32))
+        dp = self.params.mesh_dp or len(jax.devices())
+        mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
+        # implicit feedback: every view is preference 1.0
+        rating = np.ones(len(td.user_idx), np.float32)
+        data = als_ops.prepare_als_data(
+            td.user_idx, td.item_idx, rating, n_users, n_items, dp=dp
+        )
+        _, Y = als_ops.als_train(
+            data, k=self.params.rank, reg=self.params.lambda_,
+            iterations=self.params.num_iterations, mesh=mesh, seed=self.params.seed,
+        )
+        return SPModel("als", td.item_dict, td.item_categories, item_factors=Y)
+
+    def predict(self, model: SPModel, query: SimilarProductQuery) -> PredictedResult:
+        return _sp_predict(model, query)
+
+
+@dataclasses.dataclass
+class SPCooccurrenceParams(Params):
+    max_correlators_per_item: int = 50
+    min_llr: float = 0.0
+    user_block: int = 1024
+    item_tile: int = 4096
+    mesh_dp: int = 0
+
+
+class SPCooccurrenceAlgorithm(Algorithm):
+    params_class = SPCooccurrenceParams
+
+    def train(self, td: SPTrainingData) -> SPModel:
+        n_users, n_items = len(td.user_dict), len(td.item_dict)
+        if n_items == 0:
+            return SPModel("cooccurrence", td.item_dict, td.item_categories,
+                           indicator_idx=np.zeros((0, 1), np.int32),
+                           indicator_llr=np.zeros((0, 1), np.float32))
+        dp = self.params.mesh_dp or len(jax.devices())
+        mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
+        blocked = cco_ops.block_interactions(
+            td.user_idx, td.item_idx, n_users, n_items,
+            user_block=self.params.user_block,
+        )
+        counts = np.zeros(n_items, np.float32)
+        np.add.at(counts, blocked.item[blocked.mask > 0], 1)
+        scores, idx = cco_ops.cco_indicators(
+            blocked, blocked, counts, counts, n_users,
+            top_k=self.params.max_correlators_per_item,
+            llr_threshold=self.params.min_llr,
+            item_tile=self.params.item_tile,
+            mesh=mesh, exclude_self=True,
+        )
+        return SPModel(
+            "cooccurrence", td.item_dict, td.item_categories,
+            indicator_idx=idx.astype(np.int32),
+            indicator_llr=np.where(np.isfinite(scores), scores, 0.0).astype(np.float32),
+        )
+
+    def predict(self, model: SPModel, query: SimilarProductQuery) -> PredictedResult:
+        return _sp_predict(model, query)
+
+
+def _sp_predict(model: SPModel, query: SimilarProductQuery) -> PredictedResult:
+    n_items = len(model.item_dict)
+    if n_items == 0:
+        return PredictedResult([])
+    qids = [model.item_dict.id(i) for i in query.items]
+    qids = [q for q in qids if q is not None]
+    if not qids:
+        return PredictedResult([])
+    if model.kind == "als":
+        qvec = model.item_factors[np.asarray(qids)].mean(axis=0)
+        scores = np.array(_cosine_scores(jnp.asarray(model.item_factors), jnp.asarray(qvec)))
+    else:
+        scores = np.zeros(n_items, np.float32)
+        for q in qids:
+            for k_, j in enumerate(model.indicator_idx[q]):
+                if j >= 0:
+                    scores[j] += model.indicator_llr[q, k_]
+    for q in qids:  # never recommend the query items themselves
+        scores[q] = -np.inf
+    if query.categories:
+        want = set(query.categories)
+        for j in range(n_items):
+            cats = model.item_categories.get(model.item_dict.str(j), [])
+            if not want.intersection(cats):
+                scores[j] = -np.inf
+    if query.white_list:
+        allowed = {model.item_dict.id(i) for i in query.white_list}
+        for j in range(n_items):
+            if j not in allowed:
+                scores[j] = -np.inf
+    if query.black_list:
+        for b in query.black_list:
+            bid = model.item_dict.id(b)
+            if bid is not None:
+                scores[bid] = -np.inf
+    num = min(query.num, n_items)
+    top = np.argpartition(-np.nan_to_num(scores, neginf=-1e30), min(num, n_items - 1))[:num]
+    top = top[np.argsort(-scores[top], kind="stable")]
+    return PredictedResult(
+        [ItemScore(model.item_dict.str(int(j)), float(scores[j]))
+         for j in top if np.isfinite(scores[j]) and scores[j] > 0]
+    )
+
+
+class SimilarProductEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=SPDataSource,
+            preparator_class=SPPreparator,
+            algorithm_classes={
+                "als": SPALSAlgorithm,
+                "cooccurrence": SPCooccurrenceAlgorithm,
+            },
+            serving_class=FirstServing,
+        )
+
+    query_class = SimilarProductQuery

@@ -1,0 +1,4 @@
+from predictionio_tpu.models.text.engine import (  # noqa: F401
+    TextClassificationEngine,
+    TextQuery,
+)

@@ -1,0 +1,271 @@
+"""Text-classification engine template.
+
+Capability parity with the reference text classification template (tf-idf
+features + MLlib classifier — SURVEY.md §2 'Text classification') plus the
+BASELINE.json config-5 variant (embedding + MLP).
+
+Training events (reference template's convention): one event per document —
+  {"event": "train", "entityType": "content", "entityId": "...",
+   "properties": {"text": "...", "label": "spam"}}
+
+Wire format:
+  query    {"text": "free pills now"}
+  response {"label": "spam", "confidence": 0.93}
+
+Algorithms: "nb" (hashed counts → multinomial NB), "logreg" (hashed tf-idf →
+L-BFGS logreg), "mlp" (embedding-bag MLP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    PersistentModel,
+    Preparator,
+)
+from predictionio_tpu.ops import logreg as lr_ops
+from predictionio_tpu.ops import naive_bayes as nb_ops
+from predictionio_tpu.ops import text as text_ops
+from predictionio_tpu.store.event_store import PEventStore
+
+
+@dataclasses.dataclass
+class TextQuery:
+    text: str
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "TextQuery":
+        return cls(text=str(d["text"]))
+
+
+@dataclasses.dataclass
+class TextPrediction:
+    label: str
+    confidence: float
+
+    def to_json(self) -> Dict:
+        return {"label": self.label, "confidence": self.confidence}
+
+
+@dataclasses.dataclass
+class TextDSParams(Params):
+    app_name: str = "default"
+    event_name: str = "train"
+    entity_type: str = "content"
+    text_field: str = "text"
+    label_field: str = "label"
+    eval_k: int = 0
+    seed: int = 3
+
+
+@dataclasses.dataclass
+class TextTrainingData:
+    texts: List[str]
+    y: np.ndarray
+    labels: List[str]
+
+
+class TextDataSource(DataSource):
+    params_class = TextDSParams
+
+    def read_training(self) -> TextTrainingData:
+        texts: List[str] = []
+        ys: List[int] = []
+        labels: List[str] = []
+        label_of: Dict[str, int] = {}
+        for e in PEventStore.find(
+            self.params.app_name,
+            event_names=[self.params.event_name],
+            entity_type=self.params.entity_type,
+        ):
+            text = e.properties.get(self.params.text_field)
+            label = e.properties.get(self.params.label_field)
+            if text is None or label is None:
+                continue
+            label = str(label)
+            if label not in label_of:
+                label_of[label] = len(labels)
+                labels.append(label)
+            texts.append(str(text))
+            ys.append(label_of[label])
+        if not texts:
+            raise ValueError(
+                f"no {self.params.event_name!r} events with "
+                f"'{self.params.text_field}'/'{self.params.label_field}' properties"
+            )
+        return TextTrainingData(texts=texts, y=np.asarray(ys, np.int32), labels=labels)
+
+    def read_eval(self):
+        data = self.read_training()
+        k = self.params.eval_k
+        if k <= 1:
+            return []
+        rng = np.random.default_rng(self.params.seed)
+        fold_of = rng.integers(0, k, size=len(data.y))
+        folds = []
+        for f in range(k):
+            tr = fold_of != f
+            td = TextTrainingData(
+                [t for t, m in zip(data.texts, tr) if m], data.y[tr], data.labels
+            )
+            qa = [
+                (TextQuery(data.texts[i]), data.labels[int(data.y[i])])
+                for i in np.nonzero(~tr)[0]
+            ]
+            folds.append((td, {"fold": f}, qa))
+        return folds
+
+
+class TextPreparator(Preparator):
+    def prepare(self, td: TextTrainingData) -> TextTrainingData:
+        return td
+
+
+class TextModel(PersistentModel):
+    def __init__(self, kind: str, labels: List[str], dim: int, payload: dict):
+        self.kind = kind
+        self.labels = labels
+        self.dim = dim
+        self.payload = payload
+
+
+@dataclasses.dataclass
+class TextNBParams(Params):
+    dim: int = 4096
+    alpha: float = 1.0
+
+
+class TextNBAlgorithm(Algorithm):
+    params_class = TextNBParams
+
+    def train(self, td: TextTrainingData) -> TextModel:
+        counts = text_ops.hashing_vectorize(td.texts, self.params.dim)
+        inner = nb_ops.multinomial_nb_train(counts, td.y, len(td.labels), self.params.alpha)
+        return TextModel("nb", td.labels, self.params.dim, {"inner": inner})
+
+    def predict(self, model: TextModel, query: TextQuery) -> TextPrediction:
+        counts = text_ops.hashing_vectorize([query.text], model.dim)
+        inner = model.payload["inner"]
+        scores = model.payload["inner"].class_log_prior + counts @ inner.feature_log_prob.T
+        probs = _softmax(scores[0])
+        j = int(np.argmax(probs))
+        return TextPrediction(model.labels[j], float(probs[j]))
+
+    def batch_predict(self, model: TextModel, queries: Sequence[TextQuery]):
+        return [self.predict(model, q) for q in queries]
+
+
+@dataclasses.dataclass
+class TextLogRegParams(Params):
+    dim: int = 4096
+    iterations: int = 60
+    l2: float = 1e-5
+
+
+class TextLogRegAlgorithm(Algorithm):
+    params_class = TextLogRegParams
+
+    def train(self, td: TextTrainingData) -> TextModel:
+        counts = text_ops.hashing_vectorize(td.texts, self.params.dim)
+        x, idf = text_ops.tfidf_transform(counts)
+        w, b = lr_ops.logreg_train(
+            x, td.y, n_classes=len(td.labels),
+            l2=self.params.l2, iterations=self.params.iterations,
+        )
+        return TextModel("logreg", td.labels, self.params.dim, {"w": w, "b": b, "idf": idf})
+
+    def predict(self, model: TextModel, query: TextQuery) -> TextPrediction:
+        counts = text_ops.hashing_vectorize([query.text], model.dim)
+        x, _ = text_ops.tfidf_transform(counts, model.payload["idf"])
+        probs = np.asarray(
+            lr_ops.logreg_predict_proba(model.payload["w"], model.payload["b"], x)
+        )[0]
+        j = int(np.argmax(probs))
+        return TextPrediction(model.labels[j], float(probs[j]))
+
+    def batch_predict(self, model: TextModel, queries: Sequence[TextQuery]):
+        if not queries:
+            return []
+        counts = text_ops.hashing_vectorize([q.text for q in queries], model.dim)
+        x, _ = text_ops.tfidf_transform(counts, model.payload["idf"])
+        probs = np.asarray(lr_ops.logreg_predict_proba(model.payload["w"], model.payload["b"], x))
+        out = []
+        for row in probs:
+            j = int(np.argmax(row))
+            out.append(TextPrediction(model.labels[j], float(row[j])))
+        return out
+
+
+@dataclasses.dataclass
+class TextMLPParams(Params):
+    vocab_size: int = 8192
+    max_len: int = 64
+    embed_dim: int = 32
+    hidden_dim: int = 64
+    iterations: int = 150
+    learning_rate: float = 0.02
+    seed: int = 0
+
+
+class TextMLPAlgorithm(Algorithm):
+    params_class = TextMLPParams
+
+    def train(self, td: TextTrainingData) -> TextModel:
+        p = self.params
+        ids, mask = text_ops.tokens_to_ids(td.texts, p.vocab_size, p.max_len)
+        params = text_ops.mlp_train(
+            ids, mask, td.y, n_classes=len(td.labels), vocab_size=p.vocab_size,
+            embed_dim=p.embed_dim, hidden_dim=p.hidden_dim,
+            iterations=p.iterations, learning_rate=p.learning_rate, seed=p.seed,
+        )
+        return TextModel("mlp", td.labels, p.vocab_size,
+                         {"params": params, "max_len": p.max_len})
+
+    def predict(self, model: TextModel, query: TextQuery) -> TextPrediction:
+        return self.batch_predict(model, [query])[0]
+
+    def batch_predict(self, model: TextModel, queries: Sequence[TextQuery]):
+        if not queries:
+            return []
+        ids, mask = text_ops.tokens_to_ids(
+            [q.text for q in queries], model.dim, model.payload["max_len"]
+        )
+        logits = np.asarray(text_ops.mlp_predict_logits(model.payload["params"], ids, mask))
+        out = []
+        for row in logits:
+            probs = _softmax(row)
+            j = int(np.argmax(probs))
+            out.append(TextPrediction(model.labels[j], float(probs[j])))
+        return out
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - np.max(x))
+    return e / e.sum()
+
+
+class TextClassificationEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=TextDataSource,
+            preparator_class=TextPreparator,
+            algorithm_classes={
+                "nb": TextNBAlgorithm,
+                "logreg": TextLogRegAlgorithm,
+                "mlp": TextMLPAlgorithm,
+            },
+            serving_class=FirstServing,
+        )
+
+    query_class = TextQuery

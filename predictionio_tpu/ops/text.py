@@ -1,0 +1,142 @@
+"""Text featurization + embedding-bag MLP classifier.
+
+Reference: the text-classification template (tf-idf → MLlib NaiveBayes /
+LogisticRegression) and BASELINE.json config #5 ("word2vec + MLP embedding
+kernels") — SURVEY.md §2 'Text classification'.
+
+TPU design:
+- Hashing vectorizer (fixed dim => static shapes; no vocabulary shuffle).
+- tf-idf as one vectorized transform.
+- Embedding-bag MLP: learned token embeddings mean-pooled over the (padded)
+  token sequence, then a small MLP — all matmuls, trained with optax Adam
+  under `lax.scan`; batch rows dp-shardable.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+_TOKEN_RE = re.compile(r"[a-z0-9']+")
+
+
+def tokenize(text: str) -> List[str]:
+    return _TOKEN_RE.findall(text.lower())
+
+
+def hash_token(token: str, dim: int) -> int:
+    # FNV-1a 32-bit: stable across processes (unlike Python's hash())
+    h = 2166136261
+    for b in token.encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h % dim
+
+
+def hashing_vectorize(texts: Sequence[str], dim: int = 4096) -> np.ndarray:
+    """Token-count matrix [n, dim] via the hashing trick."""
+    out = np.zeros((len(texts), dim), np.float32)
+    for r, t in enumerate(texts):
+        for tok in tokenize(t):
+            out[r, hash_token(tok, dim)] += 1.0
+    return out
+
+
+def tfidf_transform(counts: np.ndarray, idf: Optional[np.ndarray] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (tfidf, idf). Pass the training idf back in at serving time."""
+    counts = jnp.asarray(counts)
+    if idf is None:
+        n = counts.shape[0]
+        df = jnp.sum(counts > 0, axis=0)
+        idf = jnp.log((1.0 + n) / (1.0 + df)) + 1.0
+    else:
+        idf = jnp.asarray(idf)
+    tf = counts / jnp.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+    x = tf * idf
+    norms = jnp.linalg.norm(x, axis=1, keepdims=True)
+    return np.asarray(x / jnp.maximum(norms, 1e-8)), np.asarray(idf)
+
+
+def tokens_to_ids(texts: Sequence[str], vocab_size: int, max_len: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Hash tokens to ids, pad/truncate to max_len. Returns (ids, mask)."""
+    ids = np.zeros((len(texts), max_len), np.int32)
+    mask = np.zeros((len(texts), max_len), np.float32)
+    for r, t in enumerate(texts):
+        toks = tokenize(t)[:max_len]
+        for c, tok in enumerate(toks):
+            ids[r, c] = hash_token(tok, vocab_size)
+            mask[r, c] = 1.0
+    return ids, mask
+
+
+# -- embedding-bag MLP -------------------------------------------------------
+
+
+def _mlp_forward(params, ids, mask):
+    emb, w1, b1, w2, b2 = params
+    e = emb[ids]                                     # [n, L, E] gather
+    pooled = (e * mask[..., None]).sum(1) / jnp.maximum(mask.sum(1, keepdims=True), 1.0)
+    h = jax.nn.relu(pooled @ w1 + b1)
+    return h @ w2 + b2
+
+
+def mlp_train(
+    ids: np.ndarray,
+    mask: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    vocab_size: int,
+    embed_dim: int = 64,
+    hidden_dim: int = 128,
+    iterations: int = 200,
+    learning_rate: float = 1e-2,
+    l2: float = 1e-5,
+    seed: int = 0,
+):
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = (
+        jax.random.normal(k1, (vocab_size, embed_dim), jnp.float32) * 0.05,
+        jax.random.normal(k2, (embed_dim, hidden_dim), jnp.float32) * (1.0 / np.sqrt(embed_dim)),
+        jnp.zeros((hidden_dim,), jnp.float32),
+        jax.random.normal(k3, (hidden_dim, n_classes), jnp.float32) * (1.0 / np.sqrt(hidden_dim)),
+        jnp.zeros((n_classes,), jnp.float32),
+    )
+    opt = optax.adam(learning_rate)
+    ids_j, mask_j, y_j = jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(y, jnp.int32)
+
+    def loss_fn(p):
+        logits = _mlp_forward(p, ids_j, mask_j)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y_j).mean()
+        reg = sum(jnp.sum(w * w) for w in p[1::2])
+        return ce + l2 * reg
+
+    @jax.jit
+    def run(params):
+        state = opt.init(params)
+
+        def step(carry, _):
+            p, s = carry
+            value, grad = jax.value_and_grad(loss_fn)(p)
+            updates, s = opt.update(grad, s, p)
+            return (optax.apply_updates(p, updates), s), value
+
+        (p, _), losses = jax.lax.scan(step, (params, state), None, length=iterations)
+        return p, losses
+
+    params, losses = run(params)
+    return tuple(np.asarray(p) for p in params)
+
+
+@jax.jit
+def mlp_predict_logits(params, ids, mask):
+    return _mlp_forward(tuple(jnp.asarray(p) for p in params), jnp.asarray(ids), jnp.asarray(mask))
+
+
+def mlp_predict(params, ids, mask) -> np.ndarray:
+    return np.asarray(jnp.argmax(mlp_predict_logits(params, ids, mask), axis=-1))

@@ -1,0 +1,90 @@
+"""Similar-product template tests: ALS-cosine and cooccurrence similarity,
+category/white/black-list filters."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.similar_product import (
+    SimilarProductEngine,
+    SimilarProductQuery,
+)
+from predictionio_tpu.models.similar_product.engine import (
+    SPALSParams,
+    SPCooccurrenceParams,
+    SPDataSourceParams,
+)
+from predictionio_tpu.storage import App
+
+
+@pytest.fixture()
+def sp_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "spapp"))
+    rng = np.random.default_rng(4)
+    events = []
+    # two co-view clusters: {a0..a4} and {z0..z4}
+    for u in range(40):
+        items = [f"a{i}" for i in range(5)] if u % 2 == 0 else [f"z{i}" for i in range(5)]
+        for it in items:
+            if rng.random() < 0.8:
+                events.append(Event(event="view", entity_type="user",
+                                    entity_id=f"u{u}", target_entity_type="item",
+                                    target_entity_id=it))
+    for i in range(5):
+        events.append(Event(event="$set", entity_type="item", entity_id=f"a{i}",
+                            properties=DataMap({"categories": ["alpha"]})))
+        events.append(Event(event="$set", entity_type="item", entity_id=f"z{i}",
+                            properties=DataMap({"categories": ["zeta"]})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+def make_ep(algo_name, params):
+    return EngineParams(
+        data_source_params=SPDataSourceParams(app_name="spapp"),
+        algorithm_params_list=[(algo_name, params)],
+    )
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("als", SPALSParams(rank=6, num_iterations=8, mesh_dp=1)),
+    ("cooccurrence", SPCooccurrenceParams(mesh_dp=1, min_llr=1.0)),
+])
+def test_similar_items_stay_in_cluster(sp_app, algo, params):
+    engine = SimilarProductEngine.apply()
+    ep = make_ep(algo, params)
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    res = predict(SimilarProductQuery(items=["a1"], num=3))
+    assert res.item_scores, f"{algo}: expected similar items"
+    assert all(s.item.startswith("a") for s in res.item_scores), res.item_scores
+    assert "a1" not in [s.item for s in res.item_scores]
+
+
+def test_multi_item_query_and_blacklist(sp_app):
+    engine = SimilarProductEngine.apply()
+    ep = make_ep("cooccurrence", SPCooccurrenceParams(mesh_dp=1))
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    res = predict(SimilarProductQuery(items=["a0", "a1"], num=4, black_list=["a2"]))
+    items = [s.item for s in res.item_scores]
+    assert "a2" not in items and "a0" not in items and "a1" not in items
+
+
+def test_category_filter_and_whitelist(sp_app):
+    engine = SimilarProductEngine.apply()
+    ep = make_ep("cooccurrence", SPCooccurrenceParams(mesh_dp=1))
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    res = predict(SimilarProductQuery(items=["a0"], num=5, categories=["zeta"]))
+    assert all(s.item.startswith("z") for s in res.item_scores)
+    res2 = predict(SimilarProductQuery(items=["a0"], num=5, white_list=["a3"]))
+    assert [s.item for s in res2.item_scores] in ([], ["a3"])
+
+
+def test_query_json():
+    q = SimilarProductQuery.from_json(
+        {"items": ["i1"], "num": 2, "whiteList": ["i2"], "blackList": ["i3"],
+         "categories": ["c"]})
+    assert q.items == ["i1"] and q.white_list == ["i2"] and q.categories == ["c"]

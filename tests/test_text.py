@@ -1,0 +1,94 @@
+"""Text-classification template tests: spam-vs-ham over all three algorithms
+(SMS-spam-shaped, BASELINE.md config #2/#5)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.text import TextClassificationEngine, TextQuery
+from predictionio_tpu.models.text.engine import (
+    TextDSParams,
+    TextLogRegParams,
+    TextMLPParams,
+    TextNBParams,
+)
+from predictionio_tpu.storage import App
+
+SPAM = ["win free cash now", "free prize claim now", "win money fast free",
+        "claim your free reward now", "cash prize winner claim today",
+        "free free win big money"]
+HAM = ["see you at dinner tonight", "meeting moved to tuesday",
+       "can you pick up milk", "the report is due tomorrow",
+       "happy birthday hope all is well", "lunch at noon works for me"]
+
+
+@pytest.fixture()
+def text_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "txtapp"))
+    events = []
+    for k, t in enumerate(SPAM):
+        events.append(Event(event="train", entity_type="content", entity_id=f"s{k}",
+                            properties=DataMap({"text": t, "label": "spam"})))
+    for k, t in enumerate(HAM):
+        events.append(Event(event="train", entity_type="content", entity_id=f"h{k}",
+                            properties=DataMap({"text": t, "label": "ham"})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("nb", TextNBParams(dim=512)),
+    ("logreg", TextLogRegParams(dim=512, iterations=40)),
+    ("mlp", TextMLPParams(vocab_size=512, max_len=16, iterations=120,
+                          embed_dim=16, hidden_dim=32)),
+])
+def test_text_classification(text_app, algo, params):
+    engine = TextClassificationEngine.apply()
+    ep = EngineParams(
+        data_source_params=TextDSParams(app_name="txtapp"),
+        algorithm_params_list=[(algo, params)],
+    )
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    spam_pred = predict(TextQuery("claim free cash prize now"))
+    ham_pred = predict(TextQuery("are we still on for lunch tomorrow"))
+    assert spam_pred.label == "spam", (algo, spam_pred)
+    assert ham_pred.label == "ham", (algo, ham_pred)
+    assert 0.0 <= spam_pred.confidence <= 1.0
+
+
+def test_text_eval_folds(text_app):
+    from predictionio_tpu.controller.evaluation import AverageMetric, MetricEvaluator
+
+    class Accuracy(AverageMetric):
+        def score_one(self, q, p, a):
+            return 1.0 if p.label == a else 0.0
+
+    engine = TextClassificationEngine.apply()
+    ep = EngineParams(
+        data_source_params=TextDSParams(app_name="txtapp", eval_k=3),
+        algorithm_params_list=[("nb", TextNBParams(dim=512))],
+    )
+    result = MetricEvaluator(Accuracy()).evaluate(engine, [ep])
+    assert result.best_score >= 0.5
+
+
+def test_hashing_is_stable():
+    from predictionio_tpu.ops.text import hash_token, hashing_vectorize
+
+    assert hash_token("hello", 1024) == hash_token("hello", 1024)
+    a = hashing_vectorize(["the cat sat"], 256)
+    b = hashing_vectorize(["the cat sat"], 256)
+    assert (a == b).all() and a.sum() == 3
+
+
+def test_missing_text_events_raise(mem_storage):
+    mem_storage.apps.insert(App(0, "emptytxt"))
+    engine = TextClassificationEngine.apply()
+    ep = EngineParams(
+        data_source_params=TextDSParams(app_name="emptytxt"),
+        algorithm_params_list=[("nb", TextNBParams())],
+    )
+    with pytest.raises(ValueError, match="no 'train' events"):
+        engine.train(ep)
