@@ -1,0 +1,6 @@
+from predictionio_tpu.e2.engine import (  # noqa: F401
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    MarkovChain,
+)
+from predictionio_tpu.e2.evaluation import k_fold_split  # noqa: F401

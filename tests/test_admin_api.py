@@ -1,0 +1,62 @@
+"""Admin REST API tests (reference analogue: AdminAPISpec)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.admin import run_admin_server
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def admin(mem_storage):
+    httpd = run_admin_server(port=0, storage=mem_storage, background=True)
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_admin_app_lifecycle(admin):
+    status, body = http("GET", admin + "/")
+    assert status == 200 and body["status"] == "alive"
+
+    status, created = http("POST", admin + "/cmd/app", {"name": "adm1"})
+    assert status == 201 and created["accessKey"]
+
+    status, dup = http("POST", admin + "/cmd/app", {"name": "adm1"})
+    assert status == 409
+
+    status, apps = http("GET", admin + "/cmd/app")
+    assert [a["name"] for a in apps["apps"]] == ["adm1"]
+
+    status, keys = http("GET", admin + "/cmd/app/adm1/accesskeys")
+    assert status == 200 and len(keys["accessKeys"]) == 1
+
+    status, newkey = http("POST", admin + "/cmd/app/adm1/accesskeys",
+                          {"events": ["view"]})
+    assert status == 201
+    status, keys = http("GET", admin + "/cmd/app/adm1/accesskeys")
+    assert len(keys["accessKeys"]) == 2
+
+    status, _ = http("DELETE", admin + "/cmd/app/adm1/data")
+    assert status == 200
+
+    status, _ = http("DELETE", admin + "/cmd/app/adm1")
+    assert status == 200
+    status, apps = http("GET", admin + "/cmd/app")
+    assert apps["apps"] == []
+
+    status, _ = http("GET", admin + "/cmd/app/ghost/accesskeys")
+    assert status == 404

@@ -1,0 +1,120 @@
+"""Full CLI loop via subprocess — the reference's `pio_tests` integration
+harness analogue (SURVEY.md §4): app new → import → train → deploy → HTTP
+query → eval → export, all through the `pio` entry point."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pio_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PIO_FS_BASEDIR"] = str(tmp_path / "pio_store")
+    # keep subprocess JAX on CPU (env JAX_PLATFORMS is overridden by this
+    # VM's sitecustomize, but training params below pin mesh_dp=1 and the
+    # CLI path itself is platform-agnostic)
+    env["PIO_TEST_SUBPROC"] = "1"
+    return env
+
+
+def pio(args, tmp_path, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *args],
+        env=pio_env(tmp_path), capture_output=True, text=True, timeout=180, **kw,
+    )
+
+
+@pytest.mark.slow
+def test_full_cli_loop(tmp_path):
+    # 1. app new
+    r = pio(["app", "new", "MyApp"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "Created app" in r.stdout
+
+    # duplicate rejected
+    r = pio(["app", "new", "MyApp"], tmp_path)
+    assert r.returncode == 1
+
+    # 2. import events (ML-100K-like tiny ratings file)
+    rng = np.random.default_rng(0)
+    events_file = tmp_path / "events.jsonl"
+    with open(events_file, "w") as f:
+        for u in range(15):
+            for i in range(10):
+                liked = (u < 8) == (i < 5)
+                if rng.random() < 0.85:
+                    f.write(json.dumps({
+                        "event": "rate", "entityType": "user", "entityId": f"u{u}",
+                        "targetEntityType": "item", "targetEntityId": f"i{i}",
+                        "properties": {"rating": 5.0 if liked else 1.0},
+                        "eventTime": "2026-01-01T00:00:00Z",
+                    }) + "\n")
+    r = pio(["import", "--app-name", "MyApp", "--input", str(events_file)], tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "Imported" in r.stdout
+
+    # 3. train
+    engine_json = os.path.join(REPO, "examples", "recommendation", "engine.json")
+    r = pio(["train", "--engine-json", engine_json], tmp_path)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "Training completed" in r.stdout
+
+    # 4. deploy (background process) + query over HTTP
+    port = 18321
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", "deploy",
+         "--engine-json", engine_json, "--ip", "127.0.0.1", "--port", str(port)],
+        env=pio_env(tmp_path), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        deadline = time.time() + 60
+        last_err = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"user": "u1", "num": 3}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    body = json.loads(resp.read())
+                break
+            except Exception as e:  # server not up yet
+                last_err = e
+                assert proc.poll() is None, proc.stderr.read().decode()
+                time.sleep(0.5)
+        else:
+            raise AssertionError(f"query server never came up: {last_err}")
+        items = [s["item"] for s in body["itemScores"]]
+        assert len(items) == 3
+        assert all(int(i[1:]) < 5 for i in items), items  # u1 is in group 0
+    finally:
+        proc.send_signal(signal.SIGINT)
+        proc.wait(timeout=10)
+
+    # 5. eval (uses the example Evaluation over the same store)
+    r = pio(["eval", "examples.recommendation.evaluation.RecommendationEvaluation"],
+            tmp_path)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "Evaluation completed" in r.stdout
+
+    # 6. export round-trips the events
+    out = tmp_path / "export.jsonl"
+    r = pio(["export", "--app-name", "MyApp", "--output", str(out)], tmp_path)
+    assert r.returncode == 0, r.stderr
+    exported = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(exported) > 100 and all("eventId" in e for e in exported)
+
+    # 7. status reports the trained instance's storage
+    r = pio(["status"], tmp_path)
+    assert r.returncode == 0 and "apps: 1" in r.stdout

@@ -1,0 +1,117 @@
+"""e2 helper + FastEval memoization tests (reference analogues:
+CategoricalNaiveBayesTest, MarkovChainTest, BinaryVectorizerTest,
+FastEvalEngineTest — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    MarkovChain,
+    k_fold_split,
+)
+
+
+def test_binary_vectorizer():
+    rows = [{"color": "red", "size": "L"}, {"color": "blue", "size": "L"}]
+    v = BinaryVectorizer.fit(rows, ["color", "size"])
+    assert v.width == 3
+    a = v.transform({"color": "red", "size": "L"})
+    assert a.sum() == 2 and a[v.index[("color", "red")]] == 1
+    b = v.transform({"color": "green"})  # unseen value -> all zeros
+    assert b.sum() == 0
+
+
+def test_categorical_naive_bayes():
+    points = [
+        ("play", ["sunny", "weekend"]), ("play", ["sunny", "weekend"]),
+        ("play", ["cloudy", "weekend"]), ("stay", ["rainy", "weekday"]),
+        ("stay", ["rainy", "weekend"]), ("stay", ["cloudy", "weekday"]),
+    ]
+    model = CategoricalNaiveBayes.train(points)
+    assert CategoricalNaiveBayes.predict(model, ["sunny", "weekend"]) == "play"
+    assert CategoricalNaiveBayes.predict(model, ["rainy", "weekday"]) == "stay"
+    # unseen value falls back to default likelihood without crashing
+    assert CategoricalNaiveBayes.predict(model, ["snowy", "weekend"]) in ("play", "stay")
+
+
+def test_markov_chain():
+    transitions = [(0, 1), (0, 1), (0, 2), (1, 2), (2, 0)]
+    mc = MarkovChain.train(transitions, n_states=3, top_k=2)
+    nxt = mc.next_states(0)
+    assert nxt[0][0] == 1 and abs(nxt[0][1] - 2 / 3) < 1e-6
+    assert nxt[1][0] == 2 and abs(nxt[1][1] - 1 / 3) < 1e-6
+
+
+def test_k_fold_split():
+    data = list(range(100))
+    folds = list(k_fold_split(data, 4, seed=1))
+    assert len(folds) == 4
+    for train, test in folds:
+        assert sorted(train + test) == data
+    all_test = sorted(sum((t for _, t in folds), []))
+    assert all_test == data
+    with pytest.raises(ValueError):
+        list(k_fold_split(data, 1))
+
+
+def test_fast_eval_memoizes_stages():
+    import dataclasses
+
+    from predictionio_tpu.controller import (
+        Algorithm, AverageMetric, DataSource, Engine, EngineParams,
+        FirstServing, MetricEvaluator, Params, Preparator,
+    )
+    from predictionio_tpu.workflow.fast_eval import FastEvalEngine
+
+    calls = {"read_eval": 0, "prepare": 0, "train": 0}
+
+    @dataclasses.dataclass
+    class AP(Params):
+        mult: float = 1.0
+
+    class DS(DataSource):
+        def read_training(self):
+            return list(range(10))
+
+        def read_eval(self):
+            calls["read_eval"] += 1
+            return [(list(range(10)), None, [(q, q * 2.0) for q in range(5)])]
+
+    class Prep(Preparator):
+        def prepare(self, td):
+            calls["prepare"] += 1
+            return td
+
+    class Algo(Algorithm):
+        params_class = AP
+
+        def train(self, pd):
+            calls["train"] += 1
+            return self.params.mult
+
+        def predict(self, model, q):
+            return q * model
+
+    class M(AverageMetric):
+        higher_is_better = False
+
+        def score_one(self, q, p, a):
+            return abs(p - a)
+
+    engine = Engine(DS, Prep, {"a": Algo}, FirstServing)
+    candidates = [
+        EngineParams(algorithm_params_list=[("a", AP(mult=m))]) for m in (1.0, 2.0, 3.0)
+    ]
+    fast = FastEvalEngine(engine)
+    result = MetricEvaluator(M()).evaluate(engine, candidates, eval_runner=fast.eval)
+    # D and P ran once despite 3 candidates; A ran once per candidate
+    assert calls["read_eval"] == 1
+    assert calls["prepare"] == 1
+    assert calls["train"] == 3
+    assert result.best_engine_params.algorithm_params_list[0][1].mult == 2.0
+    # repeating a candidate hits the model cache
+    MetricEvaluator(M()).evaluate(engine, candidates[:1], eval_runner=fast.eval)
+    assert calls["train"] == 3
+    assert fast.stats["models_hit"] >= 1
