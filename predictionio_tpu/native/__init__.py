@@ -1,0 +1,4 @@
+from predictionio_tpu.native.scanner import (  # noqa: F401
+    native_available,
+    scan_segments,
+)

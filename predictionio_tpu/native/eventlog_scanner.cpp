@@ -1,0 +1,476 @@
+// Native event-log scanner: JSONL segments -> columnar arrays.
+//
+// Role: the host-side ingest hot path (SURVEY.md §2 'TPU-equivalent mapping':
+// the reference's HBase scan -> Spark RDD ingest becomes sharded sequential
+// segment reads staged to device).  The reference has no C++ (it rides
+// HBase/Spark JVM I/O); this is its TPU-native equivalent: parse+encode at
+// memory bandwidth so the TPU is never input-bound.
+//
+// Contract: segments are written by Event.to_json_line() — compact JSON, one
+// object per line.  The parser is a minimal but correct JSON tokenizer: it
+// extracts event/entityId/entityType/targetEntityId/eventTime and
+// properties.rating, skipping everything else structurally.
+//
+// Threading: one worker per segment file (they are immutable once rotated),
+// then a single-threaded merge that dictionary-encodes strings.
+//
+// C ABI (used from Python via ctypes):
+//   scan_new() -> handle
+//   scan_add_file(h, path)
+//   scan_run(h, n_threads) -> row count or -1
+//   scan_rows/scan_col_*/scan_dict_* accessors
+//   scan_error(h) -> last error message
+//   scan_free(h)
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct RawEvent {
+  std::string event;
+  std::string entity_type;
+  std::string entity_id;
+  std::string target_id;  // empty = none
+  int64_t time_us = 0;
+  float rating = NAN;
+  bool valid = false;
+};
+
+// ---------------------------------------------------------------------- JSON
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')) p++;
+  }
+
+  bool expect(char c) {
+    skip_ws();
+    if (p < end && *p == c) { p++; return true; }
+    ok = false;
+    return false;
+  }
+
+  // Parse a JSON string (assumes *p == '"'), appending the decoded value.
+  bool parse_string(std::string* out) {
+    skip_ws();
+    if (p >= end || *p != '"') { ok = false; return false; }
+    p++;
+    while (p < end) {
+      char c = *p++;
+      if (c == '"') return true;
+      if (c == '\\') {
+        if (p >= end) break;
+        char e = *p++;
+        switch (e) {
+          case '"': if (out) out->push_back('"'); break;
+          case '\\': if (out) out->push_back('\\'); break;
+          case '/': if (out) out->push_back('/'); break;
+          case 'b': if (out) out->push_back('\b'); break;
+          case 'f': if (out) out->push_back('\f'); break;
+          case 'n': if (out) out->push_back('\n'); break;
+          case 'r': if (out) out->push_back('\r'); break;
+          case 't': if (out) out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 4) { ok = false; return false; }
+            unsigned code = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = *p++;
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else { ok = false; return false; }
+            }
+            // surrogate pair
+            if (code >= 0xD800 && code <= 0xDBFF && end - p >= 6 &&
+                p[0] == '\\' && p[1] == 'u') {
+              unsigned lo = 0;
+              const char* q = p + 2;
+              for (int i = 0; i < 4; i++) {
+                char h = *q++;
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else { lo = 0xFFFFFFFF; break; }
+              }
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+                p += 6;
+              }
+            }
+            if (out) {  // encode UTF-8
+              if (code < 0x80) out->push_back((char)code);
+              else if (code < 0x800) {
+                out->push_back((char)(0xC0 | (code >> 6)));
+                out->push_back((char)(0x80 | (code & 0x3F)));
+              } else if (code < 0x10000) {
+                out->push_back((char)(0xE0 | (code >> 12)));
+                out->push_back((char)(0x80 | ((code >> 6) & 0x3F)));
+                out->push_back((char)(0x80 | (code & 0x3F)));
+              } else {
+                out->push_back((char)(0xF0 | (code >> 18)));
+                out->push_back((char)(0x80 | ((code >> 12) & 0x3F)));
+                out->push_back((char)(0x80 | ((code >> 6) & 0x3F)));
+                out->push_back((char)(0x80 | (code & 0x3F)));
+              }
+            }
+            break;
+          }
+          default: ok = false; return false;
+        }
+      } else if (out) {
+        out->push_back(c);
+      }
+    }
+    ok = false;
+    return false;
+  }
+
+  bool skip_value();  // forward decl
+
+  bool skip_object() {
+    if (!expect('{')) return false;
+    skip_ws();
+    if (p < end && *p == '}') { p++; return true; }
+    while (p < end) {
+      if (!parse_string(nullptr)) return false;
+      if (!expect(':')) return false;
+      if (!skip_value()) return false;
+      skip_ws();
+      if (p < end && *p == ',') { p++; continue; }
+      return expect('}');
+    }
+    ok = false;
+    return false;
+  }
+
+  bool skip_array() {
+    if (!expect('[')) return false;
+    skip_ws();
+    if (p < end && *p == ']') { p++; return true; }
+    while (p < end) {
+      if (!skip_value()) return false;
+      skip_ws();
+      if (p < end && *p == ',') { p++; continue; }
+      return expect(']');
+    }
+    ok = false;
+    return false;
+  }
+
+  bool parse_number(double* out) {
+    skip_ws();
+    char* numend = nullptr;
+    double v = strtod(p, &numend);
+    if (numend == p) { ok = false; return false; }
+    if (out) *out = v;
+    p = numend;
+    return true;
+  }
+
+  bool skip_literal(const char* lit) {
+    size_t n = strlen(lit);
+    if ((size_t)(end - p) >= n && strncmp(p, lit, n) == 0) { p += n; return true; }
+    ok = false;
+    return false;
+  }
+};
+
+bool Parser::skip_value() {
+  skip_ws();
+  if (p >= end) { ok = false; return false; }
+  switch (*p) {
+    case '"': return parse_string(nullptr);
+    case '{': return skip_object();
+    case '[': return skip_array();
+    case 't': return skip_literal("true");
+    case 'f': return skip_literal("false");
+    case 'n': return skip_literal("null");
+    default: return parse_number(nullptr);
+  }
+}
+
+// days since epoch for a civil date (Howard Hinnant's algorithm)
+int64_t days_from_civil(int y, unsigned m, unsigned d) {
+  y -= m <= 2;
+  const int era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = (unsigned)(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return (int64_t)era * 146097 + (int64_t)doe - 719468;
+}
+
+// ISO-8601 -> epoch microseconds. Handles "YYYY-MM-DDTHH:MM:SS[.ffffff]"
+// with "Z" or "+HH:MM"/"-HH:MM" offset.
+bool parse_iso8601_us(const std::string& s, int64_t* out) {
+  int y, mo, d, h, mi;
+  double sec = 0;
+  if (s.size() < 19) return false;
+  if (sscanf(s.c_str(), "%d-%d-%dT%d:%d:%lf", &y, &mo, &d, &h, &mi, &sec) != 6)
+    return false;
+  // find timezone offset after the seconds field
+  int64_t offset_s = 0;
+  size_t tzpos = s.find_first_of("Z+-", 19);
+  // (a '-' inside fractional seconds can't occur; offsets start at/after pos 19)
+  if (tzpos != std::string::npos) {
+    char c = s[tzpos];
+    if (c == '+' || c == '-') {
+      int oh = 0, om = 0;
+      if (sscanf(s.c_str() + tzpos + 1, "%d:%d", &oh, &om) >= 1) {
+        offset_s = (int64_t)oh * 3600 + (int64_t)om * 60;
+        if (c == '-') offset_s = -offset_s;
+      }
+    }
+  }
+  int64_t days = days_from_civil(y, (unsigned)mo, (unsigned)d);
+  double total = (double)days * 86400.0 + h * 3600.0 + mi * 60.0 + sec - (double)offset_s;
+  *out = (int64_t)(total * 1e6);
+  return true;
+}
+
+bool parse_line(const char* line, const char* line_end, RawEvent* ev) {
+  Parser ps{line, line_end};
+  if (!ps.expect('{')) return false;
+  ps.skip_ws();
+  if (ps.p < ps.end && *ps.p == '}') { return false; }
+  std::string key, sval;
+  std::string event_time;
+  while (ps.p < ps.end) {
+    key.clear();
+    if (!ps.parse_string(&key)) return false;
+    if (!ps.expect(':')) return false;
+    if (key == "event") {
+      if (!ps.parse_string(&ev->event)) return false;
+    } else if (key == "entityType") {
+      if (!ps.parse_string(&ev->entity_type)) return false;
+    } else if (key == "entityId") {
+      if (!ps.parse_string(&ev->entity_id)) return false;
+    } else if (key == "targetEntityId") {
+      if (!ps.parse_string(&ev->target_id)) return false;
+    } else if (key == "eventTime") {
+      if (!ps.parse_string(&event_time)) return false;
+    } else if (key == "properties") {
+      // walk the object keeping only "rating" if numeric
+      ps.skip_ws();
+      if (ps.p < ps.end && *ps.p == '{') {
+        ps.p++;
+        ps.skip_ws();
+        if (ps.p < ps.end && *ps.p == '}') { ps.p++; }
+        else {
+          std::string pk;
+          while (ps.p < ps.end) {
+            pk.clear();
+            if (!ps.parse_string(&pk)) return false;
+            if (!ps.expect(':')) return false;
+            if (pk == "rating") {
+              ps.skip_ws();
+              if (ps.p < ps.end && (*ps.p == '-' || (*ps.p >= '0' && *ps.p <= '9'))) {
+                double v;
+                if (!ps.parse_number(&v)) return false;
+                ev->rating = (float)v;
+              } else if (!ps.skip_value()) {
+                return false;
+              }
+            } else if (!ps.skip_value()) {
+              return false;
+            }
+            ps.skip_ws();
+            if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
+            if (!ps.expect('}')) return false;
+            break;
+          }
+        }
+      } else if (!ps.skip_value()) {
+        return false;
+      }
+    } else {
+      if (!ps.skip_value()) return false;
+    }
+    ps.skip_ws();
+    if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
+    if (!ps.expect('}')) return false;
+    break;
+  }
+  if (ev->event.empty() || ev->entity_id.empty()) return false;
+  if (!event_time.empty() && !parse_iso8601_us(event_time, &ev->time_us)) return false;
+  ev->valid = ps.ok;
+  return ps.ok;
+}
+
+// ------------------------------------------------------------------- scanner
+
+struct Dict {
+  std::unordered_map<std::string, int32_t> map;
+  std::vector<std::string> strings;
+
+  int32_t add(const std::string& s) {
+    auto it = map.find(s);
+    if (it != map.end()) return it->second;
+    int32_t id = (int32_t)strings.size();
+    map.emplace(s, id);
+    strings.push_back(s);
+    return id;
+  }
+};
+
+struct Scanner {
+  std::vector<std::string> paths;
+  std::string error;
+
+  std::vector<int32_t> event_code, entity_type_code, entity_code, target_code;
+  std::vector<int64_t> time_us;
+  std::vector<float> rating;
+  Dict events, entity_types, entities, targets;
+
+  // dict string export buffers
+  std::vector<char> blob;
+  std::vector<int64_t> offsets;
+};
+
+bool read_file(const std::string& path, std::string* out, std::string* err) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) { *err = "cannot open " + path; return false; }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  out->resize((size_t)n);
+  size_t got = n ? fread(&(*out)[0], 1, (size_t)n, f) : 0;
+  fclose(f);
+  if ((long)got != n) { *err = "short read on " + path; return false; }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* scan_new() { return new Scanner(); }
+
+void scan_free(void* h) { delete (Scanner*)h; }
+
+void scan_add_file(void* h, const char* path) {
+  ((Scanner*)h)->paths.emplace_back(path);
+}
+
+const char* scan_error(void* h) { return ((Scanner*)h)->error.c_str(); }
+
+// Returns row count, or -1 on error.
+int64_t scan_run(void* h, int n_threads) {
+  Scanner* s = (Scanner*)h;
+  size_t n_files = s->paths.size();
+  std::vector<std::vector<RawEvent>> per_file(n_files);
+  std::vector<std::string> errors(n_files);
+  std::atomic<size_t> next{0};
+  if (n_threads < 1) n_threads = 1;
+
+  auto worker = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= n_files) return;
+      std::string content;
+      if (!read_file(s->paths[i], &content, &errors[i])) continue;
+      const char* p = content.data();
+      const char* end = p + content.size();
+      auto& out = per_file[i];
+      while (p < end) {
+        const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+        const char* line_end = nl ? nl : end;
+        if (line_end > p) {
+          RawEvent ev;
+          if (parse_line(p, line_end, &ev)) out.push_back(std::move(ev));
+        }
+        p = nl ? nl + 1 : end;
+      }
+    }
+  };
+
+  std::vector<std::thread> threads;
+  int nt = std::min<int>(n_threads, (int)std::max<size_t>(n_files, 1));
+  for (int t = 0; t < nt; t++) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+
+  for (auto& e : errors) {
+    if (!e.empty()) { s->error = e; return -1; }
+  }
+
+  size_t total = 0;
+  for (auto& v : per_file) total += v.size();
+  s->event_code.reserve(total);
+  s->entity_type_code.reserve(total);
+  s->entity_code.reserve(total);
+  s->target_code.reserve(total);
+  s->time_us.reserve(total);
+  s->rating.reserve(total);
+  for (auto& v : per_file) {
+    for (auto& ev : v) {
+      s->event_code.push_back(s->events.add(ev.event));
+      s->entity_type_code.push_back(s->entity_types.add(ev.entity_type));
+      s->entity_code.push_back(s->entities.add(ev.entity_id));
+      s->target_code.push_back(
+          ev.target_id.empty() ? -1 : s->targets.add(ev.target_id));
+      s->time_us.push_back(ev.time_us);
+      s->rating.push_back(ev.rating);
+    }
+    v.clear();
+    v.shrink_to_fit();
+  }
+  return (int64_t)s->event_code.size();
+}
+
+int64_t scan_rows(void* h) { return (int64_t)((Scanner*)h)->event_code.size(); }
+
+const int32_t* scan_col_event(void* h) { return ((Scanner*)h)->event_code.data(); }
+const int32_t* scan_col_entity_type(void* h) { return ((Scanner*)h)->entity_type_code.data(); }
+const int32_t* scan_col_entity(void* h) { return ((Scanner*)h)->entity_code.data(); }
+const int32_t* scan_col_target(void* h) { return ((Scanner*)h)->target_code.data(); }
+const int64_t* scan_col_time(void* h) { return ((Scanner*)h)->time_us.data(); }
+const float* scan_col_rating(void* h) { return ((Scanner*)h)->rating.data(); }
+
+static Dict* dict_by_id(Scanner* s, int which) {
+  switch (which) {
+    case 0: return &s->events;
+    case 1: return &s->entity_types;
+    case 2: return &s->entities;
+    case 3: return &s->targets;
+  }
+  return nullptr;
+}
+
+int64_t scan_dict_size(void* h, int which) {
+  Dict* d = dict_by_id((Scanner*)h, which);
+  return d ? (int64_t)d->strings.size() : -1;
+}
+
+// Export a dict as (blob, offsets[n+1]); returns blob size.
+int64_t scan_dict_export(void* h, int which) {
+  Scanner* s = (Scanner*)h;
+  Dict* d = dict_by_id(s, which);
+  if (!d) return -1;
+  s->blob.clear();
+  s->offsets.clear();
+  s->offsets.push_back(0);
+  for (auto& str : d->strings) {
+    s->blob.insert(s->blob.end(), str.begin(), str.end());
+    s->offsets.push_back((int64_t)s->blob.size());
+  }
+  return (int64_t)s->blob.size();
+}
+
+const char* scan_dict_blob(void* h) { return ((Scanner*)h)->blob.data(); }
+const int64_t* scan_dict_offsets(void* h) { return ((Scanner*)h)->offsets.data(); }
+
+}  // extern "C"

@@ -1,0 +1,135 @@
+"""ctypes bindings for the native event-log scanner.
+
+Builds ``libeventscan.so`` from eventlog_scanner.cpp on first use (g++ -O3,
+cached next to the source keyed by source mtime) and exposes
+``scan_segments(paths) -> EventBatch``.  Falls back gracefully: callers check
+``native_available()`` and use the pure-Python path otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("pio.native")
+
+_SRC = Path(__file__).parent / "eventlog_scanner.cpp"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        so = _BUILD_DIR / f"libeventscan-{int(_SRC.stat().st_mtime)}.so"
+        try:
+            if not so.exists():
+                _BUILD_DIR.mkdir(exist_ok=True)
+                for old in _BUILD_DIR.glob("libeventscan-*.so"):
+                    old.unlink(missing_ok=True)
+                cmd = [
+                    "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                    str(_SRC), "-o", str(so),
+                ]
+                subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            lib = ctypes.CDLL(str(so))
+            lib.scan_new.restype = ctypes.c_void_p
+            lib.scan_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.scan_run.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.scan_run.restype = ctypes.c_int64
+            lib.scan_rows.argtypes = [ctypes.c_void_p]
+            lib.scan_rows.restype = ctypes.c_int64
+            lib.scan_error.argtypes = [ctypes.c_void_p]
+            lib.scan_error.restype = ctypes.c_char_p
+            for name, typ in [
+                ("scan_col_event", ctypes.POINTER(ctypes.c_int32)),
+                ("scan_col_entity_type", ctypes.POINTER(ctypes.c_int32)),
+                ("scan_col_entity", ctypes.POINTER(ctypes.c_int32)),
+                ("scan_col_target", ctypes.POINTER(ctypes.c_int32)),
+                ("scan_col_time", ctypes.POINTER(ctypes.c_int64)),
+                ("scan_col_rating", ctypes.POINTER(ctypes.c_float)),
+            ]:
+                fn = getattr(lib, name)
+                fn.argtypes = [ctypes.c_void_p]
+                fn.restype = typ
+            lib.scan_dict_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.scan_dict_size.restype = ctypes.c_int64
+            lib.scan_dict_export.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.scan_dict_export.restype = ctypes.c_int64
+            lib.scan_dict_blob.argtypes = [ctypes.c_void_p]
+            lib.scan_dict_blob.restype = ctypes.POINTER(ctypes.c_char)
+            lib.scan_dict_offsets.argtypes = [ctypes.c_void_p]
+            lib.scan_dict_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+            lib.scan_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+            return lib
+        except Exception as e:  # compiler missing, build error, load error
+            log.warning("native scanner unavailable (%s); using Python path", e)
+            _load_failed = True
+            return None
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def _export_dict(lib, handle, which: int) -> List[str]:
+    n = lib.scan_dict_size(handle, which)
+    blob_len = lib.scan_dict_export(handle, which)
+    if n <= 0 or blob_len < 0:
+        return []
+    offsets = np.ctypeslib.as_array(lib.scan_dict_offsets(handle), shape=(n + 1,)).copy()
+    blob = ctypes.string_at(lib.scan_dict_blob(handle), blob_len)
+    return [blob[offsets[i]:offsets[i + 1]].decode() for i in range(n)]
+
+
+def scan_segments(paths: Sequence[os.PathLike], n_threads: int = 0):
+    """Parse JSONL event segments into an EventBatch (native path)."""
+    from predictionio_tpu.store.columnar import EventBatch, IdDict
+
+    lib = _build_and_load()
+    if lib is None:
+        raise RuntimeError("native scanner unavailable")
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 4, 16)
+    handle = lib.scan_new()
+    try:
+        for p in paths:
+            lib.scan_add_file(handle, str(p).encode())
+        rows = lib.scan_run(handle, n_threads)
+        if rows < 0:
+            raise RuntimeError(lib.scan_error(handle).decode())
+
+        def col(fn, dtype):
+            if rows == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(fn(handle), shape=(rows,)).astype(dtype, copy=True)
+
+        batch = EventBatch(
+            event_codes=col(lib.scan_col_event, np.int32),
+            entity_type_codes=col(lib.scan_col_entity_type, np.int32),
+            entity_ids=col(lib.scan_col_entity, np.int32),
+            target_ids=col(lib.scan_col_target, np.int32),
+            times_us=col(lib.scan_col_time, np.int64),
+            ratings=col(lib.scan_col_rating, np.float32),
+            event_dict=IdDict.from_state(_export_dict(lib, handle, 0)),
+            entity_type_dict=IdDict.from_state(_export_dict(lib, handle, 1)),
+            entity_dict=IdDict.from_state(_export_dict(lib, handle, 2)),
+            target_dict=IdDict.from_state(_export_dict(lib, handle, 3)),
+        )
+        return batch
+    finally:
+        lib.scan_free(handle)

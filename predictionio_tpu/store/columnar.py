@@ -161,6 +161,14 @@ class EventBatch:
             event_dict, entity_type_dict, entity_dict, target_dict,
         )
 
+    def subset(self, mask: np.ndarray) -> "EventBatch":
+        """Row-filter by boolean mask; dictionaries are shared."""
+        return EventBatch(
+            self.event_codes[mask], self.entity_type_codes[mask], self.entity_ids[mask],
+            self.target_ids[mask], self.times_us[mask], self.ratings[mask],
+            self.event_dict, self.entity_type_dict, self.entity_dict, self.target_dict,
+        )
+
     def select_events(self, names: Sequence[str]) -> "EventBatch":
         """Filter to rows whose event verb is in ``names`` (dicts shared)."""
         codes = [self.event_dict.id(n) for n in names]

@@ -73,7 +73,19 @@ class PEventStore:
         until_time: Optional[_dt.datetime] = None,
         storage: Optional[Storage] = None,
     ) -> EventBatch:
-        """Read matching events as ONE columnar batch (device-staging format)."""
+        """Read matching events as ONE columnar batch (device-staging format).
+
+        Fast path: when the event backend is segment-file based (localfs) the
+        native C++ scanner parses all segments in parallel and filters are
+        applied columnar; otherwise events stream through the Python path.
+        """
+        storage = storage or get_storage()
+        native = PEventStore._native_batch(
+            app_name, channel_name, event_names, entity_type,
+            start_time, until_time, storage,
+        )
+        if native is not None:
+            return native
         events = list(
             PEventStore.find(
                 app_name,
@@ -86,6 +98,43 @@ class PEventStore:
             )
         )
         return EventBatch.from_events(events)
+
+    @staticmethod
+    def _native_batch(
+        app_name, channel_name, event_names, entity_type,
+        start_time, until_time, storage,
+    ) -> Optional[EventBatch]:
+        import numpy as np
+
+        backend = storage.p_events
+        if not hasattr(backend, "segment_paths"):
+            return None
+        from predictionio_tpu.native import native_available, scan_segments
+
+        if not native_available():
+            return None
+        app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
+        paths = backend.segment_paths(app_id, channel_id)
+        if not paths:
+            return EventBatch.from_events([])
+        # tombstoned events are invisible to the columnar scanner; fall back
+        tomb = paths[0].parent / "tombstones.txt"
+        if tomb.exists() and tomb.stat().st_size > 0:
+            return None
+        batch = scan_segments(paths)
+        mask = np.ones(len(batch), bool)
+        if event_names is not None:
+            codes = [batch.event_dict.id(n) for n in event_names]
+            codes = [c for c in codes if c is not None]
+            mask &= np.isin(batch.event_codes, np.asarray(codes, np.int32))
+        if entity_type is not None:
+            c = batch.entity_type_dict.id(entity_type)
+            mask &= batch.entity_type_codes == (c if c is not None else -2)
+        if start_time is not None:
+            mask &= batch.times_us >= int(start_time.timestamp() * 1e6)
+        if until_time is not None:
+            mask &= batch.times_us < int(until_time.timestamp() * 1e6)
+        return batch.subset(mask) if not mask.all() else batch
 
     @staticmethod
     def aggregate_properties(
