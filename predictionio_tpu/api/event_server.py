@@ -105,6 +105,8 @@ def make_handler(state: EventServerState):
                 self._insert_one(ak, channel_id, body)
             elif path == "/batch/events.json":
                 self._insert_batch(ak, channel_id, body)
+            elif path.startswith("/webhooks/") and path.endswith(".json"):
+                self._webhook(ak, channel_id, path[len("/webhooks/"):-len(".json")], body)
             else:
                 self.send_error_json(404, "not found")
 
@@ -125,6 +127,29 @@ def make_handler(state: EventServerState):
                 self.send_error_json(404, "not found")
 
         # -- impl ------------------------------------------------------------
+
+        def _webhook(self, ak, channel_id, name, body):
+            from predictionio_tpu.api.webhooks import get_connector
+
+            connector = get_connector(name)
+            if connector is None:
+                self.send_error_json(404, f"no webhook connector {name!r}")
+                return
+            if not isinstance(body, dict):
+                self.send_error_json(400, "webhook body must be a JSON object")
+                return
+            try:
+                event = connector(body)
+            except ValueError as e:
+                self.send_error_json(400, str(e))
+                return
+            err = self._check_allowed(ak, event.event)
+            if err:
+                self.send_error_json(403, err)
+                return
+            event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
+            state.record(ak.app_id, event.event)
+            self.send_json({"eventId": event_id}, status=201)
 
         def _check_allowed(self, ak: AccessKey, event_name: str) -> Optional[str]:
             if ak.events and event_name not in ak.events:

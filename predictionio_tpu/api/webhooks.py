@@ -1,0 +1,81 @@
+"""Webhook connectors (reference: data/.../api/Webhooks*.scala +
+webhooks/segmentio/mailchimp connectors — SURVEY.md §2 'Event server').
+
+A connector turns a third-party JSON or form payload into the canonical
+Event.  POST /webhooks/<name>.json?accessKey=K dispatches to the registered
+connector; unknown names 404 like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping
+
+from predictionio_tpu.events.event import DataMap, Event
+
+Connector = Callable[[Mapping], Event]
+
+_CONNECTORS: Dict[str, Connector] = {}
+
+
+def register_connector(name: str, connector: Connector) -> None:
+    _CONNECTORS[name] = connector
+
+
+def get_connector(name: str):
+    return _CONNECTORS.get(name)
+
+
+def connectors() -> Dict[str, Connector]:
+    return dict(_CONNECTORS)
+
+
+# -- built-in: segment.io (reference: webhooks/segmentio/SegmentIOConnector) --
+
+
+def segmentio_connector(payload: Mapping) -> Event:
+    """Maps a segment.com track/identify/page/screen call to an Event."""
+    typ = payload.get("type")
+    user = payload.get("userId") or payload.get("anonymousId")
+    if not typ or not user:
+        raise ValueError("segmentio payload requires 'type' and 'userId'/'anonymousId'")
+    timestamp = payload.get("timestamp") or payload.get("sentAt")
+    props = DataMap(payload.get("properties") or payload.get("traits") or {})
+    if typ == "track":
+        name = payload.get("event")
+        if not name:
+            raise ValueError("segmentio 'track' requires 'event'")
+        return Event(event=name, entity_type="user", entity_id=str(user),
+                     properties=props, event_time=timestamp)
+    if typ in ("identify", "page", "screen", "alias", "group"):
+        return Event(event=typ, entity_type="user", entity_id=str(user),
+                     properties=props, event_time=timestamp)
+    raise ValueError(f"unsupported segmentio type {typ!r}")
+
+
+register_connector("segmentio", segmentio_connector)
+
+
+# -- built-in: generic form connector (reference: WebhooksConnectors.forms) --
+
+
+def form_connector(payload: Mapping) -> Event:
+    """Accepts flat form fields: event, entityType, entityId [,target...]"""
+    try:
+        return Event(
+            event=str(payload["event"]),
+            entity_type=str(payload["entityType"]),
+            entity_id=str(payload["entityId"]),
+            target_entity_type=payload.get("targetEntityType"),
+            target_entity_id=payload.get("targetEntityId"),
+            properties=DataMap({
+                k: v for k, v in payload.items()
+                if k not in ("event", "entityType", "entityId",
+                             "targetEntityType", "targetEntityId", "eventTime")
+            }),
+            event_time=payload.get("eventTime"),
+        )
+    except KeyError as e:
+        raise ValueError(f"form payload missing {e}")
+
+
+register_connector("form", form_connector)

@@ -1,0 +1,1 @@
+from predictionio_tpu.sdk.client import EngineClient, EventClient  # noqa: F401

@@ -1,0 +1,123 @@
+"""Python SDK — clients for the Event Server and Query Server REST APIs.
+
+Reference: the PredictionIO-Python-SDK repo (EventClient / EngineClient;
+SURVEY.md §2 'SDKs' — separate repos speaking the same REST wire format).
+stdlib-only so it is usable outside this package's environment.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class PIOError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+def _request(method: str, url: str, body: Any = None, timeout: float = 10.0) -> Any:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = resp.read()
+            return json.loads(payload) if payload else None
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read()).get("message", "")
+        except Exception:
+            message = e.reason
+        raise PIOError(e.code, message) from None
+
+
+class EventClient:
+    """Client for the Event Server (reference: EventClient in the SDKs)."""
+
+    def __init__(self, access_key: str, url: str = "http://localhost:7070",
+                 channel: Optional[str] = None, timeout: float = 10.0):
+        self.access_key = access_key
+        self.base = url.rstrip("/")
+        self.channel = channel
+        self.timeout = timeout
+
+    def _qs(self) -> str:
+        params = {"accessKey": self.access_key}
+        if self.channel:
+            params["channel"] = self.channel
+        return urllib.parse.urlencode(params)
+
+    def create_event(
+        self,
+        event: str,
+        entity_type: str,
+        entity_id: str,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        properties: Optional[Dict[str, Any]] = None,
+        event_time: Optional[_dt.datetime] = None,
+    ) -> str:
+        body: Dict[str, Any] = {
+            "event": event, "entityType": entity_type, "entityId": str(entity_id),
+        }
+        if target_entity_type:
+            body["targetEntityType"] = target_entity_type
+        if target_entity_id:
+            body["targetEntityId"] = str(target_entity_id)
+        if properties:
+            body["properties"] = properties
+        if event_time:
+            body["eventTime"] = event_time.isoformat()
+        out = _request("POST", f"{self.base}/events.json?{self._qs()}", body, self.timeout)
+        return out["eventId"]
+
+    def create_events(self, events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        return _request("POST", f"{self.base}/batch/events.json?{self._qs()}",
+                        list(events), self.timeout)
+
+    # convenience wrappers matching the reference SDK surface
+    def set_user(self, uid: str, properties: Optional[Dict] = None) -> str:
+        return self.create_event("$set", "user", uid, properties=properties or {})
+
+    def set_item(self, iid: str, properties: Optional[Dict] = None) -> str:
+        return self.create_event("$set", "item", iid, properties=properties or {})
+
+    def record_user_action_on_item(
+        self, action: str, uid: str, iid: str, properties: Optional[Dict] = None
+    ) -> str:
+        return self.create_event(action, "user", uid, "item", iid, properties)
+
+    def get_event(self, event_id: str) -> Dict[str, Any]:
+        return _request("GET", f"{self.base}/events/{event_id}.json?{self._qs()}",
+                        timeout=self.timeout)
+
+    def delete_event(self, event_id: str) -> None:
+        _request("DELETE", f"{self.base}/events/{event_id}.json?{self._qs()}",
+                 timeout=self.timeout)
+
+    def find_events(self, **filters: str) -> List[Dict[str, Any]]:
+        params = {"accessKey": self.access_key, **filters}
+        if self.channel:
+            params["channel"] = self.channel
+        qs = urllib.parse.urlencode(params)
+        return _request("GET", f"{self.base}/events.json?{qs}", timeout=self.timeout)
+
+
+class EngineClient:
+    """Client for a deployed engine (reference: EngineClient in the SDKs)."""
+
+    def __init__(self, url: str = "http://localhost:8000", timeout: float = 10.0):
+        self.base = url.rstrip("/")
+        self.timeout = timeout
+
+    def send_query(self, query: Dict[str, Any]) -> Dict[str, Any]:
+        return _request("POST", f"{self.base}/queries.json", query, self.timeout)

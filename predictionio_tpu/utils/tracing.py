@@ -1,0 +1,50 @@
+"""Tracing/profiling helpers.
+
+The reference has no custom tracer (SURVEY.md §5) — it leans on the Spark UI.
+The TPU-native equivalents: ``jax.named_scope`` for XLA-visible annotation,
+``jax.profiler`` traces viewable in xprof/tensorboard, and a lightweight
+wall-clock timer that feeds the workflow logs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator, Optional
+
+log = logging.getLogger("pio.trace")
+
+
+@contextlib.contextmanager
+def named_scope(name: str) -> Iterator[None]:
+    """XLA-visible scope (shows up in xprof timelines and HLO names)."""
+    import jax
+
+    with jax.named_scope(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax.profiler trace into log_dir (view with xprof/tensorboard)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(name: str, sink: Optional[dict] = None) -> Iterator[None]:
+    """Wall-clock span logged at INFO; optionally recorded into sink[name]."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        log.info("%s took %.3fs", name, dt)
+        if sink is not None:
+            sink[name] = sink.get(name, 0.0) + dt

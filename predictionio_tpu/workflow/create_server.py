@@ -57,7 +57,14 @@ class QueryServerState:
         storage: Optional[Storage] = None,
         feedback: bool = False,
         feedback_app_name: str = "",
+        plugins=None,
     ):
+        from predictionio_tpu.api.plugins import PluginRegistry
+
+        self.plugins = PluginRegistry()
+        for p in plugins or []:
+            self.plugins.register(p)
+            p.start(self)
         self.engine = engine
         self.engine_params = engine_params
         self.query_class = query_class
@@ -93,6 +100,7 @@ class QueryServerState:
         with self._lock:
             predictor = self.predictor
         prediction = predictor(query)
+        prediction = self.plugins.apply(query, prediction)
         self.query_count += 1
         if self.feedback and self.feedback_app_name:
             self._log_feedback(body, prediction)
@@ -187,6 +195,7 @@ def deploy(
     feedback: bool = False,
     storage: Optional[Storage] = None,
     background: bool = False,
+    plugins=None,
 ):
     """Programmatic deploy; returns the HTTPServer (background=True) or blocks."""
     doc = load_engine_variant(engine_json, variant)
@@ -200,6 +209,7 @@ def deploy(
     state = QueryServerState(
         engine, engine_params, query_class, eid, engine_version, variant,
         storage=storage, feedback=feedback, feedback_app_name=feedback_app,
+        plugins=plugins,
     )
     httpd = start_server(make_handler(state), host, port, background=background)
     log.info("Query server for %s listening on %s:%d", eid, host, httpd.server_address[1])

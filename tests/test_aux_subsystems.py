@@ -1,0 +1,148 @@
+"""Aux-subsystem tests: webhooks, plugins, SDK clients, pio-env loader,
+tracing helpers."""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.api.event_server import run_event_server
+from predictionio_tpu.storage import AccessKey, App
+
+
+@pytest.fixture()
+def server(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "auxapp"))
+    key = mem_storage.access_keys.insert(AccessKey("", app_id, []))
+    httpd = run_event_server(host="127.0.0.1", port=0, storage=mem_storage,
+                             background=True)
+    yield {"base": f"http://127.0.0.1:{httpd.server_address[1]}", "key": key,
+           "app_id": app_id, "storage": mem_storage}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def post(url, body):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_segmentio_webhook(server):
+    base, key = server["base"], server["key"]
+    status, body = post(f"{base}/webhooks/segmentio.json?accessKey={key}", {
+        "type": "track", "userId": "u99", "event": "Item Purchased",
+        "properties": {"revenue": 39.95},
+        "timestamp": "2026-02-01T12:00:00Z",
+    })
+    assert status == 201, body
+    ev = next(iter(server["storage"].l_events.find(server["app_id"])))
+    assert ev.event == "Item Purchased" and ev.entity_id == "u99"
+    assert ev.properties["revenue"] == 39.95
+
+
+def test_webhook_unknown_connector_and_bad_payload(server):
+    base, key = server["base"], server["key"]
+    status, _ = post(f"{base}/webhooks/nope.json?accessKey={key}", {"a": 1})
+    assert status == 404
+    status, _ = post(f"{base}/webhooks/segmentio.json?accessKey={key}", {"type": "track"})
+    assert status == 400
+
+
+def test_form_webhook(server):
+    base, key = server["base"], server["key"]
+    status, _ = post(f"{base}/webhooks/form.json?accessKey={key}", {
+        "event": "buy", "entityType": "user", "entityId": "u5",
+        "targetEntityType": "item", "targetEntityId": "i5", "price": 3})
+    assert status == 201
+    evs = list(server["storage"].l_events.find(server["app_id"], event_names=["buy"]))
+    assert evs and evs[0].properties["price"] == 3
+
+
+def test_plugins_blocker_and_sniffer():
+    from predictionio_tpu.api.plugins import (
+        OutputBlocker, OutputSniffer, PluginRegistry,
+    )
+
+    seen = []
+
+    class Cap(OutputBlocker):
+        name = "cap"
+
+        def process(self, query, prediction):
+            return min(prediction, 10)
+
+    class Sniff(OutputSniffer):
+        name = "sniff"
+
+        def process(self, query, prediction):
+            seen.append((query, prediction))
+
+    class Broken(OutputSniffer):
+        name = "broken"
+
+        def process(self, query, prediction):
+            raise RuntimeError("boom")
+
+    reg = PluginRegistry()
+    reg.register(Cap())
+    reg.register(Sniff())
+    reg.register(Broken())
+    out = reg.apply("q", 42)
+    assert out == 10          # blocker transformed
+    assert seen == [("q", 10)]  # sniffer saw transformed value; broken one ignored
+
+
+def test_sdk_event_client(server):
+    from predictionio_tpu.sdk import EventClient
+
+    c = EventClient(server["key"], server["base"])
+    eid = c.record_user_action_on_item("rate", "u1", "i1", {"rating": 4})
+    got = c.get_event(eid)
+    assert got["event"] == "rate" and got["properties"]["rating"] == 4
+    c.set_user("u1", {"plan": "pro"})
+    results = c.create_events([
+        {"event": "view", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i2"},
+    ])
+    assert results[0]["status"] == 201
+    found = c.find_events(event="view")
+    assert len(found) == 1
+    c.delete_event(eid)
+    from predictionio_tpu.sdk.client import PIOError
+
+    with pytest.raises(PIOError) as ei:
+        c.get_event(eid)
+    assert ei.value.status == 404
+
+
+def test_load_pio_env(tmp_path, monkeypatch):
+    from predictionio_tpu.utils.config import load_pio_env
+
+    f = tmp_path / "pio-env.sh"
+    f.write_text(
+        "# storage config\n"
+        "export PIO_STORAGE_SOURCES_FS_TYPE=localfs\n"
+        'PIO_STORAGE_SOURCES_FS_PATH="$BASE/store"\n'
+        "export PIO_STORAGE_REPOSITORIES_METADATA_SOURCE=FS\n"
+        "ignored line without assignment\n"
+    )
+    out = load_pio_env(str(f), apply=False, base={"BASE": "/data"})
+    assert out["PIO_STORAGE_SOURCES_FS_TYPE"] == "localfs"
+    assert out["PIO_STORAGE_SOURCES_FS_PATH"] == "/data/store"
+    assert len(out) == 3
+    assert load_pio_env("/nonexistent/pio-env.sh", apply=False) == {}
+
+
+def test_timed_tracer():
+    from predictionio_tpu.utils.tracing import timed
+
+    sink = {}
+    with timed("span", sink):
+        pass
+    assert "span" in sink and sink["span"] >= 0
