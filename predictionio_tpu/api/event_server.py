@@ -140,7 +140,7 @@ def make_handler(state: EventServerState):
                 return
             try:
                 event = connector(body)
-            except ValueError as e:
+            except (ValueError, KeyError, TypeError) as e:
                 self.send_error_json(400, str(e))
                 return
             err = self._check_allowed(ak, event.event)

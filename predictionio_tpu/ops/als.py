@@ -235,12 +235,22 @@ def recommend_scores(
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))
+def _recommend_batch_xla(user_vecs, item_factors, seen_mask, top_k):
+    scores = user_vecs @ item_factors.T
+    scores = jnp.where(seen_mask > 0, -jnp.inf, scores)
+    return jax.lax.top_k(scores, top_k)
+
+
 def recommend_batch(
     user_vecs: jnp.ndarray,       # [B, K]
     item_factors: jnp.ndarray,    # [n_items, K]
     seen_mask: jnp.ndarray,       # [B, n_items]
     top_k: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    scores = user_vecs @ item_factors.T
-    scores = jnp.where(seen_mask > 0, -jnp.inf, scores)
-    return jax.lax.top_k(scores, top_k)
+    """Batched top-K scoring; routes to the fused Pallas kernel when enabled
+    (PIO_PALLAS, see ops.pallas_kernels) — one HBM pass for matmul+mask."""
+    from predictionio_tpu.ops.pallas_kernels import pallas_enabled, recommend_batch_fused
+
+    if pallas_enabled():
+        return recommend_batch_fused(user_vecs, item_factors, seen_mask, top_k)
+    return _recommend_batch_xla(user_vecs, item_factors, seen_mask, top_k)

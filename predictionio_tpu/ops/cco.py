@@ -107,26 +107,33 @@ def interaction_counts(item: np.ndarray, n_items: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _xlogx(x):
-    return jnp.where(x > 0, x * jnp.log(jnp.maximum(x, 1e-30)), 0.0)
-
-
-def _entropy2(a, b):
-    return _xlogx(a + b) - _xlogx(a) - _xlogx(b)
-
-
-def _entropy4(a, b, c, d):
-    return _xlogx(a + b + c + d) - _xlogx(a) - _xlogx(b) - _xlogx(c) - _xlogx(d)
+def _llr_term(k, sign_d, d, row_marg, col_marg):
+    # k·log(k·N/(row·col)) rewritten as k·log1p(±D/(row·col)); the ±1e-9
+    # clamp guards fp drift past the log1p pole when k·N ≪ row·col.
+    arg = sign_d * d / jnp.maximum(row_marg * col_marg, 1e-30)
+    return jnp.where(k > 0, k * jnp.log1p(jnp.maximum(arg, -1.0 + 1e-9)), 0.0)
 
 
 def llr_score(k11, k12, k21, k22):
-    """Dunning G² (Mahout LogLikelihood.logLikelihoodRatio, entropy form):
-    2·(H(row marginals) + H(col marginals) − H(cells)) with H(ks) =
-    xlogx(Σks) − Σxlogx(k)."""
-    row = _entropy2(k11 + k12, k21 + k22)
-    col = _entropy2(k11 + k21, k12 + k22)
-    mat = _entropy4(k11, k12, k21, k22)
-    return jnp.maximum(2.0 * (row + col - mat), 0.0)
+    """Dunning G² (Mahout LogLikelihood.logLikelihoodRatio), in the
+    determinant form: for a 2×2 table, k_ij·N − r_i·c_j = ±D with
+    D = k11·k22 − k12·k21, so G² = 2·Σ k·log1p(±D/(r·c)).
+
+    Unlike the textbook entropy form (±Σ xlogx over marginals), every term
+    here is O(k·log-ratio) — no cancellation of O(N·logN) quantities — so
+    f32 on the VPU stays accurate at billion-event N where the entropy form
+    quantizes G² to multiples of eps·N·logN.
+    """
+    r1, r2 = k11 + k12, k21 + k22
+    c1, c2 = k11 + k21, k12 + k22
+    d = k11 * k22 - k12 * k21
+    g2 = 2.0 * (
+        _llr_term(k11, 1.0, d, r1, c1)
+        + _llr_term(k12, -1.0, d, r1, c2)
+        + _llr_term(k21, -1.0, d, r2, c1)
+        + _llr_term(k22, 1.0, d, r2, c2)
+    )
+    return jnp.maximum(g2, 0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -169,14 +176,14 @@ def _cooccurrence_tile(
     init = jnp.zeros((n_items_p, tile), jnp.float32)
     if axis_name is not None:
         # under shard_map the carry varies per dp shard
-        init = jax.lax.pvary(init, (axis_name,))
+        init = jax.lax.pcast(init, (axis_name,), to="varying")
     out, _ = jax.lax.scan(body, init, (p_lu, p_it, p_mk, a_lu, a_it, a_mk))
     return out
 
 
 @partial(
     jax.jit,
-    static_argnames=("block", "n_items_p", "tile", "top_k", "axis_name"),
+    static_argnames=("block", "n_items_p", "tile", "top_k", "axis_name", "pallas"),
 )
 def _cco_tile_step(
     p_lu, p_it, p_mk, a_lu, a_it, a_mk,
@@ -186,6 +193,7 @@ def _cco_tile_step(
     block: int, n_items_p: int, tile: int, top_k: int,
     llr_threshold: float,
     axis_name: Optional[str] = None,
+    pallas: str = "off",
 ):
     """Process one item tile: cooccurrence counts → LLR → merge into top-k."""
     c = _cooccurrence_tile(
@@ -193,13 +201,22 @@ def _cco_tile_step(
     )
     if axis_name is not None:
         c = jax.lax.psum(c, axis_name)
-    k11 = c                                            # users doing both
-    k12 = row_counts[:, None] - c                      # primary-only
-    k21 = jax.lax.dynamic_slice_in_dim(col_counts, tile_start, tile)[None, :] - c
-    k22 = n_total - k11 - k12 - k21
-    scores = llr_score(k11, k12, k21, k22)
-    scores = jnp.where(c > 0, scores, -jnp.inf)        # no cooccurrence → no indicator
-    scores = jnp.where(scores >= llr_threshold, scores, -jnp.inf)
+    col_tile = jax.lax.dynamic_slice_in_dim(col_counts, tile_start, tile)
+
+    from predictionio_tpu.ops.pallas_kernels import llr_masked_scores
+
+    if pallas != "off":
+        # fused Pallas pass: G² + cooccurrence/threshold masking in one
+        # VPU sweep over the tile
+        scores = llr_masked_scores(c, row_counts, col_tile, n_total, llr_threshold)
+    else:
+        k11 = c                                        # users doing both
+        k12 = row_counts[:, None] - c                  # primary-only
+        k21 = col_tile[None, :] - c
+        k22 = n_total - k11 - k12 - k21
+        scores = llr_score(k11, k12, k21, k22)
+        scores = jnp.where(c > 0, scores, -jnp.inf)    # no cooccurrence → no indicator
+        scores = jnp.where(scores >= llr_threshold, scores, -jnp.inf)
     # self-pairs excluded by the caller via diagonal masking when P == A
     tile_idx = tile_start + jnp.arange(tile, dtype=jnp.int32)[None, :]
     all_scores = jnp.concatenate([best_scores, scores], axis=1)
@@ -242,6 +259,10 @@ def cco_indicators(
     best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)
     best_idx = jnp.zeros((n_items_p, top_k), jnp.int32)
 
+    from predictionio_tpu.ops.pallas_kernels import pallas_mode
+
+    pallas = pallas_mode()
+
     if mesh is None:
         args = (
             jnp.asarray(primary.local_u), jnp.asarray(primary.item), jnp.asarray(primary.mask),
@@ -253,6 +274,7 @@ def cco_indicators(
                 best_scores, best_idx, t * tile,
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
+                pallas=pallas,
             )
     else:
         dp = mesh.shape["dp"]
@@ -286,7 +308,7 @@ def cco_indicators(
                 bs, bi, ts,
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
-                axis_name="dp",
+                axis_name="dp", pallas=pallas,
             )
 
         for t in range(n_tiles):

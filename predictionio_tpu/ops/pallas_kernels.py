@@ -1,0 +1,229 @@
+"""Hand-written Pallas TPU kernels for the serving/training hot paths.
+
+The reference has no hand-written kernels at all — its FLOPs run inside
+Spark MLlib / Mahout JVM code (SURVEY.md §2: "no C++/Rust/CUDA components in
+PredictionIO itself").  On TPU the hot ops are re-expressed so XLA can tile
+them onto the MXU; the two below additionally benefit from manual fusion
+beyond what XLA does automatically:
+
+- ``masked_score_matmul`` — the `/queries.json` serving hot path: one pass
+  computes ``U @ Vᵀ``, adds a per-item bias (business-rule boost /
+  popularity blend) and applies the seen-items mask *inside the matmul
+  tile*, so the [B, I] score matrix is written to HBM exactly once instead
+  of the mask/bias reading it back (3 HBM round-trips → 1).
+- ``llr_masked_scores`` — the CCO tile post-pass: Dunning G² over the
+  2×2 contingency table + cooccurrence mask + significance threshold,
+  fused into one VPU pass over each count tile.
+
+Both kernels run in compiled mode on TPU and interpret mode elsewhere
+(selected by ``pallas_mode()``), so the same code path is exercised by the
+CPU test suite.
+
+Control: ``PIO_PALLAS`` env var — ``auto`` (default: compiled on TPU, off
+otherwise), ``1``/``compiled``, ``interpret``, ``0``/``off``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def pallas_mode() -> str:
+    """'compiled' | 'interpret' | 'off' for this process."""
+    conf = os.environ.get("PIO_PALLAS", "auto").lower()
+    if conf in ("0", "off", "false"):
+        return "off"
+    if conf in ("1", "compiled", "true"):
+        return "compiled"
+    if conf == "interpret":
+        return "interpret"
+    return "compiled" if jax.default_backend() == "tpu" else "off"
+
+
+def pallas_enabled() -> bool:
+    return pallas_mode() != "off"
+
+
+def _interpret() -> bool:
+    return pallas_mode() == "interpret"
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# fused masked scoring matmul (serving hot path)
+# ---------------------------------------------------------------------------
+
+
+def _score_kernel(u_ref, v_ref, seen_ref, bias_ref, out_ref):
+    # MXU tile: [TB, K] @ [TI, K]ᵀ with f32 accumulation.
+    s = jax.lax.dot_general(
+        u_ref[:], v_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = s + bias_ref[:]            # [1, TI] broadcast: business-rule boost
+    # VPU: mask seen items in-register — never re-read scores from HBM.
+    out_ref[:] = jnp.where(seen_ref[:] > 0, NEG_INF, s)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "tile_i", "has_bias", "interpret"))
+def _masked_score_matmul(
+    user_vecs, item_factors, seen_mask, bias,
+    tile_b: int, tile_i: int, has_bias: bool, interpret: bool,
+):
+    """Pad to tile-aligned shapes, run the kernel, slice back — all under one
+    jit so the pads fuse into XLA's dataflow instead of eager per-call copies
+    (shapes are static per deployment, so this traces once)."""
+    b, k = user_vecs.shape
+    n_items = item_factors.shape[0]
+    bp, ip, kp = _round_up(b, tile_b), _round_up(n_items, tile_i), _round_up(k, 128)
+
+    u, v, seen = user_vecs, item_factors, seen_mask
+    if (bp, kp) != (b, k):
+        u = jnp.zeros((bp, kp), jnp.float32).at[:b, :k].set(u)
+    if (ip, kp) != (n_items, k):
+        v = jnp.zeros((ip, kp), jnp.float32).at[:n_items, :k].set(v)
+    if (bp, ip) != (b, n_items):
+        # padding items arrive pre-masked, so they can never win a top-k
+        seen = jnp.ones((bp, ip), jnp.float32).at[:b, :n_items].set(seen)
+    bias_row = jnp.zeros((1, ip), jnp.float32)
+    if has_bias:
+        bias_row = bias_row.at[0, :n_items].set(bias)
+
+    grid = (bp // tile_b, ip // tile_i)
+    out = pl.pallas_call(
+        _score_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_b, kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_i, kp), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile_b, tile_i), lambda i, j: (i, j)),
+            pl.BlockSpec((1, tile_i), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, tile_i), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((bp, ip), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * bp * ip * kp,
+            bytes_accessed=4 * (bp * kp + ip * kp + 2 * bp * ip),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(u, v, seen, bias_row)
+    return out[:b, :n_items]
+
+
+def masked_score_matmul(
+    user_vecs: jnp.ndarray,       # [B, K] f32
+    item_factors: jnp.ndarray,    # [I, K] f32
+    seen_mask: jnp.ndarray,       # [B, I], >0 where already interacted
+    bias: Optional[jnp.ndarray] = None,   # [I] additive per-item boost
+    tile_b: int = 128,
+    tile_i: int = 512,
+) -> jnp.ndarray:
+    """Fused ``scores = U @ Vᵀ + bias; scores[seen] = -inf`` as one kernel."""
+    b, k = user_vecs.shape
+    n_items = item_factors.shape[0]
+    tile_b = min(tile_b, _round_up(b, 8))
+    tile_i = min(tile_i, _round_up(n_items, 128))
+    if bias is None:
+        bias_arg = jnp.zeros((0,), jnp.float32)   # placeholder, unused trace-side
+    else:
+        bias_arg = bias
+    return _masked_score_matmul(
+        user_vecs, item_factors, seen_mask, bias_arg,
+        tile_b, tile_i, bias is not None, _interpret(),
+    )
+
+
+def recommend_batch_fused(
+    user_vecs: jnp.ndarray,
+    item_factors: jnp.ndarray,
+    seen_mask: jnp.ndarray,
+    top_k: int,
+    bias: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas-fused variant of ``ops.als.recommend_batch`` (+ optional bias)."""
+    scores = masked_score_matmul(user_vecs, item_factors, seen_mask, bias)
+    return jax.lax.top_k(scores, top_k)
+
+
+# ---------------------------------------------------------------------------
+# fused LLR + masking over CCO count tiles
+# ---------------------------------------------------------------------------
+
+
+def _llr_kernel(c_ref, row_ref, col_ref, scalars_ref, out_ref):
+    from predictionio_tpu.ops.cco import llr_score
+
+    c = c_ref[:]
+    row = row_ref[:]               # [TB, 1] primary-item user counts
+    col = col_ref[:]               # [1, TI] other-item user counts
+    n_total = scalars_ref[0, 0]
+    threshold = scalars_ref[0, 1]
+    k11 = c
+    k12 = row - c
+    k21 = col - c
+    k22 = n_total - k11 - k12 - k21
+    g2 = llr_score(k11, k12, k21, k22)   # determinant-form G², VPU-only
+    keep = (c > 0) & (g2 >= threshold)
+    out_ref[:] = jnp.where(keep, g2, NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_r", "tile_c", "interpret"))
+def _llr_padded(c, row, col, scalars, tile_r: int, tile_c: int, interpret: bool):
+    rp, cp = c.shape
+    grid = (rp // tile_r, cp // tile_c)
+    return pl.pallas_call(
+        _llr_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_r, tile_c), lambda i, j: (i, j)),
+            pl.BlockSpec((tile_r, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile_c), lambda i, j: (0, j)),
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_r, tile_c), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((rp, cp), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=30 * rp * cp,
+            bytes_accessed=4 * 2 * rp * cp,
+            transcendentals=9 * rp * cp,   # the xlogx logs
+        ),
+        interpret=interpret,
+    )(c, row, col, scalars)
+
+
+def llr_masked_scores(
+    counts: jnp.ndarray,       # [R, C] cooccurrence counts
+    row_counts: jnp.ndarray,   # [R] users per primary item
+    col_counts: jnp.ndarray,   # [C] users per other item
+    n_total: float,
+    threshold: float = 0.0,
+    tile_r: int = 256,
+    tile_c: int = 512,
+) -> jnp.ndarray:
+    """Fused G² scores with zero-cooccurrence + threshold masking (-inf)."""
+    r, c = counts.shape
+    tile_r = min(tile_r, _round_up(r, 8))
+    tile_c = min(tile_c, _round_up(c, 128))
+    rp, cp = _round_up(r, tile_r), _round_up(c, tile_c)
+    cm = jnp.zeros((rp, cp), jnp.float32).at[:r, :c].set(counts)
+    rowm = jnp.zeros((rp, 1), jnp.float32).at[:r, 0].set(row_counts)
+    colm = jnp.zeros((1, cp), jnp.float32).at[0, :c].set(col_counts)
+    # n_total / threshold may be traced scalars (called inside a jitted step)
+    scalars = jnp.stack(
+        [jnp.asarray(n_total, jnp.float32), jnp.asarray(threshold, jnp.float32)]
+    ).reshape(1, 2)
+    out = _llr_padded(cm, rowm, colm, scalars, tile_r, tile_c, _interpret())
+    return out[:r, :c]

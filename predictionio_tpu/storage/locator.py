@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Optional
 
-from predictionio_tpu.storage import base, localfs, memory
+from predictionio_tpu.storage import base, localfs, memory, sql
 
 _REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 
@@ -80,7 +80,7 @@ class _LocalFSSource:
         self.events = localfs.FSEvents(root)
 
 
-_SOURCE_TYPES = {"memory": _MemorySource, "localfs": _LocalFSSource}
+_SOURCE_TYPES = {"memory": _MemorySource, "localfs": _LocalFSSource, "sql": sql.SQLSource}
 
 
 class Storage:
@@ -103,6 +103,9 @@ class Storage:
                     )
                 if typ == "localfs":
                     self._clients[name] = _SOURCE_TYPES[typ](spec.get("path", ".pio_store"))
+                elif typ == "sql":
+                    # reference JDBC URL ≈ our path; default is an ephemeral db
+                    self._clients[name] = _SOURCE_TYPES[typ](spec.get("path", ":memory:"))
                 else:
                     self._clients[name] = _SOURCE_TYPES[typ]()
             return self._clients[name]

@@ -50,9 +50,12 @@ def load_pio_env(
         if not m:
             continue
         name, value = m.group(1), m.group(2).strip()
+        single_quoted = len(value) >= 2 and value[0] == value[-1] == "'"
         if value and value[0] == value[-1] and value[0] in "\"'" and len(value) >= 2:
             value = value[1:-1]
-        value = _REF.sub(lambda mm: env.get(mm.group(1), ""), value)
+        if not single_quoted:
+            # shell `source` semantics: no ${VAR} expansion inside 'single quotes'
+            value = _REF.sub(lambda mm: env.get(mm.group(1), ""), value)
         env[name] = value
         out[name] = value
     if apply:

@@ -62,9 +62,6 @@ class QueryServerState:
         from predictionio_tpu.api.plugins import PluginRegistry
 
         self.plugins = PluginRegistry()
-        for p in plugins or []:
-            self.plugins.register(p)
-            p.start(self)
         self.engine = engine
         self.engine_params = engine_params
         self.query_class = query_class
@@ -80,6 +77,11 @@ class QueryServerState:
         self.query_count = 0
         self.started = _dt.datetime.now(_dt.timezone.utc)
         self.reload()
+        # plugins start only once the state is fully initialized (they get
+        # a live QueryServerState with engine/storage/predictor populated)
+        for p in plugins or []:
+            self.plugins.register(p)
+            p.start(self)
 
     def reload(self) -> str:
         with self._lock:

@@ -14,18 +14,20 @@ def ts(h):
     return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
 
 
-@pytest.fixture(params=["memory", "localfs"])
+@pytest.fixture(params=["memory", "localfs", "sql", "sqlfile"])
 def storage(request, tmp_path):
     if request.param == "memory":
-        cfg = StorageConfig(
-            sources={"S": {"type": "memory"}},
-            repositories={"METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S"},
-        )
+        src = {"type": "memory"}
+    elif request.param == "localfs":
+        src = {"type": "localfs", "path": str(tmp_path / "store")}
+    elif request.param == "sql":
+        src = {"type": "sql", "path": ":memory:"}
     else:
-        cfg = StorageConfig(
-            sources={"S": {"type": "localfs", "path": str(tmp_path / "store")}},
-            repositories={"METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S"},
-        )
+        src = {"type": "sql", "path": str(tmp_path / "pio.db")}
+    cfg = StorageConfig(
+        sources={"S": src},
+        repositories={"METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S"},
+    )
     return Storage(cfg)
 
 
@@ -136,6 +138,27 @@ def test_models_blob_store(storage):
     assert storage.models.get("abc123") == b"\x00\x01binary"
     assert storage.models.delete("abc123")
     assert storage.models.get("abc123") is None
+
+
+def test_sql_backend_durable_across_reopen(tmp_path):
+    """Reference JDBC parity: a second client over the same database sees
+    everything the first wrote (no in-process-only state)."""
+    from predictionio_tpu.storage.sql import SQLSource
+
+    db = str(tmp_path / "pio.db")
+    s1 = SQLSource(db)
+    app_id = s1.apps.insert(App(0, "durable"))
+    s1.events.insert(
+        Event(event="buy", entity_type="user", entity_id="u1", event_time=ts(1)),
+        app_id,
+    )
+    s1.models.insert("m1", b"blob")
+    s1.client.conn.close()
+
+    s2 = SQLSource(db)
+    assert s2.apps.get_by_name("durable").id == app_id
+    assert len(list(s2.events.find(app_id))) == 1
+    assert s2.models.get("m1") == b"blob"
 
 
 def test_pevents_find_batches(storage):
