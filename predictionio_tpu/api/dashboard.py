@@ -1,0 +1,141 @@
+"""Evaluation dashboard (reference: dashboard/ module — `pio dashboard`
+serves a web UI on :9000 listing completed evaluation instances with their
+engine params and metric scores).
+
+  GET /                         HTML dashboard: evaluations + engine instances
+  GET /dashboard.json           same data as JSON
+  GET /engine_instances.json    all engine instances
+  GET /evaluations.json         completed evaluation instances
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+from typing import Optional
+
+from predictionio_tpu import __version__
+from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.storage.locator import Storage, get_storage
+
+log = logging.getLogger("pio.dashboard")
+
+
+def _ei_json(i) -> dict:
+    return {
+        "id": i.id,
+        "status": i.status,
+        "startTime": i.start_time.isoformat() if i.start_time else None,
+        "endTime": i.end_time.isoformat() if i.end_time else None,
+        "engineId": i.engine_id,
+        "engineVersion": i.engine_version,
+        "engineVariant": i.engine_variant,
+        "engineFactory": i.engine_factory,
+    }
+
+
+def _evi_json(i) -> dict:
+    return {
+        "id": i.id,
+        "status": i.status,
+        "startTime": i.start_time.isoformat() if i.start_time else None,
+        "endTime": i.end_time.isoformat() if i.end_time else None,
+        "evaluationClass": i.evaluation_class,
+        "evaluatorResults": i.evaluator_results,
+        "evaluatorResultsJSON": i.evaluator_results_json,
+    }
+
+
+def _render_html(storage: Storage) -> str:
+    evals = storage.evaluation_instances.get_completed()
+    engines = sorted(storage.engine_instances.get_all(),
+                     key=lambda i: i.start_time, reverse=True)
+    rows_eval = "".join(
+        "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td><pre>{res}</pre></td></tr>".format(
+            id=html.escape(i.id[:12]),
+            cls=html.escape(i.evaluation_class),
+            start=html.escape(i.start_time.isoformat(timespec="seconds") if i.start_time else ""),
+            res=html.escape((i.evaluator_results or "")[:2000]),
+        )
+        for i in sorted(evals, key=lambda i: i.start_time, reverse=True)
+    ) or "<tr><td colspan=4><i>no completed evaluations</i></td></tr>"
+    rows_engine = "".join(
+        "<tr><td>{id}</td><td>{eng}</td><td>{status}</td><td>{start}</td></tr>".format(
+            id=html.escape(i.id[:12]),
+            eng=html.escape(f"{i.engine_id} v{i.engine_version} ({i.engine_variant})"),
+            status=html.escape(i.status),
+            start=html.escape(i.start_time.isoformat(timespec="seconds") if i.start_time else ""),
+        )
+        for i in engines
+    ) or "<tr><td colspan=4><i>no engine instances</i></td></tr>"
+    return f"""<!DOCTYPE html>
+<html><head><title>PredictionIO-TPU Dashboard</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; width: 100%; margin-bottom: 2em; }}
+ th, td {{ border: 1px solid #ccc; padding: 6px 10px; text-align: left;
+           vertical-align: top; }}
+ th {{ background: #f0f0f0; }}
+ pre {{ margin: 0; white-space: pre-wrap; }}
+</style></head>
+<body>
+<h1>PredictionIO-TPU Dashboard <small>v{html.escape(__version__)}</small></h1>
+<h2>Completed evaluations</h2>
+<table><tr><th>id</th><th>evaluation</th><th>started</th><th>results</th></tr>
+{rows_eval}</table>
+<h2>Engine instances</h2>
+<table><tr><th>id</th><th>engine</th><th>status</th><th>started</th></tr>
+{rows_engine}</table>
+</body></html>"""
+
+
+def make_handler(storage: Storage):
+    class DashboardHandler(JsonHandler):
+        def do_GET(self):
+            path, _ = self.route
+            if path == "/":
+                body = _render_html(storage).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/dashboard.json":
+                self.send_json({
+                    "evaluations": [_evi_json(i) for i in
+                                    storage.evaluation_instances.get_completed()],
+                    "engineInstances": [_ei_json(i) for i in
+                                        storage.engine_instances.get_all()],
+                })
+            elif path == "/engine_instances.json":
+                self.send_json({"engineInstances": [
+                    _ei_json(i) for i in storage.engine_instances.get_all()
+                ]})
+            elif path == "/evaluations.json":
+                self.send_json({"evaluations": [
+                    _evi_json(i) for i in storage.evaluation_instances.get_completed()
+                ]})
+            else:
+                self.send_error_json(404, "not found")
+
+    return DashboardHandler
+
+
+def run_dashboard(
+    host: str = "127.0.0.1",
+    port: int = 9000,
+    storage: Optional[Storage] = None,
+    background: bool = False,
+):
+    storage = storage or get_storage()
+    httpd = start_server(make_handler(storage), host, port, background=background)
+    log.info("Dashboard listening on %s:%d", host, httpd.server_address[1])
+    if background:
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0

@@ -48,6 +48,9 @@ class PluginRegistry:
         else:
             raise TypeError(f"{plugin!r} is neither OutputBlocker nor OutputSniffer")
 
+    def all(self) -> List[EngineServerPlugin]:
+        return [*self.blockers, *self.sniffers]
+
     def apply(self, query: Any, prediction: Any) -> Any:
         for b in self.blockers:
             prediction = b.process(query, prediction)

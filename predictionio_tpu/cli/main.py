@@ -6,8 +6,11 @@ bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
   app new|list|show|delete|data-delete    application management
   accesskey new|list|delete               access keys
   channel new|delete                      channels
+  build                                   validate engine.json + register manifest
+  template list|new                       built-in template gallery / scaffolding
   train / deploy / eval                   DASE workflow (workflow module)
   import / export                         event batch files
+  eventserver / dashboard                 REST ingestion / evaluation dashboard
   status                                  storage + env sanity report
   version
 
@@ -209,6 +212,36 @@ def _cmd_export(args) -> int:
     return 0
 
 
+def _cmd_build(args) -> int:
+    from predictionio_tpu.workflow.create_workflow import run_build_from_args
+
+    return run_build_from_args(args)
+
+
+def _cmd_template(args) -> int:
+    from predictionio_tpu.cli import templates
+
+    if args.template_command == "list":
+        for name, desc in templates.list_templates().items():
+            print(f"  {name:24s} {desc}")
+        return 0
+    if args.template_command == "new":
+        try:
+            dest = templates.scaffold(args.template, args.directory)
+        except (ValueError, FileExistsError) as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        print(f"Created {args.template} engine in {dest}/ (engine.json, README.md).")
+        return 0
+    raise AssertionError(args.template_command)
+
+
+def _cmd_dashboard(args) -> int:
+    from predictionio_tpu.api.dashboard import run_dashboard
+
+    return run_dashboard(host=args.ip, port=args.port)
+
+
 def _cmd_train(args) -> int:
     from predictionio_tpu.workflow.create_workflow import run_train_from_args
 
@@ -283,6 +316,26 @@ def build_parser() -> argparse.ArgumentParser:
     exp.add_argument("--app-name", default=None)
     exp.add_argument("--output", required=True)
     exp.set_defaults(func=_cmd_export)
+
+    bd = sub.add_parser("build")
+    bd.add_argument("--engine-json", default="engine.json")
+    bd.add_argument("--engine-id", default=None)
+    bd.add_argument("--engine-version", default="1")
+    bd.add_argument("--variant", default="default")
+    bd.set_defaults(func=_cmd_build)
+
+    tp = sub.add_parser("template")
+    tp_sub = tp.add_subparsers(dest="template_command", required=True)
+    tp_sub.add_parser("list")
+    tp_new = tp_sub.add_parser("new")
+    tp_new.add_argument("template")
+    tp_new.add_argument("directory")
+    tp.set_defaults(func=_cmd_template)
+
+    db = sub.add_parser("dashboard")
+    db.add_argument("--ip", default="127.0.0.1")
+    db.add_argument("--port", type=int, default=9000)
+    db.set_defaults(func=_cmd_dashboard)
 
     tr = sub.add_parser("train")
     tr.add_argument("--engine-json", default="engine.json")

@@ -241,6 +241,16 @@ def _recommend_batch_xla(user_vecs, item_factors, seen_mask, top_k):
     return jax.lax.top_k(scores, top_k)
 
 
+@functools.lru_cache(maxsize=1)
+def _recommend_route():
+    """Resolve the scoring implementation once per process — the serving hot
+    path must not pay an env read + import per query (PIO_PALLAS is read at
+    first use; see ops.pallas_kernels)."""
+    from predictionio_tpu.ops.pallas_kernels import pallas_enabled, recommend_batch_fused
+
+    return recommend_batch_fused if pallas_enabled() else _recommend_batch_xla
+
+
 def recommend_batch(
     user_vecs: jnp.ndarray,       # [B, K]
     item_factors: jnp.ndarray,    # [n_items, K]
@@ -248,9 +258,5 @@ def recommend_batch(
     top_k: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Batched top-K scoring; routes to the fused Pallas kernel when enabled
-    (PIO_PALLAS, see ops.pallas_kernels) — one HBM pass for matmul+mask."""
-    from predictionio_tpu.ops.pallas_kernels import pallas_enabled, recommend_batch_fused
-
-    if pallas_enabled():
-        return recommend_batch_fused(user_vecs, item_factors, seen_mask, top_k)
-    return _recommend_batch_xla(user_vecs, item_factors, seen_mask, top_k)
+    — one HBM pass for matmul+mask, jitted end to end either way."""
+    return _recommend_route()(user_vecs, item_factors, seen_mask, top_k)

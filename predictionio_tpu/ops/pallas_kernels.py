@@ -146,6 +146,7 @@ def masked_score_matmul(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("top_k",))
 def recommend_batch_fused(
     user_vecs: jnp.ndarray,
     item_factors: jnp.ndarray,
@@ -153,7 +154,10 @@ def recommend_batch_fused(
     top_k: int,
     bias: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Pallas-fused variant of ``ops.als.recommend_batch`` (+ optional bias)."""
+    """Pallas-fused variant of ``ops.als.recommend_batch`` (+ optional bias).
+    Jitted end to end (static top_k) so serving is one compiled program —
+    the top_k fuses with the score kernel's output instead of dispatching
+    eagerly per query."""
     scores = masked_score_matmul(user_vecs, item_factors, seen_mask, bias)
     return jax.lax.top_k(scores, top_k)
 

@@ -7,6 +7,8 @@ from predictionio_tpu.storage.base import (  # noqa: F401
     Channels,
     EngineInstance,
     EngineInstances,
+    EngineManifest,
+    EngineManifests,
     EvaluationInstance,
     EvaluationInstances,
     LEvents,

@@ -73,6 +73,22 @@ class EngineInstance:
 
 
 @dataclass
+class EngineManifest:
+    """Registered engine build (reference: EngineManifest.scala — written by
+    `pio build` via RegisterEngine; train/deploy fall back to the registered
+    file when the --engine-json path does not exist, keyed by --engine-id/
+    --engine-version).  `files` held assembly-jar paths in the reference;
+    here it holds the engine.json path."""
+
+    id: str
+    version: str
+    name: str
+    description: str = ""
+    files: List[str] = field(default_factory=list)
+    engine_factory: str = ""
+
+
+@dataclass
 class EvaluationInstance:
     id: str
     status: str
@@ -171,6 +187,26 @@ class EngineInstances(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, instance_id: str) -> bool: ...
+
+
+class EngineManifests(abc.ABC):
+    """Engine manifest registry (reference: EngineManifests.scala; keyed by
+    (id, version), upserted by `pio build`)."""
+
+    @abc.abstractmethod
+    def insert(self, manifest: EngineManifest) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, manifest_id: str, version: str) -> Optional[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineManifest]: ...
+
+    def update(self, manifest: EngineManifest) -> None:
+        self.insert(manifest)
+
+    @abc.abstractmethod
+    def delete(self, manifest_id: str, version: str) -> bool: ...
 
 
 class EvaluationInstances(abc.ABC):

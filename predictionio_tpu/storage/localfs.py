@@ -32,6 +32,7 @@ from predictionio_tpu.storage.base import (
     App,
     Channel,
     EngineInstance,
+    EngineManifest,
     EvaluationInstance,
 )
 
@@ -244,6 +245,58 @@ class FSEngineInstances(base.EngineInstances):
             d["instances"] = [i for i in d["instances"] if i["id"] != instance_id]
             self._doc.write(d)
             return len(d["instances"]) < n
+
+
+class FSEngineManifests(base.EngineManifests):
+    def __init__(self, root: Path):
+        self._doc = _JsonDoc(root / "meta" / "engine_manifests.json", {"manifests": []})
+
+    @staticmethod
+    def _to_json(m: EngineManifest) -> Dict:
+        return {
+            "id": m.id, "version": m.version, "name": m.name,
+            "description": m.description, "files": m.files,
+            "engineFactory": m.engine_factory,
+        }
+
+    @staticmethod
+    def _from_json(d: Dict) -> EngineManifest:
+        return EngineManifest(
+            id=d["id"], version=d["version"], name=d["name"],
+            description=d.get("description", ""), files=d.get("files", []),
+            engine_factory=d.get("engineFactory", ""),
+        )
+
+    def insert(self, manifest: EngineManifest) -> None:
+        with self._doc.lock:
+            d = self._doc.read()
+            d["manifests"] = [
+                m for m in d["manifests"]
+                if not (m["id"] == manifest.id and m["version"] == manifest.version)
+            ]
+            d["manifests"].append(self._to_json(manifest))
+            self._doc.write(d)
+
+    def get(self, manifest_id: str, version: str) -> Optional[EngineManifest]:
+        return next(
+            (self._from_json(m) for m in self._doc.read()["manifests"]
+             if m["id"] == manifest_id and m["version"] == version),
+            None,
+        )
+
+    def get_all(self) -> List[EngineManifest]:
+        return [self._from_json(m) for m in self._doc.read()["manifests"]]
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        with self._doc.lock:
+            d = self._doc.read()
+            n = len(d["manifests"])
+            d["manifests"] = [
+                m for m in d["manifests"]
+                if not (m["id"] == manifest_id and m["version"] == version)
+            ]
+            self._doc.write(d)
+            return len(d["manifests"]) < n
 
 
 class FSEvaluationInstances(base.EvaluationInstances):

@@ -63,6 +63,7 @@ class _MemorySource:
         self.access_keys = memory.MemAccessKeys()
         self.channels = memory.MemChannels()
         self.engine_instances = memory.MemEngineInstances()
+        self.engine_manifests = memory.MemEngineManifests()
         self.evaluation_instances = memory.MemEvaluationInstances()
         self.models = memory.MemModels()
         self.events = memory.MemEvents()
@@ -75,6 +76,7 @@ class _LocalFSSource:
         self.access_keys = localfs.FSAccessKeys(root)
         self.channels = localfs.FSChannels(root)
         self.engine_instances = localfs.FSEngineInstances(root)
+        self.engine_manifests = localfs.FSEngineManifests(root)
         self.evaluation_instances = localfs.FSEvaluationInstances(root)
         self.models = localfs.FSModels(root)
         self.events = localfs.FSEvents(root)
@@ -126,6 +128,10 @@ class Storage:
     @property
     def engine_instances(self) -> base.EngineInstances:
         return self._client("METADATA").engine_instances
+
+    @property
+    def engine_manifests(self) -> base.EngineManifests:
+        return self._client("METADATA").engine_manifests
 
     @property
     def evaluation_instances(self) -> base.EvaluationInstances:

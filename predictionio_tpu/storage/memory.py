@@ -15,6 +15,7 @@ from predictionio_tpu.storage.base import (
     App,
     Channel,
     EngineInstance,
+    EngineManifest,
     EvaluationInstance,
 )
 
@@ -121,6 +122,23 @@ class MemEngineInstances(base.EngineInstances):
 
     def delete(self, instance_id: str) -> bool:
         return self._instances.pop(instance_id, None) is not None
+
+
+class MemEngineManifests(base.EngineManifests):
+    def __init__(self):
+        self._manifests: Dict[Tuple[str, str], EngineManifest] = {}
+
+    def insert(self, manifest: EngineManifest) -> None:
+        self._manifests[(manifest.id, manifest.version)] = manifest
+
+    def get(self, manifest_id: str, version: str) -> Optional[EngineManifest]:
+        return self._manifests.get((manifest_id, version))
+
+    def get_all(self) -> List[EngineManifest]:
+        return list(self._manifests.values())
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        return self._manifests.pop((manifest_id, version), None) is not None
 
 
 class MemEvaluationInstances(base.EvaluationInstances):

@@ -29,6 +29,7 @@ from predictionio_tpu.storage.base import (
     App,
     Channel,
     EngineInstance,
+    EngineManifest,
     EvaluationInstance,
 )
 
@@ -93,6 +94,12 @@ class SQLClient:
             CREATE TABLE IF NOT EXISTS models (
                 id TEXT PRIMARY KEY,
                 blob BLOB NOT NULL
+            );
+            CREATE TABLE IF NOT EXISTS engine_manifests (
+                id TEXT NOT NULL,
+                version TEXT NOT NULL,
+                doc TEXT NOT NULL,
+                PRIMARY KEY (id, version)
             );
             """
         )
@@ -165,6 +172,9 @@ class SQLApps(base.Apps):
                 self.c.conn.commit()
                 return new_id
             except sqlite3.IntegrityError:
+                # roll back the implicit BEGIN or the shared connection stays
+                # inside an open read transaction pinning a stale WAL snapshot
+                self.c.conn.rollback()
                 return None
 
     def get(self, app_id: int) -> Optional[App]:
@@ -219,6 +229,9 @@ class SQLAccessKeys(base.AccessKeys):
                 self.c.conn.commit()
                 return key
             except sqlite3.IntegrityError:
+                # roll back the implicit BEGIN or the shared connection stays
+                # inside an open read transaction pinning a stale WAL snapshot
+                self.c.conn.rollback()
                 return None
 
     def get(self, key: str) -> Optional[AccessKey]:
@@ -264,6 +277,9 @@ class SQLChannels(base.Channels):
                 self.c.conn.commit()
                 return new_id
             except sqlite3.IntegrityError:
+                # roll back the implicit BEGIN or the shared connection stays
+                # inside an open read transaction pinning a stale WAL snapshot
+                self.c.conn.rollback()
                 return None
 
     def get(self, channel_id: int) -> Optional[Channel]:
@@ -371,6 +387,60 @@ class SQLEngineInstances(base.EngineInstances):
         with self.c.lock:
             cur = self.c.conn.execute(
                 "DELETE FROM engine_instances WHERE id=?", (instance_id,)
+            )
+            self.c.conn.commit()
+        return cur.rowcount > 0
+
+
+class SQLEngineManifests(base.EngineManifests):
+    def __init__(self, client: SQLClient):
+        self.c = client
+
+    def insert(self, manifest: EngineManifest) -> None:
+        doc = json.dumps(
+            {
+                "name": manifest.name,
+                "description": manifest.description,
+                "files": manifest.files,
+                "engine_factory": manifest.engine_factory,
+            }
+        )
+        with self.c.lock:
+            self.c.conn.execute(
+                "INSERT OR REPLACE INTO engine_manifests (id, version, doc) VALUES (?,?,?)",
+                (manifest.id, manifest.version, doc),
+            )
+            self.c.conn.commit()
+
+    @staticmethod
+    def _from_row(mid: str, version: str, doc: str) -> EngineManifest:
+        d = json.loads(doc)
+        return EngineManifest(
+            id=mid, version=version, name=d.get("name", mid),
+            description=d.get("description", ""), files=d.get("files", []),
+            engine_factory=d.get("engine_factory", ""),
+        )
+
+    def get(self, manifest_id: str, version: str) -> Optional[EngineManifest]:
+        with self.c.lock:
+            row = self.c.conn.execute(
+                "SELECT id, version, doc FROM engine_manifests WHERE id=? AND version=?",
+                (manifest_id, version),
+            ).fetchone()
+        return self._from_row(*row) if row else None
+
+    def get_all(self) -> List[EngineManifest]:
+        with self.c.lock:
+            rows = self.c.conn.execute(
+                "SELECT id, version, doc FROM engine_manifests"
+            ).fetchall()
+        return [self._from_row(*r) for r in rows]
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        with self.c.lock:
+            cur = self.c.conn.execute(
+                "DELETE FROM engine_manifests WHERE id=? AND version=?",
+                (manifest_id, version),
             )
             self.c.conn.commit()
         return cur.rowcount > 0
@@ -666,6 +736,7 @@ class SQLSource:
         self.access_keys = SQLAccessKeys(client)
         self.channels = SQLChannels(client)
         self.engine_instances = SQLEngineInstances(client)
+        self.engine_manifests = SQLEngineManifests(client)
         self.evaluation_instances = SQLEvaluationInstances(client)
         self.models = SQLModels(client)
         self.events = SQLEvents(client)

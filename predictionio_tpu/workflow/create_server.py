@@ -27,7 +27,11 @@ from typing import Any, Callable, Dict, Optional
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.storage.locator import Storage, get_storage
 from predictionio_tpu.workflow import core_workflow
-from predictionio_tpu.workflow.create_workflow import engine_from_variant, load_engine_variant
+from predictionio_tpu.workflow.create_workflow import (
+    engine_from_variant,
+    load_engine_variant,
+    resolve_engine_id,
+)
 
 log = logging.getLogger("pio.queryserver")
 
@@ -142,12 +146,42 @@ class QueryServerState:
         }
 
 
+def _render_info_html(state: QueryServerState) -> str:
+    """Deploy web UI (reference: CreateServer's engine-instance info page)."""
+    import html as _html
+
+    info = state.info()
+    rows = "".join(
+        f"<tr><th>{_html.escape(str(k))}</th><td>{_html.escape(str(v))}</td></tr>"
+        for k, v in info.items()
+    )
+    plugins = ", ".join(p.name for p in state.plugins.all()) or "(none)"
+    return f"""<!DOCTYPE html>
+<html><head><title>PredictionIO-TPU engine server</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+th,td{{border:1px solid #ccc;padding:4px 10px;text-align:left}}</style></head>
+<body><h1>Engine server: {_html.escape(state.engine_id)}</h1>
+<table>{rows}</table>
+<p>plugins: {_html.escape(plugins)}</p>
+<p>POST /queries.json &middot; GET /reload &middot; GET /stop</p>
+</body></html>"""
+
+
 def make_handler(state: QueryServerState):
     class QueryHandler(JsonHandler):
         def do_GET(self):
             path, _query = self.route
             if path == "/":
-                self.send_json(state.info())
+                accept = self.headers.get("Accept", "")
+                if "text/html" in accept:
+                    body = _render_info_html(state).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_json(state.info())
             elif path == "/reload":
                 try:
                     iid = state.reload()
@@ -202,7 +236,7 @@ def deploy(
     """Programmatic deploy; returns the HTTPServer (background=True) or blocks."""
     doc = load_engine_variant(engine_json, variant)
     factory, engine, engine_params = engine_from_variant(doc)
-    eid = engine_id or doc.get("id") or factory.engine_id()
+    eid = resolve_engine_id(engine_id, doc, factory)
     query_class = getattr(factory, "query_class", None)
     feedback_app = ""
     if feedback:
@@ -228,9 +262,11 @@ def deploy(
 
 
 def run_server_from_args(args) -> int:
+    from predictionio_tpu.workflow.create_workflow import resolve_variant_path
+
     try:
         result = deploy(
-            engine_json=args.engine_json,
+            engine_json=resolve_variant_path(args),
             variant=args.variant,
             engine_id=args.engine_id,
             engine_version=args.engine_version,

@@ -49,7 +49,33 @@ def load_engine_variant(engine_json: str, variant_id: str = "default") -> Dict[s
     doc = json.loads(path.read_text())
     if "engineFactory" not in doc:
         raise ValueError(f"{engine_json}: missing required key 'engineFactory'")
+    # engine.json lives next to user code; make its directory importable the
+    # way the reference adds the engine assembly jar to the classpath, so
+    # engineFactory can name a module local to the engine directory.
+    parent = str(path.resolve().parent)
+    if parent not in sys.path:
+        sys.path.insert(0, parent)
     return doc
+
+
+def resolve_variant_path(args) -> str:
+    """Resolve the engine.json path for a workflow command: the --engine-json
+    path if it exists, else the file registered by `pio build` for
+    (--engine-id, --engine-version) (reference: RunWorkflow resolving the
+    engine via its EngineManifest)."""
+    if Path(args.engine_json).exists():
+        return args.engine_json
+    engine_id = getattr(args, "engine_id", None)
+    if engine_id:
+        from predictionio_tpu.storage import get_storage
+
+        manifest = get_storage().engine_manifests.get(
+            engine_id, getattr(args, "engine_version", "1")
+        )
+        if manifest and manifest.files and Path(manifest.files[0]).exists():
+            log.info("resolved engine %s via manifest: %s", engine_id, manifest.files[0])
+            return manifest.files[0]
+    return args.engine_json  # let load_engine_variant raise FileNotFoundError
 
 
 def engine_from_variant(
@@ -61,13 +87,21 @@ def engine_from_variant(
     return factory, engine, engine_params
 
 
+def resolve_engine_id(
+    cli_engine_id: Optional[str], variant: Dict[str, Any], factory: Type[EngineFactory]
+) -> str:
+    """Single precedence rule for the engine id, shared by build/train/deploy:
+    explicit --engine-id > engine.json "id" > factory class name."""
+    return cli_engine_id or variant.get("id") or factory.engine_id()
+
+
 def run_train_from_args(args) -> int:
     """`pio train` entry (reference: Console.train → RunWorkflow →
     CreateWorkflow.main)."""
     try:
-        variant = load_engine_variant(args.engine_json, args.variant)
+        variant = load_engine_variant(resolve_variant_path(args), args.variant)
         factory, engine, engine_params = engine_from_variant(variant)
-        engine_id = args.engine_id or variant.get("id") or factory.engine_id()
+        engine_id = resolve_engine_id(args.engine_id, variant, factory)
         instance = core_workflow.run_train(
             engine,
             engine_params,
@@ -80,6 +114,39 @@ def run_train_from_args(args) -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     print(f"Training completed. Engine instance id: {instance.id}")
+    return 0
+
+
+def run_build_from_args(args) -> int:
+    """`pio build` entry (reference: Console.build → sbt assembly +
+    RegisterEngine writing an EngineManifest).  There is no jar to compile
+    here; "build" = validate the engine variant end to end (factory import,
+    engine construction, params binding) and register the manifest so train/
+    deploy can resolve the engine by (id, version)."""
+    from predictionio_tpu.storage import EngineManifest, get_storage
+
+    try:
+        variant = load_engine_variant(args.engine_json, getattr(args, "variant", "default"))
+        factory, engine, engine_params = engine_from_variant(variant)
+        engine_id = resolve_engine_id(getattr(args, "engine_id", None), variant, factory)
+        version = getattr(args, "engine_version", "1")
+        manifest = EngineManifest(
+            id=engine_id,
+            version=version,
+            name=variant.get("id", engine_id),
+            description=variant.get("description", ""),
+            files=[str(Path(args.engine_json).resolve())],
+            engine_factory=variant["engineFactory"],
+        )
+        get_storage().engine_manifests.insert(manifest)
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    n_algos = len(engine_params.algorithm_params_list)
+    print(
+        f"Build successful. Registered engine {engine_id} {version} "
+        f"(factory {variant['engineFactory']}, {n_algos} algorithm(s))."
+    )
     return 0
 
 

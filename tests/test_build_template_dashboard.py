@@ -1,0 +1,220 @@
+"""pio build / pio template / dashboard / engine manifests
+(reference: Console.build + RegisterEngine → EngineManifests; template
+gallery; dashboard module)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.cli.main import main as pio_main
+from predictionio_tpu.storage.base import EngineManifest
+
+
+# ---------------------------------------------------------------------------
+# EngineManifests repository across backends
+# ---------------------------------------------------------------------------
+
+
+def _manifest_roundtrip(store):
+    m = EngineManifest(
+        id="my-engine", version="1", name="My Engine",
+        description="d", files=["/tmp/engine.json"],
+        engine_factory="predictionio_tpu.models.recommendation.RecommendationEngine",
+    )
+    store.insert(m)
+    got = store.get("my-engine", "1")
+    assert got is not None
+    assert got.engine_factory == m.engine_factory
+    assert got.files == ["/tmp/engine.json"]
+    # upsert replaces
+    m2 = EngineManifest(id="my-engine", version="1", name="Renamed")
+    store.insert(m2)
+    assert store.get("my-engine", "1").name == "Renamed"
+    assert len(store.get_all()) == 1
+    assert store.get("my-engine", "2") is None
+    assert store.delete("my-engine", "1")
+    assert not store.delete("my-engine", "1")
+
+
+def test_engine_manifests_memory():
+    from predictionio_tpu.storage.memory import MemEngineManifests
+
+    _manifest_roundtrip(MemEngineManifests())
+
+
+def test_engine_manifests_localfs(tmp_path):
+    from predictionio_tpu.storage.localfs import FSEngineManifests
+
+    _manifest_roundtrip(FSEngineManifests(tmp_path))
+
+
+def test_engine_manifests_sql():
+    from predictionio_tpu.storage.sql import SQLClient, SQLEngineManifests
+
+    _manifest_roundtrip(SQLEngineManifests(SQLClient(":memory:")))
+
+
+# ---------------------------------------------------------------------------
+# pio build
+# ---------------------------------------------------------------------------
+
+
+def test_pio_build_registers_manifest(mem_storage, tmp_path, capsys):
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps({
+        "id": "build-test",
+        "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "App"}},
+        "algorithms": [{"name": "als", "params": {"rank": 4}}],
+    }))
+    rc = pio_main(["build", "--engine-json", str(engine_json)])
+    assert rc == 0
+    assert "Build successful" in capsys.readouterr().out
+    m = mem_storage.engine_manifests.get("build-test", "1")
+    assert m is not None
+    assert m.engine_factory.endswith("RecommendationEngine")
+    assert str(engine_json) in m.files[0]
+
+
+def test_pio_build_rejects_bad_factory(mem_storage, tmp_path):
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps({"engineFactory": "no.such.module.Engine"}))
+    assert pio_main(["build", "--engine-json", str(engine_json)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# pio template
+# ---------------------------------------------------------------------------
+
+
+def test_template_list(capsys):
+    assert pio_main(["template", "list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("recommendation", "classification", "similar_product",
+                 "universal_recommender", "text"):
+        assert name in out
+
+
+@pytest.mark.parametrize("template", [
+    "recommendation", "classification", "similar_product",
+    "universal_recommender", "text",
+])
+def test_template_scaffold_builds(template, mem_storage, tmp_path):
+    """Every scaffolded engine.json must pass `pio build` (params bind)."""
+    dest = tmp_path / template
+    assert pio_main(["template", "new", template, str(dest)]) == 0
+    assert (dest / "engine.json").exists()
+    assert (dest / "README.md").exists()
+    assert pio_main(["build", "--engine-json", str(dest / "engine.json")]) == 0
+
+
+def test_template_scaffold_refuses_overwrite(tmp_path):
+    dest = tmp_path / "t"
+    assert pio_main(["template", "new", "text", str(dest)]) == 0
+    assert pio_main(["template", "new", "text", str(dest)]) == 1
+
+
+def test_template_unknown(tmp_path):
+    assert pio_main(["template", "new", "nope", str(tmp_path / "x")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# dashboard
+# ---------------------------------------------------------------------------
+
+
+def test_dashboard_server(mem_storage):
+    import datetime as dt
+
+    from predictionio_tpu.api.dashboard import run_dashboard
+    from predictionio_tpu.storage.base import EngineInstance, EvaluationInstance
+
+    now = dt.datetime.now(dt.timezone.utc)
+    mem_storage.engine_instances.insert(EngineInstance(
+        id="ei1", status="COMPLETED", start_time=now, end_time=now,
+        engine_id="reco", engine_version="1", engine_variant="default",
+        engine_factory="f",
+    ))
+    mem_storage.evaluation_instances.insert(EvaluationInstance(
+        id="ev1", status="EVALCOMPLETED", start_time=now, end_time=now,
+        evaluation_class="my.Eval", evaluator_results="metric=0.9",
+    ))
+    httpd = run_dashboard(host="127.0.0.1", port=0, storage=mem_storage,
+                          background=True)
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        html = urllib.request.urlopen(base + "/").read().decode()
+        assert "PredictionIO-TPU Dashboard" in html
+        assert "my.Eval" in html and "reco" in html
+        doc = json.loads(urllib.request.urlopen(base + "/dashboard.json").read())
+        assert doc["evaluations"][0]["id"] == "ev1"
+        assert doc["engineInstances"][0]["engineId"] == "reco"
+        evs = json.loads(urllib.request.urlopen(base + "/evaluations.json").read())
+        assert evs["evaluations"][0]["evaluatorResults"] == "metric=0.9"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# manifest-based resolution + local engine modules
+# ---------------------------------------------------------------------------
+
+
+def test_train_resolves_engine_via_manifest(mem_storage, tmp_path, capsys):
+    """After `pio build`, train finds the engine by --engine-id even when
+    run from elsewhere (reference: RunWorkflow resolving via EngineManifest)."""
+    import numpy as np
+
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.storage.base import App
+
+    app_id = mem_storage.apps.insert(App(0, "mfapp"))
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(10):
+        for i in range(6):
+            if rng.random() < 0.9:
+                liked = (u < 5) == (i < 3)
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0 if liked else 1.0})))
+    mem_storage.l_events.insert_batch(events, app_id)
+
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps({
+        "id": "mf-engine",
+        "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "mfapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "numIterations": 4, "lambda": 0.05,
+                                   "meshDp": 1}}],
+    }))
+    assert pio_main(["build", "--engine-json", str(engine_json)]) == 0
+    # engine.json path that does not exist + --engine-id -> manifest lookup
+    rc = pio_main(["train", "--engine-json", str(tmp_path / "nope.json"),
+                   "--engine-id", "mf-engine"])
+    assert rc == 0
+    assert "Training completed" in capsys.readouterr().out
+
+
+def test_local_engine_module_importable(mem_storage, tmp_path):
+    """engineFactory may name a module that lives next to engine.json
+    (the scaffold README's customization path)."""
+    (tmp_path / "my_local_engine.py").write_text(
+        "from predictionio_tpu.models.recommendation import RecommendationEngine\n"
+        "class LocalEngine(RecommendationEngine):\n"
+        "    pass\n"
+    )
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps({
+        "id": "local-engine",
+        "engineFactory": "my_local_engine.LocalEngine",
+        "datasource": {"params": {"appName": "App"}},
+        "algorithms": [{"name": "als", "params": {"rank": 4}}],
+    }))
+    assert pio_main(["build", "--engine-json", str(engine_json)]) == 0
+    m = mem_storage.engine_manifests.get("local-engine", "1")
+    assert m is not None and m.engine_factory == "my_local_engine.LocalEngine"

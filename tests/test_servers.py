@@ -193,3 +193,15 @@ def test_query_server_reload(deployed_engine):
     base = deployed_engine["base"]
     status, body = http("GET", base + "/reload")
     assert status == 200 and body["reloaded"]
+
+
+def test_query_server_web_ui(deployed_engine):
+    """GET / with Accept: text/html renders the deploy web UI
+    (reference: CreateServer engine-instance info page)."""
+    import urllib.request
+
+    req = urllib.request.Request(deployed_engine["base"] + "/",
+                                 headers={"Accept": "text/html"})
+    body = urllib.request.urlopen(req).read().decode()
+    assert "Engine server: qs-engine" in body
+    assert "queries.json" in body
