@@ -94,12 +94,7 @@ def make_handler(storage: Storage):
         def do_GET(self):
             path, _ = self.route
             if path == "/":
-                body = _render_html(storage).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/html; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self.send_html(_render_html(storage))
             elif path == "/dashboard.json":
                 self.send_json({
                     "evaluations": [_evi_json(i) for i in

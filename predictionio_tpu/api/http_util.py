@@ -47,6 +47,14 @@ class JsonHandler(BaseHTTPRequestHandler):
     def send_error_json(self, status: int, message: str) -> None:
         self.send_json({"message": message}, status=status)
 
+    def send_html(self, html: str, status: int = 200) -> None:
+        body = html.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
 
 def start_server(
     handler_cls, host: str, port: int, background: bool = False

@@ -241,14 +241,14 @@ def _recommend_batch_xla(user_vecs, item_factors, seen_mask, top_k):
     return jax.lax.top_k(scores, top_k)
 
 
-@functools.lru_cache(maxsize=1)
-def _recommend_route():
-    """Resolve the scoring implementation once per process — the serving hot
-    path must not pay an env read + import per query (PIO_PALLAS is read at
-    first use; see ops.pallas_kernels)."""
-    from predictionio_tpu.ops.pallas_kernels import pallas_enabled, recommend_batch_fused
+@functools.lru_cache(maxsize=4)
+def _recommend_route(mode: str):
+    """Scoring implementation per PIO_PALLAS mode — caching by mode keeps
+    the per-query cost to one env read (no import), while still honoring
+    runtime toggling of the env var (tests flip it)."""
+    from predictionio_tpu.ops.pallas_kernels import recommend_batch_fused
 
-    return recommend_batch_fused if pallas_enabled() else _recommend_batch_xla
+    return _recommend_batch_xla if mode == "off" else recommend_batch_fused
 
 
 def recommend_batch(
@@ -259,4 +259,6 @@ def recommend_batch(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Batched top-K scoring; routes to the fused Pallas kernel when enabled
     — one HBM pass for matmul+mask, jitted end to end either way."""
-    return _recommend_route()(user_vecs, item_factors, seen_mask, top_k)
+    from predictionio_tpu.ops.pallas_kernels import pallas_mode
+
+    return _recommend_route(pallas_mode())(user_vecs, item_factors, seen_mask, top_k)

@@ -1,3 +1,9 @@
+from predictionio_tpu.parallel.distributed import (  # noqa: F401
+    DistributedConfig,
+    init_distributed,
+    process_local_rows,
+    shard_segments,
+)
 from predictionio_tpu.parallel.mesh import (  # noqa: F401
     MeshSpec,
     create_mesh,

@@ -1,0 +1,142 @@
+"""Multi-host runtime (reference: Spark cluster deployment — the driver/
+executor topology configured via spark-submit/sparkConf; SURVEY.md §2
+'Distributed comm backend' and §5).
+
+The reference scales out by submitting to a Spark cluster: Netty shuffle +
+Akka RPC between JVMs.  The TPU-native equivalent is much thinner — every
+host runs the SAME program, `jax.distributed.initialize()` wires the hosts
+into one runtime, and after that `jax.devices()` enumerates the global chip
+set, so the mesh/GSPMD programs in this package run unchanged: collectives
+ride ICI within a slice and DCN across slices, placed by XLA.
+
+What this module adds on top of raw `jax.distributed`:
+
+- env-driven initialization matching the pio-env.sh config convention
+  (`PIO_COORDINATOR_ADDRESS`, `PIO_NUM_PROCESSES`, `PIO_PROCESS_ID`), with
+  TPU-pod autodetection when unset (JAX reads the TPU metadata itself);
+- host-sharded ingest: deterministic assignment of event-log segments to
+  processes so each host scans only its share of the append-only log
+  (replaces the reference's HBase-region → Spark-partition locality);
+- `process_local_rows`: the row range of a globally dp-sharded array that
+  this process must materialize (for `jax.make_array_from_single_device_arrays`
+  -style per-host staging).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import List, Optional, Sequence, Tuple, TypeVar
+
+import jax
+
+log = logging.getLogger("pio.distributed")
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Multi-process topology, from env (conf/pio-env.sh convention)."""
+
+    coordinator_address: Optional[str]  # host:port of process 0
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        return cls(
+            coordinator_address=os.environ.get("PIO_COORDINATOR_ADDRESS") or None,
+            num_processes=int(os.environ.get("PIO_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PIO_PROCESS_ID", "0")),
+        )
+
+    @property
+    def is_multi_process(self) -> bool:
+        return self.num_processes > 1 or self.coordinator_address is not None
+
+
+_initialized = False
+
+
+def init_distributed(config: Optional[DistributedConfig] = None) -> DistributedConfig:
+    """Idempotently initialize the multi-host JAX runtime.
+
+    Single-process configs are a no-op, so every entry point (CLI train,
+    servers, tests) can call this unconditionally.  On TPU pods where the
+    env vars are unset, `jax.distributed.initialize()` autodetects the
+    topology from the TPU metadata service; the explicit env path exists for
+    CPU/GPU fleets and for pinning the coordinator in containerized deploys.
+    """
+    global _initialized
+    config = config or DistributedConfig.from_env()
+    if _initialized:
+        return config
+    if config.is_multi_process:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        log.info(
+            "distributed runtime up: process %d/%d, %d global devices",
+            config.process_id, config.num_processes, len(jax.devices()),
+        )
+        _initialized = True
+    return config
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def shard_segments(segments: Sequence[T],
+                   n_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> List[T]:
+    """This process's share of the event-log segments, strided round-robin.
+
+    Segments are immutable once rotated (storage/localfs.py), so a static
+    assignment is safe; striding (rather than contiguous blocks) balances
+    load when segment sizes trend over time — the same reason HBase scans in
+    the reference spread regions over executors.
+    """
+    n = n_processes if n_processes is not None else process_count()
+    i = process_id if process_id is not None else process_index()
+    if not 0 <= i < n:
+        raise ValueError(f"process_id {i} out of range for {n} processes")
+    return list(segments[i::n])
+
+
+def process_local_rows(n_rows: int, mesh) -> Tuple[int, int]:
+    """[start, stop) of the dp-sharded global row space owned by this
+    process's addressable devices — what host-side staging must load.
+
+    Assumes the mesh's dp axis is the leading axis and rows divide evenly
+    over it (use mesh.pad_rows_for_mesh first).
+    """
+    import numpy as np
+
+    dp = mesh.shape["dp"]
+    if n_rows % dp != 0:
+        raise ValueError(f"{n_rows} rows do not divide over dp={dp}")
+    rows_per_shard = n_rows // dp
+    me = process_index()
+    dp_positions = sorted(
+        int(pos[0])
+        for pos, dev in np.ndenumerate(mesh.devices)
+        if dev.process_index == me
+    )
+    if not dp_positions:
+        return (0, 0)
+    if dp_positions != list(range(dp_positions[0], dp_positions[-1] + 1)):
+        raise ValueError(
+            f"this process's dp positions {dp_positions} are not contiguous; "
+            "build the mesh with hosts laid out contiguously along dp "
+            "(the default jax.devices() order) to use per-host row staging"
+        )
+    return (dp_positions[0] * rows_per_shard, (dp_positions[-1] + 1) * rows_per_shard)

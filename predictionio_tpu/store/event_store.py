@@ -72,17 +72,23 @@ class PEventStore:
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         storage: Optional[Storage] = None,
+        local_shard: bool = False,
     ) -> EventBatch:
         """Read matching events as ONE columnar batch (device-staging format).
 
         Fast path: when the event backend is segment-file based (localfs) the
         native C++ scanner parses all segments in parallel and filters are
         applied columnar; otherwise events stream through the Python path.
+
+        ``local_shard=True`` on a multi-host runtime reads only this
+        process's share of the log — whole segments on the segment-file path,
+        strided events otherwise (replaces the reference's HBase-region →
+        Spark-partition locality; see parallel.distributed.shard_segments).
         """
         storage = storage or get_storage()
         native = PEventStore._native_batch(
             app_name, channel_name, event_names, entity_type,
-            start_time, until_time, storage,
+            start_time, until_time, storage, local_shard,
         )
         if native is not None:
             return native
@@ -97,12 +103,16 @@ class PEventStore:
                 storage=storage,
             )
         )
+        if local_shard:
+            from predictionio_tpu.parallel import distributed as dist
+
+            events = dist.shard_segments(events)
         return EventBatch.from_events(events)
 
     @staticmethod
     def _native_batch(
         app_name, channel_name, event_names, entity_type,
-        start_time, until_time, storage,
+        start_time, until_time, storage, local_shard=False,
     ) -> Optional[EventBatch]:
         import numpy as np
 
@@ -117,10 +127,21 @@ class PEventStore:
         paths = backend.segment_paths(app_id, channel_id)
         if not paths:
             return EventBatch.from_events([])
-        # tombstoned events are invisible to the columnar scanner; fall back
+        # Fallback decisions (tombstones, path availability) are made on
+        # SHARED state before any per-process sharding, so every process in a
+        # multi-host run picks the same strategy — otherwise segment-sharded
+        # and event-strided processes would partition different spaces and
+        # drop events globally.  (All hosts must also run the same image so
+        # native_available() agrees; the scanner builds from source on use.)
         tomb = paths[0].parent / "tombstones.txt"
         if tomb.exists() and tomb.stat().st_size > 0:
-            return None
+            return None  # tombstoned events are invisible to the scanner
+        if local_shard:
+            from predictionio_tpu.parallel import distributed as dist
+
+            paths = dist.shard_segments(paths)
+            if not paths:
+                return EventBatch.from_events([])
         batch = scan_segments(paths)
         mask = np.ones(len(batch), bool)
         if event_names is not None:

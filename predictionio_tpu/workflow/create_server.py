@@ -174,12 +174,7 @@ def make_handler(state: QueryServerState):
             if path == "/":
                 accept = self.headers.get("Accept", "")
                 if "text/html" in accept:
-                    body = _render_info_html(state).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self.send_html(_render_info_html(state))
                 else:
                     self.send_json(state.info())
             elif path == "/reload":

@@ -99,6 +99,11 @@ def run_train_from_args(args) -> int:
     """`pio train` entry (reference: Console.train → RunWorkflow →
     CreateWorkflow.main)."""
     try:
+        # no-op single-process; on a multi-host fleet (PIO_COORDINATOR_ADDRESS
+        # et al.) this joins the global runtime before any mesh is built
+        from predictionio_tpu.parallel.distributed import init_distributed
+
+        init_distributed()
         variant = load_engine_variant(resolve_variant_path(args), args.variant)
         factory, engine, engine_params = engine_from_variant(variant)
         engine_id = resolve_engine_id(args.engine_id, variant, factory)

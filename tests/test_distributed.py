@@ -1,0 +1,118 @@
+"""Multi-host runtime helpers (reference analogue: Spark cluster topology
+config; here jax.distributed + host-sharded ingest)."""
+
+import jax
+import numpy as np
+import pytest
+
+from predictionio_tpu.parallel.distributed import (
+    DistributedConfig,
+    init_distributed,
+    process_local_rows,
+    shard_segments,
+)
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.delenv("PIO_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("PIO_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PIO_PROCESS_ID", raising=False)
+    cfg = DistributedConfig.from_env()
+    assert cfg.num_processes == 1 and cfg.process_id == 0
+    assert not cfg.is_multi_process
+
+    monkeypatch.setenv("PIO_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setenv("PIO_NUM_PROCESSES", "4")
+    monkeypatch.setenv("PIO_PROCESS_ID", "2")
+    cfg = DistributedConfig.from_env()
+    assert cfg.is_multi_process
+    assert cfg.coordinator_address == "10.0.0.1:8476"
+    assert (cfg.num_processes, cfg.process_id) == (4, 2)
+
+
+def test_init_single_process_noop():
+    cfg = init_distributed(DistributedConfig(None, 1, 0))
+    assert not cfg.is_multi_process
+    # jax still works, nothing was torn down
+    assert len(jax.devices()) >= 1
+
+
+def test_shard_segments_partition():
+    segs = [f"seg-{i:05d}" for i in range(23)]
+    shares = [shard_segments(segs, n_processes=4, process_id=p) for p in range(4)]
+    # full coverage, no overlap
+    merged = sorted(s for share in shares for s in share)
+    assert merged == sorted(segs)
+    # strided balance: share sizes differ by at most 1
+    sizes = [len(s) for s in shares]
+    assert max(sizes) - min(sizes) <= 1
+    # deterministic
+    assert shares[1] == shard_segments(segs, n_processes=4, process_id=1)
+
+
+def test_shard_segments_bad_process():
+    with pytest.raises(ValueError):
+        shard_segments([1, 2], n_processes=2, process_id=2)
+
+
+def test_process_local_rows_single_host_mesh():
+    mesh = create_mesh(MeshSpec(dp=8, mp=1), devices=jax.devices()[:8])
+    start, stop = process_local_rows(800, mesh)
+    # single process owns every dp shard
+    assert (start, stop) == (0, 800)
+    with pytest.raises(ValueError):
+        process_local_rows(801, mesh)
+
+
+def test_process_local_rows_simulated_two_hosts(monkeypatch):
+    """Pretend the mesh's second dp half belongs to another process."""
+    import predictionio_tpu.parallel.distributed as dist
+
+    mesh = create_mesh(MeshSpec(dp=8, mp=1), devices=jax.devices()[:8])
+    devs = list(mesh.devices.flatten())
+    half = {id(d) for d in devs[4:]}
+
+    class FakeDev:
+        def __init__(self, dev, pidx):
+            self._dev = dev
+            self.process_index = pidx
+
+    fake = np.array(
+        [FakeDev(d, 1 if id(d) in half else 0) for d in devs]
+    ).reshape(mesh.devices.shape)
+
+    class FakeMesh:
+        shape = {"dp": 8}
+        devices = fake
+
+    monkeypatch.setattr(dist, "process_index", lambda: 0)
+    assert process_local_rows(800, FakeMesh()) == (0, 400)
+    monkeypatch.setattr(dist, "process_index", lambda: 1)
+    assert process_local_rows(800, FakeMesh()) == (400, 800)
+
+
+def test_batch_local_shard(mem_storage, monkeypatch):
+    """PEventStore.batch(local_shard=True) reads only this process's stride."""
+    import predictionio_tpu.parallel.distributed as dist
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.store.event_store import PEventStore
+
+    app_id = mem_storage.apps.insert(App(0, "shardapp"))
+    events = [
+        Event(event="view", entity_type="user", entity_id=f"u{i}",
+              target_entity_type="item", target_entity_id=f"i{i % 5}")
+        for i in range(10)
+    ]
+    mem_storage.l_events.insert_batch(events, app_id)
+
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "process_index", lambda: 0)
+    b0 = PEventStore.batch("shardapp", storage=mem_storage, local_shard=True)
+    monkeypatch.setattr(dist, "process_index", lambda: 1)
+    b1 = PEventStore.batch("shardapp", storage=mem_storage, local_shard=True)
+    assert len(b0) + len(b1) == 10
+    assert len(b0) == 5 and len(b1) == 5
+    full = PEventStore.batch("shardapp", storage=mem_storage)
+    assert len(full) == 10
