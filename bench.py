@@ -53,16 +53,13 @@ def bench_ur(smoke: bool) -> dict:
     total_events = n_buy + n_view
 
     def train_once():
-        p = cco_ops.block_interactions(buy_u, buy_i, n_users, n_items)
-        o = cco_ops.block_interactions(view_u, view_i, n_users, n_items)
-        rc = np.zeros(n_items, np.float32)
-        np.add.at(rc, p.item[p.mask > 0], 1)
-        cc = np.zeros(n_items, np.float32)
-        np.add.at(cc, o.item[o.mask > 0], 1)
         # self + cross indicators — the UR train loop over its event types
-        cco_ops.cco_indicators(p, p, rc, rc, n_users, top_k=top_k, item_tile=tile,
-                               exclude_self=True)
-        cco_ops.cco_indicators(p, o, rc, cc, n_users, top_k=top_k, item_tile=tile)
+        cco_ops.cco_indicators_coo(
+            buy_u, buy_i, buy_u, buy_i, n_users, n_items, n_items,
+            top_k=top_k, item_tile=tile, exclude_self=True)
+        cco_ops.cco_indicators_coo(
+            buy_u, buy_i, view_u, view_i, n_users, n_items, n_items,
+            top_k=top_k, item_tile=tile)
 
     train_once()  # warm-up: XLA compile
     t0 = time.perf_counter()
@@ -168,6 +165,10 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
     ap.add_argument("--only", choices=["ur", "p50", "als", "scan"], default=None)
     args = ap.parse_args()
+
+    from predictionio_tpu.utils import apply_platform_override
+
+    apply_platform_override()
 
     if args.only:
         out = {

@@ -369,6 +369,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from predictionio_tpu.utils import apply_platform_override
+
+    apply_platform_override()
     args = build_parser().parse_args(argv)
     return args.func(args)
 

@@ -56,8 +56,11 @@ TEMPLATE_VARIANTS: Dict[str, Dict] = {
         "datasource": {"params": {"appName": "MyApp",
                                   "eventNames": ["purchase", "view"]}},
         "algorithms": [
+            # appName here too: serving-time user-history lookup reads the
+            # live event store (without it queries fall back to popularity)
             {"name": "ur",
-             "params": {"maxCorrelatorsPerItem": 50, "num": 20}},
+             "params": {"appName": "MyApp",
+                        "maxCorrelatorsPerItem": 50, "num": 20}},
         ],
     },
     "text": {

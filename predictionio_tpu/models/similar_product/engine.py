@@ -201,16 +201,12 @@ class SPCooccurrenceAlgorithm(Algorithm):
                            indicator_llr=np.zeros((0, 1), np.float32))
         dp = self.params.mesh_dp or len(jax.devices())
         mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
-        blocked = cco_ops.block_interactions(
-            td.user_idx, td.item_idx, n_users, n_items,
-            user_block=self.params.user_block,
-        )
-        counts = np.zeros(n_items, np.float32)
-        np.add.at(counts, blocked.item[blocked.mask > 0], 1)
-        scores, idx = cco_ops.cco_indicators(
-            blocked, blocked, counts, counts, n_users,
+        scores, idx = cco_ops.cco_indicators_coo(
+            td.user_idx, td.item_idx, td.user_idx, td.item_idx,
+            n_users, n_items, n_items,
             top_k=self.params.max_correlators_per_item,
             llr_threshold=self.params.min_llr,
+            user_block=self.params.user_block,
             item_tile=self.params.item_tile,
             mesh=mesh, exclude_self=True,
         )

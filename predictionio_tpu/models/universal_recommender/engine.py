@@ -268,28 +268,19 @@ class URAlgorithm(Algorithm):
         dp = self.params.mesh_dp or len(jax.devices())
         mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
         block = self.params.user_block
-        p_blocked = cco_ops.block_interactions(
-            p_user, p_item, n_users, n_items, user_block=block
-        )
-        p_counts = _distinct_counts(p_blocked)
+        p_counts = cco_ops.distinct_user_counts(p_user, p_item, n_items)
         indicator_idx: Dict[str, np.ndarray] = {}
         indicator_llr: Dict[str, np.ndarray] = {}
         event_item_dicts: Dict[str, IdDict] = {}
         for name in td.event_names:
             u, i, item_dict = td.interactions[name]
-            if name == primary:
-                blocked, counts = p_blocked, p_counts
-            else:
-                if len(item_dict) == 0:
-                    continue
-                blocked = cco_ops.block_interactions(
-                    u, i, n_users, len(item_dict), user_block=block
-                )
-                counts = _distinct_counts(blocked)
-            scores, idx = cco_ops.cco_indicators(
-                p_blocked, blocked, p_counts, counts, n_users,
+            if name != primary and len(item_dict) == 0:
+                continue
+            scores, idx = cco_ops.cco_indicators_coo(
+                p_user, p_item, u, i, n_users, n_items, len(item_dict),
                 top_k=self.params.max_correlators_per_item,
                 llr_threshold=self.params.min_llr,
+                user_block=block,
                 item_tile=self.params.item_tile,
                 mesh=mesh,
                 exclude_self=(name == primary),
@@ -430,9 +421,3 @@ class UniversalRecommenderEngine(EngineFactory):
         )
 
     query_class = URQuery
-
-
-def _distinct_counts(blocked: cco_ops.BlockedInteractions) -> np.ndarray:
-    counts = np.zeros(blocked.n_items, np.float32)
-    np.add.at(counts, blocked.item[blocked.mask > 0], 1)
-    return counts

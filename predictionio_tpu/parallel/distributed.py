@@ -126,11 +126,11 @@ def process_local_rows(n_rows: int, mesh) -> Tuple[int, int]:
         raise ValueError(f"{n_rows} rows do not divide over dp={dp}")
     rows_per_shard = n_rows // dp
     me = process_index()
-    dp_positions = sorted(
+    dp_positions = sorted({
         int(pos[0])
         for pos, dev in np.ndenumerate(mesh.devices)
         if dev.process_index == me
-    )
+    })  # set: with mp > 1 each dp position appears once per mp column
     if not dp_positions:
         return (0, 0)
     if dp_positions != list(range(dp_positions[0], dp_positions[-1] + 1)):

@@ -61,3 +61,17 @@ def load_pio_env(
     if apply:
         os.environ.update(out)
     return out
+
+
+def apply_platform_override() -> None:
+    """PIO_JAX_PLATFORM=cpu|tpu pins the JAX backend before first use.
+
+    Env-var JAX_PLATFORMS alone can be overridden by host site config, so
+    entry points (pio CLI, bench.py) apply it programmatically via
+    jax.config; must run before any jax backend initialization.
+    """
+    plat = os.environ.get("PIO_JAX_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -132,3 +132,55 @@ def test_cco_mesh_matches_single():
     s8, i8 = cco_indicators(p, o, rc, cc, n_users, top_k=5, mesh=mesh)
     assert np.allclose(np.where(np.isfinite(s1), s1, -1), np.where(np.isfinite(s8), s8, -1), atol=1e-3)
     assert (i1 == i8).all()
+
+
+def test_dense_matches_tiled(monkeypatch):
+    """The dense user-chunked path and the tiled fallback agree exactly."""
+    n_users, n_ip, n_it = 60, 12, 17
+    pu, pi = random_interactions(n_users, n_ip, 300, 11)
+    ou, oi = random_interactions(n_users, n_it, 500, 12)
+    p = block_interactions(pu, pi, n_users, n_ip, user_block=16)
+    o = block_interactions(ou, oi, n_users, n_it, user_block=16)
+    rc = interaction_counts(p.item[p.mask > 0], n_ip)
+    cc = interaction_counts(o.item[o.mask > 0], n_it)
+
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    sd, idd = cco_indicators(p, o, rc, cc, n_users, top_k=6, item_tile=8)
+    monkeypatch.setenv("PIO_CCO_DENSE", "0")
+    st, idt = cco_indicators(p, o, rc, cc, n_users, top_k=6, item_tile=8)
+    np.testing.assert_allclose(sd, st, rtol=1e-5)
+    # indices may tie-break differently only where scores tie; require
+    # identical index sets per row for non-padding entries
+    for r in range(n_ip):
+        assert set(idd[r][sd[r] > -np.inf]) == set(idt[r][st[r] > -np.inf])
+
+
+def test_dense_mesh_matches_single(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    n_users, n_ip, n_it = 64, 10, 10
+    pu, pi = random_interactions(n_users, n_ip, 240, 21)
+    ou, oi = random_interactions(n_users, n_it, 400, 22)
+    p = block_interactions(pu, pi, n_users, n_ip, user_block=8)
+    o = block_interactions(ou, oi, n_users, n_it, user_block=8)
+    rc = interaction_counts(p.item[p.mask > 0], n_ip)
+    cc = interaction_counts(o.item[o.mask > 0], n_it)
+    s1, i1 = cco_indicators(p, o, rc, cc, n_users, top_k=5)
+    mesh = create_mesh(MeshSpec(dp=8, mp=1))
+    s8, i8 = cco_indicators(p, o, rc, cc, n_users, top_k=5, mesh=mesh)
+    np.testing.assert_allclose(s1, s8, rtol=1e-5, atol=1e-5)
+
+
+def test_dense_exclude_self_and_topk_overflow(monkeypatch):
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    n_users, n_items = 40, 6
+    u, i = random_interactions(n_users, n_items, 200, 31)
+    b = block_interactions(u, i, n_users, n_items)
+    counts = interaction_counts(b.item[b.mask > 0], n_items)
+    # top_k wider than the (padded) item space still returns [I, top_k]
+    scores, idx = cco_indicators(b, b, counts, counts, n_users,
+                                 top_k=300, exclude_self=True)
+    assert scores.shape == (n_items, 300) and idx.shape == (n_items, 300)
+    for r in range(n_items):
+        assert r not in set(idx[r][idx[r] >= 0])

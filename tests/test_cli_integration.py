@@ -20,10 +20,10 @@ def pio_env(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["PIO_FS_BASEDIR"] = str(tmp_path / "pio_store")
-    # keep subprocess JAX on CPU (env JAX_PLATFORMS is overridden by this
-    # VM's sitecustomize, but training params below pin mesh_dp=1 and the
-    # CLI path itself is platform-agnostic)
-    env["PIO_TEST_SUBPROC"] = "1"
+    # keep subprocess JAX on CPU regardless of ambient TPU state — the CLI
+    # applies this programmatically (env JAX_PLATFORMS alone is overridden
+    # by this VM's sitecustomize)
+    env["PIO_JAX_PLATFORM"] = "cpu"
     return env
 
 

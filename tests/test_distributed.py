@@ -116,3 +116,9 @@ def test_batch_local_shard(mem_storage, monkeypatch):
     assert len(b0) == 5 and len(b1) == 5
     full = PEventStore.batch("shardapp", storage=mem_storage)
     assert len(full) == 10
+
+
+def test_process_local_rows_mp_mesh():
+    """mp > 1 duplicates each dp position across mp columns; still contiguous."""
+    mesh = create_mesh(MeshSpec(dp=4, mp=2), devices=jax.devices()[:8])
+    assert process_local_rows(400, mesh) == (0, 400)
