@@ -268,7 +268,9 @@ class URAlgorithm(Algorithm):
         dp = self.params.mesh_dp or len(jax.devices())
         mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
         block = self.params.user_block
-        p_counts = cco_ops.distinct_user_counts(p_user, p_item, n_items)
+        # dedup the primary ONCE; every per-event-type CCO call reuses it
+        pu_d, pi_d = cco_ops.dedup_pairs(p_user, p_item, n_items)
+        p_counts = cco_ops.interaction_counts(pi_d, n_items)
         indicator_idx: Dict[str, np.ndarray] = {}
         indicator_llr: Dict[str, np.ndarray] = {}
         event_item_dicts: Dict[str, IdDict] = {}
@@ -276,14 +278,18 @@ class URAlgorithm(Algorithm):
             u, i, item_dict = td.interactions[name]
             if name != primary and len(item_dict) == 0:
                 continue
+            if name == primary:
+                u, i = pu_d, pi_d
             scores, idx = cco_ops.cco_indicators_coo(
-                p_user, p_item, u, i, n_users, n_items, len(item_dict),
+                pu_d, pi_d, u, i, n_users, n_items, len(item_dict),
                 top_k=self.params.max_correlators_per_item,
                 llr_threshold=self.params.min_llr,
                 user_block=block,
                 item_tile=self.params.item_tile,
                 mesh=mesh,
                 exclude_self=(name == primary),
+                primary_deduped=True,
+                other_deduped=(name == primary),
             )
             indicator_idx[name] = idx.astype(np.int32)
             indicator_llr[name] = np.where(np.isfinite(scores), scores, 0.0).astype(np.float32)

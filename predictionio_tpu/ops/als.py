@@ -139,6 +139,65 @@ def _half_step(
     return jax.scipy.linalg.cho_solve(cho, b[..., None])[..., 0]  # [rows, K]
 
 
+@functools.partial(jax.jit, static_argnames=("user_rows", "item_rows"))
+def _als_run_single(
+    x0, y0, iters, reg,
+    uu, ui, ur, um, ii, iu, ir, im,
+    *, user_rows: int, item_rows: int,
+):
+    """Single-program ALS sweeps, vmapped over the shard axis.
+
+    Module-level jit with DYNAMIC iteration count and reg: one compiled
+    program per data/factor shape serves every (iterations, reg) setting —
+    retraining and hyperparameter grids never recompile.
+    """
+    dp, _, k = y0.shape
+
+    def sweep(_, carry):
+        x, y = carry
+        y_full = y.reshape(dp * item_rows, k)
+        x = jax.vmap(
+            lambda lo, ot, rr, mm: _half_step(y_full, lo, ot, rr, mm, user_rows, reg)
+        )(uu, ui, ur, um)
+        x_full = x.reshape(dp * user_rows, k)
+        y = jax.vmap(
+            lambda lo, ot, rr, mm: _half_step(x_full, lo, ot, rr, mm, item_rows, reg)
+        )(ii, iu, ir, im)
+        return (x, y)
+
+    return jax.lax.fori_loop(0, iters, sweep, (x0, y0))
+
+
+@functools.lru_cache(maxsize=8)
+def _als_sharded_fn(mesh: Mesh, user_rows: int, item_rows: int):
+    """Build (and cache per mesh/layout) the shard_map'd ALS runner."""
+
+    def per_shard(x0_, y0_, iters, reg, uu, ui, ur, um, ii, iu, ir, im):
+        def sweep(_, carry):
+            # Every array here is this shard's block: factors [1, rows, K],
+            # events [1, E].  all_gather pulls the opposite side's blocks
+            # over ICI — the only communication in the sweep.
+            x, y = carry
+            y_full = jax.lax.all_gather(y[0], "dp", tiled=True)  # [dp*item_rows, K]
+            x = _half_step(y_full, uu[0], ui[0], ur[0], um[0], user_rows, reg)[None]
+            x_full = jax.lax.all_gather(x[0], "dp", tiled=True)
+            y = _half_step(x_full, ii[0], iu[0], ir[0], im[0], item_rows, reg)[None]
+            return (x, y)
+
+        return jax.lax.fori_loop(0, iters, sweep, (x0_, y0_))
+
+    spec, rep = P("dp"), P()
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(spec, spec, rep, rep) + (spec,) * 8,
+        out_specs=(spec, spec),
+    ))
+
+
+def _als_run_sharded(mesh, user_rows, item_rows, x0, y0, iters, reg, *args):
+    return _als_sharded_fn(mesh, user_rows, item_rows)(x0, y0, iters, reg, *args)
+
+
 def als_train(
     data: ALSData,
     k: int,
@@ -165,55 +224,20 @@ def als_train(
     )
 
     if mesh is None:
-        # Single-program path: identical math, vmapped over the shard axis.
-        def sweep(_, carry):
-            x, y, uu, ui, ur, um, ii, iu, ir, im = carry
-            y_full = y.reshape(dp * data.item_rows, k)
-            x = jax.vmap(
-                lambda lo, ot, rr, mm: _half_step(y_full, lo, ot, rr, mm, data.user_rows, reg)
-            )(uu, ui, ur, um)
-            x_full = x.reshape(dp * data.user_rows, k)
-            y = jax.vmap(
-                lambda lo, ot, rr, mm: _half_step(x_full, lo, ot, rr, mm, data.item_rows, reg)
-            )(ii, iu, ir, im)
-            return (x, y, uu, ui, ur, um, ii, iu, ir, im)
-
-        @jax.jit
-        def run(x0_, y0_, *a):
-            out = jax.lax.fori_loop(0, iterations, sweep, (x0_, y0_, *a))
-            return out[0], out[1]
-
-        x, y = run(x0, y0, *args)
+        x, y = _als_run_single(
+            x0, y0, jnp.int32(iterations), jnp.float32(reg),
+            *args, user_rows=data.user_rows, item_rows=data.item_rows,
+        )
     else:
-        shard_map = jax.shard_map
-
         if mesh.shape.get("dp", 1) != dp:
             raise ValueError(f"ALSData prepared for dp={dp}, mesh has dp={mesh.shape.get('dp')}")
-
-        def per_shard_sweep(_, carry):
-            # Every array here is this shard's block: factors [1, rows, K],
-            # events [1, E].  all_gather pulls the opposite side's blocks
-            # over ICI — the only communication in the sweep.
-            x, y, uu, ui, ur, um, ii, iu, ir, im = carry
-            y_full = jax.lax.all_gather(y[0], "dp", tiled=True)  # [dp*item_rows, K]
-            x = _half_step(y_full, uu[0], ui[0], ur[0], um[0], data.user_rows, reg)[None]
-            x_full = jax.lax.all_gather(x[0], "dp", tiled=True)
-            y = _half_step(x_full, ii[0], iu[0], ir[0], im[0], data.item_rows, reg)[None]
-            return (x, y, uu, ui, ur, um, ii, iu, ir, im)
-
-        def per_shard(x0_, y0_, *a):
-            out = jax.lax.fori_loop(0, iterations, per_shard_sweep, (x0_, y0_, *a))
-            return out[0], out[1]
-
-        spec = P("dp")
-        sharded = shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(spec,) * 10, out_specs=(spec, spec),
-        )
         sharding = NamedSharding(mesh, P("dp"))
         x0 = jax.device_put(x0, sharding)
         y0 = jax.device_put(y0, sharding)
-        x, y = jax.jit(sharded)(x0, y0, *args)
+        x, y = _als_run_sharded(
+            mesh, data.user_rows, data.item_rows,
+            x0, y0, jnp.int32(iterations), jnp.float32(reg), *args,
+        )
 
     # De-interleave [dp, rows, K] back to global [n, K]: global e = shard + dp*row.
     x = np.asarray(x).transpose(1, 0, 2).reshape(-1, k)[: data.n_users]

@@ -67,8 +67,13 @@ def block_interactions(
     n_items: int,
     user_block: int = 1024,
     pad_multiple: int = 8,
+    dedup: bool = True,
 ) -> BlockedInteractions:
-    user, item = dedup_pairs(user, item, n_items)
+    if dedup:
+        user, item = dedup_pairs(user, item, n_items)
+    else:  # caller guarantees pairs are already unique
+        user = np.asarray(user, np.int32)
+        item = np.asarray(item, np.int32)
     n_blocks = max(math.ceil(n_users / user_block), 1)
     blk = user // user_block
     order = np.argsort(blk, kind="stable")
@@ -192,7 +197,10 @@ def _cooccurrence_tile(
 
 @partial(
     jax.jit,
-    static_argnames=("block", "n_items_p", "tile", "top_k", "axis_name", "pallas"),
+    static_argnames=(
+        "block", "n_items_p", "tile", "top_k", "axis_name", "pallas",
+        "exclude_self",
+    ),
 )
 def _cco_tile_step(
     p_lu, p_it, p_mk, a_lu, a_it, a_mk,
@@ -203,6 +211,7 @@ def _cco_tile_step(
     llr_threshold: float,
     axis_name: Optional[str] = None,
     pallas: str = "off",
+    exclude_self: bool = False,
 ):
     """Process one item tile: cooccurrence counts → LLR → merge into top-k."""
     c = _cooccurrence_tile(
@@ -226,8 +235,12 @@ def _cco_tile_step(
         scores = llr_score(k11, k12, k21, k22)
         scores = jnp.where(c > 0, scores, -jnp.inf)    # no cooccurrence → no indicator
         scores = jnp.where(scores >= llr_threshold, scores, -jnp.inf)
-    # self-pairs excluded by the caller via diagonal masking when P == A
     tile_idx = tile_start + jnp.arange(tile, dtype=jnp.int32)[None, :]
+    if exclude_self:
+        # mask self-pairs BEFORE the top-k merge so every row still gets a
+        # full top_k correlators (same semantics as the dense strategy)
+        row_ids = jnp.arange(n_items_p, dtype=jnp.int32)[:, None]
+        scores = jnp.where(tile_idx == row_ids, -jnp.inf, scores)
     all_scores = jnp.concatenate([best_scores, scores], axis=1)
     all_idx = jnp.concatenate([best_idx, jnp.broadcast_to(tile_idx, scores.shape)], axis=1)
     new_scores, pos = jax.lax.top_k(all_scores, top_k)
@@ -330,11 +343,15 @@ def _cco_indicators_dense_coo(
     llr_threshold: float,
     mesh: Optional[Mesh],
     exclude_self: bool,
+    p_deduped: bool = False,
+    a_deduped: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     it_pad = max(((n_items_t + 127) // 128) * 128, 128)
     chunk = _dense_chunk_users(n_items_p, it_pad, n_users)
-    p = block_interactions(pu, pi, n_users, n_items_p, user_block=chunk)
-    a = block_interactions(au, ai, n_users, n_items_t, user_block=chunk)
+    p = block_interactions(pu, pi, n_users, n_items_p, user_block=chunk,
+                           dedup=not p_deduped)
+    a = block_interactions(au, ai, n_users, n_items_t, user_block=chunk,
+                           dedup=not a_deduped)
     req_k = top_k
     top_k = min(top_k, it_pad)
 
@@ -408,19 +425,27 @@ def cco_indicators_coo(
     item_tile: int = 4096,
     mesh: Optional[Mesh] = None,
     exclude_self: bool = False,
+    primary_deduped: bool = False,
+    other_deduped: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """``cco_indicators`` from raw (user, item) COO pairs — the preferred
     entry: it lays the data out once, at the chunk size the selected device
     strategy wants, instead of blocking at ``user_block`` and re-blocking.
+
+    ``primary_deduped``/``other_deduped`` skip the O(E log E) unique pass
+    for callers that already hold unique pairs (e.g. the UR train loop,
+    which dedups its primary event once and reuses it per event type).
     """
     if _dense_path_ok(n_items_p, n_items_t):
-        # no dedup pre-pass: block_interactions inside the dense core dedups
         return _cco_indicators_dense_coo(
             p_user, p_item, a_user, a_item, n_users, n_items_p, n_items_t,
             n_users, top_k, llr_threshold, mesh, exclude_self,
+            p_deduped=primary_deduped, a_deduped=other_deduped,
         )
-    p = block_interactions(p_user, p_item, n_users, n_items_p, user_block=user_block)
-    a = block_interactions(a_user, a_item, n_users, n_items_t, user_block=user_block)
+    p = block_interactions(p_user, p_item, n_users, n_items_p,
+                           user_block=user_block, dedup=not primary_deduped)
+    a = block_interactions(a_user, a_item, n_users, n_items_t,
+                           user_block=user_block, dedup=not other_deduped)
     rc = interaction_counts(p.item[p.mask > 0], n_items_p)
     cc = interaction_counts(a.item[a.mask > 0], n_items_t)
     return cco_indicators(
@@ -466,6 +491,7 @@ def cco_indicators(
         return _cco_indicators_dense_coo(
             pu, pi, au, ai, primary.n_users, primary.n_items, other.n_items,
             n_total_users, top_k, llr_threshold, mesh, exclude_self,
+            p_deduped=True, a_deduped=True,  # blocked layouts are unique
         )
     if primary.n_blocks != other.n_blocks or primary.user_block != other.user_block:
         raise ValueError("primary/other must be blocked with the same user layout")
@@ -496,7 +522,7 @@ def cco_indicators(
                 best_scores, best_idx, t * tile,
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
-                pallas=pallas,
+                pallas=pallas, exclude_self=exclude_self,
             )
     else:
         dp = mesh.shape["dp"]
@@ -530,7 +556,7 @@ def cco_indicators(
                 bs, bi, ts,
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
-                axis_name="dp", pallas=pallas,
+                axis_name="dp", pallas=pallas, exclude_self=exclude_self,
             )
 
         for t in range(n_tiles):
@@ -541,13 +567,5 @@ def cco_indicators(
 
     scores = np.asarray(best_scores)
     idx = np.asarray(best_idx)
-    if exclude_self:
-        self_mask = idx == np.arange(n_items_p)[:, None]
-        scores = np.where(self_mask, -np.inf, scores)
-    # drop padded item columns that slipped in with -inf already; re-sort after masking
-    order = np.argsort(-scores, axis=1, kind="stable")
-    scores = np.take_along_axis(scores, order, axis=1)
-    idx = np.take_along_axis(idx, order, axis=1)
-    valid = scores > -np.inf
-    idx = np.where(valid, idx, -1)
+    idx = np.where(scores > -np.inf, idx, -1)
     return scores, idx

@@ -63,50 +63,52 @@ def logreg_train(
         y = jax.device_put(y, ys)
         mask = jax.device_put(mask, ys)
 
+    if optimizer not in ("lbfgs", "adam"):
+        raise ValueError(f"unknown optimizer {optimizer!r} (lbfgs|adam)")
     w0 = jnp.zeros((d, n_classes), jnp.float32)
     b0 = jnp.zeros((n_classes,), jnp.float32)
-    if optimizer == "lbfgs":
-        opt = optax.lbfgs()
-    elif optimizer == "adam":
-        opt = optax.adam(learning_rate)
-    else:
-        raise ValueError(f"unknown optimizer {optimizer!r} (lbfgs|adam)")
-
-    loss = functools.partial(_loss_fn, l2=l2)
-
-    use_lbfgs = optimizer == "lbfgs"
-
-    @jax.jit
-    def run(x, y, mask):
-        params = (w0, b0)
-        state = opt.init(params)
-        objective = lambda p: loss(p, x, y, mask)  # noqa: E731
-
-        if use_lbfgs:
-            value_and_grad = optax.value_and_grad_from_state(objective)
-
-            def step(carry, _):
-                params, state = carry
-                value, grad = value_and_grad(params, state=state)
-                updates, state = opt.update(
-                    grad, state, params,
-                    value=value, grad=grad, value_fn=objective,
-                )
-                params = optax.apply_updates(params, updates)
-                return (params, state), value
-        else:
-            def step(carry, _):
-                params, state = carry
-                value, grad = jax.value_and_grad(objective)(params)
-                updates, state = opt.update(grad, state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, state), value
-
-        (params, _), losses = jax.lax.scan(step, (params, state), None, length=iterations)
-        return params, losses
-
-    (w, b), losses = run(x, y, mask)
+    (w, b), _losses = _logreg_run(
+        x, y, mask, w0, b0, jnp.float32(l2),
+        optimizer=optimizer, learning_rate=float(learning_rate),
+        iterations=int(iterations),
+    )
     return np.asarray(w), np.asarray(b)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("optimizer", "learning_rate", "iterations")
+)
+def _logreg_run(x, y, mask, w0, b0, l2, *, optimizer, learning_rate, iterations):
+    """Module-level jit: one compiled program per (shape, optimizer,
+    iterations) — l2 is traced, so FastEval hyperparameter grids over the
+    regularizer reuse the compile."""
+    opt = optax.lbfgs() if optimizer == "lbfgs" else optax.adam(learning_rate)
+    params = (w0, b0)
+    state = opt.init(params)
+    objective = lambda p: _loss_fn(p, x, y, mask, l2)  # noqa: E731
+
+    if optimizer == "lbfgs":
+        value_and_grad = optax.value_and_grad_from_state(objective)
+
+        def step(carry, _):
+            params, state = carry
+            value, grad = value_and_grad(params, state=state)
+            updates, state = opt.update(
+                grad, state, params,
+                value=value, grad=grad, value_fn=objective,
+            )
+            params = optax.apply_updates(params, updates)
+            return (params, state), value
+    else:
+        def step(carry, _):
+            params, state = carry
+            value, grad = jax.value_and_grad(objective)(params)
+            updates, state = opt.update(grad, state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, state), value
+
+    (params, _), losses = jax.lax.scan(step, (params, state), None, length=iterations)
+    return params, losses
 
 
 @jax.jit

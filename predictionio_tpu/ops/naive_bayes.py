@@ -8,6 +8,7 @@ no iterations.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Tuple
 
@@ -23,23 +24,24 @@ class GaussianNBModel:
     var: np.ndarray              # [C, d]
 
 
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def _gaussian_nb_fit(x, y, eps, *, n_classes):
+    ones = jnp.ones_like(y, jnp.float32)
+    counts = jax.ops.segment_sum(ones, y, num_segments=n_classes)
+    sums = jax.ops.segment_sum(x, y, num_segments=n_classes)
+    sq = jax.ops.segment_sum(x * x, y, num_segments=n_classes)
+    denom = jnp.maximum(counts, 1.0)[:, None]
+    mean = sums / denom
+    var = sq / denom - mean * mean + eps
+    prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
+    return prior, mean, var
+
+
 def gaussian_nb_train(x: np.ndarray, y: np.ndarray, n_classes: int, eps: float = 1e-6) -> GaussianNBModel:
-    x = jnp.asarray(x, jnp.float32)
-    y = jnp.asarray(y, jnp.int32)
-
-    @jax.jit
-    def fit(x, y):
-        ones = jnp.ones_like(y, jnp.float32)
-        counts = jax.ops.segment_sum(ones, y, num_segments=n_classes)
-        sums = jax.ops.segment_sum(x, y, num_segments=n_classes)
-        sq = jax.ops.segment_sum(x * x, y, num_segments=n_classes)
-        denom = jnp.maximum(counts, 1.0)[:, None]
-        mean = sums / denom
-        var = sq / denom - mean * mean + eps
-        prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
-        return prior, mean, var
-
-    prior, mean, var = fit(x, y)
+    prior, mean, var = _gaussian_nb_fit(
+        jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.int32),
+        jnp.float32(eps), n_classes=n_classes,
+    )
     return GaussianNBModel(np.asarray(prior), np.asarray(mean), np.asarray(var))
 
 
@@ -65,23 +67,24 @@ class MultinomialNBModel:
     feature_log_prob: np.ndarray  # [C, d]
 
 
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def _multinomial_nb_fit(x, y, alpha, *, n_classes):
+    ones = jnp.ones_like(y, jnp.float32)
+    counts = jax.ops.segment_sum(ones, y, num_segments=n_classes)
+    feat = jax.ops.segment_sum(x, y, num_segments=n_classes) + alpha
+    log_prob = jnp.log(feat) - jnp.log(feat.sum(-1, keepdims=True))
+    prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
+    return prior, log_prob
+
+
 def multinomial_nb_train(
     x: np.ndarray, y: np.ndarray, n_classes: int, alpha: float = 1.0
 ) -> MultinomialNBModel:
     """x holds non-negative counts (e.g. token counts / tf-idf)."""
-    x = jnp.asarray(x, jnp.float32)
-    y = jnp.asarray(y, jnp.int32)
-
-    @jax.jit
-    def fit(x, y):
-        ones = jnp.ones_like(y, jnp.float32)
-        counts = jax.ops.segment_sum(ones, y, num_segments=n_classes)
-        feat = jax.ops.segment_sum(x, y, num_segments=n_classes) + alpha
-        log_prob = jnp.log(feat) - jnp.log(feat.sum(-1, keepdims=True))
-        prior = jnp.log(jnp.maximum(counts, 1.0) / jnp.maximum(counts.sum(), 1.0))
-        return prior, log_prob
-
-    prior, log_prob = fit(x, y)
+    prior, log_prob = _multinomial_nb_fit(
+        jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.int32),
+        jnp.float32(alpha), n_classes=n_classes,
+    )
     return MultinomialNBModel(np.asarray(prior), np.asarray(log_prob))
 
 

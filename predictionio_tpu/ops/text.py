@@ -107,30 +107,34 @@ def mlp_train(
         jax.random.normal(k3, (hidden_dim, n_classes), jnp.float32) * (1.0 / np.sqrt(hidden_dim)),
         jnp.zeros((n_classes,), jnp.float32),
     )
+    params, _losses = _mlp_run(
+        params, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(y, jnp.int32),
+        jnp.float32(l2),
+        iterations=int(iterations), learning_rate=float(learning_rate),
+    )
+    return tuple(np.asarray(p) for p in params)
+
+
+@partial(jax.jit, static_argnames=("iterations", "learning_rate"))
+def _mlp_run(params, ids, mask, y, l2, *, iterations, learning_rate):
+    """Module-level jit: retrains with the same shapes reuse the compile."""
     opt = optax.adam(learning_rate)
-    ids_j, mask_j, y_j = jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(y, jnp.int32)
 
     def loss_fn(p):
-        logits = _mlp_forward(p, ids_j, mask_j)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y_j).mean()
+        logits = _mlp_forward(p, ids, mask)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
         reg = sum(jnp.sum(w * w) for w in p[1::2])
         return ce + l2 * reg
 
-    @jax.jit
-    def run(params):
-        state = opt.init(params)
+    def step(carry, _):
+        p, s = carry
+        value, grad = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grad, s, p)
+        return (optax.apply_updates(p, updates), s), value
 
-        def step(carry, _):
-            p, s = carry
-            value, grad = jax.value_and_grad(loss_fn)(p)
-            updates, s = opt.update(grad, s, p)
-            return (optax.apply_updates(p, updates), s), value
-
-        (p, _), losses = jax.lax.scan(step, (params, state), None, length=iterations)
-        return p, losses
-
-    params, losses = run(params)
-    return tuple(np.asarray(p) for p in params)
+    state = opt.init(params)
+    (p, _), losses = jax.lax.scan(step, (params, state), None, length=iterations)
+    return p, losses
 
 
 @jax.jit

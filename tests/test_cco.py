@@ -184,3 +184,24 @@ def test_dense_exclude_self_and_topk_overflow(monkeypatch):
     assert scores.shape == (n_items, 300) and idx.shape == (n_items, 300)
     for r in range(n_items):
         assert r not in set(idx[r][idx[r] >= 0])
+
+
+def test_dense_matches_tiled_exclude_self(monkeypatch):
+    """Both strategies mask self-pairs BEFORE top-k: full top_k correlators
+    per row and identical scores either way."""
+    n_users, n_items = 60, 14
+    u, i = random_interactions(n_users, n_items, 400, 41)
+    b = block_interactions(u, i, n_users, n_items, user_block=16)
+    counts = interaction_counts(b.item[b.mask > 0], n_items)
+
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    sd, idd = cco_indicators(b, b, counts, counts, n_users, top_k=5,
+                             item_tile=8, exclude_self=True)
+    monkeypatch.setenv("PIO_CCO_DENSE", "0")
+    st, idt = cco_indicators(b, b, counts, counts, n_users, top_k=5,
+                             item_tile=8, exclude_self=True)
+    np.testing.assert_allclose(sd, st, rtol=1e-5)
+    for r in range(n_items):
+        assert r not in set(idd[r][idd[r] >= 0])
+        assert r not in set(idt[r][idt[r] >= 0])
+        assert set(idd[r][sd[r] > -np.inf]) == set(idt[r][st[r] > -np.inf])
