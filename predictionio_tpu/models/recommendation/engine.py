@@ -167,6 +167,12 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     seed: int = 7
     mesh_dp: int = 0        # 0 = use all devices
+    # snapshot factors every N sweeps and resume after failures (0 = off);
+    # dir defaults to PIO_CHECKPOINT_DIR/als — safe to share because
+    # snapshots carry a run fingerprint (hyperparams + data signature) and
+    # foreign/stale ones are ignored on resume
+    checkpoint_every: int = 0
+    checkpoint_dir: str = ""
 
 
 class ALSModel(PersistentModel):
@@ -220,6 +226,16 @@ class ALSAlgorithm(Algorithm):
         data = als_ops.prepare_als_data(
             pd.user_idx, pd.item_idx, pd.rating, n_users, n_items, dp=dp
         )
+        checkpoint = None
+        if self.params.checkpoint_every > 0:
+            import os
+
+            from predictionio_tpu.utils.checkpoint import CheckpointStore
+
+            ckpt_dir = self.params.checkpoint_dir or os.path.join(
+                os.environ.get("PIO_CHECKPOINT_DIR", ".pio_checkpoints"), "als"
+            )
+            checkpoint = CheckpointStore(ckpt_dir)
         X, Y = als_ops.als_train(
             data,
             k=self.params.rank,
@@ -227,7 +243,11 @@ class ALSAlgorithm(Algorithm):
             iterations=self.params.num_iterations,
             mesh=mesh,
             seed=self.params.seed,
+            checkpoint=checkpoint,
+            checkpoint_every=self.params.checkpoint_every,
         )
+        if checkpoint is not None:
+            checkpoint.clear()  # completed: snapshots no longer needed
         seen: Dict[int, np.ndarray] = {}
         for u in np.unique(pd.user_idx):
             seen[int(u)] = pd.item_idx[pd.user_idx == u]

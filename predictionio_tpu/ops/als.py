@@ -205,44 +205,114 @@ def als_train(
     iterations: int,
     mesh: Optional[Mesh] = None,
     seed: int = 7,
+    checkpoint=None,
+    checkpoint_every: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run ALS sweeps; returns (X [n_users, K], Y [n_items, K]) on host.
 
     With a mesh, factors live block-sharded over ``dp`` and each half-step
     all-gathers the opposite blocks (ICI); without, the same program runs on
     one device with dp=1.
+
+    ``checkpoint`` (a utils.checkpoint.CheckpointStore) + ``checkpoint_every``
+    snapshot the factor blocks every N sweeps and resume from the newest
+    snapshot — sweeps already completed by a failed run are not repeated.
     """
-    dp = data.dp
+    if checkpoint is not None and checkpoint_every > 0:
+        return _als_train_checkpointed(
+            data, k, reg, iterations, mesh, seed, checkpoint, checkpoint_every
+        )
+    x0, y0 = _als_init(data, k, seed)
+    x, y = _als_sweeps(data, x0, y0, iterations, reg, mesh)
+    return _als_deinterleave(data, x, y, k)
+
+
+def _als_init(data: ALSData, k: int, seed: int):
     key = jax.random.PRNGKey(seed)
-    y0 = jax.random.normal(key, (dp, data.item_rows, k), jnp.float32) * 0.1
-    x0 = jnp.zeros((dp, data.user_rows, k), jnp.float32)
-    args = (
+    y0 = jax.random.normal(key, (data.dp, data.item_rows, k), jnp.float32) * 0.1
+    x0 = jnp.zeros((data.dp, data.user_rows, k), jnp.float32)
+    return x0, y0
+
+
+def _als_device_args(data: ALSData):
+    return (
         jnp.asarray(data.u_user_local), jnp.asarray(data.u_item_flat),
         jnp.asarray(data.u_rating), jnp.asarray(data.u_mask),
         jnp.asarray(data.i_item_local), jnp.asarray(data.i_user_flat),
         jnp.asarray(data.i_rating), jnp.asarray(data.i_mask),
     )
 
+
+def _als_sweeps(data: ALSData, x0, y0, n_sweeps: int, reg: float, mesh, args=None):
+    if args is None:
+        args = _als_device_args(data)
     if mesh is None:
-        x, y = _als_run_single(
-            x0, y0, jnp.int32(iterations), jnp.float32(reg),
+        return _als_run_single(
+            x0, y0, jnp.int32(n_sweeps), jnp.float32(reg),
             *args, user_rows=data.user_rows, item_rows=data.item_rows,
         )
-    else:
-        if mesh.shape.get("dp", 1) != dp:
-            raise ValueError(f"ALSData prepared for dp={dp}, mesh has dp={mesh.shape.get('dp')}")
-        sharding = NamedSharding(mesh, P("dp"))
-        x0 = jax.device_put(x0, sharding)
-        y0 = jax.device_put(y0, sharding)
-        x, y = _als_run_sharded(
-            mesh, data.user_rows, data.item_rows,
-            x0, y0, jnp.int32(iterations), jnp.float32(reg), *args,
-        )
+    if mesh.shape.get("dp", 1) != data.dp:
+        raise ValueError(
+            f"ALSData prepared for dp={data.dp}, mesh has dp={mesh.shape.get('dp')}")
+    sharding = NamedSharding(mesh, P("dp"))
+    x0 = jax.device_put(x0, sharding)
+    y0 = jax.device_put(y0, sharding)
+    return _als_run_sharded(
+        mesh, data.user_rows, data.item_rows,
+        x0, y0, jnp.int32(n_sweeps), jnp.float32(reg), *args,
+    )
 
+
+def _als_deinterleave(data: ALSData, x, y, k: int):
     # De-interleave [dp, rows, K] back to global [n, K]: global e = shard + dp*row.
     x = np.asarray(x).transpose(1, 0, 2).reshape(-1, k)[: data.n_users]
     y_arr = np.asarray(y).transpose(1, 0, 2).reshape(-1, k)[: data.n_items]
     return x, y_arr
+
+
+def _als_fingerprint(data: ALSData, k: int, reg: float, seed: int) -> str:
+    """Identifies a training run well enough to reject foreign snapshots:
+    hyperparams + data layout + a cheap content signature."""
+    n_events = int(data.u_mask.sum())
+    sig = int(np.int64(data.u_rating.sum() * 1000)) if n_events else 0
+    return (
+        f"k{k}-dp{data.dp}-u{data.n_users}x{data.user_rows}"
+        f"-i{data.n_items}x{data.item_rows}-e{n_events}-r{reg}-s{seed}-h{sig}"
+    )
+
+
+def _als_train_checkpointed(
+    data: ALSData, k: int, reg: float, iterations: int, mesh,
+    seed: int, checkpoint, checkpoint_every: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Chunked sweeps with snapshot/resume (see als_train docstring)."""
+    from predictionio_tpu.utils.checkpoint import maybe_inject
+
+    fingerprint = _als_fingerprint(data, k, reg, seed)
+    done = 0
+    x = y = None
+    latest = checkpoint.latest()
+    if latest is not None:
+        step, state = latest
+        # resume ONLY a snapshot of this exact run with sweeps still to do;
+        # anything else (other dataset/params, or already >= iterations) is
+        # stale — start fresh rather than return foreign/over-trained factors
+        if state.get("fingerprint") == fingerprint and step < iterations:
+            done = step
+            x = jnp.asarray(state["x"])
+            y = jnp.asarray(state["y"])
+    if x is None:
+        x, y = _als_init(data, k, seed)
+    args = _als_device_args(data)  # one host->device upload for all chunks
+    while done < iterations:
+        n = min(checkpoint_every, iterations - done)
+        x, y = _als_sweeps(data, x, y, n, reg, mesh, args=args)
+        done += n
+        maybe_inject("als.sweep")  # rehearse mid-training failure in tests
+        checkpoint.save(done, {
+            "x": np.asarray(x), "y": np.asarray(y), "fingerprint": fingerprint,
+        })
+    return _als_deinterleave(data, x, y, k)
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))

@@ -3,3 +3,8 @@ from predictionio_tpu.utils.config import (  # noqa: F401
     load_pio_env,
 )
 from predictionio_tpu.utils.tracing import named_scope, profile_to, timed  # noqa: F401
+from predictionio_tpu.utils.checkpoint import (  # noqa: F401
+    CheckpointStore,
+    InjectedFault,
+    maybe_inject,
+)

@@ -1,0 +1,138 @@
+"""Mid-training checkpoint/resume.
+
+The reference has NO mid-training checkpointing (SURVEY.md §5: "no
+mid-training checkpointing; 'checkpointing' = completed-model persistence
+per engine instance") — Spark task retry restarts the whole job.  On TPU,
+long CCO/ALS trainings are one process, so the framework provides what the
+reference delegates to Spark: periodic factor/parameter snapshots plus a
+retry loop in the train workflow that resumes from the newest snapshot
+(workflow/core_workflow.run_train).
+
+Storage is atomic ``.npz`` per step — training state here is always a flat
+dict of host arrays (factors, weights) small enough that synchronous writes
+cost nothing next to a sweep.  (orbax-checkpoint is the drop-in upgrade
+path if/when sharded multi-host state needs async per-host writes.)
+Layout::
+
+    <dir>/step_<n>.npz
+    <dir>/MANIFEST.json     {"steps": [...]}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from pathlib import Path
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+
+class CheckpointStore:
+    """Step-indexed pytree snapshots under one directory (one training run).
+
+    Values must be a flat dict of numpy/jax arrays plus JSON-able scalars —
+    the shape every algorithm's training state reduces to here.
+    """
+
+    def __init__(self, directory: str | os.PathLike, keep: int = 2):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    # -- manifest ----------------------------------------------------------
+
+    def _manifest_path(self) -> Path:
+        return self.dir / "MANIFEST.json"
+
+    def steps(self) -> List[int]:
+        p = self._manifest_path()
+        if not p.exists():
+            return []
+        return sorted(json.loads(p.read_text()).get("steps", []))
+
+    def _write_manifest(self, steps: List[int]) -> None:
+        tmp = self._manifest_path().with_suffix(f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps({"steps": sorted(steps)}))
+        tmp.replace(self._manifest_path())
+
+    # -- save / restore ----------------------------------------------------
+
+    def save(self, step: int, state: dict) -> None:
+        """Snapshot ``state`` (dict of arrays + scalars) as ``step``."""
+        arrays = {}
+        scalars = {}
+        for k, v in state.items():
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                scalars[k] = v
+            else:
+                arrays[k] = np.asarray(v)
+        path = self.dir / f"step_{step}.npz"
+        tmp = path.with_suffix(f".tmp{os.getpid()}.npz")
+        with open(tmp, "wb") as f:
+            np.savez(f, __scalars__=json.dumps(scalars), **arrays)
+        tmp.replace(path)
+        steps = [s for s in self.steps() if s != step] + [step]
+        # prune oldest beyond keep
+        for old in sorted(steps)[:-self.keep] if self.keep > 0 else []:
+            self._delete(old)
+            steps.remove(old)
+        self._write_manifest(steps)
+
+    def restore(self, step: int) -> dict:
+        path = self.dir / f"step_{step}.npz"
+        with np.load(path, allow_pickle=False) as z:
+            state = {k: z[k] for k in z.files if k != "__scalars__"}
+            state.update(json.loads(str(z["__scalars__"])))
+        return state
+
+    def latest(self) -> Optional[Tuple[int, dict]]:
+        steps = self.steps()
+        if not steps:
+            return None
+        return steps[-1], self.restore(steps[-1])
+
+    def _delete(self, step: int) -> None:
+        p = self.dir / f"step_{step}.npz"
+        if p.exists():
+            p.unlink()
+
+    def clear(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+
+# ---------------------------------------------------------------------------
+# fault injection (test/ops tool; reference has none — SURVEY.md §5)
+# ---------------------------------------------------------------------------
+
+
+class InjectedFault(RuntimeError):
+    pass
+
+
+# hit counters keyed by the exact PIO_FAULT_INJECT config string, so a new
+# config (different site OR different :n) always starts counting from zero
+_fault_hits: dict = {}
+
+
+def maybe_inject(site: str) -> None:
+    """Raise InjectedFault once if PIO_FAULT_INJECT names this site.
+
+    Format: ``PIO_FAULT_INJECT=site[:n]`` — fail the n-th hit (default 1st)
+    of ``site``, then disarm.  Lets tests and operators rehearse the
+    retry/resume path deterministically.
+    """
+    conf = os.environ.get("PIO_FAULT_INJECT", "")
+    if not conf:
+        return
+    name, _, nth = conf.partition(":")
+    if name != site:
+        return
+    count = _fault_hits.get(conf, 0) + 1
+    _fault_hits[conf] = count
+    if count >= (int(nth) if nth else 1):
+        os.environ.pop("PIO_FAULT_INJECT", None)
+        _fault_hits.pop(conf, None)
+        raise InjectedFault(f"injected fault at {site!r} (hit {count})")

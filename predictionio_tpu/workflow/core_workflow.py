@@ -37,10 +37,22 @@ def run_train(
     engine_variant: str = "default",
     engine_factory: str = "",
     storage: Optional[Storage] = None,
+    retries: Optional[int] = None,
 ) -> EngineInstance:
     """Train and persist: returns the COMPLETED EngineInstance (or raises,
-    leaving a FAILED instance recorded)."""
+    leaving a FAILED instance recorded).
+
+    ``retries`` (default: PIO_TRAIN_RETRIES env, 0) re-runs Engine.train
+    after a failure — the elastic-recovery analogue of Spark task retry in
+    the reference.  Algorithms that checkpoint (e.g. ALS with
+    checkpointEvery) resume from their newest snapshot instead of redoing
+    completed sweeps.
+    """
+    import os
+
     storage = storage or get_storage()
+    if retries is None:
+        retries = int(os.environ.get("PIO_TRAIN_RETRIES", "0"))
     params_json = serialize_engine_params(engine_params)
     instance = EngineInstance(
         id="",
@@ -59,21 +71,29 @@ def run_train(
     instance_id = storage.engine_instances.insert(instance)
     instance.status = "TRAINING"
     storage.engine_instances.update(instance)
-    try:
-        log.info("training engine %s (instance %s)", engine_id, instance_id)
-        models = engine.train(engine_params)
-        persistence.save_models(storage, instance_id, models)
-        instance.status = "COMPLETED"
-        instance.end_time = _now()
-        storage.engine_instances.update(instance)
-        log.info("training done: instance %s COMPLETED", instance_id)
-        return instance
-    except Exception:
-        instance.status = "FAILED"
-        instance.end_time = _now()
-        storage.engine_instances.update(instance)
-        log.error("training FAILED: %s", traceback.format_exc())
-        raise
+    attempt = 0
+    while True:
+        try:
+            log.info("training engine %s (instance %s, attempt %d)",
+                     engine_id, instance_id, attempt + 1)
+            models = engine.train(engine_params)
+            persistence.save_models(storage, instance_id, models)
+            instance.status = "COMPLETED"
+            instance.end_time = _now()
+            storage.engine_instances.update(instance)
+            log.info("training done: instance %s COMPLETED", instance_id)
+            return instance
+        except Exception:
+            attempt += 1
+            if attempt <= retries:
+                log.warning("training attempt %d failed, retrying (%d left):\n%s",
+                            attempt, retries - attempt + 1, traceback.format_exc())
+                continue
+            instance.status = "FAILED"
+            instance.end_time = _now()
+            storage.engine_instances.update(instance)
+            log.error("training FAILED: %s", traceback.format_exc())
+            raise
 
 
 def load_latest_models(

@@ -1,0 +1,146 @@
+"""Checkpoint/resume + retry + fault injection (framework-added aux
+subsystem; the reference only persists completed models — SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.utils.checkpoint import CheckpointStore, InjectedFault, maybe_inject
+
+
+def test_checkpoint_roundtrip_and_prune(tmp_path):
+    store = CheckpointStore(tmp_path / "ck", keep=2)
+    for step in (1, 2, 3):
+        store.save(step, {"w": np.full((2, 2), step, np.float32), "step": step})
+    assert store.steps() == [2, 3]  # pruned to keep=2
+    step, state = store.latest()
+    assert step == 3 and state["step"] == 3
+    np.testing.assert_array_equal(state["w"], np.full((2, 2), 3, np.float32))
+    assert not (tmp_path / "ck" / "step_1.npz").exists()
+    store.clear()
+    assert store.latest() is None
+
+
+def test_fault_injection(monkeypatch):
+    monkeypatch.setenv("PIO_FAULT_INJECT", "my.site:2")
+    maybe_inject("other.site")         # different site: no-op
+    maybe_inject("my.site")            # hit 1 of 2: no-op
+    with pytest.raises(InjectedFault):
+        maybe_inject("my.site")        # hit 2: fires and disarms
+    maybe_inject("my.site")            # disarmed
+
+
+def test_als_checkpoint_resume_matches_straight_run(tmp_path):
+    """5 sweeps + crash + resume to 10 == straight 10-sweep run."""
+    from predictionio_tpu.ops.als import als_train, prepare_als_data
+    from predictionio_tpu.utils.checkpoint import CheckpointStore
+
+    rng = np.random.default_rng(0)
+    n_u, n_i, n_e = 60, 40, 1500
+    u = rng.integers(0, n_u, n_e).astype(np.int32)
+    i = rng.integers(0, n_i, n_e).astype(np.int32)
+    r = rng.integers(1, 6, n_e).astype(np.float32)
+    data = prepare_als_data(u, i, r, n_u, n_i, dp=1)
+
+    X_ref, Y_ref = als_train(data, k=6, reg=0.05, iterations=10)
+
+    store = CheckpointStore(tmp_path / "als")
+    # run that "dies" after 5 sweeps (snapshot exists)
+    als_train(data, k=6, reg=0.05, iterations=5,
+              checkpoint=store, checkpoint_every=5)
+    assert store.steps() == [5]
+    # resumed run completes the remaining sweeps from the snapshot
+    X, Y = als_train(data, k=6, reg=0.05, iterations=10,
+                     checkpoint=store, checkpoint_every=5)
+    assert store.steps() == [5, 10]
+    np.testing.assert_allclose(X, X_ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(Y, Y_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_run_train_retries_through_injected_fault(mem_storage, tmp_path, monkeypatch):
+    """PIO_TRAIN_RETRIES + checkpointEvery: a mid-training fault is retried
+    and the retry resumes from the snapshot instead of restarting."""
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.models.recommendation import RecommendationEngine
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.workflow import core_workflow
+
+    app_id = mem_storage.apps.insert(App(0, "ckapp"))
+    rng = np.random.default_rng(1)
+    events = []
+    for u in range(16):
+        for i in range(10):
+            if rng.random() < 0.9:
+                liked = (u < 8) == (i < 5)
+                events.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0 if liked else 1.0})))
+    mem_storage.l_events.insert_batch(events, app_id)
+
+    variant = {
+        "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "ckapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": 6, "lambda": 0.05, "meshDp": 1,
+            "checkpointEvery": 2, "checkpointDir": str(tmp_path / "ck"),
+        }}],
+    }
+    engine = RecommendationEngine.apply()
+    ep = engine.engine_params_from_variant(variant)
+
+    # fault fires on the 2nd sweep-chunk of the 1st attempt; retry resumes
+    monkeypatch.setenv("PIO_FAULT_INJECT", "als.sweep:2")
+    instance = core_workflow.run_train(
+        engine, ep, engine_id="ck-engine", storage=mem_storage, retries=1,
+    )
+    assert instance.status == "COMPLETED"
+
+    # without retries the same fault propagates and records FAILED
+    monkeypatch.setenv("PIO_FAULT_INJECT", "als.sweep:1")
+    with pytest.raises(InjectedFault):
+        core_workflow.run_train(
+            engine, ep, engine_id="ck-engine2", storage=mem_storage, retries=0,
+        )
+    failed = [i for i in mem_storage.engine_instances.get_all()
+              if i.engine_id == "ck-engine2"]
+    assert failed and failed[0].status == "FAILED"
+
+
+def test_stale_snapshot_rejected(tmp_path):
+    """A snapshot from different data/params (or one at >= iterations) is
+    ignored: resume never returns foreign or over-trained factors."""
+    from predictionio_tpu.ops.als import als_train, prepare_als_data
+    from predictionio_tpu.utils.checkpoint import CheckpointStore
+
+    rng = np.random.default_rng(2)
+    u = rng.integers(0, 30, 500).astype(np.int32)
+    i = rng.integers(0, 20, 500).astype(np.int32)
+    r = rng.integers(1, 6, 500).astype(np.float32)
+    data_a = prepare_als_data(u, i, r, 30, 20, dp=1)
+    data_b = prepare_als_data(u, i, (6 - r), 30, 20, dp=1)  # different content
+
+    store = CheckpointStore(tmp_path / "ck")
+    als_train(data_a, k=4, reg=0.05, iterations=4, checkpoint=store, checkpoint_every=2)
+    # same shapes, different ratings -> fingerprint mismatch -> fresh run
+    X_b, _ = als_train(data_b, k=4, reg=0.05, iterations=4,
+                       checkpoint=store, checkpoint_every=2)
+    X_b_ref, _ = als_train(data_b, k=4, reg=0.05, iterations=4)
+    np.testing.assert_allclose(X_b, X_b_ref, rtol=2e-4, atol=2e-5)
+
+    # snapshot at step 4 >= iterations=2 -> fresh 2-sweep run, not stale factors
+    X_2, _ = als_train(data_b, k=4, reg=0.05, iterations=2,
+                       checkpoint=store, checkpoint_every=2)
+    X_2_ref, _ = als_train(data_b, k=4, reg=0.05, iterations=2)
+    np.testing.assert_allclose(X_2, X_2_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_fault_counter_keyed_by_config(monkeypatch):
+    """A new PIO_FAULT_INJECT config starts counting from zero even after a
+    previous config accumulated hits without firing."""
+    monkeypatch.setenv("PIO_FAULT_INJECT", "a:3")
+    maybe_inject("a"); maybe_inject("a")      # 2 hits, no fire
+    monkeypatch.setenv("PIO_FAULT_INJECT", "b:2")
+    maybe_inject("b")                          # hit 1 of 2: must NOT fire
+    with pytest.raises(InjectedFault):
+        maybe_inject("b")                      # hit 2: fires
