@@ -51,11 +51,14 @@ def _render_html(storage: Storage) -> str:
     engines = sorted(storage.engine_instances.get_all(),
                      key=lambda i: i.start_time, reverse=True)
     rows_eval = "".join(
-        "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td><pre>{res}</pre></td></tr>".format(
+        "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td>{res}</td></tr>".format(
             id=html.escape(i.id[:12]),
             cls=html.escape(i.evaluation_class),
             start=html.escape(i.start_time.isoformat(timespec="seconds") if i.start_time else ""),
-            res=html.escape((i.evaluator_results or "")[:2000]),
+            # evaluator_results_html is framework-generated markup
+            # (core_workflow._eval_results_html), not user input
+            res=i.evaluator_results_html
+            or "<pre>" + html.escape((i.evaluator_results or "")[:2000]) + "</pre>",
         )
         for i in sorted(evals, key=lambda i: i.start_time, reverse=True)
     ) or "<tr><td colspan=4><i>no completed evaluations</i></td></tr>"

@@ -51,6 +51,29 @@ from predictionio_tpu.store.event_store import LEventStore, PEventStore
 # -- query / result ----------------------------------------------------------
 
 
+def _iso_ts(v) -> Optional[float]:
+    """ISO-8601 → epoch seconds (naive treated as UTC); None if unparseable."""
+    import datetime as _dt
+
+    try:
+        t = _dt.datetime.fromisoformat(str(v).replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return t.timestamp()
+
+
+def _query_ts(v, field: str) -> float:
+    """Strict variant for query-supplied dates: malformed input rejects the
+    query (the server maps ValueError to HTTP 400) instead of silently
+    disabling a hard filter."""
+    ts = _iso_ts(v)
+    if ts is None:
+        raise ValueError(f"{field}: {v!r} is not an ISO-8601 date")
+    return ts
+
+
 @dataclasses.dataclass
 class FieldRule:
     name: str
@@ -64,6 +87,21 @@ class FieldRule:
 
 
 @dataclasses.dataclass
+class DateRange:
+    """Hard filter on an item date property (reference UR: query dateRange
+    with name/before/after ISO-8601 bounds)."""
+
+    name: str
+    after: Optional[str] = None    # keep items with prop >= after
+    before: Optional[str] = None   # keep items with prop <= before
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "DateRange":
+        return cls(name=str(d["name"]),
+                   after=d.get("after"), before=d.get("before"))
+
+
+@dataclasses.dataclass
 class URQuery:
     user: Optional[str] = None
     item: Optional[str] = None
@@ -71,11 +109,17 @@ class URQuery:
     fields: List[FieldRule] = dataclasses.field(default_factory=list)
     blacklist_items: List[str] = dataclasses.field(default_factory=list)
     return_self: bool = False
+    date_range: Optional[DateRange] = None
+    # "now" for availableDateName/expireDateName checks; ISO-8601
+    # (reference UR: currentDate query field)
+    current_date: Optional[str] = None
 
     def __post_init__(self):
         self.fields = [
             f if isinstance(f, FieldRule) else FieldRule.from_json(f) for f in self.fields
         ]
+        if self.date_range is not None and not isinstance(self.date_range, DateRange):
+            self.date_range = DateRange.from_json(self.date_range)
 
     @classmethod
     def from_json(cls, d: Dict) -> "URQuery":
@@ -86,6 +130,8 @@ class URQuery:
             fields=[FieldRule.from_json(f) for f in d.get("fields", [])],
             blacklist_items=[str(b) for b in d.get("blacklistItems", [])],
             return_self=bool(d.get("returnSelf", False)),
+            date_range=DateRange.from_json(d["dateRange"]) if d.get("dateRange") else None,
+            current_date=d.get("currentDate"),
         )
 
 
@@ -225,6 +271,38 @@ class URModel(PersistentModel):
         self.item_properties = s["item_properties"]
         self.user_seen = s["user_seen"]
 
+    # -- serving-time property indexes (built lazily, never serialized) ----
+
+    def prop_value_index(self, name: str) -> Dict[str, np.ndarray]:
+        """value -> item ids holding it, for one property — lets field rules
+        apply as a few array writes instead of a per-item Python loop."""
+        cache = self.__dict__.setdefault("_prop_value_index", {})
+        if name not in cache:
+            idx: Dict[str, list] = {}
+            for j in range(len(self.item_dict)):
+                v = self.item_properties.get(self.item_dict.str(j), {}).get(name)
+                if v is None:
+                    continue
+                for x in (v if isinstance(v, list) else [v]):
+                    idx.setdefault(str(x), []).append(j)
+            cache[name] = {k: np.asarray(v, np.int32) for k, v in idx.items()}
+        return cache[name]
+
+    def prop_date_array(self, name: str) -> np.ndarray:
+        """Per-item epoch seconds of a date property (NaN where missing)."""
+        cache = self.__dict__.setdefault("_prop_date_array", {})
+        if name not in cache:
+            out = np.full(len(self.item_dict), np.nan)
+            for j in range(len(self.item_dict)):
+                v = self.item_properties.get(self.item_dict.str(j), {}).get(name)
+                if v is None:
+                    continue
+                ts = _iso_ts(v)  # lenient: bad item data skips, query-side is strict
+                if ts is not None:
+                    out[j] = ts
+            cache[name] = out
+        return cache[name]
+
 
 @partial(jax.jit, static_argnames=())
 def _indicator_score(idx: jnp.ndarray, llr: jnp.ndarray, hist: jnp.ndarray, use_llr: jnp.ndarray):
@@ -253,6 +331,10 @@ class URAlgorithmParams(Params):
     blacklist_events: List[str] = dataclasses.field(default_factory=list)  # default: primary
     backfill_type: str = "popular"  # popular | trending(unsupported yet) | none
     indicator_weights: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # item date properties checked against the query's currentDate
+    # (reference UR: availableDateName / expireDateName engine params)
+    available_date_name: str = ""
+    expire_date_name: str = ""
 
 
 class URAlgorithm(Algorithm):
@@ -369,6 +451,7 @@ class URAlgorithm(Algorithm):
             scores = pop / max(float(pop.max()), 1.0)
         # business rules
         mask = self._field_mask(model, query.fields)
+        mask = mask * self._date_mask(model, query)
         scores = scores * mask
         # blacklist: query items + the user's own primary-event items + self
         black = set(query.blacklist_items)
@@ -396,19 +479,45 @@ class URAlgorithm(Algorithm):
             ]
         )
 
+    def _date_mask(self, model: URModel, query: URQuery) -> np.ndarray:
+        """Hard date filters: the query's dateRange on an item date property,
+        and availableDateName <= currentDate <= expireDateName (reference:
+        URAlgorithm date rules).  Items missing the property fail dateRange
+        but pass the availability checks, as in the reference.  Vectorized
+        over the model's cached per-property timestamp arrays."""
+        n_items = len(model.item_dict)
+        mask = np.ones(n_items, np.float32)
+        dr = query.date_range
+        now = _query_ts(query.current_date, "currentDate") if query.current_date else None
+        avail, expire = self.params.available_date_name, self.params.expire_date_name
+        if dr is not None:
+            ts = model.prop_date_array(dr.name)
+            keep = ~np.isnan(ts)
+            if dr.after:
+                keep &= ts >= _query_ts(dr.after, "dateRange.after")
+            if dr.before:
+                keep &= ts <= _query_ts(dr.before, "dateRange.before")
+            mask *= keep
+        if now is not None:
+            if avail:
+                ts = model.prop_date_array(avail)
+                mask *= ~(ts > now)          # NaN compares False: missing passes
+            if expire:
+                # boundary instant still valid: available <= now <= expire
+                ts = model.prop_date_array(expire)
+                mask *= ~(ts < now)
+        return mask
+
     def _field_mask(self, model: URModel, rules: List[FieldRule]) -> np.ndarray:
         n_items = len(model.item_dict)
         mask = np.ones(n_items, np.float32)
         for rule in rules:
+            index = model.prop_value_index(rule.name)
             match = np.zeros(n_items, bool)
-            for j in range(n_items):
-                props = model.item_properties.get(model.item_dict.str(j), {})
-                v = props.get(rule.name)
-                if v is None:
-                    continue
-                vals = v if isinstance(v, list) else [v]
-                if any(str(x) in rule.values for x in vals):
-                    match[j] = True
+            for val in rule.values:
+                ids = index.get(val)
+                if ids is not None:
+                    match[ids] = True
             if rule.bias < 0:
                 mask *= match.astype(np.float32)  # hard filter
             else:

@@ -117,6 +117,30 @@ def load_latest_models(
     return instance, models
 
 
+def _eval_results_html(result: MetricEvaluatorResult) -> str:
+    """Candidate table for the dashboard (reference: EvaluationInstances'
+    evaluatorResultsHTML rendered by the dashboard module)."""
+    import html as _html
+
+    rows = "".join(
+        "<tr{hl}><td>{i}</td><td>{score:.6f}</td><td>{others}</td>"
+        "<td><pre>{params}</pre></td></tr>".format(
+            hl=' style="background:#e8f4e8"' if i == result.best_index else "",
+            i=i + 1,
+            score=score,
+            others=_html.escape(", ".join(f"{o:.4f}" for o in others)),
+            params=_html.escape(json.dumps(ep.to_json(), indent=1)[:2000]),
+        )
+        for i, (ep, score, others) in enumerate(result.engine_params_scores)
+    )
+    return (
+        f"<h3>{_html.escape(result.metric_header)}</h3>"
+        f"<table><tr><th>#</th><th>{_html.escape(result.metric_header)}</th>"
+        f"<th>{_html.escape(', '.join(result.other_metric_headers))}</th>"
+        f"<th>engine params</th></tr>{rows}</table>"
+    )
+
+
 def run_eval(
     evaluation: Evaluation,
     evaluation_class: str = "",
@@ -141,6 +165,7 @@ def run_eval(
             f"(candidate {result.best_index + 1}/{len(result.engine_params_scores)})"
         )
         instance.evaluator_results_json = json.dumps(result.to_json())
+        instance.evaluator_results_html = _eval_results_html(result)
         storage.evaluation_instances.update(instance)
         return result
     except Exception:

@@ -167,3 +167,121 @@ def test_ur_mesh_training_matches(ur_app):
     for name in m1.indicator_idx:
         assert (m1.indicator_idx[name] == m8.indicator_idx[name]).all()
         assert np.allclose(m1.indicator_llr[name], m8.indicator_llr[name], atol=1e-3)
+
+
+def test_date_range_rule(ur_app, mem_storage):
+    """dateRange hard-filters items by a date property (reference UR rule)."""
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.storage import App
+
+    app = mem_storage.apps.get_by_name("urapp")
+    # stamp e-items with a releaseDate inside the range, b-items outside
+    stamps = []
+    for it, date in [(f"e{i}", "2026-06-01T00:00:00") for i in range(6)] + [
+                     (f"b{i}", "2020-01-01T00:00:00") for i in range(6)]:
+        stamps.append(Event(event="$set", entity_type="item", entity_id=it,
+                            properties=DataMap({"releaseDate": date})))
+    mem_storage.l_events.insert_batch(stamps, app.id)
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    predictor = engine.predictor(ep, models)
+
+    # u20 is a book fan: without the rule the top rec is a b-item
+    res = predictor(URQuery.from_json({"user": "u20", "num": 4}))
+    assert res.item_scores and res.item_scores[0].item.startswith("b")
+    # with a 2026 dateRange, only e-items qualify -> recs empty or e-only
+    res = predictor(URQuery.from_json({
+        "user": "u20", "num": 4,
+        "dateRange": {"name": "releaseDate",
+                      "after": "2026-01-01T00:00:00",
+                      "before": "2026-12-31T00:00:00"},
+    }))
+    assert all(s.item.startswith("e") for s in res.item_scores)
+
+
+def test_available_expire_dates(ur_app, mem_storage):
+    """availableDateName/expireDateName vs currentDate (reference UR rule)."""
+    from predictionio_tpu.events.event import DataMap, Event
+
+    app = mem_storage.apps.get_by_name("urapp")
+    stamps = [
+        # b0 not yet available; b1 already expired; others unrestricted
+        Event(event="$set", entity_type="item", entity_id="b0",
+              properties=DataMap({"availableDate": "2027-01-01T00:00:00"})),
+        Event(event="$set", entity_type="item", entity_id="b1",
+              properties=DataMap({"expireDate": "2025-01-01T00:00:00"})),
+    ]
+    mem_storage.l_events.insert_batch(stamps, app.id)
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep(available_date_name="availableDate",
+                 expire_date_name="expireDate")
+    models = engine.train(ep)
+    predictor = engine.predictor(ep, models)
+
+    res = predictor(URQuery.from_json({
+        "user": "u20", "num": 6, "currentDate": "2026-07-29T00:00:00",
+    }))
+    items = [s.item for s in res.item_scores]
+    assert items, "should still recommend unrestricted items"
+    assert "b0" not in items and "b1" not in items
+    # without currentDate the availability rules are inert
+    res2 = predictor(URQuery.from_json({"user": "u20", "num": 6}))
+    assert len(res2.item_scores) >= len(items)
+
+
+def test_date_range_in_range_items_survive(ur_app, mem_storage):
+    """The positive half of dateRange: in-range items ARE returned for a
+    user with matching signal, and malformed query dates are rejected."""
+    from predictionio_tpu.events.event import DataMap, Event
+
+    app = mem_storage.apps.get_by_name("urapp")
+    stamps = [Event(event="$set", entity_type="item", entity_id=f"e{i}",
+                    properties=DataMap({"releaseDate": "2026-06-01T00:00:00"}))
+              for i in range(6)]
+    mem_storage.l_events.insert_batch(stamps, app.id)
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    predictor = engine.predictor(ep, models)
+
+    # u2 is an electronics fan: e-items are in range and must survive
+    res = predictor(URQuery.from_json({
+        "user": "u2", "num": 4,
+        "dateRange": {"name": "releaseDate", "after": "2026-01-01T00:00:00"},
+    }))
+    assert res.item_scores and all(s.item.startswith("e") for s in res.item_scores)
+
+    with pytest.raises(ValueError):
+        predictor(URQuery.from_json({
+            "user": "u2", "num": 4,
+            "dateRange": {"name": "releaseDate", "after": "01/2026"},
+        }))
+    with pytest.raises(ValueError):
+        predictor(URQuery.from_json({"user": "u2", "currentDate": "2026/07/29"}))
+
+
+def test_expire_date_boundary_instant_valid(ur_app, mem_storage):
+    """available <= now <= expire: an item expiring exactly at currentDate
+    is still recommendable."""
+    from predictionio_tpu.events.event import DataMap, Event
+
+    app = mem_storage.apps.get_by_name("urapp")
+    mem_storage.l_events.insert(
+        Event(event="$set", entity_type="item", entity_id="b2",
+              properties=DataMap({"expireDate": "2026-07-29T00:00:00"})), app.id)
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep(expire_date_name="expireDate")
+    models = engine.train(ep)
+    predictor = engine.predictor(ep, models)
+
+    at_boundary = predictor(URQuery.from_json({
+        "user": "u20", "num": 8, "currentDate": "2026-07-29T00:00:00"}))
+    past_boundary = predictor(URQuery.from_json({
+        "user": "u20", "num": 8, "currentDate": "2026-07-29T00:00:01"}))
+    assert "b2" in [s.item for s in at_boundary.item_scores]
+    assert "b2" not in [s.item for s in past_boundary.item_scores]
