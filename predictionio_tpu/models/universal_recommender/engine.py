@@ -52,16 +52,15 @@ from predictionio_tpu.store.event_store import LEventStore, PEventStore
 
 
 def _iso_ts(v) -> Optional[float]:
-    """ISO-8601 → epoch seconds (naive treated as UTC); None if unparseable."""
-    import datetime as _dt
+    """Date value → epoch seconds via the event pipeline's own coercion
+    (events.event.parse_time: ISO-8601 string, numeric epoch, or datetime;
+    naive treated as UTC); None if unparseable."""
+    from predictionio_tpu.events.event import parse_time
 
     try:
-        t = _dt.datetime.fromisoformat(str(v).replace("Z", "+00:00"))
-    except ValueError:
+        return parse_time(v).timestamp()
+    except (ValueError, OSError, OverflowError):
         return None
-    if t.tzinfo is None:
-        t = t.replace(tzinfo=_dt.timezone.utc)
-    return t.timestamp()
 
 
 def _query_ts(v, field: str) -> float:

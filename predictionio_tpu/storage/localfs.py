@@ -383,12 +383,122 @@ class FSModels(base.Models):
         return False
 
 
+class _EntityIndex:
+    """Incremental (entityType, entityId) → line-offset index over one
+    channel's segments.
+
+    The reference gets per-entity serving reads for free from HBase rowkeys;
+    here the log is append-only JSONL, so the index tails each segment from
+    the last consumed byte on every lookup (a stat per segment when nothing
+    changed) and stores (path, offset, length) per event — memory stays
+    O(events) small ints, and lookups read only the matching lines.  Safe
+    with concurrent writers in other processes: a torn tail line (no final
+    newline yet) is not consumed until complete.
+    """
+
+    def __init__(self, directory: Path):
+        self._dir = directory
+        self._consumed: Dict[str, int] = {}          # segment path -> bytes indexed
+        self._inodes: Dict[str, int] = {}            # segment path -> st_ino
+        self._postings: Dict[tuple, List[tuple]] = {}  # (etype, eid) -> [(path, off, len)]
+        self._lock = threading.Lock()
+
+    def _reset(self) -> None:
+        self._consumed.clear()
+        self._inodes.clear()
+        self._postings.clear()
+
+    def _refresh(self) -> None:
+        segs = sorted(self._dir.glob("seg-*.jsonl")) if self._dir.exists() else []
+        stats = {}
+        for seg in segs:
+            try:
+                stats[str(seg)] = seg.stat()
+            except FileNotFoundError:  # racing a delete
+                pass
+        # data-delete / re-import from ANY process replaces or truncates
+        # segment files; offsets into the old bytes are meaningless, so any
+        # inode change, shrink, or vanished segment rebuilds from scratch
+        for path, consumed in self._consumed.items():
+            st = stats.get(path)
+            if (
+                st is None
+                or st.st_size < consumed
+                or self._inodes.get(path) not in (None, st.st_ino)
+            ):
+                self._reset()
+                break
+        for seg in segs:
+            path = str(seg)
+            st = stats.get(path)
+            if st is None:
+                continue
+            consumed = self._consumed.get(path, 0)
+            self._inodes[path] = st.st_ino
+            if st.st_size <= consumed:
+                continue
+            with open(seg, "rb") as f:
+                f.seek(consumed)
+                chunk = f.read(st.st_size - consumed)
+            end = chunk.rfind(b"\n")
+            if end < 0:
+                continue  # only a torn partial line so far
+            offset = consumed
+            for line in chunk[: end + 1].split(b"\n"):
+                ln = len(line) + 1
+                if line.strip():
+                    try:
+                        d = json.loads(line)
+                        key = (d.get("entityType"), d.get("entityId"))
+                        self._postings.setdefault(key, []).append((path, offset, len(line)))
+                    except json.JSONDecodeError:
+                        pass  # skip corrupt line; offset still advances
+                offset += ln
+            self._consumed[path] = consumed + end + 1
+
+    def events(self, entity_type: str, entity_id: str, tombstones: set) -> List[Event]:
+        for _attempt in range(2):
+            with self._lock:
+                self._refresh()
+                postings = list(self._postings.get((entity_type, entity_id), ()))
+            try:
+                return self._read_postings(postings, tombstones)
+            except (FileNotFoundError, json.JSONDecodeError, ValueError, KeyError):
+                # segment replaced between refresh and read: rebuild once
+                with self._lock:
+                    self._reset()
+        return []
+
+    @staticmethod
+    def _read_postings(postings: List[tuple], tombstones: set) -> List[Event]:
+        out: List[Event] = []
+        by_path: Dict[str, List[tuple]] = {}
+        for path, off, ln in postings:
+            by_path.setdefault(path, []).append((off, ln))
+        for path, spans in by_path.items():
+            with open(path, "rb") as f:
+                for off, ln in spans:
+                    f.seek(off)
+                    e = Event.from_json(json.loads(f.read(ln)))
+                    if e.event_id not in tombstones:
+                        out.append(e)
+        return out
+
+
 class FSEvents(base.LEvents, base.PEvents):
     """Append-only segmented JSONL event log."""
 
     def __init__(self, root: Path):
         self._root = Path(root) / "events"
         self._lock = threading.Lock()
+        self._indexes: Dict[tuple, _EntityIndex] = {}
+
+    def _entity_index(self, app_id: int, channel_id: Optional[int]) -> _EntityIndex:
+        key = (app_id, channel_id)
+        with self._lock:
+            if key not in self._indexes:
+                self._indexes[key] = _EntityIndex(self._chan_dir(app_id, channel_id))
+            return self._indexes[key]
 
     # -- layout --------------------------------------------------------------
 
@@ -425,6 +535,8 @@ class FSEvents(base.LEvents, base.PEvents):
         import shutil
 
         d = self._chan_dir(app_id, channel_id)
+        with self._lock:
+            self._indexes.pop((app_id, channel_id), None)  # data-delete invalidates
         if d.exists():
             shutil.rmtree(d)
             return True
@@ -485,9 +597,17 @@ class FSEvents(base.LEvents, base.PEvents):
         limit: Optional[int] = None,
         reversed_order: bool = False,
     ) -> Iterator[Event]:
+        if entity_type is not None and entity_id is not None:
+            # serving hot path (LEventStore.find_by_entity): read only this
+            # entity's lines via the incremental index instead of the log
+            candidates = self._entity_index(app_id, channel_id).events(
+                entity_type, entity_id, self._tombstones(self._chan_dir(app_id, channel_id))
+            )
+        else:
+            candidates = self._iter_raw(app_id, channel_id)
         matched = (
             e
-            for e in self._iter_raw(app_id, channel_id)
+            for e in candidates
             if base.match_filters(
                 e, start_time, until_time, entity_type, entity_id,
                 event_names, target_entity_type, target_entity_id,

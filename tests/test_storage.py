@@ -171,3 +171,82 @@ def test_pevents_find_batches(storage):
     batches = list(storage.p_events.find_batches(3, batch_size=4))
     assert [len(b) for b in batches] == [4, 4, 2]
     assert all(b.target_ids.min() >= 0 for b in batches)
+
+
+def test_localfs_entity_index(tmp_path):
+    """Per-entity find uses the incremental index: correct across appends
+    from a second FSEvents handle (another process), segment rotations, and
+    tombstones."""
+    import predictionio_tpu.storage.localfs as lfs
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    old = lfs.SEGMENT_MAX_BYTES
+    lfs.SEGMENT_MAX_BYTES = 600  # force rotation
+    try:
+        ev = FSEvents(tmp_path)
+        ev.init(1)
+        for k in range(40):
+            ev.insert(Event(event="view", entity_type="user", entity_id=f"u{k % 4}",
+                            target_entity_type="item", target_entity_id=f"i{k}"), 1)
+        got = list(ev.find(1, entity_type="user", entity_id="u1"))
+        assert len(got) == 10
+        assert all(e.entity_id == "u1" for e in got)
+
+        # appends through a different handle (simulates the ingest process)
+        writer = FSEvents(tmp_path)
+        writer.insert(Event(event="view", entity_type="user", entity_id="u1",
+                            target_entity_type="item", target_entity_id="i99"), 1)
+        got = list(ev.find(1, entity_type="user", entity_id="u1"))
+        assert len(got) == 11
+        assert any(e.target_entity_id == "i99" for e in got)
+
+        # tombstoned events disappear from indexed reads
+        victim = got[0].event_id
+        assert ev.delete(victim, 1)
+        got = list(ev.find(1, entity_type="user", entity_id="u1"))
+        assert len(got) == 10 and victim not in [e.event_id for e in got]
+
+        # limit + reversed ordering still applies on the indexed path
+        latest = list(ev.find(1, entity_type="user", entity_id="u1",
+                              limit=3, reversed_order=True))
+        assert len(latest) == 3
+        times = [e.event_time for e in latest]
+        assert times == sorted(times, reverse=True)
+    finally:
+        lfs.SEGMENT_MAX_BYTES = old
+
+
+def test_localfs_entity_index_survives_reimport(tmp_path):
+    """data-delete + re-import from another handle must not leave the index
+    pointing into dead bytes (regression guard: pre-index code re-scanned)."""
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    reader = FSEvents(tmp_path)
+    reader.init(1)
+    writer = FSEvents(tmp_path)   # separate handle = separate process
+    writer.insert_batch(
+        [Event(event="view", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id=f"old{k}")
+         for k in range(20)], 1)
+    assert len(list(reader.find(1, entity_type="user", entity_id="u1"))) == 20
+
+    # operator wipes and re-imports a smaller log through the other handle
+    writer.remove(1)
+    writer.init(1)
+    writer.insert_batch(
+        [Event(event="view", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id="new0")], 1)
+    got = list(reader.find(1, entity_type="user", entity_id="u1"))
+    assert [e.target_entity_id for e in got] == ["new0"]
+
+    # re-import a LARGER log (old offsets would point mid-file)
+    writer.remove(1)
+    writer.init(1)
+    writer.insert_batch(
+        [Event(event="view", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id=f"big{k}")
+         for k in range(40)], 1)
+    got = list(reader.find(1, entity_type="user", entity_id="u1"))
+    assert len(got) == 40 and all(e.target_entity_id.startswith("big") for e in got)
