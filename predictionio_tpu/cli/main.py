@@ -158,15 +158,27 @@ def _cmd_channel(args) -> int:
         print(f"Created channel {args.name!r} with id {cid}.")
         return 0
     if args.ch_command == "delete":
-        chan = next((c for c in st.channels.get_by_app_id(app.id) if c.name == args.name), None)
-        if chan is None:
-            print(f"Error: channel {args.name!r} does not exist.", file=sys.stderr)
+        channel_id, ok = _resolve_channel(st, app, args.name)
+        if not ok:
             return 1
-        st.l_events.remove(app.id, chan.id)
-        st.channels.delete(chan.id)
+        st.l_events.remove(app.id, channel_id)
+        st.channels.delete(channel_id)
         print(f"Deleted channel {args.name!r}.")
         return 0
     raise AssertionError(args.ch_command)
+
+
+def _resolve_channel(st, app, channel_name: Optional[str]):
+    """None → default channel; unknown name → (None, error printed)."""
+    if not channel_name:
+        return None, True
+    chan = next(
+        (c for c in st.channels.get_by_app_id(app.id) if c.name == channel_name), None
+    )
+    if chan is None:
+        print(f"Error: channel {channel_name!r} does not exist.", file=sys.stderr)
+        return None, False
+    return chan.id, True
 
 
 def _cmd_import(args) -> int:
@@ -178,6 +190,9 @@ def _cmd_import(args) -> int:
     if app is None:
         print("Error: app not found.", file=sys.stderr)
         return 1
+    channel_id, ok = _resolve_channel(st, app, args.channel)
+    if not ok:
+        return 1
     count = 0
     batch = []
     with open(args.input) as f:
@@ -187,13 +202,14 @@ def _cmd_import(args) -> int:
                 continue
             batch.append(Event.from_json(json.loads(line)))
             if len(batch) >= 10000:
-                st.l_events.insert_batch(batch, app.id)
+                st.l_events.insert_batch(batch, app.id, channel_id)
                 count += len(batch)
                 batch = []
     if batch:
-        st.l_events.insert_batch(batch, app.id)
+        st.l_events.insert_batch(batch, app.id, channel_id)
         count += len(batch)
-    print(f"Imported {count} events to app {app.id}.")
+    where = f"app {app.id}" + (f" channel {args.channel}" if args.channel else "")
+    print(f"Imported {count} events to {where}.")
     return 0
 
 
@@ -203,9 +219,12 @@ def _cmd_export(args) -> int:
     if app is None:
         print("Error: app not found.", file=sys.stderr)
         return 1
+    channel_id, ok = _resolve_channel(st, app, args.channel)
+    if not ok:
+        return 1
     count = 0
     with open(args.output, "w") as f:
-        for e in st.p_events.find(app.id):
+        for e in st.p_events.find(app.id, channel_id=channel_id):
             f.write(e.to_json_line() + "\n")
             count += 1
     print(f"Exported {count} events from app {app.id} to {args.output}.")
@@ -308,12 +327,14 @@ def build_parser() -> argparse.ArgumentParser:
     imp = sub.add_parser("import")
     imp.add_argument("--appid", type=int, default=0)
     imp.add_argument("--app-name", default=None)
+    imp.add_argument("--channel", default=None)
     imp.add_argument("--input", required=True)
     imp.set_defaults(func=_cmd_import)
 
     exp = sub.add_parser("export")
     exp.add_argument("--appid", type=int, default=0)
     exp.add_argument("--app-name", default=None)
+    exp.add_argument("--channel", default=None)
     exp.add_argument("--output", required=True)
     exp.set_defaults(func=_cmd_export)
 

@@ -54,9 +54,15 @@ from predictionio_tpu.store.event_store import LEventStore, PEventStore
 def _iso_ts(v) -> Optional[float]:
     """Date value → epoch seconds via the event pipeline's own coercion
     (events.event.parse_time: ISO-8601 string, numeric epoch, or datetime;
-    naive treated as UTC); None if unparseable."""
+    naive treated as UTC); None if unparseable.
+
+    Unlike raw parse_time, None and booleans return None here — parse_time
+    maps None to "now" and bool is an int subclass, either of which would
+    turn a malformed query date into a silently wrong hard filter."""
     from predictionio_tpu.events.event import parse_time
 
+    if v is None or isinstance(v, bool):
+        return None
     try:
         return parse_time(v).timestamp()
     except (ValueError, OSError, OverflowError):

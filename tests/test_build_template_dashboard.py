@@ -218,3 +218,33 @@ def test_local_engine_module_importable(mem_storage, tmp_path):
     assert pio_main(["build", "--engine-json", str(engine_json)]) == 0
     m = mem_storage.engine_manifests.get("local-engine", "1")
     assert m is not None and m.engine_factory == "my_local_engine.LocalEngine"
+
+
+def test_import_export_channel(mem_storage, tmp_path, capsys):
+    """pio import/export --channel targets a named channel (reference:
+    tools Import/Export channel support)."""
+    assert pio_main(["app", "new", "ChApp"]) == 0
+    assert pio_main(["channel", "new", "ChApp", "side"]) == 0
+    capsys.readouterr()
+
+    events = tmp_path / "ev.jsonl"
+    events.write_text("\n".join(
+        json.dumps({"event": "view", "entityType": "user", "entityId": f"u{k}",
+                    "targetEntityType": "item", "targetEntityId": f"i{k}"})
+        for k in range(5)) + "\n")
+    assert pio_main(["import", "--app-name", "ChApp", "--channel", "side",
+                     "--input", str(events)]) == 0
+    assert "channel side" in capsys.readouterr().out
+
+    # default channel is untouched; channel export returns the 5 events
+    out_def = tmp_path / "default.jsonl"
+    out_side = tmp_path / "side.jsonl"
+    assert pio_main(["export", "--app-name", "ChApp", "--output", str(out_def)]) == 0
+    assert pio_main(["export", "--app-name", "ChApp", "--channel", "side",
+                     "--output", str(out_side)]) == 0
+    assert out_def.read_text().strip() == ""
+    assert len(out_side.read_text().strip().splitlines()) == 5
+
+    # unknown channel rejected
+    assert pio_main(["import", "--app-name", "ChApp", "--channel", "nope",
+                     "--input", str(events)]) == 1

@@ -1,0 +1,104 @@
+"""Concurrency stress: threaded ingest + concurrent indexed reads + storage
+metadata races (the reference leans on JVM immutability/Akka — SURVEY.md §5
+'race detection: none'; here the locks and the incremental entity index are
+exercised directly)."""
+
+import threading
+
+from predictionio_tpu.events.event import Event
+from predictionio_tpu.storage.localfs import FSEvents
+from predictionio_tpu.storage.sql import SQLClient, SQLApps, SQLEvents
+from predictionio_tpu.storage.base import App
+
+
+N_WRITERS = 4
+N_READERS = 4
+EVENTS_PER_WRITER = 200
+
+
+def _mk_event(w: int, k: int) -> Event:
+    return Event(event="view", entity_type="user", entity_id=f"u{w}",
+                 target_entity_type="item", target_entity_id=f"i{w}-{k}")
+
+
+def test_localfs_concurrent_ingest_and_indexed_reads(tmp_path):
+    ev = FSEvents(tmp_path)
+    ev.init(1)
+    errors = []
+    stop = threading.Event()
+
+    def writer(w: int):
+        try:
+            for k in range(EVENTS_PER_WRITER):
+                ev.insert(_mk_event(w, k), 1)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader(r: int):
+        try:
+            while not stop.is_set():
+                got = list(ev.find(1, entity_type="user", entity_id=f"u{r % N_WRITERS}"))
+                # monotone: never see duplicates or foreign entities
+                ids = [e.target_entity_id for e in got]
+                assert len(ids) == len(set(ids))
+                assert all(i.startswith(f"i{r % N_WRITERS}-") for i in ids)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    writers = [threading.Thread(target=writer, args=(w,)) for w in range(N_WRITERS)]
+    readers = [threading.Thread(target=reader, args=(r,)) for r in range(N_READERS)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, errors
+    # final consistency: every write is indexed
+    for w in range(N_WRITERS):
+        got = list(ev.find(1, entity_type="user", entity_id=f"u{w}"))
+        assert len(got) == EVENTS_PER_WRITER
+
+
+def test_sql_concurrent_ingest(tmp_path):
+    client = SQLClient(str(tmp_path / "ev.db"))
+    ev = SQLEvents(client)
+    ev.init(1)
+    errors = []
+
+    def writer(w: int):
+        try:
+            ev.insert_batch([_mk_event(w, k) for k in range(EVENTS_PER_WRITER)], 1)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(N_WRITERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(list(ev.find(1))) == N_WRITERS * EVENTS_PER_WRITER
+
+
+def test_sql_app_insert_race_unique_names(tmp_path):
+    """Concurrent duplicate app creates: exactly one wins, the rest get None
+    and the connection is left usable (rollback path)."""
+    client = SQLClient(str(tmp_path / "meta.db"))
+    apps = SQLApps(client)
+    results = []
+
+    def create():
+        results.append(apps.insert(App(0, "TheApp")))
+
+    threads = [threading.Thread(target=create) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [r for r in results if r is not None]
+    assert len(winners) == 1
+    # connection still healthy after rollbacks
+    assert apps.get_by_name("TheApp").id == winners[0]
+    assert apps.insert(App(0, "Another")) is not None
