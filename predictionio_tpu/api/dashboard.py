@@ -46,10 +46,17 @@ def _evi_json(i) -> dict:
     }
 
 
+def _start_key(i):
+    # instances may have a None start_time (inserted before train started)
+    import datetime as _dt
+
+    return i.start_time or _dt.datetime.min.replace(tzinfo=_dt.timezone.utc)
+
+
 def _render_html(storage: Storage) -> str:
     evals = storage.evaluation_instances.get_completed()
     engines = sorted(storage.engine_instances.get_all(),
-                     key=lambda i: i.start_time, reverse=True)
+                     key=_start_key, reverse=True)
     rows_eval = "".join(
         "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td>{res}</td></tr>".format(
             id=html.escape(i.id[:12]),
@@ -60,7 +67,7 @@ def _render_html(storage: Storage) -> str:
             res=i.evaluator_results_html
             or "<pre>" + html.escape((i.evaluator_results or "")[:2000]) + "</pre>",
         )
-        for i in sorted(evals, key=lambda i: i.start_time, reverse=True)
+        for i in sorted(evals, key=_start_key, reverse=True)
     ) or "<tr><td colspan=4><i>no completed evaluations</i></td></tr>"
     rows_engine = "".join(
         "<tr><td>{id}</td><td>{eng}</td><td>{status}</td><td>{start}</td></tr>".format(

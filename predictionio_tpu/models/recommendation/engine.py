@@ -168,9 +168,9 @@ class ALSAlgorithmParams(Params):
     seed: int = 7
     mesh_dp: int = 0        # 0 = use all devices
     # snapshot factors every N sweeps and resume after failures (0 = off);
-    # dir defaults to PIO_CHECKPOINT_DIR/als — safe to share because
-    # snapshots carry a run fingerprint (hyperparams + data signature) and
-    # foreign/stale ones are ignored on resume
+    # dir defaults to PIO_CHECKPOINT_DIR/als, with a per-run-fingerprint
+    # subdirectory (hyperparams + data signature) so concurrent trainings
+    # never prune/clear each other's snapshots
     checkpoint_every: int = 0
     checkpoint_dir: str = ""
 
@@ -232,10 +232,16 @@ class ALSAlgorithm(Algorithm):
 
             from predictionio_tpu.utils.checkpoint import CheckpointStore
 
-            ckpt_dir = self.params.checkpoint_dir or os.path.join(
+            base_dir = self.params.checkpoint_dir or os.path.join(
                 os.environ.get("PIO_CHECKPOINT_DIR", ".pio_checkpoints"), "als"
             )
-            checkpoint = CheckpointStore(ckpt_dir)
+            # key by run fingerprint: concurrent trainings of different
+            # datasets/params never share a snapshot dir, so one run's
+            # prune/clear cannot delete another's snapshots
+            fp = als_ops.als_fingerprint(
+                data, self.params.rank, self.params.lambda_, self.params.seed
+            )
+            checkpoint = CheckpointStore(os.path.join(base_dir, fp))
         X, Y = als_ops.als_train(
             data,
             k=self.params.rank,

@@ -487,9 +487,10 @@ class URAlgorithm(Algorithm):
     def _date_mask(self, model: URModel, query: URQuery) -> np.ndarray:
         """Hard date filters: the query's dateRange on an item date property,
         and availableDateName <= currentDate <= expireDateName (reference:
-        URAlgorithm date rules).  Items missing the property fail dateRange
-        but pass the availability checks, as in the reference.  Vectorized
-        over the model's cached per-property timestamp arrays."""
+        URAlgorithm date rules, applied as Elasticsearch range filters).
+        Items missing the property fail every date check — ES range filters
+        match only documents that have the field.  Vectorized over the
+        model's cached per-property timestamp arrays."""
         n_items = len(model.item_dict)
         mask = np.ones(n_items, np.float32)
         dr = query.date_range
@@ -504,13 +505,16 @@ class URAlgorithm(Algorithm):
                 keep &= ts <= _query_ts(dr.before, "dateRange.before")
             mask *= keep
         if now is not None:
+            # Items missing the configured date property are EXCLUDED, like
+            # the reference's Elasticsearch range filters (a range query only
+            # matches documents that have the field).
             if avail:
                 ts = model.prop_date_array(avail)
-                mask *= ~(ts > now)          # NaN compares False: missing passes
+                mask *= ts <= now            # NaN compares False: missing fails
             if expire:
                 # boundary instant still valid: available <= now <= expire
                 ts = model.prop_date_array(expire)
-                mask *= ~(ts < now)
+                mask *= ts >= now
         return mask
 
     def _field_mask(self, model: URModel, rules: List[FieldRule]) -> np.ndarray:

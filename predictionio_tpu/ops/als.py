@@ -270,7 +270,7 @@ def _als_deinterleave(data: ALSData, x, y, k: int):
     return x, y_arr
 
 
-def _als_fingerprint(data: ALSData, k: int, reg: float, seed: int) -> str:
+def als_fingerprint(data: ALSData, k: int, reg: float, seed: int) -> str:
     """Identifies a training run well enough to reject foreign snapshots:
     hyperparams + data layout + a cheap content signature."""
     n_events = int(data.u_mask.sum())
@@ -288,7 +288,7 @@ def _als_train_checkpointed(
     """Chunked sweeps with snapshot/resume (see als_train docstring)."""
     from predictionio_tpu.utils.checkpoint import maybe_inject
 
-    fingerprint = _als_fingerprint(data, k, reg, seed)
+    fingerprint = als_fingerprint(data, k, reg, seed)
     done = 0
     x = y = None
     latest = checkpoint.latest()

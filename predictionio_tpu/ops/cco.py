@@ -446,10 +446,8 @@ def cco_indicators_coo(
                            user_block=user_block, dedup=not primary_deduped)
     a = block_interactions(a_user, a_item, n_users, n_items_t,
                            user_block=user_block, dedup=not other_deduped)
-    rc = interaction_counts(p.item[p.mask > 0], n_items_p)
-    cc = interaction_counts(a.item[a.mask > 0], n_items_t)
     return cco_indicators(
-        p, a, rc, cc, n_users, top_k=top_k, llr_threshold=llr_threshold,
+        p, a, None, None, n_users, top_k=top_k, llr_threshold=llr_threshold,
         item_tile=item_tile, mesh=mesh, exclude_self=exclude_self,
     )
 
@@ -457,9 +455,9 @@ def cco_indicators_coo(
 def cco_indicators(
     primary: BlockedInteractions,
     other: BlockedInteractions,
-    primary_item_counts: np.ndarray,
-    other_item_counts: np.ndarray,
-    n_total_users: int,
+    primary_item_counts: Optional[np.ndarray] = None,
+    other_item_counts: Optional[np.ndarray] = None,
+    n_total_users: int = 0,
     top_k: int = 50,
     llr_threshold: float = 0.0,
     item_tile: int = 4096,
@@ -480,8 +478,12 @@ def cco_indicators(
       LLR+top-k over the full count matrix.  ~5× the tiled path on one chip.
     - **tiled** (huge item catalogs): the original item-tile loop that never
       materializes the full count matrix, re-densifying per tile and merging
-      a running top-k.  ``primary_item_counts``/``other_item_counts`` are
-      only read on this path; the dense path derives marginals on device.
+      a running top-k.
+
+    ``primary_item_counts``/``other_item_counts`` are DEPRECATED and ignored:
+    both strategies derive the LLR marginals from the blocked interactions
+    themselves, so the two paths are semantically identical by construction
+    (caller-supplied counts could silently disagree with the data).
     """
     if _dense_path_ok(primary.n_items, other.n_items):
         if primary.n_users != other.n_users:
@@ -499,9 +501,12 @@ def cco_indicators(
     tile = min(item_tile, max(n_items_t, 1))
     n_tiles = math.ceil(n_items_t / tile)
     padded_items_t = n_tiles * tile
+    # marginals from the data itself (blocked layouts hold unique pairs)
+    rc = interaction_counts(primary.item[primary.mask > 0], n_items_p)
+    cc = interaction_counts(other.item[other.mask > 0], n_items_t)
     col_counts = np.zeros(padded_items_t, np.float32)
-    col_counts[:n_items_t] = other_item_counts
-    row_counts = jnp.asarray(primary_item_counts, jnp.float32)
+    col_counts[:n_items_t] = cc
+    row_counts = jnp.asarray(rc, jnp.float32)
     col_counts = jnp.asarray(col_counts)
 
     best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)

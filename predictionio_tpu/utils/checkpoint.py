@@ -88,10 +88,15 @@ class CheckpointStore:
         return state
 
     def latest(self) -> Optional[Tuple[int, dict]]:
-        steps = self.steps()
-        if not steps:
-            return None
-        return steps[-1], self.restore(steps[-1])
+        # Walk newest→oldest, skipping manifest entries whose step file is
+        # gone (a concurrent run's prune/clear can race the manifest):
+        # resume falls back to an older snapshot or a fresh run, never crashes.
+        for step in reversed(self.steps()):
+            try:
+                return step, self.restore(step)
+            except FileNotFoundError:
+                continue
+        return None
 
     def _delete(self, step: int) -> None:
         p = self.dir / f"step_{step}.npz"

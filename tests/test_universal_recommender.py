@@ -206,13 +206,22 @@ def test_available_expire_dates(ur_app, mem_storage):
     from predictionio_tpu.events.event import DataMap, Event
 
     app = mem_storage.apps.get_by_name("urapp")
+    # b0 not yet available; b1 already expired; b2 missing both dates (an ES
+    # range filter matches only docs that HAVE the field, so it is excluded
+    # too); the rest carry an open validity window
     stamps = [
-        # b0 not yet available; b1 already expired; others unrestricted
         Event(event="$set", entity_type="item", entity_id="b0",
-              properties=DataMap({"availableDate": "2027-01-01T00:00:00"})),
+              properties=DataMap({"availableDate": "2027-01-01T00:00:00",
+                                  "expireDate": "2028-01-01T00:00:00"})),
         Event(event="$set", entity_type="item", entity_id="b1",
-              properties=DataMap({"expireDate": "2025-01-01T00:00:00"})),
+              properties=DataMap({"availableDate": "2024-01-01T00:00:00",
+                                  "expireDate": "2025-01-01T00:00:00"})),
     ]
+    for it in ["b3", "b4", "b5"] + [f"e{i}" for i in range(6)]:
+        stamps.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({"availableDate": "2024-01-01T00:00:00",
+                                "expireDate": "2028-01-01T00:00:00"})))
     mem_storage.l_events.insert_batch(stamps, app.id)
 
     engine = UniversalRecommenderEngine.apply()
@@ -225,8 +234,9 @@ def test_available_expire_dates(ur_app, mem_storage):
         "user": "u20", "num": 6, "currentDate": "2026-07-29T00:00:00",
     }))
     items = [s.item for s in res.item_scores]
-    assert items, "should still recommend unrestricted items"
+    assert items, "should still recommend items in their validity window"
     assert "b0" not in items and "b1" not in items
+    assert "b2" not in items, "items missing the date property are excluded"
     # without currentDate the availability rules are inert
     res2 = predictor(URQuery.from_json({"user": "u20", "num": 6}))
     assert len(res2.item_scores) >= len(items)
