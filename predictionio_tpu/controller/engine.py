@@ -143,6 +143,12 @@ class Engine(BaseEngine):
             raise ValueError(
                 f"{len(models)} model(s) for {len(algorithms)} algorithm(s)"
             )
+        # pre-stage serving state to device at deploy/reload time, so the
+        # first query never pays the host→device model transfer
+        for algo, model in zip(algorithms, models):
+            warm = getattr(algo, "warm", None)
+            if warm is not None:
+                warm(model)
 
         def predict(query: Any) -> Any:
             preds = [algo.predict(model, query) for algo, model in zip(algorithms, models)]

@@ -30,7 +30,7 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.mesh import create_mesh, MeshSpec
-from predictionio_tpu.store.columnar import EventBatch, IdDict
+from predictionio_tpu.store.columnar import CSRLookup, EventBatch, IdDict
 from predictionio_tpu.store.event_store import PEventStore
 
 
@@ -41,10 +41,19 @@ from predictionio_tpu.store.event_store import PEventStore
 class RecoQuery:
     user: str
     num: int = 10
+    # exclude the user's own rated items (reference e-commerce template's
+    # unseenOnly) and/or an explicit item blacklist
+    unseen_only: bool = False
+    blacklist: List[str] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_json(cls, d: Dict) -> "RecoQuery":
-        return cls(user=str(d["user"]), num=int(d.get("num", 10)))
+        return cls(
+            user=str(d["user"]),
+            num=int(d.get("num", 10)),
+            unseen_only=bool(d.get("unseenOnly", False)),
+            blacklist=[str(b) for b in d.get("blackList", [])],
+        )
 
 
 @dataclasses.dataclass
@@ -176,8 +185,9 @@ class ALSAlgorithmParams(Params):
 
 
 class ALSModel(PersistentModel):
-    """Factor matrices + id dictionaries (+ per-user seen items for
-    optional unseen-only serving)."""
+    """Factor matrices + id dictionaries (+ per-user seen items as a CSR
+    lookup for unseen-only serving — flat arrays, not a dict of arrays, so
+    model size and load time stay sub-linear in users)."""
 
     def __init__(
         self,
@@ -185,19 +195,19 @@ class ALSModel(PersistentModel):
         item_factors: np.ndarray,
         user_dict: IdDict,
         item_dict: IdDict,
-        seen: Optional[Dict[int, np.ndarray]] = None,
+        seen: Optional[CSRLookup] = None,
     ):
         self.user_factors = user_factors
         self.item_factors = item_factors
         self.user_dict = user_dict
         self.item_dict = item_dict
-        self.seen = seen or {}
+        self.seen = seen if seen is not None else CSRLookup.empty()
 
     def __getstate__(self):
         return {
             "X": self.user_factors, "Y": self.item_factors,
             "users": self.user_dict.to_state(), "items": self.item_dict.to_state(),
-            "seen": self.seen,
+            "seen": self.seen.to_state(),
         }
 
     def __setstate__(self, state):
@@ -205,7 +215,24 @@ class ALSModel(PersistentModel):
         self.item_factors = state["Y"]
         self.user_dict = IdDict.from_state(state["users"])
         self.item_dict = IdDict.from_state(state["items"])
-        self.seen = state["seen"]
+        self.seen = CSRLookup.from_state(state["seen"])
+
+    def item_factors_device(self):
+        """Item factors staged to device ONCE (never per query); cached on
+        the instance and rebuilt lazily after unpickle."""
+        dev = self.__dict__.get("_item_factors_dev")
+        if dev is None:
+            import jax
+            import jax.numpy as jnp
+
+            dev = jax.device_put(jnp.asarray(self.item_factors, jnp.float32))
+            self.__dict__["_item_factors_dev"] = dev
+        return dev
+
+    def warm(self) -> None:
+        """Pre-stage serving state to device (called at deploy/reload)."""
+        if len(self.item_factors):
+            self.item_factors_device()
 
 
 class ALSAlgorithm(Algorithm):
@@ -230,14 +257,19 @@ class ALSAlgorithm(Algorithm):
         if self.params.checkpoint_every > 0:
             import os
 
-            from predictionio_tpu.utils.checkpoint import CheckpointStore
+            from predictionio_tpu.utils.checkpoint import (
+                CheckpointStore,
+                prune_stale_runs,
+            )
 
             base_dir = self.params.checkpoint_dir or os.path.join(
                 os.environ.get("PIO_CHECKPOINT_DIR", ".pio_checkpoints"), "als"
             )
             # key by run fingerprint: concurrent trainings of different
             # datasets/params never share a snapshot dir, so one run's
-            # prune/clear cannot delete another's snapshots
+            # prune/clear cannot delete another's snapshots; sweep dirs from
+            # crashed runs whose fingerprint never recurs (TTL-based)
+            prune_stale_runs(base_dir)
             fp = als_ops.als_fingerprint(
                 data, self.params.rank, self.params.lambda_, self.params.seed
             )
@@ -253,25 +285,46 @@ class ALSAlgorithm(Algorithm):
             checkpoint_every=self.params.checkpoint_every,
         )
         if checkpoint is not None:
-            checkpoint.clear()  # completed: snapshots no longer needed
-        seen: Dict[int, np.ndarray] = {}
-        for u in np.unique(pd.user_idx):
-            seen[int(u)] = pd.item_idx[pd.user_idx == u]
+            # completed: remove this run's snapshot dir entirely
+            checkpoint.clear(remove_dir=True)
+        seen = CSRLookup.from_pairs(pd.user_idx, pd.item_idx, n_users)
         return ALSModel(X, Y, pd.user_dict, pd.item_dict, seen)
+
+    def warm(self, model: ALSModel) -> None:
+        model.warm()
+
+    def _exclusions(self, model: ALSModel, query: RecoQuery, uid: int) -> np.ndarray:
+        """Item ids excluded from this query's results (unpadded)."""
+        parts = []
+        if query.unseen_only and uid is not None:
+            parts.append(model.seen.row(uid))
+        for b in query.blacklist:
+            bid = model.item_dict.id(b)
+            if bid is not None:
+                parts.append(np.asarray([bid], np.int32))
+        return np.concatenate(parts) if parts else np.empty(0, np.int32)
+
+    @staticmethod
+    def _k_bucket(num: int, n_items: int) -> int:
+        """Serve top-k from a power-of-two bucket so distinct ``num`` values
+        share compiled programs (shape-bucketing, SURVEY §7 hard part d)."""
+        return min(als_ops.bucket_width(num), n_items)
 
     def predict(self, model: ALSModel, query: RecoQuery) -> PredictedResult:
         uid = model.user_dict.id(query.user)
         if uid is None or len(model.item_factors) == 0:
             return PredictedResult([])
-        k = min(query.num, len(model.item_factors))
-        seen_mask = np.zeros(len(model.item_factors), np.float32)
-        scores, idx = als_ops.recommend_scores(
-            model.user_factors[uid], model.item_factors, seen_mask, k
+        num = min(query.num, len(model.item_factors))
+        k = self._k_bucket(num, len(model.item_factors))
+        excl = als_ops.pad_ids(self._exclusions(model, query, uid))
+        scores, idx = als_ops.recommend_scores_excl(
+            np.asarray(model.user_factors[uid], np.float32),
+            model.item_factors_device(), excl, k,
         )
         return PredictedResult(
             [
                 ItemScore(model.item_dict.str(int(i)), float(s))
-                for s, i in zip(np.asarray(scores), np.asarray(idx))
+                for s, i in zip(np.asarray(scores)[:num], np.asarray(idx)[:num])
                 if np.isfinite(s)
             ]
         )
@@ -279,15 +332,25 @@ class ALSAlgorithm(Algorithm):
     def batch_predict(self, model: ALSModel, queries: Sequence[RecoQuery]) -> List[PredictedResult]:
         if not queries or len(model.item_factors) == 0:
             return [PredictedResult([]) for _ in queries]
-        k = min(max(q.num for q in queries), len(model.item_factors))
+        k = self._k_bucket(
+            min(max(q.num for q in queries), len(model.item_factors)),
+            len(model.item_factors),
+        )
         uids = np.array(
             [model.user_dict.id(q.user) if model.user_dict.id(q.user) is not None else -1
              for q in queries], np.int32,
         )
         safe = np.maximum(uids, 0)
         vecs = model.user_factors[safe]
-        seen = np.zeros((len(queries), len(model.item_factors)), np.float32)
-        scores, idx = als_ops.recommend_batch(vecs, model.item_factors, seen, k)
+        excl_rows = [self._exclusions(model, q, int(u) if u >= 0 else None)
+                     for q, u in zip(queries, uids)]
+        width = als_ops.bucket_width(max(len(e) for e in excl_rows))
+        excl = np.full((len(queries), width), -1, np.int32)
+        for j, e in enumerate(excl_rows):
+            excl[j, :len(e)] = e
+        scores, idx = als_ops.recommend_batch_excl(
+            np.asarray(vecs, np.float32), model.item_factors_device(), excl, k,
+        )
         scores, idx = np.asarray(scores), np.asarray(idx)
         out = []
         for j, q in enumerate(queries):

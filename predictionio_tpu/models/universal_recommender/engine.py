@@ -43,8 +43,9 @@ from predictionio_tpu.controller import (
     Preparator,
 )
 from predictionio_tpu.ops import cco as cco_ops
+from predictionio_tpu.ops.als import pad_ids as als_pad_ids
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.store.columnar import CSRLookup, IdDict
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
 
 
@@ -228,6 +229,8 @@ class URModel(PersistentModel):
 
     For event type t: ``indicator_idx[t]`` [I_p, K] holds correlated item ids
     in t's item space (-1 padding), ``indicator_llr[t]`` the LLR strengths.
+    ``user_seen`` is a CSR lookup (user → primary items) — flat arrays, so
+    the model blob stays sub-linear in users.
     """
 
     def __init__(
@@ -240,7 +243,7 @@ class URModel(PersistentModel):
         event_item_dicts: Dict[str, IdDict],
         popularity: np.ndarray,
         item_properties: Dict[str, Dict[str, Any]],
-        user_seen: Dict[int, np.ndarray],
+        user_seen: CSRLookup,
     ):
         self.primary_event = primary_event
         self.item_dict = item_dict
@@ -262,7 +265,7 @@ class URModel(PersistentModel):
             "event_items": {k: d.to_state() for k, d in self.event_item_dicts.items()},
             "popularity": self.popularity,
             "item_properties": self.item_properties,
-            "user_seen": self.user_seen,
+            "user_seen": self.user_seen.to_state(),
         }
 
     def __setstate__(self, s):
@@ -274,7 +277,27 @@ class URModel(PersistentModel):
         self.event_item_dicts = {k: IdDict.from_state(v) for k, v in s["event_items"].items()}
         self.popularity = s["popularity"]
         self.item_properties = s["item_properties"]
-        self.user_seen = s["user_seen"]
+        self.user_seen = CSRLookup.from_state(s["user_seen"])
+
+    def device_indicators(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+        """Indicator tables staged to device ONCE per load/reload and cached
+        on the instance (never serialized; rebuilt lazily after unpickle).
+        Serving must not re-upload the model per query — at 100k items ×
+        top-50 an indicator table is ~20 MB per event type."""
+        dev = self.__dict__.get("_dev_indicators")
+        if dev is None:
+            dev = {
+                name: (
+                    jax.device_put(jnp.asarray(self.indicator_idx[name])),
+                    jax.device_put(jnp.asarray(self.indicator_llr[name])),
+                )
+                for name in self.indicator_idx
+            }
+            self.__dict__["_dev_indicators"] = dev
+        return dev
+
+    def warm(self) -> None:
+        self.device_indicators()
 
     # -- serving-time property indexes (built lazily, never serialized) ----
 
@@ -309,11 +332,25 @@ class URModel(PersistentModel):
         return cache[name]
 
 
-@partial(jax.jit, static_argnames=())
-def _indicator_score(idx: jnp.ndarray, llr: jnp.ndarray, hist: jnp.ndarray, use_llr: jnp.ndarray):
-    """score[i] = Σ_k hist[idx[i,k]] · w[i,k] with -1 padding masked."""
+@partial(jax.jit, static_argnames=("n_items_t",))
+def _indicator_score_ids(
+    idx: jnp.ndarray,       # [I_p, K] device-resident indicator table
+    llr: jnp.ndarray,       # [I_p, K] LLR strengths
+    hist_ids: jnp.ndarray,  # [W] history item ids in t-space, -1 padding
+    use_llr: jnp.ndarray,
+    n_items_t: int,
+):
+    """score[i] = Σ_k 1[idx[i,k] ∈ hist] · w[i,k].
+
+    The history multi-hot is built ON DEVICE from a small padded id list
+    (≤ max_query_events ints), so a query transfers a few hundred bytes —
+    never an [n_items] vector and never the indicator table itself."""
+    h_valid = hist_ids >= 0
+    hvec = jnp.zeros((n_items_t,), jnp.float32).at[
+        jnp.where(h_valid, hist_ids, 0)
+    ].max(h_valid.astype(jnp.float32))
     valid = idx >= 0
-    matched = hist[jnp.where(valid, idx, 0)] * valid
+    matched = hvec[jnp.where(valid, idx, 0)] * valid
     w = jnp.where(use_llr, jnp.where(valid, llr, 0.0), 1.0)
     return (matched * w).sum(-1)
 
@@ -382,9 +419,7 @@ class URAlgorithm(Algorithm):
             indicator_llr[name] = np.where(np.isfinite(scores), scores, 0.0).astype(np.float32)
             event_item_dicts[name] = item_dict
         popularity = p_counts.astype(np.float32)
-        user_seen: Dict[int, np.ndarray] = {}
-        for u_id in np.unique(p_user) if len(p_user) else []:
-            user_seen[int(u_id)] = np.unique(p_item[p_user == u_id])
+        user_seen = CSRLookup.from_pairs(pu_d, pi_d, n_users)
         return URModel(
             primary_event=primary,
             item_dict=p_item_dict,
@@ -419,6 +454,29 @@ class URAlgorithm(Algorithm):
             hist[name] = np.asarray(sorted(set(ids)), np.int32)
         return hist
 
+    def warm(self, model: URModel) -> None:
+        model.warm()
+
+    def _score_history(
+        self, model: URModel, hist: Dict[str, np.ndarray]
+    ) -> Optional[np.ndarray]:
+        """Run the device-resident scorer over every event type's history;
+        accumulates ON DEVICE, one host transfer of the final [I_p] vector."""
+        use_llr = jnp.asarray(self.params.use_llr_weights)
+        total = None
+        for name, (idx_dev, llr_dev) in model.device_indicators().items():
+            h_ids = hist.get(name)
+            if h_ids is None or len(h_ids) == 0:
+                continue
+            n_t = max(len(model.event_item_dicts[name]), 1)
+            s = _indicator_score_ids(
+                idx_dev, llr_dev, als_pad_ids(h_ids), use_llr, n_t
+            )
+            weight = float(self.params.indicator_weights.get(name, 1.0))
+            s = s * weight if weight != 1.0 else s
+            total = s if total is None else total + s
+        return None if total is None else np.asarray(total)
+
     def predict(self, model: URModel, query: URQuery) -> URResult:
         n_items = len(model.item_dict)
         if n_items == 0:
@@ -428,29 +486,26 @@ class URAlgorithm(Algorithm):
         if query.item is not None:
             iid = model.item_dict.id(query.item)
             if iid is not None:
-                idx = model.indicator_idx.get(model.primary_event)
-                llr = model.indicator_llr.get(model.primary_event)
-                if idx is not None:
-                    for k_, j in enumerate(idx[iid]):
-                        if j >= 0:
-                            scores[j] += llr[iid, k_] if self.params.use_llr_weights else 1.0
-                    have_signal = bool((idx[iid] >= 0).any())
+                # item-similarity: the query item's OWN indicator lists act
+                # as a virtual history on each event type's field (reference
+                # URAlgorithm getBiasedSimilarItems building the ES query
+                # from the item document's indicator arrays)
+                hist: Dict[str, np.ndarray] = {}
+                for name, idx in model.indicator_idx.items():
+                    row = idx[iid]
+                    ids = row[row >= 0]
+                    if len(ids):
+                        hist[name] = ids.astype(np.int32)
+                s = self._score_history(model, hist)
+                if s is not None:
+                    scores += s
+                    have_signal = True
         elif query.user is not None:
             hist = self._user_history(model, query.user)
-            use_llr = jnp.asarray(self.params.use_llr_weights)
-            for name, idx in model.indicator_idx.items():
-                h_ids = hist.get(name)
-                if h_ids is None or len(h_ids) == 0:
-                    continue
-                hvec = np.zeros(max(len(model.event_item_dicts[name]), 1), np.float32)
-                hvec[h_ids] = 1.0
-                s = _indicator_score(
-                    jnp.asarray(idx), jnp.asarray(model.indicator_llr[name]),
-                    jnp.asarray(hvec), use_llr,
-                )
-                weight = float(self.params.indicator_weights.get(name, 1.0))
-                scores += weight * np.asarray(s)
-                have_signal = have_signal or bool(len(h_ids))
+            s = self._score_history(model, hist)
+            if s is not None:
+                scores += s
+                have_signal = True
         if not have_signal and self.params.backfill_type == "popular":
             pop = model.popularity
             scores = pop / max(float(pop.max()), 1.0)
@@ -462,11 +517,10 @@ class URAlgorithm(Algorithm):
         black = set(query.blacklist_items)
         if query.user is not None:
             uid = model.user_dict.id(query.user)
-            if uid is not None and uid in model.user_seen:
+            if uid is not None:
                 blacklist_events = self.params.blacklist_events or [model.primary_event]
                 if model.primary_event in blacklist_events:
-                    for j in model.user_seen[uid]:
-                        scores[j] = -np.inf
+                    scores[model.user_seen.row(uid)] = -np.inf
         if query.item is not None and not query.return_self:
             black.add(query.item)
         for b in black:

@@ -485,6 +485,8 @@ def cco_indicators(
     themselves, so the two paths are semantically identical by construction
     (caller-supplied counts could silently disagree with the data).
     """
+    if n_total_users <= 0:
+        raise ValueError(f"n_total_users must be positive, got {n_total_users}")
     if _dense_path_ok(primary.n_items, other.n_items):
         if primary.n_users != other.n_users:
             raise ValueError("primary/other must share the user space")

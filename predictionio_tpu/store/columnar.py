@@ -71,6 +71,58 @@ class IdDict:
         return d
 
 
+class CSRLookup:
+    """Row → sorted unique int values, stored as two flat arrays.
+
+    Replaces per-row Python dicts of arrays in serialized models (e.g. a
+    user's seen items): at 10⁷ rows a dict of ndarrays dominates the model
+    blob and load time, while CSR is two contiguous arrays — O(1) pickle,
+    O(nnz) memory, O(1) row slicing.
+    """
+
+    __slots__ = ("indptr", "values")
+
+    def __init__(self, indptr: np.ndarray, values: np.ndarray):
+        self.indptr = np.asarray(indptr, np.int64)
+        self.values = np.asarray(values, np.int32)
+
+    @classmethod
+    def from_pairs(cls, rows: np.ndarray, values: np.ndarray, n_rows: int) -> "CSRLookup":
+        rows = np.asarray(rows, np.int64)
+        values = np.asarray(values, np.int64)
+        if len(rows):
+            n_vals = int(values.max()) + 1 if len(values) else 1
+            flat = np.unique(rows * n_vals + values)
+            rows, values = flat // n_vals, flat % n_vals
+        counts = np.bincount(rows, minlength=n_rows) if len(rows) else np.zeros(n_rows, np.int64)
+        indptr = np.zeros(n_rows + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return cls(indptr, values.astype(np.int32))
+
+    @classmethod
+    def empty(cls, n_rows: int = 0) -> "CSRLookup":
+        return cls(np.zeros(n_rows + 1, np.int64), np.empty(0, np.int32))
+
+    def row(self, r: int) -> np.ndarray:
+        if r < 0 or r >= len(self):
+            return np.empty(0, np.int32)
+        return self.values[self.indptr[r]:self.indptr[r + 1]]
+
+    def __len__(self) -> int:
+        return len(self.indptr) - 1
+
+    @property
+    def nnz(self) -> int:
+        return len(self.values)
+
+    def to_state(self) -> Dict[str, np.ndarray]:
+        return {"indptr": self.indptr, "values": self.values}
+
+    @classmethod
+    def from_state(cls, state: Dict[str, np.ndarray]) -> "CSRLookup":
+        return cls(state["indptr"], state["values"])
+
+
 @dataclass
 class EventBatch:
     """Struct-of-arrays block of events.

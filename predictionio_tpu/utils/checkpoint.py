@@ -103,9 +103,43 @@ class CheckpointStore:
         if p.exists():
             p.unlink()
 
-    def clear(self) -> None:
+    def clear(self, remove_dir: bool = False) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
-        self.dir.mkdir(parents=True, exist_ok=True)
+        if not remove_dir:
+            self.dir.mkdir(parents=True, exist_ok=True)
+
+
+def prune_stale_runs(base_dir: str | os.PathLike, ttl_seconds: Optional[float] = None) -> int:
+    """Remove per-run checkpoint subdirectories untouched for ``ttl_seconds``
+    (default PIO_CHECKPOINT_TTL_SECONDS, else 7 days).
+
+    Run-keyed dirs (checkpoints keyed by data+hyperparam fingerprint) are only
+    reused by a resume of the *same* run; a crashed run whose data changes
+    before the retry would otherwise leak its snapshots forever.  Returns the
+    number of directories removed.
+    """
+    if ttl_seconds is None:
+        ttl_seconds = float(os.environ.get("PIO_CHECKPOINT_TTL_SECONDS", 7 * 86400))
+    base = Path(base_dir)
+    if not base.exists():
+        return 0
+    import time
+
+    now = time.time()
+    removed = 0
+    for d in base.iterdir():
+        if not d.is_dir():
+            continue
+        try:
+            newest = max(
+                (f.stat().st_mtime for f in d.iterdir()), default=d.stat().st_mtime
+            )
+        except OSError:
+            continue
+        if now - newest > ttl_seconds:
+            shutil.rmtree(d, ignore_errors=True)
+            removed += 1
+    return removed
 
 
 # ---------------------------------------------------------------------------

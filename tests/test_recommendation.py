@@ -129,3 +129,67 @@ def test_eval_folds(rating_app):
     result = MetricEvaluator(PrecisionAtK()).evaluate(engine, [ep])
     # liked items dominate each user's group; ALS should rank them in top-10
     assert result.best_score > 0.5
+
+
+def test_unseen_only_excludes_rated_items(rating_app):
+    """unseenOnly=true must exclude every item the user has rated
+    (reference e-commerce template's unseenOnly), via the model's CSR
+    seen lookup."""
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    models = engine.train(ep)
+    model = models[0]
+    predict = engine.predictor(ep, models)
+    uid = model.user_dict.id("u1")
+    rated = {model.item_dict.str(int(j)) for j in model.seen.row(uid)}
+    assert rated, "fixture gives u1 rated items"
+    res = predict(RecoQuery(user="u1", num=10, unseen_only=True))
+    recs = {s.item for s in res.item_scores}
+    assert recs.isdisjoint(rated), f"rated items leaked: {recs & rated}"
+    # without the flag, the top items ARE the user's high-rated ones
+    res_all = predict(RecoQuery(user="u1", num=10))
+    assert {s.item for s in res_all.item_scores} & rated
+
+
+def test_blacklist_query_field(rating_app):
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    base = predict(RecoQuery(user="u1", num=3))
+    banned = base.item_scores[0].item
+    res = predict(RecoQuery.from_json(
+        {"user": "u1", "num": 3, "blackList": [banned]}))
+    assert banned not in [s.item for s in res.item_scores]
+
+
+def test_batch_predict_respects_flags(rating_app):
+    engine = RecommendationEngine.apply()
+    ep = make_params()
+    models = engine.train(ep)
+    model = models[0]
+    algo = ALSAlgorithm(ep.algorithm_params_list[0][1])
+    uid = model.user_dict.id("u1")
+    rated = {model.item_dict.str(int(j)) for j in model.seen.row(uid)}
+    out = algo.batch_predict(model, [
+        RecoQuery(user="u1", num=10, unseen_only=True),
+        RecoQuery(user="u1", num=10),
+        RecoQuery(user="nobody", num=3),
+    ])
+    assert {s.item for s in out[0].item_scores}.isdisjoint(rated)
+    assert {s.item for s in out[1].item_scores} & rated
+    assert out[2].item_scores == []
+
+
+def test_seen_csr_is_flat_arrays(rating_app):
+    """Model blob stores seen items as two flat arrays (CSR), not a python
+    dict of per-user arrays — size must be O(nnz), not O(users) objects."""
+    import pickle
+
+    engine = RecommendationEngine.apply()
+    models = engine.train(make_params())
+    state = models[0].__getstate__()
+    assert set(state["seen"]) == {"indptr", "values"}
+    m2 = pickle.loads(pickle.dumps(models[0]))
+    uid = m2.user_dict.id("u1")
+    assert (m2.seen.row(uid) == models[0].seen.row(uid)).all()
