@@ -391,35 +391,39 @@ class URAlgorithm(Algorithm):
             raise ValueError(f"no {primary!r} events to train on")
         dp = self.params.mesh_dp or len(jax.devices())
         mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
-        block = self.params.user_block
-        # dedup the primary ONCE; every per-event-type CCO call reuses it
-        pu_d, pi_d = cco_ops.dedup_pairs(p_user, p_item, n_items)
-        p_counts = cco_ops.interaction_counts(pi_d, n_items)
-        indicator_idx: Dict[str, np.ndarray] = {}
-        indicator_llr: Dict[str, np.ndarray] = {}
+        # one staged-primary pass over all event types: the primary uploads
+        # once, device work for type t overlaps host layout of type t+1, and
+        # no host dedup runs anywhere (cco_train_indicators dedups on device
+        # via its scatter-max densify)
+        others = []
         event_item_dicts: Dict[str, IdDict] = {}
         for name in td.event_names:
             u, i, item_dict = td.interactions[name]
             if name != primary and len(item_dict) == 0:
                 continue
             if name == primary:
-                u, i = pu_d, pi_d
-            scores, idx = cco_ops.cco_indicators_coo(
-                pu_d, pi_d, u, i, n_users, n_items, len(item_dict),
-                top_k=self.params.max_correlators_per_item,
-                llr_threshold=self.params.min_llr,
-                user_block=block,
-                item_tile=self.params.item_tile,
-                mesh=mesh,
-                exclude_self=(name == primary),
-                primary_deduped=True,
-                other_deduped=(name == primary),
-            )
+                u, i = p_user, p_item  # identity → self-pair kernel reuse
+            others.append((name, u, i, len(item_dict)))
+            event_item_dicts[name] = item_dict
+        results = cco_ops.cco_train_indicators(
+            p_user, p_item, others, n_users, n_items,
+            top_k=self.params.max_correlators_per_item,
+            llr_threshold=self.params.min_llr,
+            mesh=mesh,
+            exclude_self_for=primary,
+            user_block=self.params.user_block,
+            item_tile=self.params.item_tile,
+        )
+        indicator_idx: Dict[str, np.ndarray] = {}
+        indicator_llr: Dict[str, np.ndarray] = {}
+        for name, (scores, idx) in results.items():
             indicator_idx[name] = idx.astype(np.int32)
             indicator_llr[name] = np.where(np.isfinite(scores), scores, 0.0).astype(np.float32)
-            event_item_dicts[name] = item_dict
-        popularity = p_counts.astype(np.float32)
-        user_seen = CSRLookup.from_pairs(pu_d, pi_d, n_users)
+        # CSR dedups (user, item) internally; popularity = distinct users
+        # per item, straight off the CSR values — no separate unique pass
+        user_seen = CSRLookup.from_pairs(p_user, p_item, n_users)
+        popularity = np.bincount(
+            user_seen.values, minlength=n_items).astype(np.float32)
         return URModel(
             primary_event=primary,
             item_dict=p_item_dict,

@@ -5,20 +5,32 @@ Reference: ActionML's URAlgorithm delegates to Mahout-Samsara
 ``P'ᵀ·A_t`` + Dunning LLR + per-row top-k; SURVEY.md §2 'Universal
 Recommender').  TPU-first re-expression (SURVEY.md §7.5):
 
-- Interactions arrive as dedup'd COO (user, item) pairs per event type.
-- Users are processed in fixed-size blocks: each block densifies to
-  0/1 matrices ``P_b [B, I_p]`` / ``A_b [B, I_t]`` by scatter, then
-  ``C += P_bᵀ @ A_b`` — a bf16×bf16→f32 matmul (exact for 0/1 inputs,
-  full MXU rate).  ``lax.scan`` over blocks keeps it one compiled program.
-- Item columns are processed in tiles; each tile's LLR scores merge into a
-  running per-row top-k (concat + ``lax.top_k``), so the full I_p×I_t count
-  matrix is never materialized.
-- Multi-device: user blocks are sharded over the mesh's ``dp`` axis; the
-  per-tile count matrix is ``psum``'d over ICI before LLR (counts are the
-  only cross-device quantity).
+- Interactions arrive as raw (user, item) COO pairs per event type — **no
+  host dedup pass**: the device densify is a scatter-max, and users are
+  unique within a chunk, so duplicate pairs collapse on device and the LLR
+  marginals (distinct-user counts) fall out of the densified matrices as
+  column sums.  The O(E log E) host ``np.unique`` that would dominate at
+  billion-event scale never runs.
+- Users are processed in fixed-size chunks: each chunk densifies to 0/1
+  matrices ``P_b [B, I_p]`` / ``A_b [B, I_t]`` by scatter, then
+  ``C += P_bᵀ @ A_b`` — an int8×int8→int32 matmul (exact for 0/1 inputs,
+  and v5e's MXU runs int8 at 2× its bf16 rate).  ``lax.scan`` over chunks
+  keeps it one compiled program.
+- Training runs **all event types against one staged primary**:
+  ``cco_train_indicators`` lays out and uploads the primary once, then
+  dispatches each event type's counts+LLR+top-k asynchronously — host
+  layout of event type t+1 overlaps device compute of event type t, and
+  results download once at the end.
+- Huge item catalogs take the tiled path: item columns are processed in
+  tiles, each tile's LLR scores merging into a running per-row top-k
+  (concat + ``lax.top_k``), so the full I_p×I_t count matrix is never
+  materialized.  Marginals accumulate on device inside the same scan.
+- Multi-device: user chunks are sharded over the mesh's ``dp`` axis; the
+  count matrix and marginals are ``psum``'d over ICI (counts are the only
+  cross-device quantity).
 
 LLR is Dunning's G² exactly as Mahout's ``LogLikelihood.logLikelihoodRatio``
-computes it (entropy formulation).
+computes it (determinant formulation; see ``llr_score``).
 """
 
 from __future__ import annotations
@@ -27,7 +39,7 @@ import dataclasses
 import math
 import os as _os
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +57,9 @@ class BlockedInteractions:
     """COO pairs grouped into fixed-size user blocks, padded to equal length.
 
     local_u[b, e] is the in-block user row (or 0 with mask 0), item[b, e] the
-    item id.  Block b covers global users [b*block, (b+1)*block).
+    item id.  Block b covers global users [b*block, (b+1)*block).  Pairs need
+    NOT be unique: every device consumer densifies by scatter-max, which
+    collapses duplicates.
     """
 
     local_u: np.ndarray   # int32 [n_blocks, E]
@@ -67,15 +81,19 @@ def block_interactions(
     n_items: int,
     user_block: int = 1024,
     pad_multiple: int = 8,
-    dedup: bool = True,
+    dedup: bool = False,
 ) -> BlockedInteractions:
+    """Group raw COO by user block.  ``dedup`` is optional and OFF by
+    default — device consumers dedup by construction (scatter-max densify);
+    it only shrinks the padded width when the data is heavily duplicated."""
     if dedup:
         user, item = dedup_pairs(user, item, n_items)
-    else:  # caller guarantees pairs are already unique
+    else:
         user = np.asarray(user, np.int32)
         item = np.asarray(item, np.int32)
     n_blocks = max(math.ceil(n_users / user_block), 1)
     blk = user // user_block
+    # numpy stable argsort on ints is a radix sort: O(E), not O(E log E)
     order = np.argsort(blk, kind="stable")
     user, item, blk = user[order], item[order], blk[order]
     counts = np.bincount(blk, minlength=n_blocks)
@@ -96,12 +114,15 @@ def block_interactions(
 
 
 def interaction_counts(item: np.ndarray, n_items: int) -> np.ndarray:
-    """Distinct-user count per item (column counts for the LLR table)."""
+    """Distinct-user count per item (column counts for the LLR table).
+    Caller must pass dedup'd items; prefer the device-side marginals."""
     return np.bincount(item, minlength=n_items).astype(np.float32)
 
 
 def dedup_pairs(user: np.ndarray, item: np.ndarray, n_items: int):
-    """Dedup (user, item) pairs — CCO is binary occurrence."""
+    """Dedup (user, item) pairs — CCO is binary occurrence.  Host-side
+    O(E log E); the training hot path no longer calls this (device
+    scatter-max dedups), it remains for CSR construction and tests."""
     user = np.asarray(user, np.int64)
     item = np.asarray(item, np.int64)
     if not len(user):
@@ -151,15 +172,37 @@ def llr_score(k11, k12, k21, k22):
 
 
 # ---------------------------------------------------------------------------
-# device kernel
+# device kernels — shared pieces
 # ---------------------------------------------------------------------------
 
 
-def _densify(local_u, item_local, mask, block: int, width: int):
-    """0/1 matrix [block, width] from in-block COO (scatter-max)."""
-    m = jnp.zeros((block, width), jnp.float32)
-    vals = mask  # 1.0 for real entries, 0.0 padding (scatter of 0 is harmless)
-    return m.at[local_u, item_local].max(vals)
+def _matmul_dtype() -> str:
+    """'int8' (default: exact for 0/1, 2× MXU rate on v5e) or 'bf16'."""
+    conf = _os.environ.get("PIO_CCO_MM_DTYPE", "int8").lower()
+    return conf if conf in ("int8", "bf16") else "int8"
+
+
+def _densify(local_u, item_local, valid, block: int, width: int, dtype):
+    """0/1 matrix [block, width] from in-block COO (scatter-max collapses
+    duplicate pairs — this IS the dedup)."""
+    m = jnp.zeros((block, width), dtype)
+    return m.at[local_u, item_local].max(valid.astype(dtype))
+
+
+def _count_matmul(Pm, Am, acc_dtype):
+    return jax.lax.dot_general(
+        Pm, Am, (((0,), (0,)), ((), ())), preferred_element_type=acc_dtype)
+
+
+def _mm_dtypes():
+    if _matmul_dtype() == "int8":
+        return jnp.int8, jnp.int32
+    return jnp.bfloat16, jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# tiled path (huge item catalogs; the count matrix never materializes)
+# ---------------------------------------------------------------------------
 
 
 def _cooccurrence_tile(
@@ -171,26 +214,34 @@ def _cooccurrence_tile(
     tile: int,
     axis_name: Optional[str] = None,
 ):
-    """C_tile [I_p, tile] = Σ_blocks P_bᵀ A_b[:, tile_start:tile_start+tile]."""
+    """One item tile's counts AND the LLR marginals, on device:
+    C_tile [I_p, tile] = Σ_b P_bᵀ A_b[:, tile];  rc = Σ_b colsum(P_b);
+    cc_tile = Σ_b colsum(A_b[:, tile]).  Marginals come from the densified
+    (hence dedup'd) matrices — no host unique pass feeds this path."""
+    in_dtype, acc_dtype = _mm_dtypes()
 
     def body(carry, xs):
+        C, rc, cct = carry
         plu, pit, pmk, alu, ait, amk = xs
-        pb = _densify(plu, pit, pmk, block, n_items_p)
+        pb = _densify(plu, pit, pmk, block, n_items_p, in_dtype)
         a_local = ait - tile_start
         in_tile = (a_local >= 0) & (a_local < tile)
-        ab = _densify(alu, jnp.where(in_tile, a_local, 0), amk * in_tile, block, tile)
-        # bf16 inputs, f32 accumulation: exact for 0/1 values, MXU rate.
-        c = jax.lax.dot_general(
-            pb.astype(jnp.bfloat16), ab.astype(jnp.bfloat16),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return carry + c, None
+        ab = _densify(alu, jnp.where(in_tile, a_local, 0),
+                      amk * in_tile, block, tile, in_dtype)
+        C = C + _count_matmul(pb, ab, acc_dtype)
+        rc = rc + pb.sum(0, dtype=acc_dtype)
+        cct = cct + ab.sum(0, dtype=acc_dtype)
+        return (C, rc, cct), None
 
-    init = jnp.zeros((n_items_p, tile), jnp.float32)
+    init = (
+        jnp.zeros((n_items_p, tile), acc_dtype),
+        jnp.zeros((n_items_p,), acc_dtype),
+        jnp.zeros((tile,), acc_dtype),
+    )
     if axis_name is not None:
         # under shard_map the carry varies per dp shard
-        init = jax.lax.pcast(init, (axis_name,), to="varying")
+        init = jax.tree.map(
+            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), init)
     out, _ = jax.lax.scan(body, init, (p_lu, p_it, p_mk, a_lu, a_it, a_mk))
     return out
 
@@ -204,7 +255,7 @@ def _cooccurrence_tile(
 )
 def _cco_tile_step(
     p_lu, p_it, p_mk, a_lu, a_it, a_mk,
-    row_counts, col_counts, n_total,
+    n_total,
     best_scores, best_idx,
     tile_start,
     block: int, n_items_p: int, tile: int, top_k: int,
@@ -214,12 +265,15 @@ def _cco_tile_step(
     exclude_self: bool = False,
 ):
     """Process one item tile: cooccurrence counts → LLR → merge into top-k."""
-    c = _cooccurrence_tile(
-        p_lu, p_it, p_mk, a_lu, a_it, a_mk, block, n_items_p, tile_start, tile, axis_name
+    c, rc, cct = _cooccurrence_tile(
+        p_lu, p_it, p_mk, a_lu, a_it, a_mk, block, n_items_p, tile_start, tile,
+        axis_name,
     )
     if axis_name is not None:
-        c = jax.lax.psum(c, axis_name)
-    col_tile = jax.lax.dynamic_slice_in_dim(col_counts, tile_start, tile)
+        c, rc, cct = jax.lax.psum((c, rc, cct), axis_name)
+    c = c.astype(jnp.float32)
+    row_counts = rc.astype(jnp.float32)
+    col_tile = cct.astype(jnp.float32)
 
     from predictionio_tpu.ops.pallas_kernels import llr_masked_scores
 
@@ -252,57 +306,77 @@ def _cco_tile_step(
 # dense user-chunked path (default when the count matrix fits HBM)
 # ---------------------------------------------------------------------------
 
-# Budgets are deliberately conservative for one v5e chip (16 GB HBM): the
-# densified chunk pair plus the f32 count matrix plus XLA transients.
-_DENSE_CHUNK_BYTES = 1 << 30   # per-chunk densified P+A budget (bf16)
-_DENSE_C_BYTES = 2 << 30       # full count-matrix budget (f32)
+# Budgets are sized for one v5e chip (16 GB HBM): the densified chunk pair
+# plus the count matrix plus XLA transients.
+_DENSE_CHUNK_BYTES = 1 << 30   # per-chunk densified P+A budget
+_DENSE_C_BYTES = 2 << 30       # full count-matrix budget (4-byte accum)
 
 
 def _flatten_blocked(b: BlockedInteractions) -> Tuple[np.ndarray, np.ndarray]:
-    """Blocked layout → global dedup'd COO (inverse of block_interactions)."""
+    """Blocked layout → global COO (inverse of block_interactions)."""
     gu = (np.arange(b.n_blocks, dtype=np.int64)[:, None] * b.user_block + b.local_u)
     keep = b.mask.ravel() > 0
     return gu.ravel()[keep].astype(np.int32), b.item.ravel()[keep].astype(np.int32)
 
 
-def _dense_chunk_users(n_items_p: int, it_pad: int, n_users: int) -> int:
-    per_user = (n_items_p + it_pad) * 2  # bf16 P row + A row
-    chunk = _DENSE_CHUNK_BYTES // max(per_user, 1)
-    chunk = max(256, (chunk // 256) * 256)
-    return min(chunk, max(256, ((n_users + 255) // 256) * 256))
+def _dense_chunk_users(n_items_p: int, it_pad: int, n_users: int, dp: int = 1) -> int:
+    """Chunk size minimizing padded-user waste: pick the number of chunks
+    the HBM budget forces (×dp for sharding), then split users evenly —
+    NOT budget-rounded chunks, which at e.g. 100k users and a 32k budget
+    would pad to 131k users (31% wasted MXU work)."""
+    bytes_per_cell = 2 if _matmul_dtype() == "bf16" else 1
+    per_user = (n_items_p + it_pad) * bytes_per_cell
+    max_chunk = max(_DENSE_CHUNK_BYTES // max(per_user, 1), 256)
+    n_chunks = max(math.ceil(n_users / max_chunk), 1)
+    n_chunks = math.ceil(n_chunks / dp) * dp
+    chunk = math.ceil(n_users / n_chunks / 256) * 256
+    return max(chunk, 256)
 
 
-@partial(jax.jit, static_argnames=("chunk", "n_items_p", "it_pad", "axis_name"))
+@partial(jax.jit, static_argnames=("chunk", "n_items_p", "it_pad", "axis_name",
+                                   "self_pair", "mm"))
 def _cco_counts_dense(
-    p_lu, p_it, p_mk, a_lu, a_it, a_mk,
+    p_lu, p_it, p_cnt, a_lu, a_it, a_cnt,
     chunk: int, n_items_p: int, it_pad: int,
     axis_name: Optional[str] = None,
+    self_pair: bool = False,
+    mm: str = "int8",
 ):
-    """Scan user chunks: densify to bf16 0/1, C += PᵀA (MXU, f32 accum),
-    row/col marginals as column sums — no host-side counting."""
+    """Scan user chunks: densify to 0/1 (int8 by default), C += PᵀA on the
+    MXU with 32-bit accumulation, marginals as column sums — no host-side
+    dedup or counting anywhere.  ``self_pair`` reuses the densified P as A
+    (primary×primary), halving scatter work.  ``p_cnt``/``a_cnt`` give the
+    valid-entry count per chunk; validity is an iota comparison on device,
+    so the f32 mask array never crosses the wire."""
+    in_dtype = jnp.int8 if mm == "int8" else jnp.bfloat16
+    acc_dtype = jnp.int32 if mm == "int8" else jnp.float32
+    e_p = p_lu.shape[1]
+    e_a = a_lu.shape[1]
 
     def body(carry, xs):
         C, rc, cc = carry
-        plu, pit, pmk, alu, ait, amk = xs
-        P = jnp.zeros((chunk, n_items_p), jnp.bfloat16).at[plu, pit].max(
-            pmk.astype(jnp.bfloat16))
-        A = jnp.zeros((chunk, it_pad), jnp.bfloat16).at[alu, ait].max(
-            amk.astype(jnp.bfloat16))
-        C = C + jax.lax.dot_general(
-            P, A, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        rc = rc + P.sum(0, dtype=jnp.float32)
-        cc = cc + A.sum(0, dtype=jnp.float32)
+        plu, pit, pcnt, alu, ait, acnt = xs
+        pvalid = jax.lax.iota(jnp.int32, e_p) < pcnt
+        Pm = _densify(plu, pit, pvalid, chunk, n_items_p, in_dtype)
+        if self_pair:
+            Am = Pm
+        else:
+            avalid = jax.lax.iota(jnp.int32, e_a) < acnt
+            Am = _densify(alu, ait, avalid, chunk, it_pad, in_dtype)
+        C = C + _count_matmul(Pm, Am, acc_dtype)
+        rc = rc + Pm.sum(0, dtype=acc_dtype)
+        cc = cc + Am.sum(0, dtype=acc_dtype)
         return (C, rc, cc), None
 
     init = (
-        jnp.zeros((n_items_p, it_pad), jnp.float32),
-        jnp.zeros((n_items_p,), jnp.float32),
-        jnp.zeros((it_pad,), jnp.float32),
+        jnp.zeros((n_items_p, it_pad), acc_dtype),
+        jnp.zeros((n_items_p,), acc_dtype),
+        jnp.zeros((it_pad,), acc_dtype),
     )
     if axis_name is not None:
         init = jax.tree.map(
             lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), init)
-    (C, rc, cc), _ = jax.lax.scan(body, init, (p_lu, p_it, p_mk, a_lu, a_it, a_mk))
+    (C, rc, cc), _ = jax.lax.scan(body, init, (p_lu, p_it, p_cnt, a_lu, a_it, a_cnt))
     if axis_name is not None:
         C, rc, cc = jax.lax.psum((C, rc, cc), axis_name)
     return C, rc, cc
@@ -313,6 +387,9 @@ def _llr_topk_dense(
     C, rc, cc, n_total, llr_threshold,
     top_k: int, exclude_self: bool, pallas: str,
 ):
+    C = C.astype(jnp.float32)
+    rc = rc.astype(jnp.float32)
+    cc = cc.astype(jnp.float32)
     if pallas != "off":
         from predictionio_tpu.ops.pallas_kernels import llr_masked_scores
 
@@ -334,75 +411,40 @@ def _llr_topk_dense(
     return best_scores, best_idx.astype(jnp.int32)
 
 
-def _cco_indicators_dense_coo(
-    pu: np.ndarray, pi: np.ndarray,
-    au: np.ndarray, ai: np.ndarray,
-    n_users: int, n_items_p: int, n_items_t: int,
-    n_total_users: int,
-    top_k: int,
-    llr_threshold: float,
-    mesh: Optional[Mesh],
-    exclude_self: bool,
-    p_deduped: bool = False,
-    a_deduped: bool = False,
-) -> Tuple[np.ndarray, np.ndarray]:
-    it_pad = max(((n_items_t + 127) // 128) * 128, 128)
-    chunk = _dense_chunk_users(n_items_p, it_pad, n_users)
-    p = block_interactions(pu, pi, n_users, n_items_p, user_block=chunk,
-                           dedup=not p_deduped)
-    a = block_interactions(au, ai, n_users, n_items_t, user_block=chunk,
-                           dedup=not a_deduped)
-    req_k = top_k
-    top_k = min(top_k, it_pad)
+@dataclasses.dataclass
+class _StagedCOO:
+    """Chunk-grouped pairs staged to device: int32 [n_chunks, E] ids plus a
+    per-chunk valid count — 8 bytes/event over the wire (vs 12 with an f32
+    mask array), and no dedup/unique pass behind it."""
 
-    if mesh is None:
-        C, rc, cc = _cco_counts_dense(
-            jnp.asarray(p.local_u), jnp.asarray(p.item), jnp.asarray(p.mask),
-            jnp.asarray(a.local_u), jnp.asarray(a.item), jnp.asarray(a.mask),
-            chunk=chunk, n_items_p=n_items_p, it_pad=it_pad,
-        )
-    else:
-        dp = mesh.shape["dp"]
-        nb = p.n_blocks
-        pad_blocks = (-nb) % dp
+    local_u: jax.Array    # [n_chunks, E]
+    item: jax.Array       # [n_chunks, E]
+    count: jax.Array      # [n_chunks]
 
-        def pad(arr):
-            if pad_blocks == 0:
-                return arr
-            return np.concatenate(
-                [arr, np.zeros((pad_blocks, *arr.shape[1:]), arr.dtype)])
 
-        spec, rep = P("dp"), P()
-        shard = NamedSharding(mesh, spec)
-        args = tuple(
-            jax.device_put(pad(np.asarray(arr)), shard)
-            for arr in (p.local_u, p.item, p.mask, a.local_u, a.item, a.mask)
-        )
-
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 6,
-                 out_specs=(rep, rep, rep))
-        def counts_sharded(plu, pit, pmk, alu, ait, amk):
-            return _cco_counts_dense(
-                plu, pit, pmk, alu, ait, amk,
-                chunk=chunk, n_items_p=n_items_p, it_pad=it_pad, axis_name="dp",
-            )
-
-        C, rc, cc = counts_sharded(*args)
-
-    from predictionio_tpu.ops.pallas_kernels import pallas_mode
-
-    best_scores, best_idx = _llr_topk_dense(
-        C, rc, cc, float(n_total_users), float(llr_threshold),
-        top_k=top_k, exclude_self=bool(exclude_self), pallas=pallas_mode(),
-    )
-    scores = np.asarray(best_scores)
-    idx = np.asarray(best_idx)
-    idx = np.where(scores > -np.inf, idx, -1)
-    if req_k > top_k:  # keep the promised [I_p, top_k] width
-        pad = req_k - top_k
-        scores = np.pad(scores, ((0, 0), (0, pad)), constant_values=-np.inf)
-        idx = np.pad(idx, ((0, 0), (0, pad)), constant_values=-1)
-    return scores, idx
+def _stage_chunked(
+    user: np.ndarray, item: np.ndarray,
+    chunk: int, n_chunks: int, sharding=None,
+) -> _StagedCOO:
+    user = np.asarray(user, np.int32)
+    item = np.asarray(item, np.int32)
+    blk = user // chunk
+    order = np.argsort(blk, kind="stable")   # radix sort: O(E)
+    user, item, blk = user[order], item[order], blk[order]
+    counts = np.bincount(blk, minlength=n_chunks).astype(np.int32)
+    width = max(int(counts.max()) if len(user) else 1, 1)
+    width = ((width + 7) // 8) * 8
+    lu = np.zeros((n_chunks, width), np.int32)
+    it = np.zeros((n_chunks, width), np.int32)
+    start = 0
+    for b in range(n_chunks):
+        c = int(counts[b])
+        lu[b, :c] = user[start:start + c] % chunk
+        it[b, :c] = item[start:start + c]
+        start += c
+    put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
+        else jnp.asarray
+    return _StagedCOO(put(lu), put(it), put(counts))
 
 
 def _dense_path_ok(n_items_p: int, n_items_t: int) -> bool:
@@ -413,6 +455,165 @@ def _dense_path_ok(n_items_p: int, n_items_t: int) -> bool:
         return True
     it_pad = max(((n_items_t + 127) // 128) * 128, 128)
     return n_items_p * it_pad * 4 <= _DENSE_C_BYTES
+
+
+class _DenseRunner:
+    """Stages a primary event type once and runs per-event-type dense CCO
+    against it, dispatching asynchronously (device results; download via
+    ``collect``).  One instance per training run."""
+
+    def __init__(self, p_user, p_item, n_users: int, n_items_p: int,
+                 it_pad_max: int, mesh: Optional[Mesh],
+                 n_total_users: Optional[int] = None):
+        dp = mesh.shape["dp"] if mesh is not None else 1
+        self.mesh = mesh
+        self.n_users = n_users
+        # LLR population total: may exceed n_users when these interactions
+        # are one shard of a larger user space
+        self.n_total_users = n_total_users if n_total_users else n_users
+        self.n_items_p = n_items_p
+        self.chunk = _dense_chunk_users(n_items_p, it_pad_max, n_users, dp)
+        self.n_chunks = math.ceil(max(n_users, 1) / self.chunk)
+        self.n_chunks = math.ceil(self.n_chunks / dp) * dp
+        self.sharding = (
+            NamedSharding(mesh, P("dp")) if mesh is not None else None)
+        self.p = _stage_chunked(p_user, p_item,
+                                self.chunk, self.n_chunks, self.sharding)
+
+    def _counts(self, a: _StagedCOO, it_pad: int, self_pair: bool):
+        mm = _matmul_dtype()
+        if self.mesh is None:
+            return _cco_counts_dense(
+                self.p.local_u, self.p.item, self.p.count,
+                a.local_u, a.item, a.count,
+                chunk=self.chunk, n_items_p=self.n_items_p, it_pad=it_pad,
+                self_pair=self_pair, mm=mm,
+            )
+        spec, rep = P("dp"), P()
+
+        @partial(jax.shard_map, mesh=self.mesh, in_specs=(spec,) * 6,
+                 out_specs=(rep, rep, rep))
+        def counts_sharded(plu, pit, pcnt, alu, ait, acnt):
+            return _cco_counts_dense(
+                plu, pit, pcnt, alu, ait, acnt,
+                chunk=self.chunk, n_items_p=self.n_items_p, it_pad=it_pad,
+                axis_name="dp", self_pair=self_pair, mm=mm,
+            )
+
+        return counts_sharded(self.p.local_u, self.p.item, self.p.count,
+                              a.local_u, a.item, a.count)
+
+    def dispatch(self, a_user, a_item, n_items_t: int, top_k: int,
+                 llr_threshold: float, exclude_self: bool,
+                 self_pair: bool = False):
+        """Queue one event type's CCO; returns device (scores, idx)."""
+        from predictionio_tpu.ops.pallas_kernels import pallas_mode
+
+        if self_pair:
+            it_pad = self.n_items_p
+            a = self.p
+        else:
+            it_pad = max(((n_items_t + 127) // 128) * 128, 128)
+            a = _stage_chunked(a_user, a_item,
+                               self.chunk, self.n_chunks, self.sharding)
+        C, rc, cc = self._counts(a, it_pad, self_pair)
+        k = min(top_k, it_pad)
+        s, i = _llr_topk_dense(
+            C, rc, cc, float(self.n_total_users), float(llr_threshold),
+            top_k=k, exclude_self=bool(exclude_self), pallas=pallas_mode(),
+        )
+        return s, i, n_items_t, top_k
+
+    @staticmethod
+    def collect(dispatched) -> Tuple[np.ndarray, np.ndarray]:
+        s_dev, i_dev, n_items_t, req_k = dispatched
+        scores = np.asarray(s_dev)
+        idx = np.asarray(i_dev)
+        # drop indicator columns that are padding (item id >= n_items_t or
+        # -inf score) and restore the promised [I_p, req_k] width
+        idx = np.where((scores > -np.inf) & (idx < n_items_t), idx, -1)
+        scores = np.where(idx >= 0, scores, -np.inf)
+        k = scores.shape[1]
+        if req_k > k:
+            pad = req_k - k
+            scores = np.pad(scores, ((0, 0), (0, pad)), constant_values=-np.inf)
+            idx = np.pad(idx, ((0, 0), (0, pad)), constant_values=-1)
+        return scores, idx
+
+
+def cco_train_indicators(
+    p_user: np.ndarray, p_item: np.ndarray,
+    others: Sequence[Tuple[str, np.ndarray, np.ndarray, int]],
+    n_users: int, n_items_p: int,
+    top_k: int = 50,
+    llr_threshold: float = 0.0,
+    mesh: Optional[Mesh] = None,
+    exclude_self_for: Optional[str] = None,
+    user_block: int = 1024,
+    item_tile: int = 4096,
+) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """The UR train loop's entry: indicators for every event type against
+    ONE staged primary.
+
+    ``others`` is an ordered list of ``(name, a_user, a_item, n_items_t)``;
+    pass the primary's own name/arrays for the self-indicator (detected by
+    array identity, which skips the second densify).  The primary is laid
+    out and uploaded once; each event type's device work is dispatched
+    asynchronously so host layout of type t+1 overlaps device compute of
+    type t.  Event types whose count matrix exceeds the HBM budget fall
+    back to the tiled path transparently.
+    """
+    dense_names = [nm for nm, _, _, nt in others if _dense_path_ok(n_items_p, nt)]
+    runner: Optional[_DenseRunner] = None
+    if dense_names:
+        it_pad_max = max(
+            max(((nt + 127) // 128) * 128, 128)
+            for nm, _, _, nt in others if nm in dense_names
+        )
+        it_pad_max = max(it_pad_max, n_items_p)
+        runner = _DenseRunner(p_user, p_item, n_users, n_items_p, it_pad_max, mesh)
+
+    pending: List[Tuple[str, object]] = []
+    results: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for name, au, ai, n_items_t in others:
+        excl = (name == exclude_self_for)
+        if runner is not None and name in dense_names:
+            self_pair = au is p_user and ai is p_item
+            pending.append((name, runner.dispatch(
+                au, ai, n_items_t, top_k, llr_threshold, excl,
+                self_pair=self_pair)))
+        else:
+            results[name] = cco_indicators_coo(
+                p_user, p_item, au, ai, n_users, n_items_p, n_items_t,
+                top_k=top_k, llr_threshold=llr_threshold,
+                user_block=user_block, item_tile=item_tile,
+                mesh=mesh, exclude_self=excl,
+            )
+    for name, d in pending:
+        results[name] = _DenseRunner.collect(d)
+    return results
+
+
+def _cco_indicators_dense_coo(
+    pu: np.ndarray, pi: np.ndarray,
+    au: np.ndarray, ai: np.ndarray,
+    n_users: int, n_items_p: int, n_items_t: int,
+    top_k: int,
+    llr_threshold: float,
+    mesh: Optional[Mesh],
+    exclude_self: bool,
+    n_total_users: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    it_pad = max(((n_items_t + 127) // 128) * 128, 128)
+    runner = _DenseRunner(pu, pi, n_users, n_items_p,
+                          max(it_pad, n_items_p), mesh,
+                          n_total_users=n_total_users)
+    # strict identity only: anything weaker (shape/overlap heuristics) could
+    # silently alias two distinct event types
+    self_pair = au is pu and ai is pi
+    d = runner.dispatch(au, ai, n_items_t, top_k, llr_threshold, exclude_self,
+                        self_pair=self_pair)
+    return _DenseRunner.collect(d)
 
 
 def cco_indicators_coo(
@@ -428,24 +629,22 @@ def cco_indicators_coo(
     primary_deduped: bool = False,
     other_deduped: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """``cco_indicators`` from raw (user, item) COO pairs — the preferred
-    entry: it lays the data out once, at the chunk size the selected device
-    strategy wants, instead of blocking at ``user_block`` and re-blocking.
-
-    ``primary_deduped``/``other_deduped`` skip the O(E log E) unique pass
-    for callers that already hold unique pairs (e.g. the UR train loop,
-    which dedups its primary event once and reuses it per event type).
+    """``cco_indicators`` from raw (user, item) COO pairs — single event
+    type.  Training should prefer ``cco_train_indicators`` (stages the
+    primary once across event types).  ``primary_deduped``/``other_deduped``
+    are accepted for compatibility and ignored: neither device path needs
+    pre-dedup'd pairs anymore.
     """
+    del primary_deduped, other_deduped  # device scatter-max dedups
     if _dense_path_ok(n_items_p, n_items_t):
         return _cco_indicators_dense_coo(
             p_user, p_item, a_user, a_item, n_users, n_items_p, n_items_t,
-            n_users, top_k, llr_threshold, mesh, exclude_self,
-            p_deduped=primary_deduped, a_deduped=other_deduped,
+            top_k, llr_threshold, mesh, exclude_self,
         )
     p = block_interactions(p_user, p_item, n_users, n_items_p,
-                           user_block=user_block, dedup=not primary_deduped)
+                           user_block=user_block)
     a = block_interactions(a_user, a_item, n_users, n_items_t,
-                           user_block=user_block, dedup=not other_deduped)
+                           user_block=user_block)
     return cco_indicators(
         p, a, None, None, n_users, top_k=top_k, llr_threshold=llr_threshold,
         item_tile=item_tile, mesh=mesh, exclude_self=exclude_self,
@@ -472,18 +671,19 @@ def cco_indicators(
     and other are the same event type.
 
     Two device strategies, selected by memory (override: PIO_CCO_DENSE):
-    - **dense** (default when the full I_p×I_t f32 count matrix fits): scan
-      user chunks sized to HBM, densify each chunk to bf16 0/1 and run one
-      MXU matmul per chunk, marginals as column sums; then one fused
-      LLR+top-k over the full count matrix.  ~5× the tiled path on one chip.
-    - **tiled** (huge item catalogs): the original item-tile loop that never
-      materializes the full count matrix, re-densifying per tile and merging
-      a running top-k.
+    - **dense** (default when the full I_p×I_t 32-bit count matrix fits):
+      scan user chunks sized to HBM, densify each chunk to int8 0/1 and run
+      one MXU matmul per chunk, marginals as column sums; then one fused
+      LLR+top-k over the full count matrix.
+    - **tiled** (huge item catalogs): an item-tile loop that never
+      materializes the full count matrix, re-densifying per tile and
+      merging a running top-k; marginals accumulate in the same scan.
 
     ``primary_item_counts``/``other_item_counts`` are DEPRECATED and ignored:
-    both strategies derive the LLR marginals from the blocked interactions
-    themselves, so the two paths are semantically identical by construction
-    (caller-supplied counts could silently disagree with the data).
+    both strategies derive the LLR marginals from the interactions
+    themselves ON DEVICE (densified matrices are dedup'd by construction),
+    so the two paths are semantically identical and no host unique/count
+    pass exists for callers to get wrong.
     """
     if n_total_users <= 0:
         raise ValueError(f"n_total_users must be positive, got {n_total_users}")
@@ -491,25 +691,17 @@ def cco_indicators(
         if primary.n_users != other.n_users:
             raise ValueError("primary/other must share the user space")
         pu, pi = _flatten_blocked(primary)
-        au, ai = _flatten_blocked(other)
+        au, ai = (pu, pi) if other is primary else _flatten_blocked(other)
         return _cco_indicators_dense_coo(
             pu, pi, au, ai, primary.n_users, primary.n_items, other.n_items,
-            n_total_users, top_k, llr_threshold, mesh, exclude_self,
-            p_deduped=True, a_deduped=True,  # blocked layouts are unique
+            top_k, llr_threshold, mesh, exclude_self,
+            n_total_users=n_total_users,
         )
     if primary.n_blocks != other.n_blocks or primary.user_block != other.user_block:
         raise ValueError("primary/other must be blocked with the same user layout")
     n_items_p, n_items_t = primary.n_items, other.n_items
     tile = min(item_tile, max(n_items_t, 1))
     n_tiles = math.ceil(n_items_t / tile)
-    padded_items_t = n_tiles * tile
-    # marginals from the data itself (blocked layouts hold unique pairs)
-    rc = interaction_counts(primary.item[primary.mask > 0], n_items_p)
-    cc = interaction_counts(other.item[other.mask > 0], n_items_t)
-    col_counts = np.zeros(padded_items_t, np.float32)
-    col_counts[:n_items_t] = cc
-    row_counts = jnp.asarray(rc, jnp.float32)
-    col_counts = jnp.asarray(col_counts)
 
     best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)
     best_idx = jnp.zeros((n_items_p, top_k), jnp.int32)
@@ -525,7 +717,7 @@ def cco_indicators(
         )
         for t in range(n_tiles):
             best_scores, best_idx = _cco_tile_step(
-                *args, row_counts, col_counts, float(n_total_users),
+                *args, float(n_total_users),
                 best_scores, best_idx, t * tile,
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
@@ -554,12 +746,12 @@ def cco_indicators(
 
         @partial(
             jax.shard_map, mesh=mesh,
-            in_specs=(spec,) * 6 + (rep,) * 4 + (rep,),
+            in_specs=(spec,) * 6 + (rep,) * 3,
             out_specs=(rep, rep),
         )
-        def tile_step_sharded(plu, pit, pmk, alu, ait, amk, rc, cc, bs, bi, ts):
+        def tile_step_sharded(plu, pit, pmk, alu, ait, amk, bs, bi, ts):
             return _cco_tile_step(
-                plu, pit, pmk, alu, ait, amk, rc, cc, float(n_total_users),
+                plu, pit, pmk, alu, ait, amk, float(n_total_users),
                 bs, bi, ts,
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
@@ -568,11 +760,12 @@ def cco_indicators(
 
         for t in range(n_tiles):
             best_scores, best_idx = tile_step_sharded(
-                *args, row_counts, col_counts, best_scores, best_idx,
-                jnp.int32(t * tile),
+                *args, best_scores, best_idx, jnp.int32(t * tile),
             )
 
     scores = np.asarray(best_scores)
     idx = np.asarray(best_idx)
-    idx = np.where(scores > -np.inf, idx, -1)
+    # entries pointing past the real catalog (tile padding) are not items
+    idx = np.where((scores > -np.inf) & (idx < n_items_t), idx, -1)
+    scores = np.where(idx >= 0, scores, -np.inf)
     return scores, idx

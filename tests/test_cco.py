@@ -67,8 +67,8 @@ def test_cco_matches_naive(user_block, item_tile):
     # dedup for the naive side
     C, llr = naive_cco(pu, pi, ou, oi, n_users, n_ip, n_it)
 
-    p = block_interactions(pu, pi, n_users, n_ip, user_block=user_block)
-    o = block_interactions(ou, oi, n_users, n_it, user_block=user_block)
+    p = block_interactions(pu, pi, n_users, n_ip, user_block=user_block, dedup=True)
+    o = block_interactions(ou, oi, n_users, n_it, user_block=user_block, dedup=True)
     # distinct-user counts from dedup'd blocked data
     rc = np.zeros(n_ip, np.float32)
     np.add.at(rc, p.item[p.mask > 0], 1)
@@ -112,7 +112,7 @@ def test_cco_top_k_and_threshold():
 def test_cco_exclude_self():
     n_users, n_items = 30, 8
     u, i = random_interactions(n_users, n_items, 150, 5)
-    b = block_interactions(u, i, n_users, n_items)
+    b = block_interactions(u, i, n_users, n_items, dedup=True)
     counts = _dense(u, i, n_users, n_items).sum(0).astype(np.float32)
     scores, idx = cco_indicators(b, b, counts, counts, n_users, top_k=4, exclude_self=True)
     for row in range(n_items):
@@ -139,8 +139,8 @@ def test_dense_matches_tiled(monkeypatch):
     n_users, n_ip, n_it = 60, 12, 17
     pu, pi = random_interactions(n_users, n_ip, 300, 11)
     ou, oi = random_interactions(n_users, n_it, 500, 12)
-    p = block_interactions(pu, pi, n_users, n_ip, user_block=16)
-    o = block_interactions(ou, oi, n_users, n_it, user_block=16)
+    p = block_interactions(pu, pi, n_users, n_ip, user_block=16, dedup=True)
+    o = block_interactions(ou, oi, n_users, n_it, user_block=16, dedup=True)
     rc = interaction_counts(p.item[p.mask > 0], n_ip)
     cc = interaction_counts(o.item[o.mask > 0], n_it)
 
@@ -176,7 +176,7 @@ def test_dense_exclude_self_and_topk_overflow(monkeypatch):
     monkeypatch.setenv("PIO_CCO_DENSE", "1")
     n_users, n_items = 40, 6
     u, i = random_interactions(n_users, n_items, 200, 31)
-    b = block_interactions(u, i, n_users, n_items)
+    b = block_interactions(u, i, n_users, n_items, dedup=True)
     counts = interaction_counts(b.item[b.mask > 0], n_items)
     # top_k wider than the (padded) item space still returns [I, top_k]
     scores, idx = cco_indicators(b, b, counts, counts, n_users,
@@ -191,7 +191,7 @@ def test_dense_matches_tiled_exclude_self(monkeypatch):
     per row and identical scores either way."""
     n_users, n_items = 60, 14
     u, i = random_interactions(n_users, n_items, 400, 41)
-    b = block_interactions(u, i, n_users, n_items, user_block=16)
+    b = block_interactions(u, i, n_users, n_items, user_block=16, dedup=True)
     counts = interaction_counts(b.item[b.mask > 0], n_items)
 
     monkeypatch.setenv("PIO_CCO_DENSE", "1")
@@ -205,3 +205,89 @@ def test_dense_matches_tiled_exclude_self(monkeypatch):
         assert r not in set(idd[r][idd[r] >= 0])
         assert r not in set(idt[r][idt[r] >= 0])
         assert set(idd[r][sd[r] > -np.inf]) == set(idt[r][st[r] > -np.inf])
+
+
+def test_duplicates_collapse_without_host_dedup(monkeypatch):
+    """Raw pairs with heavy duplication give the same indicators as
+    pre-dedup'd pairs on BOTH device strategies — the scatter-max densify
+    is the dedup, and marginals derive from it on device."""
+    from predictionio_tpu.ops.cco import cco_indicators_coo, dedup_pairs
+
+    n_users, n_ip, n_it = 40, 9, 11
+    pu, pi = random_interactions(n_users, n_ip, 500, 51)  # ~500 raw, many dups
+    ou, oi = random_interactions(n_users, n_it, 700, 52)
+    pu_d, pi_d = dedup_pairs(pu, pi, n_ip)
+    ou_d, oi_d = dedup_pairs(ou, oi, n_it)
+    for dense in ("1", "0"):
+        monkeypatch.setenv("PIO_CCO_DENSE", dense)
+        s_raw, i_raw = cco_indicators_coo(
+            pu, pi, ou, oi, n_users, n_ip, n_it, top_k=4, item_tile=8)
+        s_ded, i_ded = cco_indicators_coo(
+            pu_d, pi_d, ou_d, oi_d, n_users, n_ip, n_it, top_k=4, item_tile=8)
+        np.testing.assert_allclose(s_raw, s_ded, rtol=1e-5)
+        for r in range(n_ip):
+            assert set(i_raw[r][s_raw[r] > -np.inf]) == set(i_ded[r][s_ded[r] > -np.inf])
+
+
+def test_cco_train_indicators_matches_per_call(monkeypatch):
+    """The staged multi-event-type entry returns exactly what independent
+    cco_indicators_coo calls return (self + cross)."""
+    from predictionio_tpu.ops.cco import cco_indicators_coo, cco_train_indicators
+
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    n_users, n_ip, n_view = 50, 12, 18
+    pu, pi = random_interactions(n_users, n_ip, 300, 61)
+    vu, vi = random_interactions(n_users, n_view, 600, 62)
+    out = cco_train_indicators(
+        pu, pi,
+        [("buy", pu, pi, n_ip), ("view", vu, vi, n_view)],
+        n_users, n_ip, top_k=5, exclude_self_for="buy")
+    s_self, i_self = cco_indicators_coo(
+        pu, pi, pu, pi, n_users, n_ip, n_ip, top_k=5, exclude_self=True)
+    s_cross, i_cross = cco_indicators_coo(
+        pu, pi, vu, vi, n_users, n_ip, n_view, top_k=5)
+    np.testing.assert_allclose(out["buy"][0], s_self, rtol=1e-5)
+    np.testing.assert_allclose(out["view"][0], s_cross, rtol=1e-5)
+    for r in range(n_ip):
+        assert r not in set(out["buy"][1][r][out["buy"][1][r] >= 0])
+        assert set(out["view"][1][r][out["view"][0][r] > -np.inf]) == set(
+            i_cross[r][s_cross[r] > -np.inf])
+
+
+def test_cco_train_indicators_tiled_fallback(monkeypatch):
+    """Event types too big for the dense budget route through the tiled
+    path inside the same call, with identical semantics."""
+    from predictionio_tpu.ops.cco import cco_train_indicators
+
+    n_users, n_ip, n_view = 30, 8, 10
+    pu, pi = random_interactions(n_users, n_ip, 200, 71)
+    vu, vi = random_interactions(n_users, n_view, 300, 72)
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    dense = cco_train_indicators(
+        pu, pi, [("buy", pu, pi, n_ip), ("view", vu, vi, n_view)],
+        n_users, n_ip, top_k=4, exclude_self_for="buy")
+    monkeypatch.setenv("PIO_CCO_DENSE", "0")
+    tiled = cco_train_indicators(
+        pu, pi, [("buy", pu, pi, n_ip), ("view", vu, vi, n_view)],
+        n_users, n_ip, top_k=4, exclude_self_for="buy", item_tile=8, user_block=8)
+    for name in ("buy", "view"):
+        np.testing.assert_allclose(dense[name][0], tiled[name][0], rtol=1e-4)
+
+
+def test_cco_train_indicators_mesh(monkeypatch):
+    from predictionio_tpu.ops.cco import cco_train_indicators
+
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    n_users, n_ip, n_view = 64, 10, 12
+    pu, pi = random_interactions(n_users, n_ip, 250, 81)
+    vu, vi = random_interactions(n_users, n_view, 400, 82)
+    single = cco_train_indicators(
+        pu, pi, [("buy", pu, pi, n_ip), ("view", vu, vi, n_view)],
+        n_users, n_ip, top_k=5, exclude_self_for="buy")
+    mesh = create_mesh(MeshSpec(dp=8, mp=1))
+    sharded = cco_train_indicators(
+        pu, pi, [("buy", pu, pi, n_ip), ("view", vu, vi, n_view)],
+        n_users, n_ip, top_k=5, exclude_self_for="buy", mesh=mesh)
+    for name in ("buy", "view"):
+        np.testing.assert_allclose(single[name][0], sharded[name][0],
+                                   rtol=1e-5, atol=1e-5)
