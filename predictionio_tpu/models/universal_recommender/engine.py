@@ -172,13 +172,15 @@ class URDataSourceParams(Params):
 class URTrainingData:
     """Per-event-type COO with a shared user dictionary.
 
-    interactions[event_name] = (user_idx, item_idx, item_dict); the primary
-    event is event_names[0] and defines the recommendable item space.
+    interactions[event_name] = (user_idx, item_idx, item_dict, times); the
+    primary event is event_names[0] and defines the recommendable item
+    space; ``times`` is epoch seconds per event (feeds the PopModel
+    backfill windows).
     """
 
     event_names: List[str]
     user_dict: IdDict
-    interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict]]
+    interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict, np.ndarray]]
     item_properties: Dict[str, Dict[str, Any]]  # item id -> property map
 
 
@@ -187,20 +189,23 @@ class URDataSource(DataSource):
 
     def read_training(self) -> URTrainingData:
         user_dict = IdDict()
-        interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict]] = {}
+        interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict, np.ndarray]] = {}
         for name in self.params.event_names:
             item_dict = IdDict()
             users: List[int] = []
             items: List[int] = []
+            times: List[float] = []
             for e in PEventStore.find(self.params.app_name, event_names=[name]):
                 if e.target_entity_id is None:
                     continue
                 users.append(user_dict.add(e.entity_id))
                 items.append(item_dict.add(e.target_entity_id))
+                times.append(e.event_time.timestamp())
             interactions[name] = (
                 np.asarray(users, np.int32),
                 np.asarray(items, np.int32),
                 item_dict,
+                np.asarray(times, np.float64),
             )
         props = PEventStore.aggregate_properties(
             self.params.app_name, self.params.item_entity_type
@@ -244,6 +249,7 @@ class URModel(PersistentModel):
         popularity: np.ndarray,
         item_properties: Dict[str, Dict[str, Any]],
         user_seen: CSRLookup,
+        user_seen_by_event: Optional[Dict[str, CSRLookup]] = None,
     ):
         self.primary_event = primary_event
         self.item_dict = item_dict
@@ -254,6 +260,10 @@ class URModel(PersistentModel):
         self.popularity = popularity
         self.item_properties = item_properties
         self.user_seen = user_seen
+        # non-primary blacklist_events: user → seen items mapped into the
+        # PRIMARY item space (reference UR blacklists from every configured
+        # event type, not just the conversion event)
+        self.user_seen_by_event = user_seen_by_event or {}
 
     def __getstate__(self):
         return {
@@ -266,6 +276,8 @@ class URModel(PersistentModel):
             "popularity": self.popularity,
             "item_properties": self.item_properties,
             "user_seen": self.user_seen.to_state(),
+            "user_seen_by_event": {
+                k: c.to_state() for k, c in self.user_seen_by_event.items()},
         }
 
     def __setstate__(self, s):
@@ -278,6 +290,9 @@ class URModel(PersistentModel):
         self.popularity = s["popularity"]
         self.item_properties = s["item_properties"]
         self.user_seen = CSRLookup.from_state(s["user_seen"])
+        self.user_seen_by_event = {
+            k: CSRLookup.from_state(v)
+            for k, v in s.get("user_seen_by_event", {}).items()}
 
     def device_indicators(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
         """Indicator tables staged to device ONCE per load/reload and cached
@@ -298,6 +313,17 @@ class URModel(PersistentModel):
 
     def warm(self) -> None:
         self.device_indicators()
+        self.pop_order()
+
+    def pop_order(self) -> np.ndarray:
+        """Item ids in descending backfill-score order, computed once per
+        model load — padding scans this instead of argsorting [n_items]
+        per query (lazily cached; never serialized)."""
+        order = self.__dict__.get("_pop_order")
+        if order is None:
+            order = np.argsort(-self.popularity, kind="stable").astype(np.int32)
+            self.__dict__["_pop_order"] = order
+        return order
 
     # -- serving-time property indexes (built lazily, never serialized) ----
 
@@ -371,7 +397,10 @@ class URAlgorithmParams(Params):
     mesh_dp: int = 0
     use_llr_weights: bool = False
     blacklist_events: List[str] = dataclasses.field(default_factory=list)  # default: primary
-    backfill_type: str = "popular"  # popular | trending(unsupported yet) | none
+    backfill_type: str = "popular"  # popular | trending | hot | none
+    # PopModel window (reference UR backfillField.duration); halves/thirds
+    # of this window feed trending/hot velocity and acceleration
+    backfill_duration: str = "3650 days"
     indicator_weights: Dict[str, float] = dataclasses.field(default_factory=dict)
     # item date properties checked against the query's currentDate
     # (reference UR: availableDateName / expireDateName engine params)
@@ -384,11 +413,16 @@ class URAlgorithm(Algorithm):
 
     def train(self, td: URTrainingData) -> URModel:
         primary = td.event_names[0]
-        p_user, p_item, p_item_dict = td.interactions[primary]
+        p_user, p_item, p_item_dict, p_times = td.interactions[primary]
         n_users = len(td.user_dict)
         n_items = len(p_item_dict)
         if n_items == 0:
             raise ValueError(f"no {primary!r} events to train on")
+        blacklist_events = self.params.blacklist_events or [primary]
+        unknown = [b for b in blacklist_events if b not in td.event_names]
+        if unknown:
+            raise ValueError(
+                f"blacklist_events {unknown} not in event_names {td.event_names}")
         dp = self.params.mesh_dp or len(jax.devices())
         mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
         # one staged-primary pass over all event types: the primary uploads
@@ -398,7 +432,7 @@ class URAlgorithm(Algorithm):
         others = []
         event_item_dicts: Dict[str, IdDict] = {}
         for name in td.event_names:
-            u, i, item_dict = td.interactions[name]
+            u, i, item_dict, _ = td.interactions[name]
             if name != primary and len(item_dict) == 0:
                 continue
             if name == primary:
@@ -419,11 +453,29 @@ class URAlgorithm(Algorithm):
         for name, (scores, idx) in results.items():
             indicator_idx[name] = idx.astype(np.int32)
             indicator_llr[name] = np.where(np.isfinite(scores), scores, 0.0).astype(np.float32)
-        # CSR dedups (user, item) internally; popularity = distinct users
-        # per item, straight off the CSR values — no separate unique pass
+        # CSR dedups (user, item) internally
         user_seen = CSRLookup.from_pairs(p_user, p_item, n_users)
-        popularity = np.bincount(
-            user_seen.values, minlength=n_items).astype(np.float32)
+        # PopModel backfill scores over the configured event-time window
+        # (raw events, not distinct pairs: popularity ranks by volume)
+        from predictionio_tpu.models.universal_recommender.popmodel import (
+            backfill_scores, parse_duration)
+
+        popularity = backfill_scores(
+            self.params.backfill_type, p_item, p_times, n_items,
+            parse_duration(self.params.backfill_duration),
+        )
+        # per-event seen CSRs for non-primary blacklist_events, with items
+        # translated into the primary item space
+        user_seen_by_event: Dict[str, CSRLookup] = {}
+        for name in blacklist_events:
+            if name == primary or name not in event_item_dicts:
+                continue
+            u, i, item_dict, _ = td.interactions[name]
+            translate = p_item_dict.lookup_many(item_dict.strings())
+            mapped = translate[i]
+            keep = mapped >= 0
+            user_seen_by_event[name] = CSRLookup.from_pairs(
+                u[keep], mapped[keep], n_users)
         return URModel(
             primary_event=primary,
             item_dict=p_item_dict,
@@ -434,6 +486,7 @@ class URAlgorithm(Algorithm):
             popularity=popularity,
             item_properties=td.item_properties,
             user_seen=user_seen,
+            user_seen_by_event=user_seen_by_event,
         )
 
     # -- serving -------------------------------------------------------------
@@ -510,37 +563,60 @@ class URAlgorithm(Algorithm):
             if s is not None:
                 scores += s
                 have_signal = True
-        if not have_signal and self.params.backfill_type == "popular":
-            pop = model.popularity
-            scores = pop / max(float(pop.max()), 1.0)
         # business rules
         mask = self._field_mask(model, query.fields)
         mask = mask * self._date_mask(model, query)
         scores = scores * mask
-        # blacklist: query items + the user's own primary-event items + self
+        # blacklist: query items + the user's seen items under every
+        # configured blacklist event type (reference UR blacklists from all
+        # of blackListEvents, not only the primary) + self for item queries
+        excluded = np.zeros(n_items, bool)
         black = set(query.blacklist_items)
         if query.user is not None:
             uid = model.user_dict.id(query.user)
             if uid is not None:
                 blacklist_events = self.params.blacklist_events or [model.primary_event]
-                if model.primary_event in blacklist_events:
-                    scores[model.user_seen.row(uid)] = -np.inf
+                for name in blacklist_events:
+                    if name == model.primary_event:
+                        excluded[model.user_seen.row(uid)] = True
+                    else:
+                        csr = model.user_seen_by_event.get(name)
+                        if csr is not None:
+                            excluded[csr.row(uid)] = True
         if query.item is not None and not query.return_self:
             black.add(query.item)
         for b in black:
             bid = model.item_dict.id(b)
             if bid is not None:
-                scores[bid] = -np.inf
+                excluded[bid] = True
+        scores[excluded] = -np.inf
         num = min(query.num, n_items)
-        top = np.argpartition(-np.nan_to_num(scores, neginf=-1e30), min(num, n_items - 1))[:num]
-        top = top[np.argsort(-scores[top], kind="stable")]
-        return URResult(
-            [
-                ItemScore(model.item_dict.str(int(j)), float(scores[j]))
-                for j in top
-                if np.isfinite(scores[j]) and scores[j] > 0
-            ]
-        )
+        results: List[ItemScore] = []
+        chosen = np.zeros(n_items, bool)
+        if have_signal:
+            top = np.argpartition(
+                -np.nan_to_num(scores, neginf=-1e30), min(num, n_items - 1))[:num]
+            top = top[np.argsort(-scores[top], kind="stable")]
+            for j in top:
+                if np.isfinite(scores[j]) and scores[j] > 0:
+                    results.append(ItemScore(model.item_dict.str(int(j)), float(scores[j])))
+                    chosen[j] = True
+        # backfill: fills the whole list when there is no signal, and PADS
+        # short lists up to num (reference UR appends popRank-ordered items)
+        if len(results) < num and self.params.backfill_type != "none":
+            bf = model.popularity
+            norm = max(float(np.abs(bf).max()), 1.0) if n_items else 1.0
+            eligible = (mask > 0) & ~excluded & ~chosen
+            needed = num - len(results)
+            # model-static rank order, O(num + skipped) per query
+            for j in model.pop_order():
+                if eligible[j]:
+                    results.append(
+                        ItemScore(model.item_dict.str(int(j)), float(bf[j]) / norm))
+                    needed -= 1
+                    if needed == 0:
+                        break
+        return URResult(results)
 
     def _date_mask(self, model: URModel, query: URQuery) -> np.ndarray:
         """Hard date filters: the query's dateRange on an item date property,

@@ -345,3 +345,156 @@ def test_item_similarity_uses_all_indicators(trained):
     top_full = max(s.score for s in full.item_scores)
     base = float(s_primary_only.max()) if s_primary_only is not None else 0.0
     assert top_full > base, "multi-indicator score must exceed primary-only"
+
+
+# -- PopModel backfill family (trending / hot / padding) ---------------------
+
+
+def _pop_app(mem_storage, app_name="popapp"):
+    """Time-shaped purchase log: 'old' is popular long ago, 'rising' ramps
+    up inside the recent window, 'steady' is flat."""
+    import datetime as dt
+
+    app_id = mem_storage.apps.insert(App(0, app_name))
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    day = dt.timedelta(days=1)
+    events = []
+
+    def buy(u, item, when):
+        events.append(Event(event="purchase", entity_type="user", entity_id=u,
+                            target_entity_type="item", target_entity_id=item,
+                            event_time=when))
+
+    # 30-day log. "old": 20 buys in days 0-9, none after.
+    for k in range(20):
+        buy(f"o{k}", "old", t0 + day * (k % 10))
+    # "rising": 12 buys, all in days 24-29 (accelerating).
+    for k in range(12):
+        buy(f"r{k}", "rising", t0 + day * (24 + (k % 6)))
+    # "steady": one buy per day, days 0-29.
+    for k in range(30):
+        buy(f"s{k}", "steady", t0 + day * k)
+    # one shared user giving CCO something to chew on (not under test here)
+    for it in ("old", "rising", "steady"):
+        buy("shared", it, t0 + day * 15)
+    mem_storage.l_events.insert_batch(events, app_id)
+    return app_name
+
+
+def _pop_ep(app_name, **algo_over):
+    algo = dict(app_name=app_name, mesh_dp=1)
+    algo.update(algo_over)
+    return EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name=app_name, event_names=["purchase"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(**algo))],
+    )
+
+
+def _backfill_order(mem_storage, backfill_type, duration):
+    app = _pop_app(mem_storage)
+    engine = UniversalRecommenderEngine.apply()
+    ep = _pop_ep(app, backfill_type=backfill_type, backfill_duration=duration)
+    models = engine.train(ep)
+    res = engine.predictor(ep, models)(URQuery(user="cold-user", num=3))
+    return [s.item for s in res.item_scores]
+
+
+def test_popular_backfill_counts_window(mem_storage):
+    # whole log: old(21) > steady(31)? old=21, steady=31, rising=13
+    order = _backfill_order(mem_storage, "popular", "3650 days")
+    assert order[0] == "steady" and set(order) == {"old", "rising", "steady"}
+
+
+def test_trending_backfill_prefers_velocity(mem_storage):
+    # 30-day window halves: rising has all events in the recent half →
+    # highest velocity; old has everything in the older half → negative
+    order = _backfill_order(mem_storage, "trending", "30 days")
+    assert order[0] == "rising"
+    assert order[-1] == "old"
+
+
+def test_hot_backfill_prefers_acceleration(mem_storage):
+    order = _backfill_order(mem_storage, "hot", "30 days")
+    assert order[0] == "rising"
+
+
+def test_backfill_type_none_returns_empty_for_cold_user(mem_storage):
+    order = _backfill_order(mem_storage, "none", "30 days")
+    assert order == []
+
+
+def test_bad_backfill_params_fail_loudly(mem_storage):
+    app = _pop_app(mem_storage, "popapp2")
+    engine = UniversalRecommenderEngine.apply()
+    with pytest.raises(ValueError):
+        engine.train(_pop_ep(app, backfill_type="voguish"))
+    with pytest.raises(ValueError):
+        engine.train(_pop_ep(app, backfill_duration="three fortnights"))
+
+
+def test_backfill_pads_short_result_lists(trained):
+    """A user with real signal still gets `num` items: signal first, then
+    popularity-ranked backfill (reference UR fills up to num)."""
+    engine, ep, models = trained
+    res = engine.predictor(ep, models)(URQuery(user="u2", num=8))
+    # u2 has 12 catalog items minus their own purchases (blacklisted), so 8
+    # are servable; signal alone yields far fewer — backfill pads to num
+    assert len(res.item_scores) == 8
+    # signal items (score > padding) come first; padding afterwards
+    scores = [s.score for s in res.item_scores]
+    n_signal = sum(1 for s in scores if s > 1.0)
+    assert n_signal >= 1
+    # padded tail respects the primary-event blacklist: u2's purchases
+    # never appear even as padding
+    from predictionio_tpu.store.event_store import LEventStore
+
+    bought = {e.target_entity_id for e in LEventStore.find_by_entity(
+        "urapp", "user", "u2", event_names=["purchase"])}
+    assert bought and not (bought & {s.item for s in res.item_scores})
+
+
+def test_non_primary_blacklist_events(ur_app):
+    """blacklist_events: ['purchase', 'view'] removes viewed-but-never-
+    bought items too (the round-2 gap: non-primary names were silently
+    ignored)."""
+    engine = UniversalRecommenderEngine.apply()
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="urapp", event_names=["purchase", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(
+            app_name="urapp", mesh_dp=1, max_correlators_per_item=8,
+            blacklist_events=["purchase", "view"]))],
+    )
+    models = engine.train(ep)
+    from predictionio_tpu.store.event_store import LEventStore
+
+    seen = set()
+    for name in ("purchase", "view"):
+        seen |= {e.target_entity_id for e in LEventStore.find_by_entity(
+            "urapp", "user", "u2", event_names=[name])}
+    res = engine.predictor(ep, models)(URQuery(user="u2", num=12))
+    assert seen and not (seen & {s.item for s in res.item_scores})
+
+
+def test_unknown_blacklist_event_rejected(ur_app):
+    engine = UniversalRecommenderEngine.apply()
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="urapp", event_names=["purchase", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(
+            app_name="urapp", mesh_dp=1, blacklist_events=["add-to-cart"]))],
+    )
+    with pytest.raises(ValueError, match="blacklist_events"):
+        engine.train(ep)
+
+
+def test_parse_duration_units():
+    from predictionio_tpu.models.universal_recommender.popmodel import parse_duration
+
+    assert parse_duration("90 days") == 90 * 86400
+    assert parse_duration("12 hours") == 12 * 3600
+    assert parse_duration("45") == 45
+    assert parse_duration("2 weeks") == 2 * 604800
+    with pytest.raises(ValueError):
+        parse_duration("soon")
