@@ -43,7 +43,7 @@ from predictionio_tpu.controller import (
     Preparator,
 )
 from predictionio_tpu.ops import cco as cco_ops
-from predictionio_tpu.ops.als import pad_ids as als_pad_ids
+from predictionio_tpu.ops.als import bucket_width, pad_ids as als_pad_ids
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
 from predictionio_tpu.store.columnar import CSRLookup, IdDict
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
@@ -313,17 +313,80 @@ class URModel(PersistentModel):
 
     def warm(self) -> None:
         self.device_indicators()
-        self.pop_order()
+        self.device_popularity()
+        self.device_ones()
+        self.pop_norm()
 
-    def pop_order(self) -> np.ndarray:
-        """Item ids in descending backfill-score order, computed once per
-        model load — padding scans this instead of argsorting [n_items]
-        per query (lazily cached; never serialized)."""
-        order = self.__dict__.get("_pop_order")
-        if order is None:
-            order = np.argsort(-self.popularity, kind="stable").astype(np.int32)
-            self.__dict__["_pop_order"] = order
-        return order
+    def pop_norm(self) -> float:
+        norm = self.__dict__.get("_pop_norm")
+        if norm is None:
+            norm = max(float(np.abs(self.popularity).max()), 1.0) \
+                if len(self.popularity) else 1.0
+            self.__dict__["_pop_norm"] = norm
+        return norm
+
+    # -- device-resident serving state (lazily cached, never serialized) ----
+
+    def device_popularity(self) -> jnp.ndarray:
+        dev = self.__dict__.get("_dev_pop")
+        if dev is None:
+            dev = jax.device_put(jnp.asarray(self.popularity, jnp.float32))
+            self.__dict__["_dev_pop"] = dev
+        return dev
+
+    def device_ones(self) -> jnp.ndarray:
+        dev = self.__dict__.get("_dev_ones")
+        if dev is None:
+            dev = jax.device_put(jnp.ones(len(self.item_dict), jnp.float32))
+            self.__dict__["_dev_ones"] = dev
+        return dev
+
+    def device_zeros(self) -> jnp.ndarray:
+        dev = self.__dict__.get("_dev_zeros")
+        if dev is None:
+            dev = jax.device_put(jnp.zeros(len(self.item_dict), jnp.float32))
+            self.__dict__["_dev_zeros"] = dev
+        return dev
+
+    _VALUE_MASK_CACHE_MAX = 512
+
+    def device_value_mask(self, name: str, value: str) -> jnp.ndarray:
+        """0/1 device mask of items whose property ``name`` holds ``value``
+        — the Elasticsearch-filter-bitset analogue, cached per (name, value)
+        so repeated business rules cost one gather-free multiply.  Values
+        absent from the catalog return the shared zero mask WITHOUT caching
+        (query fields are user input; caching unknowns would let arbitrary
+        queries pin unbounded HBM), and the cache itself is FIFO-bounded."""
+        ids = self.prop_value_index(name).get(value)
+        if ids is None:
+            return self.device_zeros()
+        cache = self.__dict__.setdefault("_dev_value_mask", {})
+        key = (name, value)
+        if key not in cache:
+            if len(cache) >= self._VALUE_MASK_CACHE_MAX:
+                cache.pop(next(iter(cache)))
+            m = np.zeros(len(self.item_dict), np.float32)
+            m[ids] = 1.0
+            cache[key] = jax.device_put(jnp.asarray(m))
+        return cache[key]
+
+    def device_date(self, name: str) -> Tuple[float, jnp.ndarray]:
+        """(base_epoch_s, device int32 offsets) for a date property; -1
+        where missing.  Integer seconds relative to the earliest value keep
+        boundary comparisons EXACT (f32 epoch offsets would quantize to
+        ~32 s over decade spans); sub-second precision is rounded, matching
+        the second-granularity date semantics of the reference's ES range
+        filters."""
+        cache = self.__dict__.setdefault("_dev_date", {})
+        if name not in cache:
+            ts = self.prop_date_array(name)
+            missing = np.isnan(ts)
+            finite = ts[~missing]
+            base = float(finite.min()) if len(finite) else 0.0
+            off = np.where(missing, -1.0, np.rint(ts - base))
+            off = np.clip(off, -1, 2**31 - 2).astype(np.int32)
+            cache[name] = (base, jax.device_put(jnp.asarray(off)))
+        return cache[name]
 
     # -- serving-time property indexes (built lazily, never serialized) ----
 
@@ -379,6 +442,64 @@ def _indicator_score_ids(
     matched = hvec[jnp.where(valid, idx, 0)] * valid
     w = jnp.where(use_llr, jnp.where(valid, llr, 0.0), 1.0)
     return (matched * w).sum(-1)
+
+
+# -- device mask composition (tiny jitted combinators; python-float biases
+#    and bounds trace as 0-d weak-typed scalars, so no recompile per value) --
+
+
+@jax.jit
+def _m_or(a, b):
+    return jnp.maximum(a, b)
+
+
+@jax.jit
+def _m_hard(mask, match):
+    return mask * match
+
+
+@jax.jit
+def _m_boost(mask, match, bias):
+    return mask * jnp.where(match > 0, bias, 1.0)
+
+
+# date arrays are int32 second-offsets with -1 = property missing; every
+# check requires presence (ES range filters match only docs with the field)
+
+
+@jax.jit
+def _m_present(mask, ts):
+    return mask * (ts >= 0).astype(jnp.float32)
+
+
+@jax.jit
+def _m_ge(mask, ts, bound):
+    return mask * ((ts >= bound) & (ts >= 0)).astype(jnp.float32)
+
+
+@jax.jit
+def _m_le(mask, ts, bound):
+    return mask * ((ts <= bound) & (ts >= 0)).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _serve_topk(signal, mask, bf, black_ids, k: int):
+    """The device-final serving tail: apply business-rule mask + blacklist,
+    take top-k of the signal AND top-k of the backfill eligibility in one
+    program — only 4 small [k] arrays cross back to host, never an
+    [n_items] vector (at 100k+ items the old full-vector download plus
+    host masking/argpartition was the serving bottleneck)."""
+    valid = black_ids >= 0
+    excl = jnp.zeros_like(signal).at[
+        jnp.where(valid, black_ids, 0)
+    ].max(valid.astype(signal.dtype))
+    s = jnp.where(excl > 0, -jnp.inf, signal * mask)
+    st, si = jax.lax.top_k(s, k)
+    # backfill ranks by bf * mask so field boosts reorder the fallback list
+    # exactly as they reorder signal scores; mask > 0 is the eligibility cut
+    bfm = jnp.where((mask > 0) & (excl <= 0), bf * mask, -jnp.inf)
+    bt, bi = jax.lax.top_k(bfm, k)
+    return st, si, bt, bi
 
 
 # -- algorithm ---------------------------------------------------------------
@@ -516,9 +637,10 @@ class URAlgorithm(Algorithm):
 
     def _score_history(
         self, model: URModel, hist: Dict[str, np.ndarray]
-    ) -> Optional[np.ndarray]:
+    ) -> Optional[jnp.ndarray]:
         """Run the device-resident scorer over every event type's history;
-        accumulates ON DEVICE, one host transfer of the final [I_p] vector."""
+        accumulates ON DEVICE and stays there — the serving tail
+        (_serve_topk) consumes it without any [I_p] host transfer."""
         use_llr = jnp.asarray(self.params.use_llr_weights)
         total = None
         for name, (idx_dev, llr_dev) in model.device_indicators().items():
@@ -532,14 +654,18 @@ class URAlgorithm(Algorithm):
             weight = float(self.params.indicator_weights.get(name, 1.0))
             s = s * weight if weight != 1.0 else s
             total = s if total is None else total + s
-        return None if total is None else np.asarray(total)
+        return total
 
     def predict(self, model: URModel, query: URQuery) -> URResult:
+        """Device-final serving: signal accumulation, business-rule masks,
+        blacklist, and BOTH top-ks (signal + backfill) run on device; only
+        4 [k]-sized arrays and the small history/blacklist id lists cross
+        the host boundary.  Query shapes are bucketed (pad_ids, k buckets)
+        so every shape traces once per deployment."""
         n_items = len(model.item_dict)
         if n_items == 0:
             return URResult([])
-        scores = np.zeros(n_items, np.float32)
-        have_signal = False
+        signal = None
         if query.item is not None:
             iid = model.item_dict.id(query.item)
             if iid is not None:
@@ -553,118 +679,108 @@ class URAlgorithm(Algorithm):
                     ids = row[row >= 0]
                     if len(ids):
                         hist[name] = ids.astype(np.int32)
-                s = self._score_history(model, hist)
-                if s is not None:
-                    scores += s
-                    have_signal = True
+                signal = self._score_history(model, hist)
         elif query.user is not None:
             hist = self._user_history(model, query.user)
-            s = self._score_history(model, hist)
-            if s is not None:
-                scores += s
-                have_signal = True
-        # business rules
-        mask = self._field_mask(model, query.fields)
-        mask = mask * self._date_mask(model, query)
-        scores = scores * mask
-        # blacklist: query items + the user's seen items under every
-        # configured blacklist event type (reference UR blacklists from all
-        # of blackListEvents, not only the primary) + self for item queries
-        excluded = np.zeros(n_items, bool)
-        black = set(query.blacklist_items)
+            signal = self._score_history(model, hist)
+        have_signal = signal is not None
+        if signal is None:
+            signal = model.device_zeros()
+        mask = self._device_mask(model, query)
+        black_ids = self._blacklist_ids(model, query)
+        num = min(query.num, n_items)
+        # k covers the worst case: every signal pick also occupying a
+        # backfill slot; bucketed so distinct nums share compiles
+        k = min(bucket_width(2 * num, 16), n_items)
+        st, si, bt, bi = _serve_topk(
+            signal, mask, model.device_popularity(),
+            jnp.asarray(als_pad_ids(black_ids)), k)
+        st, si, bt, bi = (np.asarray(x) for x in (st, si, bt, bi))
+        results: List[ItemScore] = []
+        chosen = set()
+        if have_signal:
+            for s, j in zip(st, si):
+                if np.isfinite(s) and s > 0 and len(results) < num:
+                    results.append(ItemScore(model.item_dict.str(int(j)), float(s)))
+                    chosen.add(int(j))
+        # backfill: fills the whole list when there is no signal, and PADS
+        # short lists up to num (reference UR appends popRank-ordered items)
+        if len(results) < num and self.params.backfill_type != "none":
+            norm = model.pop_norm()
+            for s, j in zip(bt, bi):
+                if len(results) >= num:
+                    break
+                if int(j) in chosen or not np.isfinite(s):
+                    continue
+                results.append(ItemScore(model.item_dict.str(int(j)), float(s) / norm))
+        return URResult(results)
+
+    def _blacklist_ids(self, model: URModel, query: URQuery) -> List[int]:
+        """Item ids to exclude: the user's seen items under every configured
+        blacklist event type (reference UR blacklists from all of
+        blackListEvents, not only the primary), query blacklistItems, and
+        self for item queries."""
+        ids: List[int] = []
         if query.user is not None:
             uid = model.user_dict.id(query.user)
             if uid is not None:
                 blacklist_events = self.params.blacklist_events or [model.primary_event]
                 for name in blacklist_events:
                     if name == model.primary_event:
-                        excluded[model.user_seen.row(uid)] = True
+                        ids.extend(model.user_seen.row(uid).tolist())
                     else:
                         csr = model.user_seen_by_event.get(name)
                         if csr is not None:
-                            excluded[csr.row(uid)] = True
+                            ids.extend(csr.row(uid).tolist())
+        black = set(query.blacklist_items)
         if query.item is not None and not query.return_self:
             black.add(query.item)
         for b in black:
             bid = model.item_dict.id(b)
             if bid is not None:
-                excluded[bid] = True
-        scores[excluded] = -np.inf
-        num = min(query.num, n_items)
-        results: List[ItemScore] = []
-        chosen = np.zeros(n_items, bool)
-        if have_signal:
-            top = np.argpartition(
-                -np.nan_to_num(scores, neginf=-1e30), min(num, n_items - 1))[:num]
-            top = top[np.argsort(-scores[top], kind="stable")]
-            for j in top:
-                if np.isfinite(scores[j]) and scores[j] > 0:
-                    results.append(ItemScore(model.item_dict.str(int(j)), float(scores[j])))
-                    chosen[j] = True
-        # backfill: fills the whole list when there is no signal, and PADS
-        # short lists up to num (reference UR appends popRank-ordered items)
-        if len(results) < num and self.params.backfill_type != "none":
-            bf = model.popularity
-            norm = max(float(np.abs(bf).max()), 1.0) if n_items else 1.0
-            eligible = (mask > 0) & ~excluded & ~chosen
-            needed = num - len(results)
-            # model-static rank order, O(num + skipped) per query
-            for j in model.pop_order():
-                if eligible[j]:
-                    results.append(
-                        ItemScore(model.item_dict.str(int(j)), float(bf[j]) / norm))
-                    needed -= 1
-                    if needed == 0:
-                        break
-        return URResult(results)
+                ids.append(bid)
+        return ids
 
-    def _date_mask(self, model: URModel, query: URQuery) -> np.ndarray:
-        """Hard date filters: the query's dateRange on an item date property,
-        and availableDateName <= currentDate <= expireDateName (reference:
-        URAlgorithm date rules, applied as Elasticsearch range filters).
-        Items missing the property fail every date check — ES range filters
-        match only documents that have the field.  Vectorized over the
-        model's cached per-property timestamp arrays."""
-        n_items = len(model.item_dict)
-        mask = np.ones(n_items, np.float32)
+    def _device_mask(self, model: URModel, query: URQuery) -> jnp.ndarray:
+        """Business-rule mask composed ON DEVICE from cached per-(property,
+        value) bitsets and base-relative date arrays — the Elasticsearch
+        filter/boost analogue (reference: URAlgorithm field biases and date
+        rules as ES bool-query filters).  Items missing a checked date
+        property fail the check, like ES range filters."""
+        mask = model.device_ones()
+        for rule in query.fields:
+            match = None
+            for val in rule.values:
+                m = model.device_value_mask(rule.name, val)
+                match = m if match is None else _m_or(match, m)
+            if match is None:
+                match = model.device_zeros()
+            if rule.bias < 0:
+                mask = _m_hard(mask, match)      # hard filter
+            else:
+                mask = _m_boost(mask, match, float(rule.bias))
+        def bound(epoch_s: float, base: float) -> int:
+            # same rounding as the item offsets → exact boundary equality
+            return int(np.clip(np.rint(epoch_s - base), -1, 2**31 - 2))
+
         dr = query.date_range
-        now = _query_ts(query.current_date, "currentDate") if query.current_date else None
-        avail, expire = self.params.available_date_name, self.params.expire_date_name
         if dr is not None:
-            ts = model.prop_date_array(dr.name)
-            keep = ~np.isnan(ts)
+            base, ts = model.device_date(dr.name)
+            mask = _m_present(mask, ts)
             if dr.after:
-                keep &= ts >= _query_ts(dr.after, "dateRange.after")
+                mask = _m_ge(mask, ts, bound(_query_ts(dr.after, "dateRange.after"), base))
             if dr.before:
-                keep &= ts <= _query_ts(dr.before, "dateRange.before")
-            mask *= keep
+                mask = _m_le(mask, ts, bound(_query_ts(dr.before, "dateRange.before"), base))
+        now = _query_ts(query.current_date, "currentDate") if query.current_date else None
         if now is not None:
-            # Items missing the configured date property are EXCLUDED, like
-            # the reference's Elasticsearch range filters (a range query only
-            # matches documents that have the field).
+            avail, expire = self.params.available_date_name, self.params.expire_date_name
             if avail:
-                ts = model.prop_date_array(avail)
-                mask *= ts <= now            # NaN compares False: missing fails
+                base, ts = model.device_date(avail)
+                mask = _m_le(mask, ts, bound(now, base))   # available <= now
             if expire:
                 # boundary instant still valid: available <= now <= expire
-                ts = model.prop_date_array(expire)
-                mask *= ts >= now
-        return mask
-
-    def _field_mask(self, model: URModel, rules: List[FieldRule]) -> np.ndarray:
-        n_items = len(model.item_dict)
-        mask = np.ones(n_items, np.float32)
-        for rule in rules:
-            index = model.prop_value_index(rule.name)
-            match = np.zeros(n_items, bool)
-            for val in rule.values:
-                ids = index.get(val)
-                if ids is not None:
-                    match[ids] = True
-            if rule.bias < 0:
-                mask *= match.astype(np.float32)  # hard filter
-            else:
-                mask *= np.where(match, rule.bias, 1.0).astype(np.float32)
+                base, ts = model.device_date(expire)
+                mask = _m_ge(mask, ts, bound(now, base))
         return mask
 
 

@@ -498,3 +498,16 @@ def test_parse_duration_units():
     assert parse_duration("2 weeks") == 2 * 604800
     with pytest.raises(ValueError):
         parse_duration("soon")
+
+
+def test_field_boost_reorders_backfill(trained):
+    """A cold user's popularity fallback is reordered by field boosts, like
+    the reference's ES boost on the popRank-backed query."""
+    engine, ep, models = trained
+    pred = engine.predictor(ep, models)
+    plain = pred(URQuery(user="cold", num=12))
+    boosted = pred(URQuery(user="cold", num=12, fields=[
+        {"name": "category", "values": ["books"], "bias": 50.0}]))
+    assert len(boosted.item_scores) == len(plain.item_scores) > 0
+    top6 = {s.item for s in boosted.item_scores[:6]}
+    assert all(i.startswith("b") for i in top6), top6
