@@ -349,6 +349,20 @@ class URModel(PersistentModel):
         return dev
 
     _VALUE_MASK_CACHE_MAX = 512
+    _DATE_CACHE_MAX = 512
+
+    def known_prop_names(self) -> frozenset:
+        """Property names that exist on at least one item — the gate that
+        keeps query-supplied field/date names from triggering O(n_items)
+        index builds or device-array caching for properties that cannot
+        match anything (ES semantics: a filter on a nonexistent field
+        matches no documents)."""
+        names = self.__dict__.get("_known_prop_names")
+        if names is None:
+            names = frozenset(
+                k for props in self.item_properties.values() for k in props)
+            self.__dict__["_known_prop_names"] = names
+        return names
 
     def device_value_mask(self, name: str, value: str) -> jnp.ndarray:
         """0/1 device mask of items whose property ``name`` holds ``value``
@@ -357,6 +371,8 @@ class URModel(PersistentModel):
         absent from the catalog return the shared zero mask WITHOUT caching
         (query fields are user input; caching unknowns would let arbitrary
         queries pin unbounded HBM), and the cache itself is FIFO-bounded."""
+        if name not in self.known_prop_names():
+            return self.device_zeros()
         ids = self.prop_value_index(name).get(value)
         if ids is None:
             return self.device_zeros()
@@ -370,15 +386,21 @@ class URModel(PersistentModel):
             cache[key] = jax.device_put(jnp.asarray(m))
         return cache[key]
 
-    def device_date(self, name: str) -> Tuple[float, jnp.ndarray]:
+    def device_date(self, name: str) -> Optional[Tuple[float, jnp.ndarray]]:
         """(base_epoch_s, device int32 offsets) for a date property; -1
-        where missing.  Integer seconds relative to the earliest value keep
-        boundary comparisons EXACT (f32 epoch offsets would quantize to
-        ~32 s over decade spans); sub-second precision is rounded, matching
-        the second-granularity date semantics of the reference's ES range
-        filters."""
+        where missing; None when NO item has the property (callers must
+        treat that as match-nothing — and it keeps query-supplied names
+        from growing the cache).  Integer seconds relative to the earliest
+        value keep boundary comparisons EXACT (f32 epoch offsets would
+        quantize to ~32 s over decade spans); sub-second precision is
+        rounded, matching the second-granularity date semantics of the
+        reference's ES range filters."""
+        if name not in self.known_prop_names():
+            return None
         cache = self.__dict__.setdefault("_dev_date", {})
         if name not in cache:
+            if len(cache) >= self._DATE_CACHE_MAX:
+                cache.pop(next(iter(cache)))
             ts = self.prop_date_array(name)
             missing = np.isnan(ts)
             finite = ts[~missing]
@@ -765,7 +787,10 @@ class URAlgorithm(Algorithm):
 
         dr = query.date_range
         if dr is not None:
-            base, ts = model.device_date(dr.name)
+            dd = model.device_date(dr.name)
+            if dd is None:           # no item has the property: match nothing
+                return model.device_zeros()
+            base, ts = dd
             mask = _m_present(mask, ts)
             if dr.after:
                 mask = _m_ge(mask, ts, bound(_query_ts(dr.after, "dateRange.after"), base))
@@ -774,13 +799,15 @@ class URAlgorithm(Algorithm):
         now = _query_ts(query.current_date, "currentDate") if query.current_date else None
         if now is not None:
             avail, expire = self.params.available_date_name, self.params.expire_date_name
-            if avail:
-                base, ts = model.device_date(avail)
-                mask = _m_le(mask, ts, bound(now, base))   # available <= now
-            if expire:
-                # boundary instant still valid: available <= now <= expire
-                base, ts = model.device_date(expire)
-                mask = _m_ge(mask, ts, bound(now, base))
+            for prop, op in ((avail, _m_le), (expire, _m_ge)):
+                # available <= now <= expire; boundary instants still valid
+                if not prop:
+                    continue
+                dd = model.device_date(prop)
+                if dd is None:
+                    return model.device_zeros()
+                base, ts = dd
+                mask = op(mask, ts, bound(now, base))
         return mask
 
 

@@ -88,28 +88,55 @@ def block_interactions(
     it only shrinks the padded width when the data is heavily duplicated."""
     if dedup:
         user, item = dedup_pairs(user, item, n_items)
-    else:
+    return block_interactions_stream(
+        [(user, item)], n_users, n_items,
+        user_block=user_block, pad_multiple=pad_multiple,
+    )
+
+
+def block_interactions_stream(
+    batches,
+    n_users: int,
+    n_items: int,
+    user_block: int = 1024,
+    pad_multiple: int = 8,
+) -> BlockedInteractions:
+    """``block_interactions`` over an ITERATOR of (user, item) array batches
+    — the host-staging path for event logs larger than comfortable as one
+    array (SURVEY.md §7 hard part (a)): each batch is split into user
+    blocks and appended incrementally, so peak host memory is one batch
+    plus the final layout (never raw + layout at once)."""
+    n_blocks = max(math.ceil(n_users / user_block), 1)
+    per_block_u: List[List[np.ndarray]] = [[] for _ in range(n_blocks)]
+    per_block_i: List[List[np.ndarray]] = [[] for _ in range(n_blocks)]
+    for user, item in batches:
         user = np.asarray(user, np.int32)
         item = np.asarray(item, np.int32)
-    n_blocks = max(math.ceil(n_users / user_block), 1)
-    blk = user // user_block
-    # numpy stable argsort on ints is a radix sort: O(E), not O(E log E)
-    order = np.argsort(blk, kind="stable")
-    user, item, blk = user[order], item[order], blk[order]
-    counts = np.bincount(blk, minlength=n_blocks)
-    width = max(int(counts.max()) if len(user) else 1, 1)
+        blk = user // user_block
+        order = np.argsort(blk, kind="stable")
+        user, item, blk = user[order], item[order], blk[order]
+        counts = np.bincount(blk, minlength=n_blocks)
+        start = 0
+        for b in range(n_blocks):
+            c = int(counts[b])
+            if c:
+                sl = slice(start, start + c)
+                per_block_u[b].append(user[sl] % user_block)
+                per_block_i[b].append(item[sl])
+                start += c
+    sizes = [sum(len(a) for a in lists) for lists in per_block_u]
+    width = max(max(sizes) if sizes else 1, 1)
     width = ((width + pad_multiple - 1) // pad_multiple) * pad_multiple
     lu = np.zeros((n_blocks, width), np.int32)
     it = np.zeros((n_blocks, width), np.int32)
     mk = np.zeros((n_blocks, width), np.float32)
-    start = 0
     for b in range(n_blocks):
-        c = int(counts[b])
-        sl = slice(start, start + c)
-        lu[b, :c] = user[sl] % user_block
-        it[b, :c] = item[sl]
-        mk[b, :c] = 1.0
-        start += c
+        c = sizes[b]
+        if c:
+            lu[b, :c] = np.concatenate(per_block_u[b])
+            it[b, :c] = np.concatenate(per_block_i[b])
+            mk[b, :c] = 1.0
+        per_block_u[b] = per_block_i[b] = []  # free as we go
     return BlockedInteractions(lu, it, mk, n_users, n_items, user_block)
 
 

@@ -291,3 +291,24 @@ def test_cco_train_indicators_mesh(monkeypatch):
     for name in ("buy", "view"):
         np.testing.assert_allclose(single[name][0], sharded[name][0],
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_block_interactions_stream_matches_batch():
+    """The streaming host-staging layout yields identical indicators to the
+    one-shot layout (same data, batched arbitrarily)."""
+    from predictionio_tpu.ops.cco import (
+        block_interactions, block_interactions_stream, cco_indicators)
+
+    n_users, n_items = 48, 12
+    u, i = random_interactions(n_users, n_items, 400, 91)
+    whole = block_interactions(u, i, n_users, n_items, user_block=16)
+    streamed = block_interactions_stream(
+        ((u[s:s + 37], i[s:s + 37]) for s in range(0, 400, 37)),
+        n_users, n_items, user_block=16)
+    s1, i1 = cco_indicators(whole, whole, None, None, n_users, top_k=5,
+                            item_tile=8, exclude_self=True)
+    s2, i2 = cco_indicators(streamed, streamed, None, None, n_users, top_k=5,
+                            item_tile=8, exclude_self=True)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5)
+    for r in range(n_items):
+        assert set(i1[r][s1[r] > -np.inf]) == set(i2[r][s2[r] > -np.inf])

@@ -511,3 +511,19 @@ def test_field_boost_reorders_backfill(trained):
     assert len(boosted.item_scores) == len(plain.item_scores) > 0
     top6 = {s.item for s in boosted.item_scores[:6]}
     assert all(i.startswith("b") for i in top6), top6
+
+
+def test_unknown_property_names_match_nothing(trained):
+    """Field/date rules naming properties no item has match NO documents
+    (ES semantics) and never build per-name caches from query input."""
+    engine, ep, models = trained
+    pred = engine.predictor(ep, models)
+    res = pred(URQuery(user="u2", num=5, fields=[
+        {"name": "no-such-prop", "values": ["x"], "bias": -1}]))
+    assert res.item_scores == []
+    res2 = pred(URQuery(user="u2", num=5,
+                        date_range={"name": "not-a-date", "after": "2020-01-01"}))
+    assert res2.item_scores == []
+    model = models[0]
+    assert not model.__dict__.get("_dev_date")
+    assert ("no-such-prop", "x") not in (model.__dict__.get("_dev_value_mask") or {})
