@@ -188,24 +188,35 @@ class URDataSource(DataSource):
     params_class = URDataSourceParams
 
     def read_training(self) -> URTrainingData:
+        """One columnar batch read for ALL event types (native C++ scan on
+        segment-file backends — no per-event Python loop), then vectorized
+        per-type dictionary translation."""
         user_dict = IdDict()
         interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict, np.ndarray]] = {}
+        batch = PEventStore.batch(
+            self.params.app_name, event_names=list(self.params.event_names))
+        # entity codes → one global user id space.  Only codes REFERENCED by
+        # interaction rows enroll (the scan's shared entity_dict also holds
+        # $set item ids etc.; enrolling those would inflate n_users and
+        # corrupt the LLR population total).
+        user_of_code = np.full(max(len(batch.entity_dict), 1), -1, np.int32)
         for name in self.params.event_names:
-            item_dict = IdDict()
-            users: List[int] = []
-            items: List[int] = []
-            times: List[float] = []
-            for e in PEventStore.find(self.params.app_name, event_names=[name]):
-                if e.target_entity_id is None:
-                    continue
-                users.append(user_dict.add(e.entity_id))
-                items.append(item_dict.add(e.target_entity_id))
-                times.append(e.event_time.timestamp())
+            sel = batch.select_events([name])
+            has_t = sel.target_ids >= 0
+            for c in np.unique(sel.entity_ids[has_t]):
+                if user_of_code[c] < 0:
+                    user_of_code[c] = user_dict.add(batch.entity_dict.str(int(c)))
+            t_codes = sel.target_ids[has_t]
+            uniq = np.unique(t_codes)
+            item_dict = IdDict(
+                [batch.target_dict.str(int(c)) for c in uniq])
+            local_of_target = np.full(max(len(batch.target_dict), 1), -1, np.int32)
+            local_of_target[uniq] = np.arange(len(uniq), dtype=np.int32)
             interactions[name] = (
-                np.asarray(users, np.int32),
-                np.asarray(items, np.int32),
+                user_of_code[sel.entity_ids[has_t]].astype(np.int32),
+                local_of_target[t_codes].astype(np.int32),
                 item_dict,
-                np.asarray(times, np.float64),
+                sel.times_us[has_t].astype(np.float64) / 1e6,
             )
         props = PEventStore.aggregate_properties(
             self.params.app_name, self.params.item_entity_type

@@ -8,8 +8,14 @@
 //
 // Contract: segments are written by Event.to_json_line() — compact JSON, one
 // object per line.  The parser is a minimal but correct JSON tokenizer: it
-// extracts event/entityId/entityType/targetEntityId/eventTime and
-// properties.rating, skipping everything else structurally.
+// extracts event/entityId/entityType/targetEntityId/eventTime and the FULL
+// properties map into sparse per-key columns (discovered schema):
+//   kind 0 = number (f64), 1 = bool (0/1 in the num facet),
+//   kind 2 = string, 3 = list of strings (string facet, per-key dict;
+//   numeric/bool list elements are stringified, nested containers inside
+//   lists are dropped), 4 = null, 5 = nested object kept as its raw JSON
+//   span — dates stay ISO strings for the Python side.
+// A legacy dense `rating` column (NaN-missing) is kept as the ALS fast path.
 //
 // Threading: one worker per segment file (they are immutable once rotated),
 // then a single-threaded merge that dictionary-encodes strings.
@@ -19,6 +25,7 @@
 //   scan_add_file(h, path)
 //   scan_run(h, n_threads) -> row count or -1
 //   scan_rows/scan_col_*/scan_dict_* accessors
+//   scan_prop_* accessors (sparse property columns)
 //   scan_error(h) -> last error message
 //   scan_free(h)
 
@@ -35,6 +42,15 @@
 
 namespace {
 
+// One parsed property value.  kind: 0 num, 1 bool, 2 str, 3 str-list,
+// 4 null (kept: $unset lists keys with null values), 5 raw JSON (nested
+// object — the raw text span, decoded lazily Python-side).
+struct PropValue {
+  int8_t kind = -1;
+  double num = NAN;
+  std::vector<std::string> strs;
+};
+
 struct RawEvent {
   std::string event;
   std::string entity_type;
@@ -43,6 +59,7 @@ struct RawEvent {
   int64_t time_us = 0;
   float rating = NAN;
   bool valid = false;
+  std::vector<std::pair<std::string, PropValue>> props;
 };
 
 // ---------------------------------------------------------------------- JSON
@@ -242,6 +259,72 @@ bool parse_iso8601_us(const std::string& s, int64_t* out) {
   return true;
 }
 
+// Parse one property VALUE into pv (see PropValue kinds).  Unsupported
+// shapes (nested objects, null, lists with nested containers) are skipped
+// structurally with kind -1 — the line still parses.
+bool parse_prop_value(Parser& ps, PropValue* pv) {
+  ps.skip_ws();
+  if (ps.p >= ps.end) { ps.ok = false; return false; }
+  char c = *ps.p;
+  if (c == '"') {
+    pv->strs.emplace_back();
+    if (!ps.parse_string(&pv->strs.back())) return false;
+    pv->kind = 2;
+    return true;
+  }
+  if (c == 't') { pv->kind = 1; pv->num = 1.0; return ps.skip_literal("true"); }
+  if (c == 'f') { pv->kind = 1; pv->num = 0.0; return ps.skip_literal("false"); }
+  if (c == 'n') { pv->kind = 4; return ps.skip_literal("null"); }
+  if (c == '{') {
+    const char* start = ps.p;
+    if (!ps.skip_object()) return false;
+    pv->kind = 5;
+    pv->strs.emplace_back(start, (size_t)(ps.p - start));
+    return true;
+  }
+  if (c == '[') {
+    ps.p++;
+    pv->kind = 3;
+    ps.skip_ws();
+    if (ps.p < ps.end && *ps.p == ']') { ps.p++; return true; }
+    while (ps.p < ps.end) {
+      ps.skip_ws();
+      if (ps.p >= ps.end) break;
+      char e = *ps.p;
+      if (e == '"') {
+        pv->strs.emplace_back();
+        if (!ps.parse_string(&pv->strs.back())) return false;
+      } else if (e == 't') {
+        if (!ps.skip_literal("true")) return false;
+        pv->strs.emplace_back("true");
+      } else if (e == 'f') {
+        if (!ps.skip_literal("false")) return false;
+        pv->strs.emplace_back("false");
+      } else if (e == 'n') {
+        if (!ps.skip_literal("null")) return false;  // dropped
+      } else if (e == '{' ) {
+        if (!ps.skip_object()) return false;         // dropped
+      } else if (e == '[') {
+        if (!ps.skip_array()) return false;          // dropped
+      } else {
+        double v;
+        if (!ps.parse_number(&v)) return false;
+        char buf[32];
+        snprintf(buf, sizeof buf, "%.17g", v);
+        pv->strs.emplace_back(buf);
+      }
+      ps.skip_ws();
+      if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
+      return ps.expect(']');
+    }
+    ps.ok = false;
+    return false;
+  }
+  if (!ps.parse_number(&pv->num)) return false;
+  pv->kind = 0;
+  return true;
+}
+
 bool parse_line(const char* line, const char* line_end, RawEvent* ev) {
   Parser ps{line, line_end};
   if (!ps.expect('{')) return false;
@@ -264,7 +347,6 @@ bool parse_line(const char* line, const char* line_end, RawEvent* ev) {
     } else if (key == "eventTime") {
       if (!ps.parse_string(&event_time)) return false;
     } else if (key == "properties") {
-      // walk the object keeping only "rating" if numeric
       ps.skip_ws();
       if (ps.p < ps.end && *ps.p == '{') {
         ps.p++;
@@ -276,18 +358,10 @@ bool parse_line(const char* line, const char* line_end, RawEvent* ev) {
             pk.clear();
             if (!ps.parse_string(&pk)) return false;
             if (!ps.expect(':')) return false;
-            if (pk == "rating") {
-              ps.skip_ws();
-              if (ps.p < ps.end && (*ps.p == '-' || (*ps.p >= '0' && *ps.p <= '9'))) {
-                double v;
-                if (!ps.parse_number(&v)) return false;
-                ev->rating = (float)v;
-              } else if (!ps.skip_value()) {
-                return false;
-              }
-            } else if (!ps.skip_value()) {
-              return false;
-            }
+            PropValue pv;
+            if (!parse_prop_value(ps, &pv)) return false;
+            if (pv.kind == 0 && pk == "rating") ev->rating = (float)pv.num;
+            if (pv.kind >= 0) ev->props.emplace_back(std::move(pk), std::move(pv));
             ps.skip_ws();
             if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
             if (!ps.expect('}')) return false;
@@ -327,6 +401,18 @@ struct Dict {
   }
 };
 
+// Sparse per-key property column: entry j is (rows[j], kind[j], num[j],
+// strings codes[str_offs[j] .. str_offs[j+1])).  rows are ascending by
+// construction (merge walks rows in order).
+struct PropColumn {
+  std::vector<int64_t> rows;
+  std::vector<int8_t> kind;
+  std::vector<double> num;
+  std::vector<int64_t> str_offs;  // finalized to size n+1 after merge
+  std::vector<int32_t> codes;
+  Dict dict;
+};
+
 struct Scanner {
   std::vector<std::string> paths;
   std::string error;
@@ -336,9 +422,23 @@ struct Scanner {
   std::vector<float> rating;
   Dict events, entity_types, entities, targets;
 
+  std::unordered_map<std::string, int> prop_index;
+  std::vector<std::string> prop_keys;
+  std::vector<PropColumn> prop_cols;
+
   // dict string export buffers
   std::vector<char> blob;
   std::vector<int64_t> offsets;
+
+  PropColumn* prop_col(const std::string& key) {
+    auto it = prop_index.find(key);
+    if (it != prop_index.end()) return &prop_cols[it->second];
+    int idx = (int)prop_cols.size();
+    prop_index.emplace(key, idx);
+    prop_keys.push_back(key);
+    prop_cols.emplace_back();
+    return &prop_cols[idx];
+  }
 };
 
 bool read_file(const std::string& path, std::string* out, std::string* err) {
@@ -417,6 +517,7 @@ int64_t scan_run(void* h, int n_threads) {
   s->rating.reserve(total);
   for (auto& v : per_file) {
     for (auto& ev : v) {
+      int64_t row = (int64_t)s->event_code.size();
       s->event_code.push_back(s->events.add(ev.event));
       s->entity_type_code.push_back(s->entity_types.add(ev.entity_type));
       s->entity_code.push_back(s->entities.add(ev.entity_id));
@@ -424,9 +525,28 @@ int64_t scan_run(void* h, int n_threads) {
           ev.target_id.empty() ? -1 : s->targets.add(ev.target_id));
       s->time_us.push_back(ev.time_us);
       s->rating.push_back(ev.rating);
+      for (auto& kv : ev.props) {
+        PropColumn* col = s->prop_col(kv.first);
+        col->rows.push_back(row);
+        col->kind.push_back(kv.second.kind);
+        col->num.push_back(kv.second.num);
+        col->str_offs.push_back((int64_t)kv.second.strs.size());  // lengths now
+        for (auto& str : kv.second.strs) col->codes.push_back(col->dict.add(str));
+      }
     }
     v.clear();
     v.shrink_to_fit();
+  }
+  // finalize lengths -> exclusive-scan offsets [n+1]
+  for (auto& col : s->prop_cols) {
+    int64_t acc = 0;
+    col.str_offs.push_back(0);
+    for (size_t j = 0; j + 1 < col.str_offs.size(); j++) {
+      int64_t len = col.str_offs[j];
+      col.str_offs[j] = acc;
+      acc += len;
+    }
+    col.str_offs.back() = acc;
   }
   return (int64_t)s->event_code.size();
 }
@@ -472,5 +592,85 @@ int64_t scan_dict_export(void* h, int which) {
 
 const char* scan_dict_blob(void* h) { return ((Scanner*)h)->blob.data(); }
 const int64_t* scan_dict_offsets(void* h) { return ((Scanner*)h)->offsets.data(); }
+
+// ------------------------------ sparse property columns (discovered schema)
+
+int64_t scan_prop_count(void* h) { return (int64_t)((Scanner*)h)->prop_cols.size(); }
+
+// Key export is length-delimited (NOT c_str): JSON keys may contain
+// embedded NULs via the \u0000 escape, and truncation could silently collide two
+// distinct columns on the Python side.
+const char* scan_prop_key(void* h, int k) {
+  Scanner* s = (Scanner*)h;
+  if (k < 0 || (size_t)k >= s->prop_keys.size()) return nullptr;
+  return s->prop_keys[k].data();
+}
+
+int64_t scan_prop_key_len(void* h, int k) {
+  Scanner* s = (Scanner*)h;
+  if (k < 0 || (size_t)k >= s->prop_keys.size()) return -1;
+  return (int64_t)s->prop_keys[k].size();
+}
+
+static PropColumn* prop_by_id(void* h, int k) {
+  Scanner* s = (Scanner*)h;
+  if (k < 0 || (size_t)k >= s->prop_cols.size()) return nullptr;
+  return &s->prop_cols[k];
+}
+
+int64_t scan_prop_len(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? (int64_t)c->rows.size() : -1;
+}
+
+const int64_t* scan_prop_rows(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? c->rows.data() : nullptr;
+}
+
+const int8_t* scan_prop_kind(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? c->kind.data() : nullptr;
+}
+
+const double* scan_prop_num(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? c->num.data() : nullptr;
+}
+
+const int64_t* scan_prop_stroffs(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? c->str_offs.data() : nullptr;
+}
+
+const int32_t* scan_prop_codes(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? c->codes.data() : nullptr;
+}
+
+int64_t scan_prop_codes_len(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? (int64_t)c->codes.size() : -1;
+}
+
+int64_t scan_prop_dict_size(void* h, int k) {
+  PropColumn* c = prop_by_id(h, k);
+  return c ? (int64_t)c->dict.strings.size() : -1;
+}
+
+// Export a property column's dict via the shared blob/offsets buffers.
+int64_t scan_prop_dict_export(void* h, int k) {
+  Scanner* s = (Scanner*)h;
+  PropColumn* c = prop_by_id(h, k);
+  if (!c) return -1;
+  s->blob.clear();
+  s->offsets.clear();
+  s->offsets.push_back(0);
+  for (auto& str : c->dict.strings) {
+    s->blob.insert(s->blob.end(), str.begin(), str.end());
+    s->offsets.push_back((int64_t)s->blob.size());
+  }
+  return (int64_t)s->blob.size();
+}
 
 }  // extern "C"

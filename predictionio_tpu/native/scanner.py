@@ -73,6 +73,27 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             lib.scan_dict_blob.restype = ctypes.POINTER(ctypes.c_char)
             lib.scan_dict_offsets.argtypes = [ctypes.c_void_p]
             lib.scan_dict_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+            lib.scan_prop_count.argtypes = [ctypes.c_void_p]
+            lib.scan_prop_count.restype = ctypes.c_int64
+            lib.scan_prop_key.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.scan_prop_key.restype = ctypes.POINTER(ctypes.c_char)
+            lib.scan_prop_key_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.scan_prop_key_len.restype = ctypes.c_int64
+            for name, typ in [
+                ("scan_prop_rows", ctypes.POINTER(ctypes.c_int64)),
+                ("scan_prop_kind", ctypes.POINTER(ctypes.c_int8)),
+                ("scan_prop_num", ctypes.POINTER(ctypes.c_double)),
+                ("scan_prop_stroffs", ctypes.POINTER(ctypes.c_int64)),
+                ("scan_prop_codes", ctypes.POINTER(ctypes.c_int32)),
+            ]:
+                fn = getattr(lib, name)
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_int]
+                fn.restype = typ
+            for name in ("scan_prop_len", "scan_prop_codes_len",
+                         "scan_prop_dict_size", "scan_prop_dict_export"):
+                fn = getattr(lib, name)
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_int]
+                fn.restype = ctypes.c_int64
             lib.scan_free.argtypes = [ctypes.c_void_p]
             _lib = lib
             return lib
@@ -93,12 +114,21 @@ def _export_dict(lib, handle, which: int) -> List[str]:
         return []
     offsets = np.ctypeslib.as_array(lib.scan_dict_offsets(handle), shape=(n + 1,)).copy()
     blob = ctypes.string_at(lib.scan_dict_blob(handle), blob_len)
-    return [blob[offsets[i]:offsets[i + 1]].decode() for i in range(n)]
+    # surrogatepass: JSON may legally carry lone surrogates (Python's own
+    # json emits them); anything else malformed falls back to replacement
+    return [_decode(blob[offsets[i]:offsets[i + 1]]) for i in range(n)]
+
+
+def _decode(b: bytes) -> str:
+    try:
+        return b.decode("utf-8", "surrogatepass")
+    except UnicodeDecodeError:
+        return b.decode("utf-8", "replace")
 
 
 def scan_segments(paths: Sequence[os.PathLike], n_threads: int = 0):
     """Parse JSONL event segments into an EventBatch (native path)."""
-    from predictionio_tpu.store.columnar import EventBatch, IdDict
+    from predictionio_tpu.store.columnar import EventBatch, IdDict, PropColumn
 
     lib = _build_and_load()
     if lib is None:
@@ -118,6 +148,37 @@ def scan_segments(paths: Sequence[os.PathLike], n_threads: int = 0):
                 return np.empty(0, dtype)
             return np.ctypeslib.as_array(fn(handle), shape=(rows,)).astype(dtype, copy=True)
 
+        def arr(ptr, n, dtype):
+            if n == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+        props = {}
+        for k in range(lib.scan_prop_count(handle)):
+            key = ctypes.string_at(lib.scan_prop_key(handle, k),
+                                   lib.scan_prop_key_len(handle, k))
+            n = lib.scan_prop_len(handle, k)
+            nc = lib.scan_prop_codes_len(handle, k)
+            nd = lib.scan_prop_dict_size(handle, k)
+            blob_len = lib.scan_prop_dict_export(handle, k)
+            if nd > 0 and blob_len >= 0:
+                offsets = np.ctypeslib.as_array(
+                    lib.scan_dict_offsets(handle), shape=(nd + 1,)).copy()
+                blob = ctypes.string_at(lib.scan_dict_blob(handle), blob_len)
+                strings = [_decode(blob[offsets[i]:offsets[i + 1]]) for i in range(nd)]
+            else:
+                strings = []
+            props[_decode(key)] = PropColumn(
+                rows=arr(lib.scan_prop_rows(handle, k), n, np.int64),
+                kind=arr(lib.scan_prop_kind(handle, k), n, np.int8),
+                num=arr(lib.scan_prop_num(handle, k), n, np.float64),
+                str_offs=arr(lib.scan_prop_stroffs(handle, k),
+                             n + 1 if n else 0, np.int64)
+                if n else np.zeros(1, np.int64),
+                codes=arr(lib.scan_prop_codes(handle, k), nc, np.int32),
+                dict=IdDict.from_state(strings),
+            )
+
         batch = EventBatch(
             event_codes=col(lib.scan_col_event, np.int32),
             entity_type_codes=col(lib.scan_col_entity_type, np.int32),
@@ -129,6 +190,7 @@ def scan_segments(paths: Sequence[os.PathLike], n_threads: int = 0):
             entity_type_dict=IdDict.from_state(_export_dict(lib, handle, 1)),
             entity_dict=IdDict.from_state(_export_dict(lib, handle, 2)),
             target_dict=IdDict.from_state(_export_dict(lib, handle, 3)),
+            prop_columns=props,
         )
         return batch
     finally:

@@ -134,11 +134,78 @@ class CSRLookup:
 
 
 @dataclass
+class PropColumn:
+    """Sparse per-key property column (discovered schema; native scanner).
+
+    Entry j belongs to batch row ``rows[j]``; ``kind[j]`` is 0 num, 1 bool,
+    2 str, 3 str-list; numbers/bools live in ``num``, strings as
+    dictionary codes in ``codes[str_offs[j]:str_offs[j+1]]``.
+    """
+
+    rows: np.ndarray      # int64 [n], ascending
+    kind: np.ndarray      # int8 [n]
+    num: np.ndarray       # f64 [n]
+    str_offs: np.ndarray  # int64 [n+1]
+    codes: np.ndarray     # int32 [total strings]
+    dict: IdDict
+
+    def __len__(self) -> int:
+        return int(self.rows.shape[0])
+
+    def value_at(self, j: int):
+        k = int(self.kind[j])
+        if k == 0:
+            v = float(self.num[j])
+            return int(v) if v.is_integer() else v
+        if k == 1:
+            return bool(self.num[j])
+        if k == 4:
+            return None
+        s, e = int(self.str_offs[j]), int(self.str_offs[j + 1])
+        strs = [self.dict.str(int(c)) for c in self.codes[s:e]]
+        if k == 5:   # nested object kept as its raw JSON span
+            import json as _json
+
+            try:
+                return _json.loads(strs[0]) if strs else None
+            except ValueError:
+                return None
+        return strs if k == 3 else (strs[0] if strs else "")
+
+    def remap_rows(self, new_row_of: np.ndarray) -> "PropColumn":
+        """Column for a row-subset: ``new_row_of[old_row]`` is the new row
+        index or -1 if dropped."""
+        nr = new_row_of[self.rows]
+        keep = nr >= 0
+        if keep.all():
+            return PropColumn(nr, self.kind, self.num, self.str_offs,
+                              self.codes, self.dict)
+        idx = np.flatnonzero(keep)
+        lens = np.diff(self.str_offs)[idx]
+        offs = np.zeros(len(idx) + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        total = int(offs[-1])
+        if total == 0:
+            codes = np.empty(0, np.int32)
+        else:
+            # vectorized ragged gather: source position = kept entry's start
+            # + intra-entry offset (no per-entry Python loop)
+            starts = self.str_offs[idx]
+            gather = np.arange(total, dtype=np.int64) + np.repeat(
+                starts - offs[:-1], lens)
+            codes = self.codes[gather]
+        return PropColumn(nr[keep], self.kind[keep], self.num[keep],
+                          offs, codes.astype(np.int32), self.dict)
+
+
+@dataclass
 class EventBatch:
     """Struct-of-arrays block of events.
 
     Columns are parallel arrays of length N; string columns are dictionary
-    encoded.  ``target_ids`` rows with no target are -1.
+    encoded.  ``target_ids`` rows with no target are -1.  ``prop_columns``
+    (native-scan path) holds the FULL property maps as sparse per-key
+    columns; None means only the legacy ``ratings`` column is available.
     """
 
     event_codes: np.ndarray      # int32 [N] → event_dict
@@ -151,6 +218,7 @@ class EventBatch:
     entity_type_dict: IdDict
     entity_dict: IdDict
     target_dict: IdDict
+    prop_columns: Optional[Dict[str, PropColumn]] = None
 
     def __len__(self) -> int:
         return int(self.event_codes.shape[0])
@@ -225,10 +293,16 @@ class EventBatch:
 
     def subset(self, mask: np.ndarray) -> "EventBatch":
         """Row-filter by boolean mask; dictionaries are shared."""
+        props = None
+        if self.prop_columns is not None:
+            new_row_of = np.full(len(self), -1, np.int64)
+            new_row_of[mask] = np.arange(int(mask.sum()), dtype=np.int64)
+            props = {k: c.remap_rows(new_row_of) for k, c in self.prop_columns.items()}
         return EventBatch(
             self.event_codes[mask], self.entity_type_codes[mask], self.entity_ids[mask],
             self.target_ids[mask], self.times_us[mask], self.ratings[mask],
             self.event_dict, self.entity_type_dict, self.entity_dict, self.target_dict,
+            prop_columns=props,
         )
 
     def select_events(self, names: Sequence[str]) -> "EventBatch":
@@ -236,8 +310,71 @@ class EventBatch:
         codes = [self.event_dict.id(n) for n in names]
         codes = [c for c in codes if c is not None]
         mask = np.isin(self.event_codes, np.asarray(codes, np.int32))
-        return EventBatch(
-            self.event_codes[mask], self.entity_type_codes[mask], self.entity_ids[mask],
-            self.target_ids[mask], self.times_us[mask], self.ratings[mask],
-            self.event_dict, self.entity_type_dict, self.entity_dict, self.target_dict,
-        )
+        return self.subset(mask)
+
+
+def fold_properties(batch: EventBatch, entity_type: Optional[str] = None):
+    """Columnar $set/$unset/$delete folding over a native-scanned batch —
+    the C++-path analogue of events.event.aggregate_properties (reference:
+    LEventAggregator.aggregateProperties): events apply in (eventTime,
+    row) order; $set merges keys, $unset removes named keys, $delete drops
+    the snapshot.  Only the special-event rows are touched in Python; the
+    scan/parse/encode of everything else stayed native."""
+    from predictionio_tpu.events.event import (
+        DELETE_EVENT, SET_EVENT, SPECIAL_EVENTS, UNSET_EVENT, PropertyMap,
+    )
+
+    if batch.prop_columns is None:
+        raise ValueError("fold_properties requires a batch with prop_columns")
+    special_codes = [batch.event_dict.id(n) for n in SPECIAL_EVENTS]
+    special_codes = np.asarray(
+        [c for c in special_codes if c is not None], np.int32)
+    sel = np.isin(batch.event_codes, special_codes)
+    if entity_type is not None:
+        et = batch.entity_type_dict.id(entity_type)
+        sel &= batch.entity_type_codes == (et if et is not None else -2)
+    rows = np.flatnonzero(sel)
+    if not len(rows):
+        return {}
+    order = np.lexsort((rows, batch.times_us[rows]))
+    rows = rows[order]
+    # per-selected-row property entries, gathered column-wise (col.rows is
+    # ascending, so searchsorted finds each row's entry in O(log n))
+    row_props: Dict[int, list] = {int(r): [] for r in rows}
+    for key, col in batch.prop_columns.items():
+        pos = np.searchsorted(col.rows, rows)
+        hit = (pos < len(col)) & (col.rows[np.minimum(pos, len(col) - 1)] == rows)
+        for r, j in zip(rows[hit], pos[hit]):
+            row_props[int(r)].append((key, col, int(j)))
+    import datetime as _dt
+
+    def ts(r):
+        return _dt.datetime.fromtimestamp(
+            batch.times_us[r] / 1e6, tz=_dt.timezone.utc)
+
+    set_c = batch.event_dict.id(SET_EVENT)
+    unset_c = batch.event_dict.id(UNSET_EVENT)
+    del_c = batch.event_dict.id(DELETE_EVENT)
+    snap: Dict[str, PropertyMap] = {}
+    for r in rows:
+        code = batch.event_codes[r]
+        eid = batch.entity_dict.str(int(batch.entity_ids[r]))
+        if code == del_c:
+            snap.pop(eid, None)
+            continue
+        cur = snap.get(eid)
+        when = ts(r)
+        if code == set_c:
+            if cur is None:
+                cur = PropertyMap({}, first_updated=when, last_updated=when)
+                snap[eid] = cur
+            for key, col, j in row_props[int(r)]:
+                cur[key] = col.value_at(j)
+            cur.last_updated = max(cur.last_updated, when)
+        elif code == unset_c:
+            if cur is None:
+                continue
+            for key, _col, _j in row_props[int(r)]:
+                cur.pop(key, None)
+            cur.last_updated = max(cur.last_updated, when)
+    return snap

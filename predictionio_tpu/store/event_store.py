@@ -167,6 +167,18 @@ class PEventStore:
         storage: Optional[Storage] = None,
     ) -> Dict[str, PropertyMap]:
         storage = storage or get_storage()
+        # fast path: native scan of the special events + columnar fold
+        # (full property maps ride the C++ parser; only the $set/$unset/
+        # $delete rows are touched in Python)
+        from predictionio_tpu.events.event import SPECIAL_EVENTS
+        from predictionio_tpu.store.columnar import fold_properties
+
+        native = PEventStore._native_batch(
+            app_name, channel_name, list(SPECIAL_EVENTS), entity_type,
+            start_time, until_time, storage,
+        )
+        if native is not None and native.prop_columns is not None:
+            return fold_properties(native)
         app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
         return storage.l_events.aggregate_properties(
             app_id,
