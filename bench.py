@@ -298,6 +298,64 @@ def bench_scan(smoke: bool) -> float:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_ingest(smoke: bool) -> dict:
+    """Event-server ingest throughput over real HTTP against the localfs
+    backend: batch endpoint (50-event batches, the reference's batch limit)
+    and single-event POSTs, under the default fsync policy (PIO_FSYNC=rotate)."""
+    import os
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.api.event_server import run_event_server
+    from predictionio_tpu.storage import AccessKey, App
+    from predictionio_tpu.storage.locator import Storage, StorageConfig
+
+    n_batch_events, n_single = (2_000, 200) if smoke else (100_000, 2_000)
+    os.environ["PIO_FSYNC"] = "rotate"   # pin the measured durability policy
+    tmp = tempfile.mkdtemp(prefix="pio_bench_ingest")
+    try:
+        storage = Storage(StorageConfig(
+            sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
+            repositories={r: "FS" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+        ))
+        app_id = storage.apps.insert(App(0, "ingestapp"))
+        key = storage.access_keys.insert(AccessKey("", app_id, []))
+        httpd = run_event_server(host="127.0.0.1", port=0, storage=storage,
+                                 background=True)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            def ev(k):
+                return {"event": "buy", "entityType": "user",
+                        "entityId": f"u{k % 1000}",
+                        "targetEntityType": "item", "targetEntityId": f"i{k % 5000}",
+                        "properties": {"price": 1.0 + (k % 7)}}
+
+            # warm
+            _http_post(f"{base}/events.json?accessKey={key}", ev(0))
+            t0 = time.perf_counter()
+            for s in range(0, n_batch_events, 50):
+                status, body = _http_post(
+                    f"{base}/batch/events.json?accessKey={key}",
+                    [ev(k) for k in range(s, min(s + 50, n_batch_events))])
+                assert status == 200, body
+            batch_rate = n_batch_events / (time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            for k in range(n_single):
+                status, body = _http_post(f"{base}/events.json?accessKey={key}", ev(k))
+                assert status == 201, body
+            single_rate = n_single / (time.perf_counter() - t0)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        return {
+            "ingest_batch_events_per_sec": batch_rate,
+            "ingest_single_events_per_sec": single_rate,
+            "fsync_policy": "rotate",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_scale(smoke: bool) -> dict:
     """North-star scale slice: the TILED CCO path (the strategy the
     1B-event story depends on — the full count matrix never materializes)
@@ -397,7 +455,8 @@ def _run_isolated(which: str, smoke: bool):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
-    ap.add_argument("--only", choices=["ur", "p50", "als", "scan", "http", "scale"],
+    ap.add_argument("--only",
+                    choices=["ur", "p50", "als", "scan", "http", "scale", "ingest"],
                     default=None)
     ap.add_argument("--scale", action="store_true",
                     help="run only the 1B-scale tiled-path slice")
@@ -419,6 +478,7 @@ def main() -> int:
             "scan": lambda: {"events_per_sec": bench_scan(args.smoke)},
             "http": lambda: bench_http(args.smoke),
             "scale": lambda: bench_scale(args.smoke),
+            "ingest": lambda: bench_ingest(args.smoke),
         }[args.only]()
         print(json.dumps(out))
         return 0
@@ -429,6 +489,7 @@ def main() -> int:
     scan = _run_isolated("scan", args.smoke)["events_per_sec"]
     http = _run_isolated("http", args.smoke)
     scale = _run_isolated("scale", args.smoke)
+    ingest = _run_isolated("ingest", args.smoke)
     p50 = http["ur_http_p50_ms"]   # the served path IS the north-star metric
 
     result = {
@@ -459,6 +520,9 @@ def main() -> int:
             "scale_n_items": scale["n_items"],
             "scale_peak_hbm_bytes": scale["peak_hbm_bytes"],
             "scale_parity": scale["parity"],
+            "ingest_batch_events_per_sec": round(ingest["ingest_batch_events_per_sec"], 1),
+            "ingest_single_events_per_sec": round(ingest["ingest_single_events_per_sec"], 1),
+            "ingest_fsync_policy": ingest["fsync_policy"],
         },
     }
     print(json.dumps(result))

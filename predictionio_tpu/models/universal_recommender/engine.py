@@ -195,6 +195,9 @@ class URDataSource(DataSource):
         interactions: Dict[str, Tuple[np.ndarray, np.ndarray, IdDict, np.ndarray]] = {}
         batch = PEventStore.batch(
             self.params.app_name, event_names=list(self.params.event_names))
+        # interactions never read property columns; dropping them here keeps
+        # the per-event-type select_events() from remapping every column
+        batch = dataclasses.replace(batch, prop_columns=None)
         # entity codes → one global user id space.  Only codes REFERENCED by
         # interaction rows enroll (the scan's shared entity_dict also holds
         # $set item ids etc.; enrolling those would inflate n_users and

@@ -259,9 +259,9 @@ bool parse_iso8601_us(const std::string& s, int64_t* out) {
   return true;
 }
 
-// Parse one property VALUE into pv (see PropValue kinds).  Unsupported
-// shapes (nested objects, null, lists with nested containers) are skipped
-// structurally with kind -1 — the line still parses.
+// Parse one property VALUE into pv (see PropValue kinds): nulls keep
+// kind 4, nested objects keep their raw JSON span as kind 5; only nested
+// containers INSIDE lists are skipped structurally — the line still parses.
 bool parse_prop_value(Parser& ps, PropValue* pv) {
   ps.skip_ws();
   if (ps.p >= ps.end) { ps.ok = false; return false; }

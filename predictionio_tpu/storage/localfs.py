@@ -40,6 +40,77 @@ SEGMENT_MAX_BYTES = 64 << 20  # rotate segments at 64 MiB
 DEFAULT_CHANNEL = "_default"
 
 
+def _fsync_policy() -> str:
+    """Ingest durability policy (PIO_FSYNC):
+
+    - ``rotate`` (default): fsync only when a segment rotates or the writer
+      closes — a crash can lose the OS-buffered tail of the active segment,
+      like the reference's HBase deferred-WAL-flush mode.
+    - ``always``: fsync after every append — no acknowledged event is ever
+      lost, at a per-request latency cost.
+    - ``interval:<ms>``: fsync at most every <ms> milliseconds — bounded
+      loss window, group-commit throughput.
+    - ``never``: leave it entirely to the OS.
+    """
+    return os.environ.get("PIO_FSYNC", "rotate").lower()
+
+
+class _SegmentWriter:
+    """Kept-open appender for one (app, channel) log.
+
+    The previous write path re-opened the active segment per insert (open +
+    append + close per HTTP request); this holds the handle open, appends
+    with one write(), and applies the PIO_FSYNC durability policy.  Callers
+    serialize via FSEvents._lock; writes use O_APPEND semantics so external
+    writers to the same directory stay safe."""
+
+    def __init__(self, d: Path):
+        self._dir = d
+        self._f = None
+        self._last_sync = 0.0
+
+    def append(self, text: str) -> None:
+        import time as _time
+
+        if self._f is None or self._f.tell() >= SEGMENT_MAX_BYTES:
+            self._open_next()
+        self._f.write(text)
+        self._f.flush()
+        policy = _fsync_policy()
+        if policy == "always":
+            os.fsync(self._f.fileno())
+        elif policy.startswith("interval:"):
+            try:
+                every = float(policy.split(":", 1)[1]) / 1e3
+            except ValueError:
+                every = 0.1
+            now = _time.monotonic()
+            if now - self._last_sync >= every:
+                os.fsync(self._f.fileno())
+                self._last_sync = now
+
+    def _open_next(self) -> None:
+        self.close()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        segs = sorted(self._dir.glob("seg-*.jsonl"))
+        if segs and segs[-1].stat().st_size < SEGMENT_MAX_BYTES:
+            path = segs[-1]
+        else:
+            n = int(segs[-1].stem.split("-")[1]) + 1 if segs else 0
+            path = self._dir / f"seg-{n:05d}.jsonl"
+        self._f = open(path, "a")
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.flush()
+                if _fsync_policy() != "never":
+                    os.fsync(self._f.fileno())
+            finally:
+                self._f.close()
+                self._f = None
+
+
 def _atomic_write(path: Path, text: str) -> None:
     tmp = path.with_suffix(path.suffix + f".tmp{os.getpid()}")
     tmp.write_text(text)
@@ -492,6 +563,7 @@ class FSEvents(base.LEvents, base.PEvents):
         self._root = Path(root) / "events"
         self._lock = threading.Lock()
         self._indexes: Dict[tuple, _EntityIndex] = {}
+        self._writers: Dict[tuple, _SegmentWriter] = {}
 
     def _entity_index(self, app_id: int, channel_id: Optional[int]) -> _EntityIndex:
         key = (app_id, channel_id)
@@ -512,13 +584,6 @@ class FSEvents(base.LEvents, base.PEvents):
             return []
         return sorted(d.glob("seg-*.jsonl"))
 
-    def _active_segment(self, d: Path) -> Path:
-        segs = sorted(d.glob("seg-*.jsonl"))
-        if segs and segs[-1].stat().st_size < SEGMENT_MAX_BYTES:
-            return segs[-1]
-        n = int(segs[-1].stem.split("-")[1]) + 1 if segs else 0
-        return d / f"seg-{n:05d}.jsonl"
-
     def _tombstones(self, d: Path) -> set:
         p = d / "tombstones.txt"
         if not p.exists():
@@ -537,6 +602,9 @@ class FSEvents(base.LEvents, base.PEvents):
         d = self._chan_dir(app_id, channel_id)
         with self._lock:
             self._indexes.pop((app_id, channel_id), None)  # data-delete invalidates
+            w = self._writers.pop((app_id, channel_id), None)
+            if w is not None:
+                w.close()
         if d.exists():
             shutil.rmtree(d)
             return True
@@ -548,13 +616,14 @@ class FSEvents(base.LEvents, base.PEvents):
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> List[str]:
-        d = self._chan_dir(app_id, channel_id)
-        d.mkdir(parents=True, exist_ok=True)
         lines = "".join(e.to_json_line() + "\n" for e in events)
+        key = (app_id, channel_id)
         with self._lock:
-            seg = self._active_segment(d)
-            with open(seg, "a") as f:
-                f.write(lines)
+            w = self._writers.get(key)
+            if w is None:
+                w = self._writers[key] = _SegmentWriter(
+                    self._chan_dir(app_id, channel_id))
+            w.append(lines)
         return [e.event_id for e in events]
 
     def _iter_raw(self, app_id: int, channel_id: Optional[int]) -> Iterator[Event]:

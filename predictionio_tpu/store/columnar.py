@@ -342,6 +342,8 @@ def fold_properties(batch: EventBatch, entity_type: Optional[str] = None):
     # ascending, so searchsorted finds each row's entry in O(log n))
     row_props: Dict[int, list] = {int(r): [] for r in rows}
     for key, col in batch.prop_columns.items():
+        if len(col) == 0:   # key exists only on filtered-out rows
+            continue
         pos = np.searchsorted(col.rows, rows)
         hit = (pos < len(col)) & (col.rows[np.minimum(pos, len(col) - 1)] == rows)
         for r, j in zip(rows[hit], pos[hit]):

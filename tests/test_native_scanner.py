@@ -297,3 +297,19 @@ def test_hostile_property_keys(tmp_path):
     assert "a" in pc and "a\x00b" in pc
     assert len(pc["a"]) == 2 and len(pc["a\x00b"]) == 1
     assert len([k for k in pc if k.endswith("key")]) == 1
+
+
+def test_fold_with_interaction_only_property_keys(fs_storage):
+    """A property key that appears only on non-special events (e.g. price
+    on buy) must not break aggregate_properties — its column is empty after
+    the special-event filter."""
+    app_id = fs_storage.apps.insert(App(0, "mixprops"))
+    fs_storage.l_events.insert_batch([
+        Event(event="buy", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"price": 3.5}), event_time=ts(1)),
+        Event(event="$set", entity_type="item", entity_id="i1",
+              properties=DataMap({"category": "x"}), event_time=ts(2)),
+    ], app_id)
+    props = PEventStore.aggregate_properties("mixprops", "item", storage=fs_storage)
+    assert dict(props["i1"]) == {"category": "x"}

@@ -250,3 +250,26 @@ def test_localfs_entity_index_survives_reimport(tmp_path):
          for k in range(40)], 1)
     got = list(reader.find(1, entity_type="user", entity_id="u1"))
     assert len(got) == 40 and all(e.target_entity_id.startswith("big") for e in got)
+
+
+def test_segment_writer_rotation_and_fsync_policies(tmp_path, monkeypatch):
+    """The kept-open writer rotates segments at the size cap and honors
+    every PIO_FSYNC durability policy without losing events."""
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.storage import localfs as lf
+
+    monkeypatch.setattr(lf, "SEGMENT_MAX_BYTES", 4096)
+    for policy in ("rotate", "always", "interval:5", "never"):
+        monkeypatch.setenv("PIO_FSYNC", policy)
+        root = tmp_path / f"s_{policy.replace(':', '_')}"
+        ev = lf.FSEvents(root)
+        ids = []
+        for k in range(40):
+            ids.extend(ev.insert_batch(
+                [Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                       target_entity_type="item", target_entity_id=f"i{j}")
+                 for j in range(5)], app_id=1))
+        segs = ev.segment_paths(1)
+        assert len(segs) > 1, f"no rotation under {policy}"
+        got = sum(1 for _ in ev._iter_raw(1, None))
+        assert got == 200 and len(set(ids)) == 200
