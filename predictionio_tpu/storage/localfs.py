@@ -72,6 +72,16 @@ class _SegmentWriter:
     def append(self, text: str) -> None:
         import time as _time
 
+        if self._f is not None:
+            # a data-delete/re-import from ANY process may have unlinked the
+            # segment under us; writing on would ack events into an orphaned
+            # inode (nlink 0) that no reader can ever see
+            try:
+                if os.fstat(self._f.fileno()).st_nlink == 0:
+                    self._f.close()
+                    self._f = None
+            except OSError:
+                self._f = None
         if self._f is None or self._f.tell() >= SEGMENT_MAX_BYTES:
             self._open_next()
         self._f.write(text)
@@ -106,6 +116,8 @@ class _SegmentWriter:
                 self._f.flush()
                 if _fsync_policy() != "never":
                     os.fsync(self._f.fileno())
+            except OSError:
+                pass  # handle invalidated externally; nothing to persist
             finally:
                 self._f.close()
                 self._f = None
@@ -585,10 +597,13 @@ class FSEvents(base.LEvents, base.PEvents):
         return sorted(d.glob("seg-*.jsonl"))
 
     def _tombstones(self, d: Path) -> set:
-        p = d / "tombstones.txt"
-        if not p.exists():
-            return set()
-        return set(p.read_text().split())
+        # union of all tombstone files: "tombstones.txt" (single-writer
+        # localfs) and per-writer "tombstones-<writer>.txt" (sharedfs)
+        dead: set = set()
+        if d.exists():
+            for p in d.glob("tombstones*.txt"):
+                dead.update(p.read_text().split())
+        return dead
 
     # -- LEvents -------------------------------------------------------------
 
@@ -613,6 +628,14 @@ class FSEvents(base.LEvents, base.PEvents):
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]
 
+    def _new_writer(self, d: Path) -> _SegmentWriter:
+        """Writer factory hook (sharedfs overrides with per-writer naming)."""
+        return _SegmentWriter(d)
+
+    def _tombstone_path(self, d: Path) -> Path:
+        """Tombstone file hook (sharedfs overrides with per-writer naming)."""
+        return d / "tombstones.txt"
+
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> List[str]:
@@ -621,7 +644,7 @@ class FSEvents(base.LEvents, base.PEvents):
         with self._lock:
             w = self._writers.get(key)
             if w is None:
-                w = self._writers[key] = _SegmentWriter(
+                w = self._writers[key] = self._new_writer(
                     self._chan_dir(app_id, channel_id))
             w.append(lines)
         return [e.event_id for e in events]
@@ -648,7 +671,7 @@ class FSEvents(base.LEvents, base.PEvents):
             # Single pass under the lock: confirm the id is live, then tombstone.
             if not any(e.event_id == event_id for e in self._iter_raw(app_id, channel_id)):
                 return False
-            with open(d / "tombstones.txt", "a") as f:
+            with open(self._tombstone_path(d), "a") as f:
                 f.write(event_id + "\n")
         return True
 

@@ -82,7 +82,18 @@ class _LocalFSSource:
         self.events = localfs.FSEvents(root)
 
 
-_SOURCE_TYPES = {"memory": _MemorySource, "localfs": _LocalFSSource, "sql": sql.SQLSource}
+def _sharedfs_source(path: str):
+    from predictionio_tpu.storage import sharedfs
+
+    return sharedfs.SharedFSSource(path)
+
+
+_SOURCE_TYPES = {
+    "memory": _MemorySource,
+    "localfs": _LocalFSSource,
+    "sql": sql.SQLSource,
+    "sharedfs": _sharedfs_source,
+}
 
 
 class Storage:
@@ -103,7 +114,7 @@ class Storage:
                     raise ValueError(
                         f"unknown storage source type {typ!r} (have: {sorted(_SOURCE_TYPES)})"
                     )
-                if typ == "localfs":
+                if typ in ("localfs", "sharedfs"):
                     self._clients[name] = _SOURCE_TYPES[typ](spec.get("path", ".pio_store"))
                 elif typ == "sql":
                     # reference JDBC URL ≈ our path; default is an ephemeral db

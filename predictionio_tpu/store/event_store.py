@@ -133,8 +133,8 @@ class PEventStore:
         # and event-strided processes would partition different spaces and
         # drop events globally.  (All hosts must also run the same image so
         # native_available() agrees; the scanner builds from source on use.)
-        tomb = paths[0].parent / "tombstones.txt"
-        if tomb.exists() and tomb.stat().st_size > 0:
+        if any(t.stat().st_size > 0
+               for t in paths[0].parent.glob("tombstones*.txt")):
             return None  # tombstoned events are invisible to the scanner
         if local_shard:
             from predictionio_tpu.parallel import distributed as dist

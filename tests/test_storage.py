@@ -14,7 +14,7 @@ def ts(h):
     return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
 
 
-@pytest.fixture(params=["memory", "localfs", "sql", "sqlfile"])
+@pytest.fixture(params=["memory", "localfs", "sql", "sqlfile", "sharedfs"])
 def storage(request, tmp_path):
     if request.param == "memory":
         src = {"type": "memory"}
@@ -22,6 +22,8 @@ def storage(request, tmp_path):
         src = {"type": "localfs", "path": str(tmp_path / "store")}
     elif request.param == "sql":
         src = {"type": "sql", "path": ":memory:"}
+    elif request.param == "sharedfs":
+        src = {"type": "sharedfs", "path": str(tmp_path / "shared")}
     else:
         src = {"type": "sql", "path": str(tmp_path / "pio.db")}
     cfg = StorageConfig(
@@ -273,3 +275,138 @@ def test_segment_writer_rotation_and_fsync_policies(tmp_path, monkeypatch):
         assert len(segs) > 1, f"no rotation under {policy}"
         got = sum(1 for _ in ev._iter_raw(1, None))
         assert got == 200 and len(set(ids)) == 200
+
+
+# -- sharedfs: multi-host system-of-record ----------------------------------
+
+
+def _shared_events(tmp_path, tag, monkeypatch=None):
+    from predictionio_tpu.storage import sharedfs
+
+    # a writer on another host = an instance with its own writer tag
+    return sharedfs.SharedFSEvents(tmp_path / "shared", writer_tag=tag)
+
+
+def test_sharedfs_concurrent_writers_one_log(tmp_path, monkeypatch):
+    """Two writer processes (different hosts) ingest into the SAME (app,
+    channel) concurrently; every reader sees the union, and segments never
+    collide (per-writer naming)."""
+    w1 = _shared_events(tmp_path, "hostA-1", monkeypatch)
+    w2 = _shared_events(tmp_path, "hostB-2", monkeypatch)
+    for k in range(30):
+        w = w1 if k % 2 else w2
+        w.insert_batch([Event(event="buy", entity_type="user",
+                              entity_id=f"u{k}", target_entity_type="item",
+                              target_entity_id=f"i{k % 7}", event_time=ts(k % 20))],
+                       app_id=1)
+    # a fresh reader (third host) sees all 30
+    from predictionio_tpu.storage import sharedfs
+
+    reader = sharedfs.SharedFSEvents(tmp_path / "shared")
+    assert sum(1 for _ in reader._iter_raw(1, None)) == 30
+    segs = reader.segment_paths(1)
+    tags = {s.name.split("-")[1] for s in segs}
+    assert tags == {"hostA", "hostB"}
+    # tombstone from one writer hides the event for every reader
+    victim = next(reader._iter_raw(1, None)).event_id
+    assert w2.delete(victim, 1)
+    assert all(e.event_id != victim for e in reader._iter_raw(1, None))
+
+
+def test_sharedfs_host_sharded_scan_covers_log(tmp_path, monkeypatch):
+    """distributed.shard_segments over sharedfs segments: every process
+    reads a disjoint share and the union is the full log."""
+    from predictionio_tpu.parallel import distributed as dist
+    from predictionio_tpu.storage import localfs as lf, sharedfs
+
+    monkeypatch.setattr(lf, "SEGMENT_MAX_BYTES", 2048)  # force rotations
+    w1 = _shared_events(tmp_path, "hostA-1", monkeypatch)
+    w2 = _shared_events(tmp_path, "hostB-2", monkeypatch)
+    for k in range(200):
+        (w1 if k % 2 else w2).insert_batch(
+            [Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                   target_entity_type="item", target_entity_id=f"i{k % 11}")],
+            app_id=1)
+    reader = sharedfs.SharedFSEvents(tmp_path / "shared")
+    segs = reader.segment_paths(1)
+    assert len(segs) >= 4
+    seen = []
+    for pid in range(3):
+        mine = dist.shard_segments(segs, n_processes=3, process_id=pid)
+        for seg in mine:
+            seen.extend(l for l in seg.read_text().splitlines() if l.strip())
+    assert len(seen) == 200
+    # disjoint: no segment assigned twice
+    all_assigned = [s for pid in range(3)
+                    for s in dist.shard_segments(segs, n_processes=3, process_id=pid)]
+    assert len(all_assigned) == len(set(all_assigned)) == len(segs)
+
+
+def test_sharedfs_native_scan_and_training(tmp_path, monkeypatch):
+    """The native scanner + UR training run unchanged over per-writer
+    sharedfs segments."""
+    pytest.importorskip("predictionio_tpu.native")
+    from predictionio_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native scanner unavailable")
+    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
+    from predictionio_tpu.store.event_store import PEventStore
+
+    storage = Storage(StorageConfig(
+        sources={"S": {"type": "sharedfs", "path": str(tmp_path / "shared")}},
+        repositories={r: "S" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+    ))
+    app_id = storage.apps.insert(App(0, "shapp"))
+    evs = [Event(event="buy", entity_type="user", entity_id=f"u{k % 9}",
+                 target_entity_type="item", target_entity_id=f"i{k % 5}")
+           for k in range(60)]
+    storage.l_events.insert_batch(evs, app_id)
+    batch = PEventStore.batch("shapp", storage=storage)
+    assert len(batch) == 60 and batch.prop_columns is not None
+
+
+def test_sharedfs_app_insert_crash_recovery(tmp_path):
+    """A crash between the name claim and the id claim leaves a repairable
+    record: retrying the insert completes it instead of wedging the name."""
+    from predictionio_tpu.storage import sharedfs
+
+    apps = sharedfs.SharedApps(tmp_path / "shared")
+    # simulate the crash: phase-1 record exists with id 0, no id claim
+    from predictionio_tpu.storage.sharedfs import _safe_name
+
+    apps._names.put_new(_safe_name("wedged"), {"id": 0, "name": "wedged",
+                                               "description": ""})
+    assert apps.get_by_name("wedged") is None  # incomplete → invisible
+    app_id = apps.insert(App(0, "wedged", "retried"))
+    assert app_id and apps.get_by_name("wedged").id == app_id
+    assert apps.get(app_id).name == "wedged"
+
+
+def test_sharedfs_channel_id_collision_probes(tmp_path, monkeypatch):
+    """Two channels whose hash ids collide get DISTINCT ids (probed), so
+    their event directories never merge."""
+    from predictionio_tpu.storage import sharedfs
+
+    chans = sharedfs.SharedChannels(tmp_path / "shared")
+    monkeypatch.setattr(sharedfs.zlib, "crc32", lambda b: 42)  # force collision
+    c1 = chans.insert(Channel(0, "one", 1))
+    c2 = chans.insert(Channel(0, "two", 1))
+    assert c1 and c2 and c1 != c2
+    assert chans.get(c1).name == "one" and chans.get(c2).name == "two"
+
+
+def test_writer_survives_external_data_delete(tmp_path):
+    """Events POSTed after another process data-deletes the channel land in
+    a fresh segment, not an unlinked inode (kept-open writer regression)."""
+    import shutil
+
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    ev = FSEvents(tmp_path)
+    ev.insert(Event(event="buy", entity_type="user", entity_id="u1"), 1)
+    # another process deletes the app's data out from under the writer
+    shutil.rmtree(ev._chan_dir(1, None))
+    ev2 = FSEvents(tmp_path)  # reader in a third process
+    ev.insert(Event(event="buy", entity_type="user", entity_id="u2"), 1)
+    got = [e.entity_id for e in ev2._iter_raw(1, None)]
+    assert got == ["u2"]
