@@ -378,6 +378,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     ev = sub.add_parser("eval")
     ev.add_argument("evaluation_class")
+    ev.add_argument("params_generator", nargs="?", default=None,
+                    help="dotted path to an EngineParamsGenerator supplying "
+                         "the candidate grid (reference: pio eval's second arg)")
     ev.add_argument("--engine-json", default="engine.json")
     ev.set_defaults(func=_cmd_eval)
 

@@ -38,13 +38,16 @@ class EngineParams:
     serving_params: Params = dataclasses.field(default_factory=EmptyParams)
 
     def to_json(self) -> Dict[str, Any]:
+        def pj(p):  # Params object or a plain dict from engine.json binding
+            return p.to_json() if hasattr(p, "to_json") else p
+
         return {
-            "dataSourceParams": self.data_source_params.to_json(),
-            "preparatorParams": self.preparator_params.to_json(),
+            "dataSourceParams": pj(self.data_source_params),
+            "preparatorParams": pj(self.preparator_params),
             "algorithmParamsList": [
-                {"name": name, "params": p.to_json()} for name, p in self.algorithm_params_list
+                {"name": name, "params": pj(p)} for name, p in self.algorithm_params_list
             ],
-            "servingParams": self.serving_params.to_json(),
+            "servingParams": pj(self.serving_params),
         }
 
 

@@ -180,3 +180,57 @@ class Evaluation:
         evaluator = MetricEvaluator(self.metric, self.other_metrics)
         params = list(self.engine_params_list) or [EngineParams()]
         return evaluator.evaluate(self.engine, params, eval_runner)
+
+
+class EngineParamsGenerator:
+    """Supplies the candidate EngineParams for an Evaluation (reference:
+    EngineParamsGenerator.scala, passed to `pio eval` alongside the
+    Evaluation).  Subclass and set ``engine_params_list`` — usually via
+    ``params_grid`` — or pass it to __init__."""
+
+    engine_params_list: Sequence[EngineParams] = ()
+
+    def __init__(self, engine_params_list: Optional[Sequence[EngineParams]] = None):
+        if engine_params_list is not None:
+            self.engine_params_list = engine_params_list
+
+
+def params_grid(
+    base: EngineParams,
+    algorithm: str,
+    grid: Dict[str, Sequence[Any]],
+) -> List[EngineParams]:
+    """Cartesian hyperparameter grid over one algorithm's params.
+
+    The reference's engine-params-list workflows build candidate lists by
+    hand (e.g. copying a baseParams and varying appId/rank per candidate);
+    this is the generator for the common case: every combination of
+    ``grid`` values overlaid on ``algorithm``'s params in ``base``.
+
+        params_grid(ep, "als", {"rank": [8, 16], "reg": [0.01, 0.1]})
+        → 4 EngineParams candidates
+    """
+    import itertools
+
+    if not grid:
+        return [base]
+    names = [n for n, _ in base.algorithm_params_list]
+    if algorithm not in names:
+        raise ValueError(f"algorithm {algorithm!r} not in {names}")
+    keys = list(grid)
+    out: List[EngineParams] = []
+    for combo in itertools.product(*(grid[k] for k in keys)):
+        override = dict(zip(keys, combo))
+        apl = []
+        for name, p in base.algorithm_params_list:
+            if name == algorithm:
+                if dataclasses.is_dataclass(p):
+                    p = dataclasses.replace(p, **override)
+                elif isinstance(p, dict):
+                    p = {**p, **override}
+                else:
+                    raise TypeError(
+                        f"cannot overlay grid on params of type {type(p).__name__}")
+            apl.append((name, p))
+        out.append(dataclasses.replace(base, algorithm_params_list=apl))
+    return out

@@ -83,13 +83,23 @@ class SPDataSource(DataSource):
     params_class = SPDataSourceParams
 
     def read_training(self) -> SPTrainingData:
-        user_dict, item_dict = IdDict(), IdDict()
-        users, items = [], []
-        for e in PEventStore.find(self.params.app_name, event_names=list(self.params.event_names)):
-            if e.target_entity_id is None:
-                continue
-            users.append(user_dict.add(e.entity_id))
-            items.append(item_dict.add(e.target_entity_id))
+        """Columnar batch read (native C++ scan on segment-file backends) +
+        vectorized dictionary translation — no per-event Python loop."""
+        batch = PEventStore.batch(
+            self.params.app_name, event_names=list(self.params.event_names))
+        has_t = batch.target_ids >= 0
+        u_codes = batch.entity_ids[has_t]
+        t_codes = batch.target_ids[has_t]
+        uu = np.unique(u_codes)
+        user_dict = IdDict([batch.entity_dict.str(int(c)) for c in uu])
+        u_map = np.full(max(len(batch.entity_dict), 1), -1, np.int32)
+        u_map[uu] = np.arange(len(uu), dtype=np.int32)
+        ti = np.unique(t_codes)
+        item_dict = IdDict([batch.target_dict.str(int(c)) for c in ti])
+        t_map = np.full(max(len(batch.target_dict), 1), -1, np.int32)
+        t_map[ti] = np.arange(len(ti), dtype=np.int32)
+        users = u_map[u_codes]
+        items = t_map[t_codes]
         props = PEventStore.aggregate_properties(
             self.params.app_name, self.params.item_entity_type
         )

@@ -102,7 +102,10 @@ class _SegmentWriter:
     def _open_next(self) -> None:
         self.close()
         self._dir.mkdir(parents=True, exist_ok=True)
-        segs = sorted(self._dir.glob("seg-*.jsonl"))
+        # only THIS writer's numeric naming — never append into a sharedfs
+        # per-writer segment that may coexist in the same directory
+        segs = sorted(p for p in self._dir.glob("seg-*.jsonl")
+                      if p.stem.split("-", 1)[1].isdigit())
         if segs and segs[-1].stat().st_size < SEGMENT_MAX_BYTES:
             path = segs[-1]
         else:
@@ -113,14 +116,19 @@ class _SegmentWriter:
     def close(self) -> None:
         if self._f is not None:
             try:
+                # skip the durability sync ONLY for externally-unlinked
+                # handles (nothing to persist); real flush/fsync failures
+                # (ENOSPC/EIO) must propagate so ingest NACKs the events
+                try:
+                    unlinked = os.fstat(self._f.fileno()).st_nlink == 0
+                except OSError:
+                    unlinked = True
                 self._f.flush()
-                if _fsync_policy() != "never":
+                if _fsync_policy() != "never" and not unlinked:
                     os.fsync(self._f.fileno())
-            except OSError:
-                pass  # handle invalidated externally; nothing to persist
             finally:
-                self._f.close()
-                self._f = None
+                f, self._f = self._f, None
+                f.close()
 
 
 def _atomic_write(path: Path, text: str) -> None:

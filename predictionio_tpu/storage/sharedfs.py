@@ -137,17 +137,19 @@ class SharedApps(base.Apps):
     def insert(self, app: App) -> Optional[int]:
         name_key = _safe_name(app.name)
         # two-phase but CRASH-SAFE: phase 1 claims the name (id 0 =
-        # incomplete), phase 2 claims an id and finalizes.  A retry after a
-        # crash mid-insert finds the incomplete record and resumes phase 2;
-        # the id probe is deterministic (crc32 of the name, then +1), so
-        # concurrent repairers converge on the same id.
-        rec = {"id": 0, "name": app.name, "description": app.description}
+        # incomplete) AND records the wanted id, phase 2 claims the id and
+        # finalizes.  A retry after a crash mid-insert finds the incomplete
+        # record and resumes phase 2 FROM THE RECORDED want, so concurrent
+        # repairers (who may not know the original app.id) converge.
+        want = app.id if app.id > 0 else (zlib.crc32(app.name.encode()) % (1 << 30)) + 1
+        rec = {"id": 0, "want": want, "name": app.name,
+               "description": app.description}
         if not self._names.put_new(name_key, rec):
             existing = self._names.get(name_key)
             if existing is None or existing.get("id"):
                 return None  # completed insert by someone else: duplicate
             rec = existing  # resume a wedged insert
-        want = app.id if app.id > 0 else (zlib.crc32(app.name.encode()) % (1 << 30)) + 1
+            want = int(rec.get("want") or want)
         app_id = _claim_id(self._ids, want, app.name)
         rec["id"] = app.id = app_id
         self._names.put(name_key, rec)
@@ -257,7 +259,9 @@ class SharedChannels(base.Channels):
         ch = self.get(channel_id)
         if ch is None:
             return False
-        return self._dir(ch.app_id).delete(_safe_name(ch.name))
+        ok = self._dir(ch.app_id).delete(_safe_name(ch.name))
+        self._ids(ch.app_id).delete(str(channel_id))  # release the id claim
+        return ok
 
 
 class SharedEngineInstances(base.EngineInstances):

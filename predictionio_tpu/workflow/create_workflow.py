@@ -155,19 +155,32 @@ def run_build_from_args(args) -> int:
     return 0
 
 
+def _load_dotted(path: str, what: str):
+    module_name, _, attr = path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"{what} {path!r} must be a dotted path")
+    return getattr(importlib.import_module(module_name), attr)
+
+
 def run_eval_from_args(args) -> int:
     """`pio eval` entry — evaluation_class is a dotted path to an Evaluation
-    subclass or instance (reference: Console.eval → EvaluationWorkflow)."""
-    from predictionio_tpu.controller.evaluation import Evaluation
+    subclass or instance; an optional EngineParamsGenerator dotted path
+    supplies the candidate grid (reference: Console.eval taking
+    <Evaluation> [<EngineParamsGenerator>] → EvaluationWorkflow)."""
+    from predictionio_tpu.controller.evaluation import Evaluation, EngineParamsGenerator
 
     try:
-        module_name, _, attr = args.evaluation_class.rpartition(".")
-        if not module_name:
-            raise ValueError(f"evaluation class {args.evaluation_class!r} must be a dotted path")
-        obj = getattr(importlib.import_module(module_name), attr)
+        obj = _load_dotted(args.evaluation_class, "evaluation class")
         evaluation = obj() if isinstance(obj, type) else obj
         if not isinstance(evaluation, Evaluation):
             raise TypeError(f"{args.evaluation_class} is not an Evaluation")
+        gen_path = getattr(args, "params_generator", None)
+        if gen_path:
+            gobj = _load_dotted(gen_path, "engine params generator")
+            gen = gobj() if isinstance(gobj, type) else gobj
+            if not isinstance(gen, EngineParamsGenerator):
+                raise TypeError(f"{gen_path} is not an EngineParamsGenerator")
+            evaluation.engine_params_list = list(gen.engine_params_list)
         result = core_workflow.run_eval(evaluation, evaluation_class=args.evaluation_class)
     except Exception as e:
         print(f"Error: {e}", file=sys.stderr)

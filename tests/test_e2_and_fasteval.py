@@ -115,3 +115,65 @@ def test_fast_eval_memoizes_stages():
     MetricEvaluator(M()).evaluate(engine, candidates[:1], eval_runner=fast.eval)
     assert calls["train"] == 3
     assert fast.stats["models_hit"] >= 1
+
+
+def test_params_grid_expands_cartesian():
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.controller.evaluation import params_grid
+    from predictionio_tpu.models.recommendation.engine import ALSAlgorithmParams
+
+    base = EngineParams(algorithm_params_list=[
+        ("als", ALSAlgorithmParams(rank=4, num_iterations=2))])
+    grid = params_grid(base, "als", {"rank": [4, 8], "lambda_": [0.01, 0.1]})
+    assert len(grid) == 4
+    combos = {(ep.algorithm_params_list[0][1].rank,
+               ep.algorithm_params_list[0][1].lambda_) for ep in grid}
+    assert combos == {(4, 0.01), (4, 0.1), (8, 0.01), (8, 0.1)}
+    # base is untouched
+    assert base.algorithm_params_list[0][1].rank == 4
+    with pytest.raises(ValueError):
+        params_grid(base, "nope", {"rank": [1]})
+
+
+def test_eval_with_params_generator_cli(tmp_path, mem_storage, monkeypatch):
+    """`pio eval <Evaluation> <EngineParamsGenerator>`: the generator's grid
+    becomes the candidate list and the best params are recorded."""
+    import sys
+    import types
+
+    from predictionio_tpu.cli.main import main as pio_main
+    from predictionio_tpu.controller.engine import Engine, EngineParams
+    from predictionio_tpu.controller.evaluation import (
+        EngineParamsGenerator, Evaluation, Metric, params_grid)
+
+    class FakeMetric(Metric):
+        def score_one(self, q, p, a):
+            return float(p == a)
+
+    class FakeEval(Evaluation):
+        def __init__(self):
+            super().__init__(engine=object(), metric=FakeMetric())
+
+        def run(self, eval_runner=None):
+            # scores favor the candidate whose dict param x == 2
+            from predictionio_tpu.controller.evaluation import (
+                MetricEvaluator)
+            ev = MetricEvaluator(self.metric)
+            return ev.evaluate(
+                self.engine, list(self.engine_params_list),
+                eval_runner=lambda eng, ep: [
+                    (None, [(0, ep.algorithm_params_list[0][1]["x"], 2)])])
+
+    class Gen(EngineParamsGenerator):
+        engine_params_list = params_grid(
+            EngineParams(algorithm_params_list=[("a", {"x": 1})]),
+            "a", {"x": [1, 2, 3]})
+
+    mod = types.ModuleType("fake_eval_mod")
+    mod.FakeEval = FakeEval
+    mod.Gen = Gen
+    monkeypatch.setitem(sys.modules, "fake_eval_mod", mod)
+    rc = pio_main(["eval", "fake_eval_mod.FakeEval", "fake_eval_mod.Gen"])
+    assert rc == 0
+    done = mem_storage.evaluation_instances.get_completed()
+    assert done and '"x": 2' in done[-1].evaluator_results_json
