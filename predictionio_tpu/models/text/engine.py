@@ -83,21 +83,42 @@ class TextDataSource(DataSource):
         ys: List[int] = []
         labels: List[str] = []
         label_of: Dict[str, int] = {}
-        for e in PEventStore.find(
-            self.params.app_name,
-            event_names=[self.params.event_name],
-            entity_type=self.params.entity_type,
-        ):
-            text = e.properties.get(self.params.text_field)
-            label = e.properties.get(self.params.label_field)
+
+        def add(text, label) -> None:
             if text is None or label is None:
-                continue
+                return
             label = str(label)
             if label not in label_of:
                 label_of[label] = len(labels)
                 labels.append(label)
             texts.append(str(text))
             ys.append(label_of[label])
+
+        batch = PEventStore.native_batch(
+            self.params.app_name,
+            event_names=[self.params.event_name],
+            entity_type=self.params.entity_type,
+        )
+        pc = batch.prop_columns if batch is not None else None
+        if pc is not None:
+            # native-scan path: both feature columns straight off the C++
+            # parser, aligned on rows that carry both properties
+            tcol = pc.get(self.params.text_field)
+            lcol = pc.get(self.params.label_field)
+            if tcol is not None and lcol is not None:
+                _, ti, li = np.intersect1d(
+                    tcol.rows, lcol.rows, return_indices=True)
+                for tj, lj in zip(ti, li):
+                    add(tcol.value_at(int(tj)), lcol.value_at(int(lj)))
+        else:
+            # row-object fallback (memory/SQL backends) — the ONLY read
+            for e in PEventStore.find(
+                self.params.app_name,
+                event_names=[self.params.event_name],
+                entity_type=self.params.entity_type,
+            ):
+                add(e.properties.get(self.params.text_field),
+                    e.properties.get(self.params.label_field))
         if not texts:
             raise ValueError(
                 f"no {self.params.event_name!r} events with "

@@ -13,9 +13,10 @@ Recommender').  TPU-first re-expression (SURVEY.md §7.5):
   billion-event scale never runs.
 - Users are processed in fixed-size chunks: each chunk densifies to 0/1
   matrices ``P_b [B, I_p]`` / ``A_b [B, I_t]`` by scatter, then
-  ``C += P_bᵀ @ A_b`` — an int8×int8→int32 matmul (exact for 0/1 inputs,
-  and v5e's MXU runs int8 at 2× its bf16 rate).  ``lax.scan`` over chunks
-  keeps it one compiled program.
+  ``C += P_bᵀ @ A_b`` — an MXU matmul with exact int32 count accumulation
+  (bf16 inputs by default; int8 — 2× MXU rate on v5e — via
+  PIO_CCO_MM_DTYPE once measured faster).  ``lax.scan`` over chunks keeps
+  it one compiled program.
 - Training runs **all event types against one staged primary**:
   ``cco_train_indicators`` lays out and uploads the primary once, then
   dispatches each event type's counts+LLR+top-k asynchronously — host
@@ -204,9 +205,14 @@ def llr_score(k11, k12, k21, k22):
 
 
 def _matmul_dtype() -> str:
-    """'int8' (default: exact for 0/1, 2× MXU rate on v5e) or 'bf16'."""
-    conf = _os.environ.get("PIO_CCO_MM_DTYPE", "int8").lower()
-    return conf if conf in ("int8", "bf16") else "int8"
+    """'bf16' (default) or 'int8' via PIO_CCO_MM_DTYPE.
+
+    Both are exact for 0/1 inputs.  int8 runs the v5e MXU at 2× the bf16
+    rate on paper, but XLA CPU lowers s8 GEMMs ~6× SLOWER than bf16
+    (measured with profile_tpu.py), so int8 stays opt-in until the real
+    chip confirms the MXU lowering wins."""
+    conf = _os.environ.get("PIO_CCO_MM_DTYPE", "bf16").lower()
+    return conf if conf in ("int8", "bf16") else "bf16"
 
 
 def _densify(local_u, item_local, valid, block: int, width: int, dtype):
@@ -216,15 +222,29 @@ def _densify(local_u, item_local, valid, block: int, width: int, dtype):
     return m.at[local_u, item_local].max(valid.astype(dtype))
 
 
-def _count_matmul(Pm, Am, acc_dtype):
+def _count_matmul(Pm, Am, mm: str):
+    """One user-chunk's count contribution, EXACT as int32 either way:
+    int8 accumulates in int32 natively; bf16 accumulates the chunk in f32
+    (per-chunk counts ≤ chunk size ≪ 2²⁴, so exactly representable) and
+    casts — cross-chunk accumulation then stays integer to 2³¹, where
+    f32 += 1 would silently saturate at 2²⁴."""
+    if mm == "int8":
+        return jax.lax.dot_general(
+            Pm, Am, (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32)
     return jax.lax.dot_general(
-        Pm, Am, (((0,), (0,)), ((), ())), preferred_element_type=acc_dtype)
+        Pm, Am, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
 
 
-def _mm_dtypes():
-    if _matmul_dtype() == "int8":
-        return jnp.int8, jnp.int32
-    return jnp.bfloat16, jnp.float32
+def _col_count(M) -> jnp.ndarray:
+    """Per-chunk column marginal, exact int32 (see _count_matmul)."""
+    if M.dtype == jnp.int8:
+        return M.sum(0, dtype=jnp.int32)
+    return M.sum(0, dtype=jnp.float32).astype(jnp.int32)
+
+
+def _mm_in_dtype():
+    return jnp.int8 if _matmul_dtype() == "int8" else jnp.bfloat16
 
 
 # ---------------------------------------------------------------------------
@@ -245,7 +265,8 @@ def _cooccurrence_tile(
     C_tile [I_p, tile] = Σ_b P_bᵀ A_b[:, tile];  rc = Σ_b colsum(P_b);
     cc_tile = Σ_b colsum(A_b[:, tile]).  Marginals come from the densified
     (hence dedup'd) matrices — no host unique pass feeds this path."""
-    in_dtype, acc_dtype = _mm_dtypes()
+    in_dtype = _mm_in_dtype()
+    mm = _matmul_dtype()
 
     def body(carry, xs):
         C, rc, cct = carry
@@ -255,15 +276,15 @@ def _cooccurrence_tile(
         in_tile = (a_local >= 0) & (a_local < tile)
         ab = _densify(alu, jnp.where(in_tile, a_local, 0),
                       amk * in_tile, block, tile, in_dtype)
-        C = C + _count_matmul(pb, ab, acc_dtype)
-        rc = rc + pb.sum(0, dtype=acc_dtype)
-        cct = cct + ab.sum(0, dtype=acc_dtype)
+        C = C + _count_matmul(pb, ab, mm)
+        rc = rc + _col_count(pb)
+        cct = cct + _col_count(ab)
         return (C, rc, cct), None
 
     init = (
-        jnp.zeros((n_items_p, tile), acc_dtype),
-        jnp.zeros((n_items_p,), acc_dtype),
-        jnp.zeros((tile,), acc_dtype),
+        jnp.zeros((n_items_p, tile), jnp.int32),
+        jnp.zeros((n_items_p,), jnp.int32),
+        jnp.zeros((tile,), jnp.int32),
     )
     if axis_name is not None:
         # under shard_map the carry varies per dp shard
@@ -367,16 +388,16 @@ def _cco_counts_dense(
     chunk: int, n_items_p: int, it_pad: int,
     axis_name: Optional[str] = None,
     self_pair: bool = False,
-    mm: str = "int8",
+    mm: str = "bf16",
 ):
-    """Scan user chunks: densify to 0/1 (int8 by default), C += PᵀA on the
-    MXU with 32-bit accumulation, marginals as column sums — no host-side
-    dedup or counting anywhere.  ``self_pair`` reuses the densified P as A
-    (primary×primary), halving scatter work.  ``p_cnt``/``a_cnt`` give the
-    valid-entry count per chunk; validity is an iota comparison on device,
-    so the f32 mask array never crosses the wire."""
+    """Scan user chunks: densify to 0/1 (dtype per PIO_CCO_MM_DTYPE),
+    C += PᵀA on the MXU with exact int32 accumulation (see _count_matmul),
+    marginals as column sums — no host-side dedup or counting anywhere.
+    ``self_pair`` reuses the densified P as A (primary×primary), halving
+    scatter work.  ``p_cnt``/``a_cnt`` give the valid-entry count per
+    chunk; validity is an iota comparison on device, so the f32 mask array
+    never crosses the wire."""
     in_dtype = jnp.int8 if mm == "int8" else jnp.bfloat16
-    acc_dtype = jnp.int32 if mm == "int8" else jnp.float32
     e_p = p_lu.shape[1]
     e_a = a_lu.shape[1]
 
@@ -390,15 +411,15 @@ def _cco_counts_dense(
         else:
             avalid = jax.lax.iota(jnp.int32, e_a) < acnt
             Am = _densify(alu, ait, avalid, chunk, it_pad, in_dtype)
-        C = C + _count_matmul(Pm, Am, acc_dtype)
-        rc = rc + Pm.sum(0, dtype=acc_dtype)
-        cc = cc + Am.sum(0, dtype=acc_dtype)
+        C = C + _count_matmul(Pm, Am, mm)
+        rc = rc + _col_count(Pm)
+        cc = cc + _col_count(Am)
         return (C, rc, cc), None
 
     init = (
-        jnp.zeros((n_items_p, it_pad), acc_dtype),
-        jnp.zeros((n_items_p,), acc_dtype),
-        jnp.zeros((it_pad,), acc_dtype),
+        jnp.zeros((n_items_p, it_pad), jnp.int32),
+        jnp.zeros((n_items_p,), jnp.int32),
+        jnp.zeros((it_pad,), jnp.int32),
     )
     if axis_name is not None:
         init = jax.tree.map(
@@ -699,9 +720,9 @@ def cco_indicators(
 
     Two device strategies, selected by memory (override: PIO_CCO_DENSE):
     - **dense** (default when the full I_p×I_t 32-bit count matrix fits):
-      scan user chunks sized to HBM, densify each chunk to int8 0/1 and run
-      one MXU matmul per chunk, marginals as column sums; then one fused
-      LLR+top-k over the full count matrix.
+      scan user chunks sized to HBM, densify each chunk to 0/1 and run
+      one MXU matmul per chunk (exact int32 counts), marginals as column
+      sums; then one fused LLR+top-k over the full count matrix.
     - **tiled** (huge item catalogs): an item-tile loop that never
       materializes the full count matrix, re-densifying per tile and
       merging a running top-k; marginals accumulate in the same scan.

@@ -110,6 +110,25 @@ class PEventStore:
         return EventBatch.from_events(events)
 
     @staticmethod
+    def native_batch(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        entity_type: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        storage: Optional[Storage] = None,
+    ) -> Optional[EventBatch]:
+        """Columnar batch WITH full property columns, or None when the
+        backend/native scanner can't provide one — callers that need
+        per-event properties use this to pick a strategy WITHOUT paying a
+        throwaway row-object read first."""
+        return PEventStore._native_batch(
+            app_name, channel_name, event_names, entity_type,
+            start_time, until_time, storage or get_storage(),
+        )
+
+    @staticmethod
     def _native_batch(
         app_name, channel_name, event_names, entity_type,
         start_time, until_time, storage, local_shard=False,

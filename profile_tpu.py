@@ -1,0 +1,170 @@
+"""Stage-by-stage CCO profiler for the bench shapes (run on the real chip).
+
+Measures, with forced readback sync after each stage:
+  1. host layout (_stage_chunked, no dedup)
+  2. H2D upload bytes/time
+  3. device counts: int8 vs bf16 matmul, self-pair reuse on/off
+  4. scatter-densify alone vs matmul alone (isolates the scatter cost)
+  5. LLR+topk
+  6. full cco_train_indicators (the headline path)
+
+Usage: python profile_tpu.py [--events N] [--items I] [--users U]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+def sync(x):
+    import jax
+
+    jax.block_until_ready(x)
+    # axon tunnel: block_until_ready may not actually block; force readback
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(leaf).ravel()[:1]
+    return x
+
+
+def t(label, fn, n=3):
+    fn()
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:48s} {best * 1e3:9.1f} ms")
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=100_000)
+    ap.add_argument("--items", type=int, default=8_192)
+    ap.add_argument("--buy", type=int, default=1_000_000)
+    ap.add_argument("--view", type=int, default=3_000_000)
+    args = ap.parse_args()
+
+    from predictionio_tpu.utils import apply_platform_override
+
+    apply_platform_override()   # PIO_JAX_PLATFORM=cpu for off-chip testing
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import synth_commerce
+    from predictionio_tpu.ops import cco
+
+    print(f"device: {jax.devices()[0]}")
+    n_users, n_items = args.users, args.items
+    buy_u, buy_i, view_u, view_i = synth_commerce(n_users, n_items, args.buy, args.view)
+    total = args.buy + args.view
+
+    it_pad = n_items
+    chunk = cco._dense_chunk_users(n_items, it_pad, n_users)
+    n_chunks = -(-n_users // chunk)
+    print(f"chunk={chunk} n_chunks={n_chunks} mm={cco._matmul_dtype()}")
+
+    # 1. host layout
+    t("host layout buy (1M, no dedup)", lambda: cco._stage_chunked(
+        buy_u, buy_i, chunk, n_chunks))
+    t("host layout view (3M, no dedup)", lambda: cco._stage_chunked(
+        view_u, view_i, chunk, n_chunks))
+
+    p = cco._stage_chunked(buy_u, buy_i, chunk, n_chunks)
+    a = cco._stage_chunked(view_u, view_i, chunk, n_chunks)
+    sync((p.local_u, a.local_u))
+    nbytes = sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                 for x in (p.local_u, p.item, a.local_u, a.item))
+    print(f"staged {nbytes / 1e6:.1f} MB")
+
+    # 2. upload
+    def upload():
+        q = cco._stage_chunked(view_u, view_i, chunk, n_chunks)
+        sync((q.local_u, q.item))
+    t("layout+upload view (3M)", upload)
+
+    # 3. counts: int8 vs bf16, self vs cross
+    for mm in ("int8", "bf16"):
+        for self_pair, label in ((False, "cross"), (True, "self")):
+            def counts(mm=mm, sp=self_pair):
+                out = cco._cco_counts_dense(
+                    p.local_u, p.item, p.count, a.local_u, a.item, a.count,
+                    chunk=chunk, n_items_p=n_items, it_pad=it_pad,
+                    self_pair=sp, mm=mm)
+                sync(out)
+            t(f"counts {label} mm={mm}", counts)
+
+    # 4. isolate scatter vs matmul
+    in_dtype = jnp.int8
+
+    @jax.jit
+    def scatter_only(lu, it, cnt):
+        def body(c, xs):
+            l, i, n = xs
+            valid = jax.lax.iota(jnp.int32, l.shape[0]) < n
+            m = jnp.zeros((chunk, n_items), in_dtype).at[l, i].max(
+                valid.astype(in_dtype))
+            return c + m.sum(dtype=jnp.int32), None
+        out, _ = jax.lax.scan(body, jnp.int32(0), (lu, it, cnt))
+        return out
+
+    t("scatter-densify only (view 3M)", lambda: sync(
+        scatter_only(a.local_u, a.item, a.count)))
+    t("scatter-densify only (buy 1M)", lambda: sync(
+        scatter_only(p.local_u, p.item, p.count)))
+
+    P8 = jnp.zeros((chunk, n_items), jnp.int8)
+
+    @jax.jit
+    def mm_only(P):
+        def body(c, _):
+            return c + jax.lax.dot_general(
+                P, P, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32), None
+        out, _ = jax.lax.scan(body, jnp.zeros((n_items, n_items), jnp.int32),
+                              None, length=n_chunks)
+        return out
+    t(f"matmul only int8 ({n_chunks}x)", lambda: sync(mm_only(P8)))
+    Pb = jnp.zeros((chunk, n_items), jnp.bfloat16)
+
+    @jax.jit
+    def mm_only_bf(P):
+        def body(c, _):
+            return c + jax.lax.dot_general(
+                P, P, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32), None
+        out, _ = jax.lax.scan(body, jnp.zeros((n_items, n_items), jnp.float32),
+                              None, length=n_chunks)
+        return out
+    t(f"matmul only bf16 ({n_chunks}x)", lambda: sync(mm_only_bf(Pb)))
+
+    # 5. LLR+topk
+    C, rc, cc = cco._cco_counts_dense(
+        p.local_u, p.item, p.count, a.local_u, a.item, a.count,
+        chunk=chunk, n_items_p=n_items, it_pad=it_pad, self_pair=False,
+        mm=cco._matmul_dtype())
+    sync((C, rc, cc))
+    modes = ("off", "on") if jax.default_backend() == "tpu" else ("off",)
+    for pl in modes:
+        t(f"LLR+topk pallas={pl}", lambda pl=pl: sync(cco._llr_topk_dense(
+            C, rc, cc, float(n_users), 0.0, top_k=50, exclude_self=False,
+            pallas=pl)))
+
+    # 6. the headline path
+    def full():
+        cco.cco_train_indicators(
+            buy_u, buy_i,
+            [("buy", buy_u, buy_i, n_items), ("view", view_u, view_i, n_items)],
+            n_users, n_items, top_k=50, exclude_self_for="buy")
+    wall = t("FULL cco_train_indicators (bench path)", full)
+    print(f"=> {total / wall:,.0f} events/s  "
+          f"(vs_baseline {total / wall / 200_000:.2f}, target >= 20)")
+
+
+if __name__ == "__main__":
+    main()

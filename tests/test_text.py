@@ -92,3 +92,57 @@ def test_missing_text_events_raise(mem_storage):
     )
     with pytest.raises(ValueError, match="no 'train' events"):
         engine.train(ep)
+
+
+def test_text_trains_through_native_scan(tmp_path):
+    """Text features ride the C++ property columns on segment backends."""
+    from predictionio_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native scanner unavailable")
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
+
+    storage = Storage(StorageConfig(
+        sources={"S": {"type": "localfs", "path": str(tmp_path / "store")}},
+        repositories={r: "S" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+    ))
+    app_id = storage.apps.insert(App(0, "textnat"))
+    evs = []
+    for k in range(40):
+        spam = k % 2 == 0
+        evs.append(Event(
+            event="documents", entity_type="content", entity_id=f"d{k}",
+            properties=DataMap({
+                "text": ("win cash prize now" if spam else "see you at lunch")
+                + f" {k}",
+                "label": "spam" if spam else "ham"})))
+    storage.l_events.insert_batch(evs, app_id)
+    set_storage(storage)
+    try:
+        # the native columnar path must actually be available — otherwise
+        # this test would silently cover only the row-object fallback
+        from predictionio_tpu.store.event_store import PEventStore
+
+        nb = PEventStore.native_batch("textnat", event_names=["documents"])
+        assert nb is not None and nb.prop_columns is not None
+        assert {"text", "label"} <= set(nb.prop_columns)
+        from predictionio_tpu.models.text import TextClassificationEngine
+        from predictionio_tpu.models.text.engine import TextDSParams, TextNBParams
+
+        engine = TextClassificationEngine.apply()
+        ep = EngineParams(
+            data_source_params=TextDSParams(app_name="textnat",
+                                            event_name="documents"),
+            algorithm_params_list=[("nb", TextNBParams())],
+        )
+        models = engine.train(ep)
+        predict = engine.predictor(ep, models)
+        from predictionio_tpu.models.text.engine import TextQuery
+
+        res = predict(TextQuery(text="free cash prize"))
+        assert res.label == "spam"
+    finally:
+        set_storage(None)
