@@ -94,6 +94,16 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 fn = getattr(lib, name)
                 fn.argtypes = [ctypes.c_void_p, ctypes.c_int]
                 fn.restype = ctypes.c_int64
+            lib.layout_width.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+            lib.layout_width.restype = ctypes.c_int64
+            lib.layout_fill.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32)]
+            lib.layout_fill.restype = ctypes.c_int32
             lib.scan_free.argtypes = [ctypes.c_void_p]
             _lib = lib
             return lib
@@ -195,3 +205,37 @@ def scan_segments(paths: Sequence[os.PathLike], n_threads: int = 0):
         return batch
     finally:
         lib.scan_free(handle)
+
+
+def layout_chunks(user, item, chunk: int, n_chunks: int, pad_multiple: int = 8):
+    """Chunk-grouped COO layout via the native O(n) counting pass:
+    (lu [n_chunks, width], it [n_chunks, width], cnt [n_chunks]).
+
+    Returns None ONLY when the native library is unavailable (callers fall
+    back to numpy); invalid input — length mismatch, user ids outside
+    [0, chunk*n_chunks) — raises ValueError loudly on this path just as
+    callers validate for the numpy path."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    user = np.ascontiguousarray(user, np.int32)
+    item = np.ascontiguousarray(item, np.int32)
+    if len(user) != len(item):
+        raise ValueError(
+            f"user/item length mismatch: {len(user)} vs {len(item)}")
+    n = len(user)
+    p32 = ctypes.POINTER(ctypes.c_int32)
+    u_ptr = user.ctypes.data_as(p32)
+    width = lib.layout_width(u_ptr, n, chunk, n_chunks, pad_multiple)
+    if width < 0:
+        raise ValueError(
+            f"user ids outside [0, {chunk * n_chunks}) in layout_chunks")
+    lu = np.zeros((n_chunks, int(width)), np.int32)
+    it = np.zeros((n_chunks, int(width)), np.int32)
+    cnt = np.zeros(n_chunks, np.int32)
+    rc = lib.layout_fill(
+        u_ptr, item.ctypes.data_as(p32), n, chunk, n_chunks, width,
+        lu.ctypes.data_as(p32), it.ctypes.data_as(p32), cnt.ctypes.data_as(p32))
+    if rc != 0:
+        raise ValueError(f"native layout_fill failed (rc={rc})")
+    return lu, it, cnt

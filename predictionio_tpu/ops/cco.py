@@ -474,22 +474,33 @@ def _stage_chunked(
     user: np.ndarray, item: np.ndarray,
     chunk: int, n_chunks: int, sharding=None,
 ) -> _StagedCOO:
+    from predictionio_tpu.native import layout_chunks
+
     user = np.asarray(user, np.int32)
     item = np.asarray(item, np.int32)
-    blk = user // chunk
-    order = np.argsort(blk, kind="stable")   # radix sort: O(E)
-    user, item, blk = user[order], item[order], blk[order]
-    counts = np.bincount(blk, minlength=n_chunks).astype(np.int32)
-    width = max(int(counts.max()) if len(user) else 1, 1)
-    width = ((width + 7) // 8) * 8
-    lu = np.zeros((n_chunks, width), np.int32)
-    it = np.zeros((n_chunks, width), np.int32)
-    start = 0
-    for b in range(n_chunks):
-        c = int(counts[b])
-        lu[b, :c] = user[start:start + c] % chunk
-        it[b, :c] = item[start:start + c]
-        start += c
+    if len(user) != len(item):
+        raise ValueError(f"user/item length mismatch: {len(user)} vs {len(item)}")
+    if len(user) and (int(user.min()) < 0 or int(user.max()) >= chunk * n_chunks):
+        raise ValueError(
+            f"user ids outside [0, {chunk * n_chunks}) in _stage_chunked")
+    native = layout_chunks(user, item, chunk, n_chunks) if len(user) else None
+    if native is not None:
+        lu, it, counts = native   # O(E) two-pass counting layout in C++
+    else:
+        blk = user // chunk
+        order = np.argsort(blk, kind="stable")   # radix sort: O(E)
+        user, item, blk = user[order], item[order], blk[order]
+        counts = np.bincount(blk, minlength=n_chunks).astype(np.int32)
+        width = max(int(counts.max()) if len(user) else 1, 1)
+        width = ((width + 7) // 8) * 8
+        lu = np.zeros((n_chunks, width), np.int32)
+        it = np.zeros((n_chunks, width), np.int32)
+        start = 0
+        for b in range(n_chunks):
+            c = int(counts[b])
+            lu[b, :c] = user[start:start + c] % chunk
+            it[b, :c] = item[start:start + c]
+            start += c
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
         else jnp.asarray
     return _StagedCOO(put(lu), put(it), put(counts))

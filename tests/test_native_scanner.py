@@ -313,3 +313,50 @@ def test_fold_with_interaction_only_property_keys(fs_storage):
     ], app_id)
     props = PEventStore.aggregate_properties("mixprops", "item", storage=fs_storage)
     assert dict(props["i1"]) == {"category": "x"}
+
+
+def test_native_layout_matches_numpy():
+    """The C++ counting layout equals the numpy staging (same chunk
+    grouping, counts and in-chunk order is irrelevant to the consumer, but
+    contents per chunk must match as multisets)."""
+    from predictionio_tpu.native import layout_chunks
+
+    rng = np.random.default_rng(17)
+    n_users, chunk, n_chunks = 1000, 256, 4
+    u = rng.integers(0, n_users, 5000).astype(np.int32)
+    i = rng.integers(0, 300, 5000).astype(np.int32)
+    out = layout_chunks(u, i, chunk, n_chunks)
+    assert out is not None
+    lu, it, cnt = out
+    assert lu.shape == it.shape and lu.shape[0] == n_chunks
+    assert cnt.sum() == 5000
+    for b in range(n_chunks):
+        c = int(cnt[b])
+        sel = (u // chunk) == b
+        want = sorted(zip((u[sel] % chunk).tolist(), i[sel].tolist()))
+        got = sorted(zip(lu[b, :c].tolist(), it[b, :c].tolist()))
+        assert got == want
+        assert (lu[b, c:] == 0).all() and (it[b, c:] == 0).all()
+    # invalid input fails LOUDLY (same contract as the numpy path)
+    bad = np.array([chunk * n_chunks + 5], np.int32)
+    with pytest.raises(ValueError):
+        layout_chunks(bad, bad, chunk, n_chunks)
+    with pytest.raises(ValueError):
+        layout_chunks(np.array([-1], np.int32), np.array([0], np.int32),
+                      chunk, n_chunks)
+    with pytest.raises(ValueError):
+        layout_chunks(u, i[:100], chunk, n_chunks)
+
+
+def test_native_layout_perf_sanity():
+    from predictionio_tpu.native import layout_chunks
+
+    rng = np.random.default_rng(3)
+    n = 2_000_000
+    u = rng.integers(0, 100_000, n).astype(np.int32)
+    i = rng.integers(0, 8192, n).astype(np.int32)
+    t0 = time.perf_counter()
+    out = layout_chunks(u, i, 32768, 4)
+    dt = time.perf_counter() - t0
+    assert out is not None and out[2].sum() == n
+    assert dt < 2.0, f"native layout too slow: {dt:.2f}s for {n} events"
