@@ -170,6 +170,47 @@ def distinct_user_counts(user: np.ndarray, item: np.ndarray, n_items: int) -> np
 # ---------------------------------------------------------------------------
 
 
+def _llr_mask_scores(c, row_counts, col_counts, n_total, llr_threshold,
+                     pallas: str):
+    """Shared LLR scoring + masking used by EVERY strategy (dense, chunked
+    tiled, P-resident tiled): G² over the 2×2 table, -inf where there is no
+    cooccurrence or the score misses the significance threshold."""
+    if pallas != "off":
+        from predictionio_tpu.ops.pallas_kernels import llr_masked_scores
+
+        return llr_masked_scores(c, row_counts, col_counts, n_total, llr_threshold)
+    k11 = c
+    k12 = row_counts[:, None] - c
+    k21 = col_counts[None, :] - c
+    k22 = n_total - k11 - k12 - k21
+    scores = llr_score(k11, k12, k21, k22)
+    scores = jnp.where(c > 0, scores, -jnp.inf)
+    return jnp.where(scores >= llr_threshold, scores, -jnp.inf)
+
+
+def _merge_topk(best_scores, best_idx, scores, tile_start, tile: int,
+                top_k: int, n_items_p: int, exclude_self: bool):
+    """Shared running top-k merge for the tiled strategies; masks self-pairs
+    BEFORE the merge so every row still gets a full top_k correlators."""
+    tile_idx = tile_start + jnp.arange(tile, dtype=jnp.int32)[None, :]
+    if exclude_self:
+        row_ids = jnp.arange(n_items_p, dtype=jnp.int32)[:, None]
+        scores = jnp.where(tile_idx == row_ids, -jnp.inf, scores)
+    all_scores = jnp.concatenate([best_scores, scores], axis=1)
+    all_idx = jnp.concatenate(
+        [best_idx, jnp.broadcast_to(tile_idx, scores.shape)], axis=1)
+    new_scores, pos = jax.lax.top_k(all_scores, top_k)
+    return new_scores, jnp.take_along_axis(all_idx, pos, axis=1)
+
+
+def _finalize_topk(best_scores, best_idx, n_items_t: int):
+    """Shared host epilogue: -1-pad entries that are -inf or tile padding."""
+    scores = np.asarray(best_scores)
+    idx = np.asarray(best_idx)
+    idx = np.where((scores > -np.inf) & (idx < n_items_t), idx, -1)
+    return np.where(idx >= 0, scores, -np.inf), idx
+
+
 def _llr_term(k, sign_d, d, row_marg, col_marg):
     # k·log(k·N/(row·col)) rewritten as k·log1p(±D/(row·col)); the ±1e-9
     # clamp guards fp drift past the log1p pole when k·N ≪ row·col.
@@ -248,6 +289,95 @@ def _mm_in_dtype():
 
 
 # ---------------------------------------------------------------------------
+# P-resident tiled path (huge catalogs, but the densified primary fits HBM)
+# ---------------------------------------------------------------------------
+
+_TILED_P_BYTES = 4 << 30   # budget for keeping the densified primary resident
+
+
+@partial(jax.jit, static_argnames=("n_rows", "n_cols"))
+def _densify_global(gu, gi, valid, n_rows: int, n_cols: int):
+    """One scatter-max of global COO into a resident 0/1 matrix."""
+    dtype = _mm_in_dtype()
+    return jnp.zeros((n_rows, n_cols), dtype).at[
+        jnp.where(valid, gu, 0), jnp.where(valid, gi, 0)
+    ].max(valid.astype(dtype))
+
+
+@partial(jax.jit, static_argnames=("tile", "top_k", "exclude_self", "pallas", "mm"))
+def _cco_tile_step_resident(
+    P, rc, a_gu, a_gi, a_valid,
+    n_total, best_scores, best_idx, tile_start,
+    tile: int, top_k: int, llr_threshold,
+    exclude_self: bool, pallas: str, mm: str,
+):
+    """One item tile against the RESIDENT densified primary: densify only
+    this tile's slice of A (one scatter), one matmul, LLR, top-k merge —
+    the primary is never re-densified per tile, unlike the chunked tiled
+    path which pays n_tiles × that cost."""
+    n_rows = P.shape[0]
+    n_items_p = P.shape[1]
+    a_local = a_gi - tile_start
+    in_tile = a_valid & (a_local >= 0) & (a_local < tile)
+    A_t = _densify_global(a_gu, jnp.where(in_tile, a_local, 0), in_tile,
+                          n_rows, tile)
+    c = _count_matmul(P, A_t, mm).astype(jnp.float32)
+    cct = _col_count(A_t).astype(jnp.float32)
+    scores = _llr_mask_scores(c, rc.astype(jnp.float32), cct, n_total,
+                              llr_threshold, pallas)
+    return _merge_topk(best_scores, best_idx, scores, tile_start, tile,
+                       top_k, n_items_p, exclude_self)
+
+
+def _resident_p_ok(n_users: int, n_items_p: int, item_tile: int = 4096) -> bool:
+    """The P-resident strategy is used only when its WHOLE working set
+    fits the budget (resident P + per-tile densified A + the f32 count
+    tile), AND counts stay exact: bf16 contracts the full user space in
+    one f32 pass, so n_users must stay below 2²⁴ (int8 accumulates int32
+    and has no such cap)."""
+    bytes_per = 2 if _matmul_dtype() == "bf16" else 1
+    n_rows = max(((n_users + 127) // 128) * 128, 128)
+    working = (n_rows * n_items_p + n_rows * item_tile) * bytes_per \
+        + n_items_p * item_tile * 4
+    if working > _TILED_P_BYTES:
+        return False
+    return _matmul_dtype() == "int8" or n_users < (1 << 24)
+
+
+def _cco_indicators_resident(
+    primary: BlockedInteractions,
+    other: BlockedInteractions,
+    n_total_users: int, top_k: int, llr_threshold: float,
+    item_tile: int, exclude_self: bool,
+) -> Tuple[np.ndarray, np.ndarray]:
+    pu, pi = _flatten_blocked(primary)
+    au, ai = _flatten_blocked(other) if other is not primary else (pu, pi)
+    n_items_p, n_items_t = primary.n_items, other.n_items
+    n_rows = max(((primary.n_users + 127) // 128) * 128, 128)
+    mm = _matmul_dtype()
+    P = _densify_global(jnp.asarray(pu), jnp.asarray(pi),
+                        jnp.ones(len(pu), bool), n_rows, n_items_p)
+    rc = _col_count(P)
+    a_gu, a_gi = jnp.asarray(au), jnp.asarray(ai)
+    a_valid = jnp.ones(len(au), bool)
+    tile = min(item_tile, max(n_items_t, 1))
+    n_tiles = math.ceil(n_items_t / tile)
+    best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)
+    best_idx = jnp.zeros((n_items_p, top_k), jnp.int32)
+
+    from predictionio_tpu.ops.pallas_kernels import pallas_mode
+
+    for t in range(n_tiles):
+        best_scores, best_idx = _cco_tile_step_resident(
+            P, rc, a_gu, a_gi, a_valid,
+            float(n_total_users), best_scores, best_idx, t * tile,
+            tile=tile, top_k=top_k, llr_threshold=float(llr_threshold),
+            exclude_self=exclude_self, pallas=pallas_mode(), mm=mm,
+        )
+    return _finalize_topk(best_scores, best_idx, n_items_t)
+
+
+# ---------------------------------------------------------------------------
 # tiled path (huge item catalogs; the count matrix never materializes)
 # ---------------------------------------------------------------------------
 
@@ -319,35 +449,11 @@ def _cco_tile_step(
     )
     if axis_name is not None:
         c, rc, cct = jax.lax.psum((c, rc, cct), axis_name)
-    c = c.astype(jnp.float32)
-    row_counts = rc.astype(jnp.float32)
-    col_tile = cct.astype(jnp.float32)
-
-    from predictionio_tpu.ops.pallas_kernels import llr_masked_scores
-
-    if pallas != "off":
-        # fused Pallas pass: G² + cooccurrence/threshold masking in one
-        # VPU sweep over the tile
-        scores = llr_masked_scores(c, row_counts, col_tile, n_total, llr_threshold)
-    else:
-        k11 = c                                        # users doing both
-        k12 = row_counts[:, None] - c                  # primary-only
-        k21 = col_tile[None, :] - c
-        k22 = n_total - k11 - k12 - k21
-        scores = llr_score(k11, k12, k21, k22)
-        scores = jnp.where(c > 0, scores, -jnp.inf)    # no cooccurrence → no indicator
-        scores = jnp.where(scores >= llr_threshold, scores, -jnp.inf)
-    tile_idx = tile_start + jnp.arange(tile, dtype=jnp.int32)[None, :]
-    if exclude_self:
-        # mask self-pairs BEFORE the top-k merge so every row still gets a
-        # full top_k correlators (same semantics as the dense strategy)
-        row_ids = jnp.arange(n_items_p, dtype=jnp.int32)[:, None]
-        scores = jnp.where(tile_idx == row_ids, -jnp.inf, scores)
-    all_scores = jnp.concatenate([best_scores, scores], axis=1)
-    all_idx = jnp.concatenate([best_idx, jnp.broadcast_to(tile_idx, scores.shape)], axis=1)
-    new_scores, pos = jax.lax.top_k(all_scores, top_k)
-    new_idx = jnp.take_along_axis(all_idx, pos, axis=1)
-    return new_scores, new_idx
+    scores = _llr_mask_scores(
+        c.astype(jnp.float32), rc.astype(jnp.float32), cct.astype(jnp.float32),
+        n_total, llr_threshold, pallas)
+    return _merge_topk(best_scores, best_idx, scores, tile_start, tile,
+                       top_k, n_items_p, exclude_self)
 
 
 # ---------------------------------------------------------------------------
@@ -435,21 +541,9 @@ def _llr_topk_dense(
     C, rc, cc, n_total, llr_threshold,
     top_k: int, exclude_self: bool, pallas: str,
 ):
-    C = C.astype(jnp.float32)
-    rc = rc.astype(jnp.float32)
-    cc = cc.astype(jnp.float32)
-    if pallas != "off":
-        from predictionio_tpu.ops.pallas_kernels import llr_masked_scores
-
-        scores = llr_masked_scores(C, rc, cc, n_total, llr_threshold)
-    else:
-        k11 = C
-        k12 = rc[:, None] - C
-        k21 = cc[None, :] - C
-        k22 = n_total - k11 - k12 - k21
-        scores = llr_score(k11, k12, k21, k22)
-        scores = jnp.where(C > 0, scores, -jnp.inf)
-        scores = jnp.where(scores >= llr_threshold, scores, -jnp.inf)
+    scores = _llr_mask_scores(
+        C.astype(jnp.float32), rc.astype(jnp.float32), cc.astype(jnp.float32),
+        n_total, llr_threshold, pallas)
     if exclude_self:
         n_p, n_t = scores.shape
         eye = jnp.arange(n_p, dtype=jnp.int32)[:, None] == jnp.arange(
@@ -586,12 +680,9 @@ class _DenseRunner:
     @staticmethod
     def collect(dispatched) -> Tuple[np.ndarray, np.ndarray]:
         s_dev, i_dev, n_items_t, req_k = dispatched
-        scores = np.asarray(s_dev)
-        idx = np.asarray(i_dev)
         # drop indicator columns that are padding (item id >= n_items_t or
         # -inf score) and restore the promised [I_p, req_k] width
-        idx = np.where((scores > -np.inf) & (idx < n_items_t), idx, -1)
-        scores = np.where(idx >= 0, scores, -np.inf)
+        scores, idx = _finalize_topk(s_dev, i_dev, n_items_t)
         k = scores.shape[1]
         if req_k > k:
             pad = req_k - k
@@ -758,6 +849,15 @@ def cco_indicators(
         )
     if primary.n_blocks != other.n_blocks or primary.user_block != other.user_block:
         raise ValueError("primary/other must be blocked with the same user layout")
+    if mesh is None and _resident_p_ok(
+            primary.n_users, primary.n_items,
+            min(item_tile, max(other.n_items, 1))):
+        # tiled over items but with the densified primary RESIDENT in HBM:
+        # avoids re-densifying P for every tile (n_tiles × the work)
+        return _cco_indicators_resident(
+            primary, other, n_total_users, top_k, llr_threshold,
+            item_tile, exclude_self,
+        )
     n_items_p, n_items_t = primary.n_items, other.n_items
     tile = min(item_tile, max(n_items_t, 1))
     n_tiles = math.ceil(n_items_t / tile)
@@ -822,9 +922,4 @@ def cco_indicators(
                 *args, best_scores, best_idx, jnp.int32(t * tile),
             )
 
-    scores = np.asarray(best_scores)
-    idx = np.asarray(best_idx)
-    # entries pointing past the real catalog (tile padding) are not items
-    idx = np.where((scores > -np.inf) & (idx < n_items_t), idx, -1)
-    scores = np.where(idx >= 0, scores, -np.inf)
-    return scores, idx
+    return _finalize_topk(best_scores, best_idx, n_items_t)

@@ -312,3 +312,47 @@ def test_block_interactions_stream_matches_batch():
     np.testing.assert_allclose(s1, s2, rtol=1e-5)
     for r in range(n_items):
         assert set(i1[r][s1[r] > -np.inf]) == set(i2[r][s2[r] > -np.inf])
+
+
+def test_resident_tiled_matches_chunked_tiled(monkeypatch):
+    """The P-resident tiled strategy (primary densified once, reused per
+    tile) returns the same scores as the chunked tiled path and the dense
+    path."""
+    from predictionio_tpu.ops import cco as cco_mod
+    from predictionio_tpu.ops.cco import cco_indicators_coo
+
+    n_users, n_ip, n_it = 70, 14, 19
+    pu, pi = random_interactions(n_users, n_ip, 400, 101)
+    ou, oi = random_interactions(n_users, n_it, 600, 102)
+
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    sd, _ = cco_indicators_coo(pu, pi, ou, oi, n_users, n_ip, n_it,
+                               top_k=5, item_tile=8)
+    monkeypatch.setenv("PIO_CCO_DENSE", "0")
+    # resident path active (P easily fits)
+    assert cco_mod._resident_p_ok(n_users, n_ip)
+    sr, _ = cco_indicators_coo(pu, pi, ou, oi, n_users, n_ip, n_it,
+                               top_k=5, item_tile=8, user_block=16)
+    # force the chunked tiled path by shrinking the resident budget
+    monkeypatch.setattr(cco_mod, "_TILED_P_BYTES", 1)
+    st, _ = cco_indicators_coo(pu, pi, ou, oi, n_users, n_ip, n_it,
+                               top_k=5, item_tile=8, user_block=16)
+    np.testing.assert_allclose(sd, sr, rtol=1e-4)
+    np.testing.assert_allclose(sr, st, rtol=1e-4)
+
+
+def test_resident_tiled_self_pair(monkeypatch):
+    from predictionio_tpu.ops import cco as cco_mod
+    from predictionio_tpu.ops.cco import cco_indicators_coo
+
+    n_users, n_items = 50, 12
+    u, i = random_interactions(n_users, n_items, 300, 111)
+    monkeypatch.setenv("PIO_CCO_DENSE", "0")
+    s1, i1 = cco_indicators_coo(u, i, u, i, n_users, n_items, n_items,
+                                top_k=4, item_tile=8, exclude_self=True)
+    monkeypatch.setattr(cco_mod, "_TILED_P_BYTES", 1)
+    s2, i2 = cco_indicators_coo(u, i, u, i, n_users, n_items, n_items,
+                                top_k=4, item_tile=8, exclude_self=True)
+    np.testing.assert_allclose(s1, s2, rtol=1e-4)
+    for r in range(n_items):
+        assert r not in set(i1[r][i1[r] >= 0])
