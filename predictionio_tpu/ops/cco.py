@@ -292,7 +292,11 @@ def _mm_in_dtype():
 # P-resident tiled path (huge catalogs, but the densified primary fits HBM)
 # ---------------------------------------------------------------------------
 
-_TILED_P_BYTES = 4 << 30   # budget for keeping the densified primary resident
+# Working-set budget for the P-resident strategy (P + per-tile A slab +
+# f32 count tile).  8 GB of a 16 GB v5e leaves headroom for XLA transients;
+# e.g. the 100k-item serving bench (20k users) needs ~6 GB and saves 25
+# re-densifies of a 4 GB primary vs the chunked path.
+_TILED_P_BYTES = 8 << 30
 
 
 @partial(jax.jit, static_argnames=("n_rows", "n_cols"))
