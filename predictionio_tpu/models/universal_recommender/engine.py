@@ -111,6 +111,9 @@ class DateRange:
 class URQuery:
     user: Optional[str] = None
     item: Optional[str] = None
+    # shopping-cart style: recommend for a SET of items (reference UR
+    # itemSet queries — wishlist/cart complements)
+    item_set: List[str] = dataclasses.field(default_factory=list)
     num: int = 20
     fields: List[FieldRule] = dataclasses.field(default_factory=list)
     blacklist_items: List[str] = dataclasses.field(default_factory=list)
@@ -132,6 +135,7 @@ class URQuery:
         return cls(
             user=str(d["user"]) if d.get("user") is not None else None,
             item=str(d["item"]) if d.get("item") is not None else None,
+            item_set=[str(i) for i in d.get("itemSet", [])],
             num=int(d.get("num", 20)),
             fields=[FieldRule.from_json(f) for f in d.get("fields", [])],
             blacklist_items=[str(b) for b in d.get("blacklistItems", [])],
@@ -554,6 +558,9 @@ class URAlgorithmParams(Params):
     mesh_dp: int = 0
     use_llr_weights: bool = False
     blacklist_events: List[str] = dataclasses.field(default_factory=list)  # default: primary
+    # per-event-type tuning overrides (reference UR: indicators config),
+    # e.g. {"view": {"maxCorrelatorsPerItem": 25, "minLLR": 4.0}}
+    indicator_params: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
     backfill_type: str = "popular"  # popular | trending | hot | none
     # PopModel window (reference UR backfillField.duration); halves/thirds
     # of this window feed trending/hot velocity and acceleration
@@ -596,6 +603,27 @@ class URAlgorithm(Algorithm):
                 u, i = p_user, p_item  # identity → self-pair kernel reuse
             others.append((name, u, i, len(item_dict)))
             event_item_dicts[name] = item_dict
+        per_type = {}
+        for name, over in (self.params.indicator_params or {}).items():
+            # validate against the CONFIGURED types, not the data-dependent
+            # set (a type with zero events this window is still valid)
+            if name not in td.event_names:
+                raise ValueError(
+                    f"indicator_params names unknown event type {name!r}; "
+                    f"configured event_names: {td.event_names}")
+            t_k = self.params.max_correlators_per_item
+            t_llr = self.params.min_llr
+            for key, val in over.items():
+                norm = key.replace("_", "").lower()   # minLLR/minLlr/min_llr
+                if norm == "maxcorrelatorsperitem":
+                    t_k = int(val)
+                elif norm == "minllr":
+                    t_llr = float(val)
+                else:
+                    raise ValueError(
+                        f"indicator_params[{name!r}]: unknown key {key!r} "
+                        "(expected maxCorrelatorsPerItem / minLLR)")
+            per_type[name] = (t_k, t_llr)
         results = cco_ops.cco_train_indicators(
             p_user, p_item, others, n_users, n_items,
             top_k=self.params.max_correlators_per_item,
@@ -604,6 +632,7 @@ class URAlgorithm(Algorithm):
             exclude_self_for=primary,
             user_block=self.params.user_block,
             item_tile=self.params.item_tile,
+            per_type=per_type,
         )
         indicator_idx: Dict[str, np.ndarray] = {}
         indicator_llr: Dict[str, np.ndarray] = {}
@@ -702,17 +731,22 @@ class URAlgorithm(Algorithm):
         if n_items == 0:
             return URResult([])
         signal = None
-        if query.item is not None:
-            iid = model.item_dict.id(query.item)
-            if iid is not None:
-                # item-similarity: the query item's OWN indicator lists act
-                # as a virtual history on each event type's field (reference
-                # URAlgorithm getBiasedSimilarItems building the ES query
-                # from the item document's indicator arrays)
+        set_ids = [model.item_dict.id(i) for i in query.item_set]
+        set_ids = [i for i in set_ids if i is not None]
+        if query.item is not None or set_ids:
+            # item-similarity / itemSet (cart): the query items' OWN
+            # indicator lists act as a virtual history on each event type's
+            # field (reference URAlgorithm getBiasedSimilarItems / itemSet
+            # queries building the ES query from item-document indicators)
+            if query.item is not None:
+                iid = model.item_dict.id(query.item)
+                if iid is not None:
+                    set_ids.append(iid)
+            if set_ids:
                 hist: Dict[str, np.ndarray] = {}
                 for name, idx in model.indicator_idx.items():
-                    row = idx[iid]
-                    ids = row[row >= 0]
+                    rows = idx[np.asarray(set_ids, np.int32)]
+                    ids = np.unique(rows[rows >= 0])
                     if len(ids):
                         hist[name] = ids.astype(np.int32)
                 signal = self._score_history(model, hist)
@@ -769,8 +803,10 @@ class URAlgorithm(Algorithm):
                         if csr is not None:
                             ids.extend(csr.row(uid).tolist())
         black = set(query.blacklist_items)
-        if query.item is not None and not query.return_self:
-            black.add(query.item)
+        if not query.return_self:
+            if query.item is not None:
+                black.add(query.item)
+            black.update(query.item_set)
         for b in black:
             bid = model.item_dict.id(b)
             if bid is not None:

@@ -705,6 +705,7 @@ def cco_train_indicators(
     exclude_self_for: Optional[str] = None,
     user_block: int = 1024,
     item_tile: int = 4096,
+    per_type: Optional[Dict[str, Tuple[int, float]]] = None,
 ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
     """The UR train loop's entry: indicators for every event type against
     ONE staged primary.
@@ -716,7 +717,11 @@ def cco_train_indicators(
     asynchronously so host layout of type t+1 overlaps device compute of
     type t.  Event types whose count matrix exceeds the HBM budget fall
     back to the tiled path transparently.
+
+    ``per_type`` optionally overrides ``(top_k, llr_threshold)`` for named
+    event types (reference UR: per-indicator maxCorrelatorsPerItem/minLLR).
     """
+    per_type = per_type or {}
     dense_names = [nm for nm, _, _, nt in others if _dense_path_ok(n_items_p, nt)]
     runner: Optional[_DenseRunner] = None
     if dense_names:
@@ -731,15 +736,16 @@ def cco_train_indicators(
     results: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     for name, au, ai, n_items_t in others:
         excl = (name == exclude_self_for)
+        t_k, t_llr = per_type.get(name, (top_k, llr_threshold))
         if runner is not None and name in dense_names:
             self_pair = au is p_user and ai is p_item
             pending.append((name, runner.dispatch(
-                au, ai, n_items_t, top_k, llr_threshold, excl,
+                au, ai, n_items_t, t_k, t_llr, excl,
                 self_pair=self_pair)))
         else:
             results[name] = cco_indicators_coo(
                 p_user, p_item, au, ai, n_users, n_items_p, n_items_t,
-                top_k=top_k, llr_threshold=llr_threshold,
+                top_k=t_k, llr_threshold=t_llr,
                 user_block=user_block, item_tile=item_tile,
                 mesh=mesh, exclude_self=excl,
             )

@@ -527,3 +527,40 @@ def test_unknown_property_names_match_nothing(trained):
     model = models[0]
     assert not model.__dict__.get("_dev_date")
     assert ("no-such-prop", "x") not in (model.__dict__.get("_dev_value_mask") or {})
+
+
+def test_item_set_query(trained):
+    """itemSet (cart) queries: union of the set's indicators drives the
+    scores; the set's own items never come back (returnSelf default)."""
+    engine, ep, models = trained
+    pred = engine.predictor(ep, models)
+    res = pred(URQuery(item_set=["e1", "e3"], num=4))
+    assert res.item_scores, "cart query returned nothing"
+    got = {s.item for s in res.item_scores}
+    assert got.isdisjoint({"e1", "e3"})
+    assert all(i.startswith("e") for i in got), got
+    # wire-format binding
+    q = URQuery.from_json({"itemSet": ["e1", "e3"], "num": 4})
+    assert q.item_set == ["e1", "e3"]
+    res2 = pred(q)
+    assert {s.item for s in res2.item_scores} == got
+
+
+def test_per_indicator_overrides(ur_app):
+    """indicator_params tunes top-k/minLLR per event type (reference UR's
+    per-indicator config); unknown names fail loudly."""
+    engine = UniversalRecommenderEngine.apply()
+    models = engine.train(make_ep(indicator_params={
+        "view": {"maxCorrelatorsPerItem": 3, "minLLR": 0.0}}))
+    m = models[0]
+    assert m.indicator_idx["view"].shape[1] == 3
+    assert m.indicator_idx["purchase"].shape[1] == 8  # base param
+    with pytest.raises(ValueError, match="indicator_params"):
+        engine.train(make_ep(indicator_params={"nope": {"minLLR": 1.0}}))
+    # repo-convention camelCase spelling binds too
+    m2 = engine.train(make_ep(indicator_params={
+        "view": {"maxCorrelatorsPerItem": 2, "minLlr": 0.0}}))[0]
+    assert m2.indicator_idx["view"].shape[1] == 2
+    # unknown override keys fail loudly instead of silently doing nothing
+    with pytest.raises(ValueError, match="unknown key"):
+        engine.train(make_ep(indicator_params={"view": {"topK": 5}}))
