@@ -373,7 +373,10 @@ def bench_scale(smoke: bool) -> dict:
         p_users, p_items, p_events = 500, 200, 20_000
         user_block = 256
     else:
-        n_users, n_items, n_events, batch, tile = 200_000, 32_768, 8_000_000, 1_000_000, 4096
+        # tile=8192 → 4 item tiles: the chunked tiled path re-densifies the
+        # primary once per tile, so fewer/larger tiles cut that HBM traffic
+        # (C_tile stays 32k x 8k x 4B = 1 GB)
+        n_users, n_items, n_events, batch, tile = 200_000, 32_768, 8_000_000, 1_000_000, 8192
         p_users, p_items, p_events = 30_000, 3_000, 1_000_000
         user_block = 4096
 
