@@ -10,7 +10,7 @@ bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
   template list|new                       built-in template gallery / scaffolding
   train / deploy / eval                   DASE workflow (workflow module)
   import / export                         event batch files
-  eventserver / dashboard                 REST ingestion / evaluation dashboard
+  eventserver / adminserver / dashboard   REST ingestion / admin API / eval dashboard
   status                                  storage + env sanity report
   version
 
@@ -285,6 +285,12 @@ def _cmd_eventserver(args) -> int:
     return run_event_server(host=args.ip, port=args.port)
 
 
+def _cmd_adminserver(args) -> int:
+    from predictionio_tpu.api.admin import run_admin_server
+
+    return run_admin_server(host=args.ip, port=args.port)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="pio", description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="command", required=True)
@@ -388,6 +394,11 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
     es.set_defaults(func=_cmd_eventserver)
+
+    adm = sub.add_parser("adminserver")
+    adm.add_argument("--ip", default="127.0.0.1")
+    adm.add_argument("--port", type=int, default=7071)
+    adm.set_defaults(func=_cmd_adminserver)
 
     return p
 

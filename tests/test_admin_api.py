@@ -60,3 +60,11 @@ def test_admin_app_lifecycle(admin):
 
     status, _ = http("GET", admin + "/cmd/app/ghost/accesskeys")
     assert status == 404
+
+
+def test_adminserver_cli_registered():
+    """`pio adminserver` exists (reference: Console adminserver)."""
+    from predictionio_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(["adminserver", "--port", "0"])
+    assert args.port == 0 and callable(args.func)
