@@ -118,3 +118,97 @@ def test_full_cli_loop(tmp_path):
     # 7. status reports the trained instance's storage
     r = pio(["status"], tmp_path)
     assert r.returncode == 0 and "apps: 1" in r.stdout
+
+
+def sharedfs_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PIO_JAX_PLATFORM"] = "cpu"
+    env.pop("PIO_FS_BASEDIR", None)
+    env["PIO_STORAGE_SOURCES_SH_TYPE"] = "sharedfs"
+    env["PIO_STORAGE_SOURCES_SH_PATH"] = str(tmp_path / "shared_store")
+    for r in ("METADATA", "EVENTDATA", "MODELDATA"):
+        env[f"PIO_STORAGE_REPOSITORIES_{r}_SOURCE"] = "SH"
+    return env
+
+
+def pio_sh(args, tmp_path, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", *args],
+        env=sharedfs_env(tmp_path), capture_output=True, text=True,
+        timeout=180, **kw)
+
+
+@pytest.mark.slow
+def test_cli_loop_on_sharedfs_with_concurrent_importers(tmp_path):
+    """The full product path on the multi-host backend: app new → TWO
+    concurrent importer PROCESSES (per-writer segments in one shared log)
+    → UR train → deploy → HTTP query."""
+    r = pio_sh(["app", "new", "ShopApp"], tmp_path)
+    assert r.returncode == 0, r.stderr
+
+    rng = np.random.default_rng(23)
+    files = []
+    for w in range(2):
+        lines = []
+        for k in range(400):
+            u, it = int(rng.integers(0, 40)), int(rng.integers(0, 15))
+            lines.append(json.dumps({
+                "event": "buy", "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{it}"}))
+        f = tmp_path / f"events{w}.jsonl"
+        f.write_text("\n".join(lines) + "\n")
+        files.append(f)
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", "import",
+         "--app-name", "ShopApp", "--input", str(f)],
+        env=sharedfs_env(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for f in files]
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err
+    # two writer processes → per-writer segments, one log
+    segs = list((tmp_path / "shared_store" / "events").rglob("seg-*.jsonl"))
+    assert len(segs) >= 2
+    assert len({s.name.rsplit("-", 1)[0] for s in segs}) >= 2
+
+    variant = {
+        "id": "sh-ur",
+        "engineFactory":
+            "predictionio_tpu.models.universal_recommender.UniversalRecommenderEngine",
+        "datasource": {"params": {"appName": "ShopApp", "eventNames": ["buy"]}},
+        "algorithms": [{"name": "ur", "params": {
+            "appName": "ShopApp", "meshDp": 1, "maxCorrelatorsPerItem": 5}}],
+    }
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(variant))
+    r = pio_sh(["train", "--engine-json", str(ej)], tmp_path)
+    assert r.returncode == 0, r.stderr
+
+    server = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.cli.main", "deploy",
+         "--engine-json", str(ej), "--ip", "127.0.0.1", "--port", "18731"],
+        env=sharedfs_env(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 90
+        body = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    "http://127.0.0.1:18731/queries.json",
+                    data=json.dumps({"user": "u1", "num": 3}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    body = json.loads(resp.read())
+                break
+            except Exception:
+                time.sleep(1.5)
+        assert body is not None and "itemScores" in body, body
+        assert len(body["itemScores"]) > 0
+    finally:
+        server.send_signal(signal.SIGINT)
+        try:
+            server.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            server.kill()
