@@ -41,7 +41,7 @@ def synth_commerce(n_users, n_items, n_buy, n_view, seed=0):
     return buy_u, buy_i, view_u, view_i
 
 
-def bench_ur(smoke: bool) -> dict:
+def bench_ur(smoke: bool, profile_dir: str = "") -> dict:
     from predictionio_tpu.ops import cco as cco_ops
 
     if smoke:
@@ -64,9 +64,17 @@ def bench_ur(smoke: bool) -> dict:
             exclude_self_for="buy")
 
     train_once()  # warm-up: XLA compile
-    t0 = time.perf_counter()
-    train_once()  # steady state (host prep + device compute, compile cached)
-    wall = time.perf_counter() - t0
+    if profile_dir:
+        from predictionio_tpu.utils.tracing import profile_to
+
+        with profile_to(profile_dir):
+            t0 = time.perf_counter()
+            train_once()
+            wall = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        train_once()  # steady state (host prep + device compute, compile cached)
+        wall = time.perf_counter() - t0
     return {"events_per_sec": total_events / wall, "wall_s": wall,
             "events": total_events}
 
@@ -485,6 +493,9 @@ def main() -> int:
                     default=None)
     ap.add_argument("--scale", action="store_true",
                     help="run only the 1B-scale tiled-path slice")
+    ap.add_argument("--profile", default="",
+                    help="with --only ur: capture a jax.profiler (xprof) "
+                         "trace of the steady-state iteration into this dir")
     args = ap.parse_args()
 
     from predictionio_tpu.utils import apply_platform_override
@@ -497,7 +508,7 @@ def main() -> int:
 
     if args.only:
         out = {
-            "ur": lambda: bench_ur(args.smoke),
+            "ur": lambda: bench_ur(args.smoke, profile_dir=args.profile),
             "p50": lambda: {"p50_ms": bench_predict_p50(args.smoke)},
             "als": lambda: {"updates_per_sec": bench_als(args.smoke)},
             "scan": lambda: {"events_per_sec": bench_scan(args.smoke)},
