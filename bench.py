@@ -67,11 +67,12 @@ def bench_ur(smoke: bool, profile_dir: str = "") -> dict:
     if profile_dir:
         from predictionio_tpu.utils.tracing import profile_to
 
-        with profile_to(profile_dir):
-            t0 = time.perf_counter()
-            train_once()
-            wall = time.perf_counter() - t0
+        ctx = profile_to(profile_dir)
     else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    with ctx:
         t0 = time.perf_counter()
         train_once()  # steady state (host prep + device compute, compile cached)
         wall = time.perf_counter() - t0
@@ -501,6 +502,9 @@ def main() -> int:
     from predictionio_tpu.utils import apply_platform_override
 
     apply_platform_override()
+
+    if args.profile and args.only != "ur":
+        ap.error("--profile requires --only ur (the traced iteration)")
 
     if args.scale:
         print(json.dumps(bench_scale(args.smoke)))
