@@ -255,8 +255,10 @@ def _als_sweeps(data: ALSData, x0, y0, n_sweeps: int, reg: float, mesh, args=Non
         raise ValueError(
             f"ALSData prepared for dp={data.dp}, mesh has dp={mesh.shape.get('dp')}")
     sharding = NamedSharding(mesh, P("dp"))
-    x0 = jax.device_put(x0, sharding)
-    y0 = jax.device_put(y0, sharding)
+    from predictionio_tpu.parallel.sharding import stage_global
+
+    x0 = stage_global(np.asarray(x0), sharding)
+    y0 = stage_global(np.asarray(y0), sharding)
     return _als_run_sharded(
         mesh, data.user_rows, data.item_rows,
         x0, y0, jnp.int32(n_sweeps), jnp.float32(reg), *args,

@@ -599,8 +599,12 @@ def _stage_chunked(
             lu[b, :c] = user[start:start + c] % chunk
             it[b, :c] = item[start:start + c]
             start += c
-    put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
-        else jnp.asarray
+    if sharding is not None:
+        from predictionio_tpu.parallel.sharding import stage_global
+
+        put = lambda x: stage_global(np.asarray(x), sharding)  # noqa: E731
+    else:
+        put = jnp.asarray
     return _StagedCOO(put(lu), put(it), put(counts))
 
 
@@ -902,11 +906,13 @@ def cco_indicators(
                 return a
             return np.concatenate([a, np.zeros((pad_blocks, *a.shape[1:]), a.dtype)])
 
+        from predictionio_tpu.parallel.sharding import stage_global
+
         spec = P("dp")
         rep = P()
         shard = NamedSharding(mesh, spec)
         args = tuple(
-            jax.device_put(pad(np.asarray(a)), shard)
+            stage_global(pad(np.asarray(a)), shard)
             for a in (
                 primary.local_u, primary.item, primary.mask,
                 other.local_u, other.item, other.mask,

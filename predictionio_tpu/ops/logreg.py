@@ -59,9 +59,11 @@ def logreg_train(
         xs = NamedSharding(mesh, P("dp", None))
         ys = NamedSharding(mesh, P("dp"))
         rep = NamedSharding(mesh, P())
-        x = jax.device_put(x, xs)
-        y = jax.device_put(y, ys)
-        mask = jax.device_put(mask, ys)
+        from predictionio_tpu.parallel.sharding import stage_global
+
+        x = stage_global(np.asarray(x), xs)
+        y = stage_global(np.asarray(y), ys)
+        mask = stage_global(np.asarray(mask), ys)
 
     if optimizer not in ("lbfgs", "adam"):
         raise ValueError(f"unknown optimizer {optimizer!r} (lbfgs|adam)")

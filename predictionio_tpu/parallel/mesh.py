@@ -88,7 +88,9 @@ def host_staging_iterator(
 
     pending: Optional[jax.Array] = None
     for arr in arrays:
-        staged = jax.device_put(arr, shard_rows(mesh, axis, arr.ndim))
+        from predictionio_tpu.parallel.sharding import stage_global
+
+        staged = stage_global(arr, shard_rows(mesh, axis, arr.ndim))
         if pending is not None:
             yield pending
         pending = staged

@@ -39,7 +39,24 @@ def pad_to_multiple(
 def device_put_sharded_rows(
     arr: np.ndarray, mesh: Mesh, axis: str = "dp"
 ) -> jax.Array:
-    """Pad rows to the dp extent and place row-sharded on the mesh."""
+    """Pad rows to the dp extent and place row-sharded on the mesh
+    (multi-process-safe via stage_global)."""
     dp = mesh.shape[axis]
     arr = pad_to_multiple(arr, dp, axis=0)
-    return jax.device_put(arr, shard_rows(mesh, axis, arr.ndim))
+    return stage_global(np.asarray(arr), shard_rows(mesh, axis, arr.ndim))
+
+
+def stage_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """Place a host array under ``sharding`` — including on meshes that SPAN
+    PROCESSES, where plain ``jax.device_put`` fails on non-addressable
+    devices.  Every process holds the full host array (the sharedfs event
+    log is reachable from every host, so each re-derives the same layout)
+    and ships only the shards its own devices own; the result is one global
+    jax.Array usable by pjit/shard_map exactly like the single-process case.
+    (Reference analogue: Spark broadcast + per-executor partition reads.)
+    """
+    if len(sharding.device_set) == len(sharding.addressable_devices):
+        return jax.device_put(arr, sharding)
+    idx_map = sharding.addressable_devices_indices_map(arr.shape)
+    locals_ = [jax.device_put(arr[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(arr.shape, sharding, locals_)

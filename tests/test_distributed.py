@@ -124,6 +124,36 @@ def test_process_local_rows_mp_mesh():
     assert process_local_rows(400, mesh) == (0, 400)
 
 
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_workers(cmds, envs, timeout=180):
+    """Start worker subprocesses and ALWAYS reap them — a worker deadlocked
+    in a collective must not outlive the test and squat the coordinator."""
+    import subprocess
+
+    procs = [subprocess.Popen(c, env=e, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for c, e in zip(cmds, envs)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
 @pytest.mark.slow
 def test_two_process_runtime_end_to_end(tmp_path):
     """REAL multi-process proof: two OS processes join one JAX runtime via
@@ -180,7 +210,7 @@ def test_two_process_runtime_end_to_end(tmp_path):
     repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
     env_base = {
         "PYTHONPATH": repo_root,
-        "PIO_COORDINATOR_ADDRESS": "127.0.0.1:19733",
+        "PIO_COORDINATOR_ADDRESS": f"127.0.0.1:{_free_port()}",
         "PIO_NUM_PROCESSES": "2",
         "PIO_STORAGE_SOURCES_S_TYPE": "sharedfs",
         "PIO_STORAGE_SOURCES_S_PATH": store,
@@ -189,16 +219,13 @@ def test_two_process_runtime_end_to_end(tmp_path):
     }
     for r in ("METADATA", "EVENTDATA", "MODELDATA"):
         env_base[f"PIO_STORAGE_REPOSITORIES_{r}_SOURCE"] = "S"
-    procs = []
-    for pid in range(2):
-        env = dict(env_base, PIO_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = _run_workers(
+        [[sys.executable, "-c", worker] for _ in range(2)],
+        [dict(env_base, PIO_PROCESS_ID=str(pid)) for pid in range(2)],
+        timeout=150)
     locals_seen = {}
-    for p in procs:
-        out, err = p.communicate(timeout=150)
-        assert p.returncode == 0, err[-2000:]
+    for rc, out, err in results:
+        assert rc == 0, err[-2000:]
         line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
         _, pid_s, local_s, total_s = line.split()
         locals_seen[int(pid_s)] = int(local_s)
@@ -206,3 +233,77 @@ def test_two_process_runtime_end_to_end(tmp_path):
     # disjoint shards that union to everything, both non-empty
     assert sum(locals_seen.values()) == n_events
     assert all(v > 0 for v in locals_seen.values()), locals_seen
+
+
+@pytest.mark.slow
+def test_two_process_cco_training_matches_single(tmp_path):
+    """Multi-HOST CCO training: two OS processes, one global mesh (dp=4
+    spanning both), cross-process psum of count tiles — the result must
+    equal a single-process train on the same data."""
+    import subprocess
+    import sys
+    import textwrap
+    import os as _os
+
+    import numpy as np
+
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker = textwrap.dedent("""
+        import os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from predictionio_tpu.parallel.distributed import init_distributed
+        init_distributed()
+        import numpy as np
+        from jax.sharding import Mesh
+        from predictionio_tpu.ops.cco import cco_train_indicators
+        rng = np.random.default_rng(7)
+        n_users, n_items = 64, 12
+        pu = rng.integers(0, n_users, 300).astype(np.int32)
+        pi = rng.integers(0, n_items, 300).astype(np.int32)
+        vu = rng.integers(0, n_users, 500).astype(np.int32)
+        vi = rng.integers(0, n_items, 500).astype(np.int32)
+        mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("dp", "mp"))
+        out = cco_train_indicators(
+            pu, pi, [("buy", pu, pi, n_items), ("view", vu, vi, n_items)],
+            n_users, n_items, top_k=4, exclude_self_for="buy", mesh=mesh)
+        np.savez(sys.argv[1],
+                 buy=out["buy"][0], view=out["view"][0])
+        print("TRAIN OK", jax.process_index(), len(jax.devices()), flush=True)
+    """)
+    env_base = {
+        "PYTHONPATH": repo_root,
+        "PIO_COORDINATOR_ADDRESS": f"127.0.0.1:{_free_port()}",
+        "PIO_NUM_PROCESSES": "2",
+        "PATH": _os.environ.get("PATH", ""),
+        "HOME": _os.environ.get("HOME", "/root"),
+    }
+    results = _run_workers(
+        [[sys.executable, "-c", worker, str(out_dir / f"p{pid}.npz")]
+         for pid in range(2)],
+        [dict(env_base, PIO_PROCESS_ID=str(pid)) for pid in range(2)])
+    for rc, out, err in results:
+        assert rc == 0, err[-2000:]
+        assert "TRAIN OK" in out
+
+    # single-process reference on the SAME data
+    from predictionio_tpu.ops.cco import cco_train_indicators
+
+    rng = np.random.default_rng(7)
+    n_users, n_items = 64, 12
+    pu = rng.integers(0, n_users, 300).astype(np.int32)
+    pi = rng.integers(0, n_items, 300).astype(np.int32)
+    vu = rng.integers(0, n_users, 500).astype(np.int32)
+    vi = rng.integers(0, n_items, 500).astype(np.int32)
+    ref = cco_train_indicators(
+        pu, pi, [("buy", pu, pi, n_items), ("view", vu, vi, n_items)],
+        n_users, n_items, top_k=4, exclude_self_for="buy")
+    for pid in range(2):
+        got = np.load(out_dir / f"p{pid}.npz")
+        np.testing.assert_allclose(got["buy"], ref["buy"][0], rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(got["view"], ref["view"][0], rtol=1e-4,
+                                   atol=1e-4)
