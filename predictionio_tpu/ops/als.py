@@ -267,8 +267,17 @@ def _als_sweeps(data: ALSData, x0, y0, n_sweeps: int, reg: float, mesh, args=Non
 
 def _als_deinterleave(data: ALSData, x, y, k: int):
     # De-interleave [dp, rows, K] back to global [n, K]: global e = shard + dp*row.
-    x = np.asarray(x).transpose(1, 0, 2).reshape(-1, k)[: data.n_users]
-    y_arr = np.asarray(y).transpose(1, 0, 2).reshape(-1, k)[: data.n_items]
+    def host(a):
+        # multi-process meshes: gather before fetching (np.asarray can only
+        # read fully-addressable arrays)
+        if hasattr(a, "is_fully_addressable") and not a.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            a = multihost_utils.process_allgather(a, tiled=True)
+        return np.asarray(a)
+
+    x = host(x).transpose(1, 0, 2).reshape(-1, k)[: data.n_users]
+    y_arr = host(y).transpose(1, 0, 2).reshape(-1, k)[: data.n_items]
     return x, y_arr
 
 

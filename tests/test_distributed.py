@@ -307,3 +307,68 @@ def test_two_process_cco_training_matches_single(tmp_path):
                                    atol=1e-4)
         np.testing.assert_allclose(got["view"], ref["view"][0], rtol=1e-4,
                                    atol=1e-4)
+
+
+@pytest.mark.slow
+def test_two_process_als_training_matches_single(tmp_path):
+    """Two-process ALS training over one global mesh equals single-process
+    (factor staging via stage_global, all_gather across processes)."""
+    import subprocess
+    import sys
+    import textwrap
+    import os as _os
+
+    import numpy as np
+
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker = textwrap.dedent("""
+        import os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from predictionio_tpu.parallel.distributed import init_distributed
+        init_distributed()
+        import numpy as np
+        from jax.sharding import Mesh
+        from predictionio_tpu.ops.als import als_train, prepare_als_data
+        rng = np.random.default_rng(5)
+        n_users, n_items = 32, 24
+        u = rng.integers(0, n_users, 400).astype(np.int32)
+        i = rng.integers(0, n_items, 400).astype(np.int32)
+        r = (rng.integers(1, 6, 400)).astype(np.float32)
+        mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("dp", "mp"))
+        data = prepare_als_data(u, i, r, n_users, n_items, dp=4)
+        X, Y = als_train(data, k=6, reg=0.1, iterations=2, mesh=mesh)
+        np.savez(sys.argv[1], X=np.asarray(X), Y=np.asarray(Y))
+        print("ALS OK", jax.process_index(), flush=True)
+    """)
+    env_base = {
+        "PYTHONPATH": repo_root,
+        "PIO_COORDINATOR_ADDRESS": f"127.0.0.1:{_free_port()}",
+        "PIO_NUM_PROCESSES": "2",
+        "PATH": _os.environ.get("PATH", ""),
+        "HOME": _os.environ.get("HOME", "/root"),
+    }
+    results = _run_workers(
+        [[sys.executable, "-c", worker, str(out_dir / f"p{pid}.npz")]
+         for pid in range(2)],
+        [dict(env_base, PIO_PROCESS_ID=str(pid)) for pid in range(2)])
+    for rc, out, err in results:
+        assert rc == 0, err[-2000:]
+        assert "ALS OK" in out
+
+    from predictionio_tpu.ops.als import als_train, prepare_als_data
+
+    rng = np.random.default_rng(5)
+    n_users, n_items = 32, 24
+    u = rng.integers(0, n_users, 400).astype(np.int32)
+    i = rng.integers(0, n_items, 400).astype(np.int32)
+    r = (rng.integers(1, 6, 400)).astype(np.float32)
+    data = prepare_als_data(u, i, r, n_users, n_items, dp=4)
+    X, Y = als_train(data, k=6, reg=0.1, iterations=2)
+    for pid in range(2):
+        got = np.load(out_dir / f"p{pid}.npz")
+        np.testing.assert_allclose(got["X"], np.asarray(X), rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(got["Y"], np.asarray(Y), rtol=2e-3, atol=2e-3)
