@@ -153,6 +153,40 @@ def bench_http(smoke: bool) -> dict:
                 assert status == 200, body
             return float(np.percentile(times, 50)), float(np.percentile(times, 95))
 
+        def measure_qps(httpd, make_body, seconds=3.0, workers=8):
+            """Concurrent sustained throughput (queries/s) — closer to a
+            loaded deployment than the serial p50 loop."""
+            import threading
+
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            stop = time.perf_counter() + seconds
+            done = [0] * workers
+            errors = []
+
+            def worker(w):
+                try:
+                    q = w
+                    while time.perf_counter() < stop:
+                        status, body = _http_post(
+                            base + "/queries.json", make_body(q))
+                        if status != 200:
+                            raise AssertionError(f"HTTP {status}: {body}")
+                        done[w] += 1
+                        q += workers
+                except Exception as e:   # surfaced after join, not swallowed
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(workers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+            return sum(done) / (time.perf_counter() - t0)
+
         # ---- UR ----
         commerce_events("benchur", n_users, n_items, n_buy, n_view)
         variant = {
@@ -178,11 +212,11 @@ def bench_http(smoke: bool) -> dict:
         httpd = deploy(engine_json=ur_json, host="127.0.0.1", port=0,
                        storage=storage, background=True)
         try:
-            ur_p50, ur_p95 = measure(
-                httpd,
-                lambda q: {"user": f"u{(q * 37) % n_users}", "num": 10}
-                if q % 5 else {"user": f"cold{q}", "num": 10},  # 20% cold
-                n_q)
+            body_fn = (lambda q: {"user": f"u{(q * 37) % n_users}", "num": 10}
+                       if q % 5 else {"user": f"cold{q}", "num": 10})  # 20% cold
+            ur_p50, ur_p95 = measure(httpd, body_fn, n_q)
+            ur_qps = measure_qps(httpd, body_fn,
+                                 seconds=1.0 if smoke else 5.0)
         finally:
             httpd.shutdown()
             httpd.server_close()
@@ -228,6 +262,7 @@ def bench_http(smoke: bool) -> dict:
             httpd.server_close()
         return {
             "ur_http_p50_ms": ur_p50, "ur_http_p95_ms": ur_p95,
+            "ur_http_qps": ur_qps,
             "als_http_p50_ms": als_p50, "als_http_p95_ms": als_p95,
             "ur_catalog_items": n_items, "ur_train_e2e_s": ur_train_s,
             "ur_train_e2e_events_per_sec": (n_buy + n_view) / ur_train_s,
@@ -554,6 +589,7 @@ def main() -> int:
             "predict_p50_basis": "http_queries_json_ur_100k_items",
             "predict_p50_vs_10ms_target": round(10.0 / max(p50, 1e-9), 2),
             "predict_p95_ms": round(http["ur_http_p95_ms"], 3),
+            "ur_http_qps": round(http["ur_http_qps"], 1),
             "als_http_p50_ms": round(http["als_http_p50_ms"], 3),
             "predict_kernel_p50_ms": round(kernel_p50, 3),
             "ur_train_e2e_events_per_sec": round(http["ur_train_e2e_events_per_sec"], 1),

@@ -564,3 +564,30 @@ def test_per_indicator_overrides(ur_app):
     # unknown override keys fail loudly instead of silently doing nothing
     with pytest.raises(ValueError, match="unknown key"):
         engine.train(make_ep(indicator_params={"view": {"topK": 5}}))
+
+
+def test_ur_checkpoint_resume_after_injected_fault(ur_app, tmp_path, monkeypatch):
+    """UR training with per-event-type snapshots: a fault on the SECOND
+    event type leaves the first type's snapshot; the retry resumes past it
+    and the final model equals an un-faulted train."""
+    from predictionio_tpu.utils.checkpoint import InjectedFault
+
+    engine = UniversalRecommenderEngine.apply()
+    ref = engine.train(make_ep())[0]
+
+    ckdir = str(tmp_path / "ck")
+    ep = make_ep(checkpoint=True, checkpoint_dir=ckdir)
+    monkeypatch.setenv("PIO_FAULT_INJECT", "ur.indicators:2")
+    with pytest.raises(InjectedFault):
+        engine.train(ep)
+    monkeypatch.delenv("PIO_FAULT_INJECT", raising=False)  # maybe_inject disarms
+    # snapshot of the first event type survived the crash
+    import pathlib
+
+    assert any(pathlib.Path(ckdir).rglob("step_0.npz"))
+    model = engine.train(ep)[0]
+    for name in ref.indicator_idx:
+        np.testing.assert_array_equal(
+            model.indicator_idx[name], ref.indicator_idx[name])
+        np.testing.assert_allclose(
+            model.indicator_llr[name], ref.indicator_llr[name], rtol=1e-5)
