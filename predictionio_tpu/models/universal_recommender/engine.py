@@ -565,6 +565,9 @@ class URAlgorithmParams(Params):
     # PopModel window (reference UR backfillField.duration); halves/thirds
     # of this window feed trending/hot velocity and acceleration
     backfill_duration: str = "3650 days"
+    # event types whose volume feeds the backfill ranking (reference UR
+    # backfillField.eventNames); default: the primary event only
+    backfill_event_names: List[str] = dataclasses.field(default_factory=list)
     # per-event-type indicator snapshots: a crashed/retried train resumes
     # past completed event types (reference has NO mid-training
     # checkpointing; dir defaults to PIO_CHECKPOINT_DIR/ur/<fingerprint>).
@@ -654,12 +657,36 @@ class URAlgorithm(Algorithm):
         # CSR dedups (user, item) internally
         user_seen = CSRLookup.from_pairs(p_user, p_item, n_users)
         # PopModel backfill scores over the configured event-time window
-        # (raw events, not distinct pairs: popularity ranks by volume)
+        # (raw events, not distinct pairs: popularity ranks by volume);
+        # backfill_event_names widens the counted types beyond the primary
+        # (reference UR backfillField.eventNames), with items translated
+        # into the primary space
         from predictionio_tpu.models.universal_recommender.popmodel import (
             backfill_scores, parse_duration)
 
+        bf_names = self.params.backfill_event_names or [primary]
+        unknown_bf = [b for b in bf_names if b not in td.event_names]
+        if unknown_bf:
+            raise ValueError(
+                f"backfill_event_names {unknown_bf} not in event_names "
+                f"{td.event_names}")
+        bf_items, bf_times = [], []
+        for name in bf_names:
+            u, i, item_dict_t, times = td.interactions[name]
+            if name == primary:
+                bf_items.append(p_item)
+                bf_times.append(p_times)
+            else:
+                translate = p_item_dict.lookup_many(item_dict_t.strings())
+                mapped = translate[i]
+                keep = mapped >= 0
+                bf_items.append(mapped[keep])
+                bf_times.append(times[keep])
         popularity = backfill_scores(
-            self.params.backfill_type, p_item, p_times, n_items,
+            self.params.backfill_type,
+            np.concatenate(bf_items) if bf_items else p_item,
+            np.concatenate(bf_times) if bf_times else p_times,
+            n_items,
             parse_duration(self.params.backfill_duration),
         )
         # per-event seen CSRs for non-primary blacklist_events, with items

@@ -591,3 +591,17 @@ def test_ur_checkpoint_resume_after_injected_fault(ur_app, tmp_path, monkeypatch
             model.indicator_idx[name], ref.indicator_idx[name])
         np.testing.assert_allclose(
             model.indicator_llr[name], ref.indicator_llr[name], rtol=1e-5)
+
+
+def test_backfill_event_names_widen_popularity(ur_app):
+    """backfill_event_names counts the named event types' volume
+    (translated into the primary item space); unknown names fail loudly."""
+    engine = UniversalRecommenderEngine.apply()
+    m_primary = engine.train(make_ep())[0]
+    m_views = engine.train(make_ep(
+        backfill_event_names=["purchase", "view"]))[0]
+    # views add volume: totals strictly grow somewhere
+    assert m_views.popularity.sum() > m_primary.popularity.sum()
+    assert len(m_views.popularity) == len(m_primary.popularity)
+    with pytest.raises(ValueError, match="backfill_event_names"):
+        engine.train(make_ep(backfill_event_names=["nope"]))
