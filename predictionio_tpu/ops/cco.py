@@ -89,6 +89,17 @@ def block_interactions(
     it only shrinks the padded width when the data is heavily duplicated."""
     if dedup:
         user, item = dedup_pairs(user, item, n_items)
+    user = np.asarray(user, np.int32)
+    item = np.asarray(item, np.int32)
+    n_blocks = max(math.ceil(n_users / user_block), 1)
+    if len(user) and 0 <= int(user.min()) and int(user.max()) < n_blocks * user_block:
+        from predictionio_tpu.native import layout_chunks
+
+        native = layout_chunks(user, item, user_block, n_blocks, pad_multiple)
+        if native is not None:
+            lu, it, cnt = native
+            mask = (np.arange(lu.shape[1]) < cnt[:, None]).astype(np.float32)
+            return BlockedInteractions(lu, it, mask, n_users, n_items, user_block)
     return block_interactions_stream(
         [(user, item)], n_users, n_items,
         user_block=user_block, pad_multiple=pad_multiple,
