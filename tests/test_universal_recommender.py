@@ -605,3 +605,33 @@ def test_backfill_event_names_widen_popularity(ur_app):
     assert len(m_views.popularity) == len(m_primary.popularity)
     with pytest.raises(ValueError, match="backfill_event_names"):
         engine.train(make_ep(backfill_event_names=["nope"]))
+
+
+def test_ur_model_pickle_roundtrip(ur_app):
+    """Model blobs survive persistence: every serving-relevant field —
+    indicator tables, per-event blacklist CSRs, popularity, properties —
+    round-trips, and the reloaded model serves identical results."""
+    import pickle
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep(blacklist_events=["purchase", "view"])
+    models = engine.train(ep)
+    m = models[0]
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2.primary_event == m.primary_event
+    assert set(m2.indicator_idx) == set(m.indicator_idx)
+    for name in m.indicator_idx:
+        np.testing.assert_array_equal(m2.indicator_idx[name], m.indicator_idx[name])
+        np.testing.assert_allclose(m2.indicator_llr[name], m.indicator_llr[name])
+    np.testing.assert_allclose(m2.popularity, m.popularity)
+    assert set(m2.user_seen_by_event) == set(m.user_seen_by_event)
+    for k, csr in m.user_seen_by_event.items():
+        np.testing.assert_array_equal(m2.user_seen_by_event[k].values, csr.values)
+    assert m2.item_properties == m.item_properties
+    p1 = engine.predictor(ep, models)
+    p2 = engine.predictor(ep, [m2])
+    for q in (URQuery(user="u2", num=6), URQuery(item="e1", num=4),
+              URQuery(user="cold", num=5)):
+        r1 = [(s.item, round(s.score, 5)) for s in p1(q).item_scores]
+        r2 = [(s.item, round(s.score, 5)) for s in p2(q).item_scores]
+        assert r1 == r2, (q, r1, r2)
