@@ -110,6 +110,37 @@ def _cmd_app(args) -> int:
         st.l_events.init(app.id)
         print(f"Deleted all events of app {args.name!r}.")
         return 0
+    if args.app_command == "compact":
+        app = st.apps.get_by_name(args.name)
+        if app is None:
+            print(f"Error: app {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        compact = getattr(st.l_events, "compact", None)
+        if compact is None:
+            print("Error: this event backend does not support compaction.",
+                  file=sys.stderr)
+            return 1
+        channel_id = None
+        if getattr(args, "channel", None):
+            chan = next((c for c in st.channels.get_by_app_id(app.id)
+                         if c.name == args.channel), None)
+            if chan is None:
+                print(f"Error: channel {args.channel!r} not found.", file=sys.stderr)
+                return 1
+            channel_id = chan.id
+        before = None
+        if getattr(args, "before", None):
+            from predictionio_tpu.events.event import parse_time
+
+            try:
+                before = parse_time(args.before)
+            except (ValueError, TypeError) as e:
+                print(f"Error: invalid --before date: {e}", file=sys.stderr)
+                return 1
+        stats = compact(app.id, channel_id, before=before)
+        print(f"Compacted app {args.name!r}: kept {stats['kept']} events, "
+              f"expired {stats['expired']}, {stats['segments']} segment(s).")
+        return 0
     raise AssertionError(args.app_command)
 
 
@@ -309,6 +340,14 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("show", "delete", "data-delete"):
         sp = app_sub.add_parser(name)
         sp.add_argument("name")
+    cp = app_sub.add_parser(
+        "compact",
+        help="rewrite the event log dropping tombstoned (and, with "
+             "--before, expired) events — run with ingest paused")
+    cp.add_argument("name")
+    cp.add_argument("--channel", default=None)
+    cp.add_argument("--before", default=None,
+                    help="also expire events older than this ISO-8601 instant")
     app.set_defaults(func=_cmd_app)
 
     ak = sub.add_parser("accesskey")

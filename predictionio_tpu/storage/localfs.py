@@ -581,7 +581,9 @@ class FSEvents(base.LEvents, base.PEvents):
 
     def __init__(self, root: Path):
         self._root = Path(root) / "events"
-        self._lock = threading.Lock()
+        # RLock: lock-holding paths (delete, compact) re-enter via
+        # segment_paths' crashed-compaction recovery branch
+        self._lock = threading.RLock()
         self._indexes: Dict[tuple, _EntityIndex] = {}
         self._writers: Dict[tuple, _SegmentWriter] = {}
 
@@ -598,11 +600,19 @@ class FSEvents(base.LEvents, base.PEvents):
         chan = DEFAULT_CHANNEL if channel_id is None else f"channel_{channel_id}"
         return self._root / f"app_{app_id}" / chan
 
-    def segment_paths(self, app_id: int, channel_id: Optional[int] = None) -> List[Path]:
-        d = self._chan_dir(app_id, channel_id)
+    @staticmethod
+    def _list_segments(d: Path) -> List[Path]:
         if not d.exists():
             return []
         return sorted(d.glob("seg-*.jsonl"))
+
+    def segment_paths(self, app_id: int, channel_id: Optional[int] = None) -> List[Path]:
+        d = self._chan_dir(app_id, channel_id)
+        if (d / self._COMPACT_INTENT).exists():
+            # finish/roll back a crashed compaction before anyone reads
+            with self._lock:
+                self._recover_compact(d)
+        return self._list_segments(d)
 
     def _tombstones(self, d: Path) -> set:
         # union of all tombstone files: "tombstones.txt" (single-writer
@@ -657,10 +667,109 @@ class FSEvents(base.LEvents, base.PEvents):
             w.append(lines)
         return [e.event_id for e in events]
 
-    def _iter_raw(self, app_id: int, channel_id: Optional[int]) -> Iterator[Event]:
+    _COMPACT_INTENT = "compact-intent.json"
+
+    def _recover_compact(self, d: Path) -> None:
+        """Finish or roll back a crashed compaction (two-phase intent file).
+
+        phase 'prepare': hidden output may exist but nothing was published —
+        delete the partial output, keep the original log.  phase 'commit':
+        the output is complete — publish any still-hidden segments, unlink
+        the superseded files, drop the intent."""
+        intent_path = d / self._COMPACT_INTENT
+        if not intent_path.exists():
+            return
+        try:
+            intent = json.loads(intent_path.read_text())
+        except (json.JSONDecodeError, OSError):
+            intent = {"phase": "prepare", "old": [], "tag": ""}
+        tag = intent.get("tag", "")
+        if intent.get("phase") == "commit":
+            for hidden in d.glob(f".seg-{tag}-*.jsonl.tmp"):
+                hidden.rename(d / hidden.name[1:-4])
+            for name in intent.get("old", []):
+                (d / name).unlink(missing_ok=True)
+        else:
+            for hidden in d.glob(f".seg-{tag}-*.jsonl.tmp"):
+                hidden.unlink(missing_ok=True)
+            for pub in d.glob(f"seg-{tag}-*.jsonl"):
+                pub.unlink(missing_ok=True)
+        intent_path.unlink(missing_ok=True)
+
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                before: Optional[_dt.datetime] = None) -> Dict[str, int]:
+        """Rewrite the (app, channel) log dropping tombstoned events — and,
+        with ``before``, expiring events older than that instant (the
+        ActionML ecosystem's SelfCleaningDataSource role: TTL + compaction
+        so the append-only log doesn't grow forever).
+
+        OFFLINE maintenance op, like the reference runs data maintenance:
+        pause ingest AND in-flight scans for this (app, channel) while it
+        runs.  It is crash-safe — a two-phase intent file means a kill at
+        any instant either rolls back (original log intact) or rolls
+        forward (compacted log) on the next access; survivors stream
+        straight from the read to hidden output files (O(1 event) memory).
+        Returns {"kept", "expired", "segments"}.
+        """
+        from predictionio_tpu.events.event import parse_time
+
+        if before is not None:
+            before = parse_time(before)
         d = self._chan_dir(app_id, channel_id)
-        dead = self._tombstones(d)
-        for seg in self.segment_paths(app_id, channel_id):
+        with self._lock:
+            w = self._writers.pop((app_id, channel_id), None)
+            if w is not None:
+                w.close()
+            d.mkdir(parents=True, exist_ok=True)
+            self._recover_compact(d)
+            old_segs = self._list_segments(d)
+            old_tombs = sorted(d.glob("tombstones*.txt"))
+            tag = uuid.uuid4().hex[:8]
+            intent_path = d / self._COMPACT_INTENT
+            old_names = [p.name for p in old_segs] + [p.name for p in old_tombs]
+            _atomic_write(intent_path, json.dumps(
+                {"phase": "prepare", "tag": tag, "old": old_names}))
+            # phase 1: stream survivors into HIDDEN output (readers can't
+            # see it; a crash here rolls back)
+            kept = expired = n_new = 0
+            f = None
+            try:
+                # iterate the snapshot directly (NOT _iter_raw, whose
+                # segment_paths recovery branch would self-deadlock on the
+                # intent we just wrote); tombstones applied the same way
+                for e in self._iter_segments(old_segs, self._tombstones(d)):
+                    if before is not None and e.event_time < before:
+                        expired += 1
+                        continue
+                    if f is None or f.tell() >= SEGMENT_MAX_BYTES:
+                        if f is not None:
+                            f.flush()
+                            os.fsync(f.fileno())
+                            f.close()
+                        f = open(d / f".seg-{tag}-{n_new:05d}.jsonl.tmp", "w")
+                        n_new += 1
+                    f.write(e.to_json_line() + "\n")
+                    kept += 1
+            finally:
+                if f is not None:
+                    f.flush()
+                    os.fsync(f.fileno())
+                    f.close()
+            # phase 2: COMMIT — atomic intent flip, then publish + unlink
+            # (a crash after the flip rolls forward via _recover_compact)
+            _atomic_write(intent_path, json.dumps(
+                {"phase": "commit", "tag": tag, "old": old_names}))
+            for hidden in sorted(d.glob(f".seg-{tag}-*.jsonl.tmp")):
+                hidden.rename(d / hidden.name[1:-4])
+            for p in old_segs + old_tombs:
+                p.unlink(missing_ok=True)
+            intent_path.unlink(missing_ok=True)
+            self._indexes.pop((app_id, channel_id), None)
+            return {"kept": kept, "expired": expired, "segments": n_new}
+
+    @staticmethod
+    def _iter_segments(segs: Sequence[Path], dead: set) -> Iterator[Event]:
+        for seg in segs:
             with open(seg) as f:
                 for line in f:
                     line = line.strip()
@@ -669,6 +778,11 @@ class FSEvents(base.LEvents, base.PEvents):
                     e = Event.from_json(json.loads(line))
                     if e.event_id not in dead:
                         yield e
+
+    def _iter_raw(self, app_id: int, channel_id: Optional[int]) -> Iterator[Event]:
+        d = self._chan_dir(app_id, channel_id)
+        yield from self._iter_segments(
+            self.segment_paths(app_id, channel_id), self._tombstones(d))
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         return next((e for e in self._iter_raw(app_id, channel_id) if e.event_id == event_id), None)

@@ -410,3 +410,122 @@ def test_writer_survives_external_data_delete(tmp_path):
     ev.insert(Event(event="buy", entity_type="user", entity_id="u2"), 1)
     got = [e.entity_id for e in ev2._iter_raw(1, None)]
     assert got == ["u2"]
+
+
+# -- compaction (SelfCleaningDataSource role) --------------------------------
+
+
+def test_compact_drops_tombstones_and_expired(tmp_path):
+    import predictionio_tpu.storage.localfs as lfs
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    old = lfs.SEGMENT_MAX_BYTES
+    lfs.SEGMENT_MAX_BYTES = 2048
+    try:
+        ev = FSEvents(tmp_path)
+        ids = []
+        for k in range(60):
+            ids.extend(ev.insert_batch(
+                [Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                       target_entity_type="item", target_entity_id=f"i{k % 7}",
+                       event_time=ts(k % 23))], 1))
+        for eid in ids[:5]:
+            assert ev.delete(eid, 1)
+        n_segs_before = len(ev.segment_paths(1))
+        assert n_segs_before > 1
+        stats = ev.compact(1, before=ts(3))  # expire hours 0-2
+        live = list(ev._iter_raw(1, None))
+        assert stats["kept"] == len(live)
+        assert all(e.event_id not in ids[:5] for e in live)
+        assert all(e.event_time >= ts(3) for e in live)
+        assert stats["expired"] > 0
+        # tombstone files gone; per-entity index still correct
+        assert not list((tmp_path / "events").rglob("tombstones*.txt"))
+        got = list(ev.find(1, entity_type="user", entity_id="u30"))
+        assert len(got) == 1
+        # ingest continues cleanly after compaction
+        ev.insert(Event(event="buy", entity_type="user", entity_id="fresh"), 1)
+        assert any(e.entity_id == "fresh" for e in ev._iter_raw(1, None))
+    finally:
+        lfs.SEGMENT_MAX_BYTES = old
+
+
+def test_compact_on_sharedfs_multiwriter(tmp_path, monkeypatch):
+    from predictionio_tpu.storage import localfs as lfs, sharedfs
+
+    monkeypatch.setattr(lfs, "SEGMENT_MAX_BYTES", 2048)
+    w1 = sharedfs.SharedFSEvents(tmp_path / "sh", writer_tag="hostA-1")
+    w2 = sharedfs.SharedFSEvents(tmp_path / "sh", writer_tag="hostB-2")
+    for k in range(40):
+        (w1 if k % 2 else w2).insert_batch(
+            [Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                   target_entity_type="item", target_entity_id=f"i{k % 5}")], 1)
+    victim = next(w1._iter_raw(1, None)).event_id
+    assert w2.delete(victim, 1)
+    stats = w1.compact(1)
+    assert stats["kept"] == 39
+    reader = sharedfs.SharedFSEvents(tmp_path / "sh")
+    assert sum(1 for _ in reader._iter_raw(1, None)) == 39
+
+
+def test_compact_cli(tmp_path, monkeypatch):
+    from predictionio_tpu.cli.main import main as pio_main
+    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
+
+    storage = Storage(StorageConfig(
+        sources={"S": {"type": "localfs", "path": str(tmp_path / "store")}},
+        repositories={r: "S" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+    ))
+    set_storage(storage)
+    try:
+        app_id = storage.apps.insert(App(0, "capp"))
+        storage.l_events.insert_batch(
+            [Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                   event_time=ts(k % 20)) for k in range(30)], app_id)
+        rc = pio_main(["app", "compact", "capp", "--before",
+                       ts(10).isoformat()])
+        assert rc == 0
+        left = list(storage.l_events.find(app_id))
+        assert all(e.event_time >= ts(10) for e in left) and left
+    finally:
+        set_storage(None)
+
+
+def test_compact_crash_recovery_both_phases(tmp_path):
+    """A compaction killed mid-run self-heals on the next read: 'prepare'
+    rolls back to the original log, 'commit' rolls forward to the
+    compacted one — never duplicates, never loses."""
+    import json as _json
+
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    ev = FSEvents(tmp_path)
+    ids = ev.insert_batch(
+        [Event(event="buy", entity_type="user", entity_id=f"u{k}")
+         for k in range(20)], 1)
+    assert ev.delete(ids[0], 1)
+    d = ev._chan_dir(1, None)
+
+    # simulate a crash in phase PREPARE: intent + partial hidden output
+    (d / ev._COMPACT_INTENT).write_text(_json.dumps(
+        {"phase": "prepare", "tag": "deadbeef",
+         "old": [p.name for p in ev._list_segments(d)]}))
+    (d / ".seg-deadbeef-00000.jsonl.tmp").write_text("partial garbage\n")
+    reader = FSEvents(tmp_path)
+    got = list(reader._iter_raw(1, None))
+    assert len(got) == 19                       # original log intact
+    assert not list(d.glob("*deadbeef*"))       # partial output rolled back
+    assert not (d / ev._COMPACT_INTENT).exists()
+
+    # simulate a crash in phase COMMIT: full hidden output + commit intent
+    lines = "".join(e.to_json_line() + "\n" for e in got[:7])
+    (d / ".seg-cafe0001-00000.jsonl.tmp").write_text(lines)
+    (d / ev._COMPACT_INTENT).write_text(_json.dumps(
+        {"phase": "commit", "tag": "cafe0001",
+         "old": [p.name for p in ev._list_segments(d)]}))
+    reader2 = FSEvents(tmp_path)
+    got2 = list(reader2._iter_raw(1, None))
+    assert len(got2) == 7                       # rolled FORWARD
+    assert not (d / ev._COMPACT_INTENT).exists()
+    assert all(p.name.startswith("seg-cafe0001-")
+               for p in reader2._list_segments(d))
