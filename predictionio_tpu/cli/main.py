@@ -3,7 +3,7 @@
 Reference: tools/src/main/scala/io/prediction/tools/console/Console.scala and
 bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
 
-  app new|list|show|delete|data-delete    application management
+  app new|list|show|delete|data-delete|compact   application management + log compaction
   accesskey new|list|delete               access keys
   channel new|delete                      channels
   build                                   validate engine.json + register manifest
