@@ -198,6 +198,22 @@ class MemEvents(base.LEvents, base.PEvents):
         with self._lock:
             return self._events.pop((app_id, channel_id), None) is not None
 
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                before=None) -> Dict[str, int]:
+        """Deletes are in-place here, so compaction is only the TTL trim
+        (interface parity with the segment-file backends)."""
+        from predictionio_tpu.events.event import parse_time
+
+        bucket = self._bucket(app_id, channel_id)
+        with self._lock:
+            if before is None:
+                return {"kept": len(bucket), "expired": 0, "segments": 0}
+            before = parse_time(before)
+            doomed = [k for k, e in bucket.items() if e.event_time < before]
+            for k in doomed:
+                del bucket[k]
+            return {"kept": len(bucket), "expired": len(doomed), "segments": 0}
+
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         bucket = self._bucket(app_id, channel_id)
         with self._lock:

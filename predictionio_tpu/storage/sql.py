@@ -576,6 +576,27 @@ class SQLEvents(base.LEvents, base.PEvents):
         self.c.init_event_table(app_id, channel_id)
         return True
 
+    def compact(self, app_id: int, channel_id: Optional[int] = None,
+                before=None) -> dict:
+        """Deletes are in-place in SQL, so compaction is the TTL trim plus
+        a VACUUM to reclaim pages (interface parity with segment backends)."""
+        from predictionio_tpu.events.event import parse_time
+
+        if not self.c.has_event_table(app_id, channel_id):
+            return {"kept": 0, "expired": 0, "segments": 0}
+        t = self.c.event_table(app_id, channel_id)
+        with self.c.lock:
+            expired = 0
+            if before is not None:
+                before = parse_time(before)
+                cur = self.c.conn.execute(
+                    f"DELETE FROM {t} WHERE event_time < ?", (_ts(before),))
+                expired = cur.rowcount
+            kept = self.c.conn.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]
+            self.c.conn.commit()
+        self.c.conn.execute("VACUUM")
+        return {"kept": kept, "expired": expired, "segments": 0}
+
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         if not self.c.has_event_table(app_id, channel_id):
             return False

@@ -529,3 +529,18 @@ def test_compact_crash_recovery_both_phases(tmp_path):
     assert not (d / ev._COMPACT_INTENT).exists()
     assert all(p.name.startswith("seg-cafe0001-")
                for p in reader2._list_segments(d))
+
+
+def test_compact_all_backends(storage):
+    """compact() exists on every backend: segment backends rewrite the log;
+    memory/SQL (in-place deletes) implement the TTL trim."""
+    ev = storage.l_events
+    ev.init(9)
+    ev.insert_batch(
+        [Event(event="buy", entity_type="user", entity_id=f"u{k}",
+               event_time=ts(k % 20)) for k in range(20)], 9)
+    stats = ev.compact(9, before=ts(10))
+    assert stats["expired"] > 0
+    left = list(ev.find(9))
+    assert left and all(e.event_time >= ts(10) for e in left)
+    assert stats["kept"] == len(left)
