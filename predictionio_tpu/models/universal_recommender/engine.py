@@ -244,6 +244,15 @@ class URPreparator(Preparator):
         return td
 
 
+def _evict_oldest(cache: Dict) -> None:
+    """FIFO-evict one entry, tolerating concurrent serving threads racing
+    the same eviction (dict iteration/pop may raise under mutation)."""
+    try:
+        cache.pop(next(iter(cache)), None)
+    except (StopIteration, RuntimeError, KeyError):
+        pass
+
+
 # -- model -------------------------------------------------------------------
 
 
@@ -398,7 +407,7 @@ class URModel(PersistentModel):
         key = (name, value)
         if key not in cache:
             if len(cache) >= self._VALUE_MASK_CACHE_MAX:
-                cache.pop(next(iter(cache)))
+                _evict_oldest(cache)
             m = np.zeros(len(self.item_dict), np.float32)
             m[ids] = 1.0
             cache[key] = jax.device_put(jnp.asarray(m))
@@ -418,7 +427,7 @@ class URModel(PersistentModel):
         cache = self.__dict__.setdefault("_dev_date", {})
         if name not in cache:
             if len(cache) >= self._DATE_CACHE_MAX:
-                cache.pop(next(iter(cache)))
+                _evict_oldest(cache)
             ts = self.prop_date_array(name)
             missing = np.isnan(ts)
             finite = ts[~missing]

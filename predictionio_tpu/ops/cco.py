@@ -115,9 +115,10 @@ def block_interactions_stream(
 ) -> BlockedInteractions:
     """``block_interactions`` over an ITERATOR of (user, item) array batches
     — the host-staging path for event logs larger than comfortable as one
-    array (SURVEY.md §7 hard part (a)): each batch is split into user
-    blocks and appended incrementally, so peak host memory is one batch
-    plus the final layout (never raw + layout at once)."""
+    array (SURVEY.md §7 hard part (a)).  Peak host memory is the grouped
+    per-block copies plus the padded layout (~2× the data, freed block by
+    block as the layout fills) — it avoids the raw + sorted + layout 3×
+    peak of a one-shot argsort, not the copies themselves."""
     n_blocks = max(math.ceil(n_users / user_block), 1)
     per_block_u: List[List[np.ndarray]] = [[] for _ in range(n_blocks)]
     per_block_i: List[List[np.ndarray]] = [[] for _ in range(n_blocks)]
@@ -596,20 +597,11 @@ def _stage_chunked(
     if native is not None:
         lu, it, counts = native   # O(E) two-pass counting layout in C++
     else:
-        blk = user // chunk
-        order = np.argsort(blk, kind="stable")   # radix sort: O(E)
-        user, item, blk = user[order], item[order], blk[order]
-        counts = np.bincount(blk, minlength=n_chunks).astype(np.int32)
-        width = max(int(counts.max()) if len(user) else 1, 1)
-        width = ((width + 7) // 8) * 8
-        lu = np.zeros((n_chunks, width), np.int32)
-        it = np.zeros((n_chunks, width), np.int32)
-        start = 0
-        for b in range(n_chunks):
-            c = int(counts[b])
-            lu[b, :c] = user[start:start + c] % chunk
-            it[b, :c] = item[start:start + c]
-            start += c
+        # numpy fallback: reuse the one shared layout implementation
+        b = block_interactions_stream(
+            [(user, item)], n_chunks * chunk, 0, user_block=chunk)
+        lu, it = b.local_u[:n_chunks], b.item[:n_chunks]
+        counts = b.mask[:n_chunks].sum(axis=1).astype(np.int32)
     if sharding is not None:
         from predictionio_tpu.parallel.sharding import stage_global
 
@@ -649,6 +641,7 @@ class _DenseRunner:
         self.n_chunks = math.ceil(self.n_chunks / dp) * dp
         self.sharding = (
             NamedSharding(mesh, P("dp")) if mesh is not None else None)
+        self._sharded_counts: Dict[tuple, object] = {}
         self.p = _stage_chunked(p_user, p_item,
                                 self.chunk, self.n_chunks, self.sharding)
 
@@ -661,16 +654,23 @@ class _DenseRunner:
                 chunk=self.chunk, n_items_p=self.n_items_p, it_pad=it_pad,
                 self_pair=self_pair, mm=mm,
             )
-        spec, rep = P("dp"), P()
+        # one shard_map wrapper per (it_pad, self_pair, mm): rebuilding the
+        # wrapper per dispatch would re-trace the sharded program every call
+        key = (it_pad, self_pair, mm)
+        counts_sharded = self._sharded_counts.get(key)
+        if counts_sharded is None:
+            spec, rep = P("dp"), P()
 
-        @partial(jax.shard_map, mesh=self.mesh, in_specs=(spec,) * 6,
-                 out_specs=(rep, rep, rep))
-        def counts_sharded(plu, pit, pcnt, alu, ait, acnt):
-            return _cco_counts_dense(
-                plu, pit, pcnt, alu, ait, acnt,
-                chunk=self.chunk, n_items_p=self.n_items_p, it_pad=it_pad,
-                axis_name="dp", self_pair=self_pair, mm=mm,
-            )
+            @partial(jax.shard_map, mesh=self.mesh, in_specs=(spec,) * 6,
+                     out_specs=(rep, rep, rep))
+            def counts_sharded(plu, pit, pcnt, alu, ait, acnt):
+                return _cco_counts_dense(
+                    plu, pit, pcnt, alu, ait, acnt,
+                    chunk=self.chunk, n_items_p=self.n_items_p, it_pad=it_pad,
+                    axis_name="dp", self_pair=self_pair, mm=mm,
+                )
+
+            self._sharded_counts[key] = counts_sharded
 
         return counts_sharded(self.p.local_u, self.p.item, self.p.count,
                               a.local_u, a.item, a.count)

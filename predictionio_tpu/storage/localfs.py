@@ -662,39 +662,67 @@ class FSEvents(base.LEvents, base.PEvents):
         with self._lock:
             w = self._writers.get(key)
             if w is None:
-                w = self._writers[key] = self._new_writer(
-                    self._chan_dir(app_id, channel_id))
+                d = self._chan_dir(app_id, channel_id)
+                if (d / self._COMPACT_INTENT).exists():
+                    # finish a crashed compaction BEFORE picking a segment:
+                    # appending to a superseded segment would ack events the
+                    # roll-forward recovery then unlinks
+                    self._recover_compact(d)
+                w = self._writers[key] = self._new_writer(d)
             w.append(lines)
         return [e.event_id for e in events]
 
     _COMPACT_INTENT = "compact-intent.json"
+    _COMPACT_LOCK = "compact.lock"
 
-    def _recover_compact(self, d: Path) -> None:
-        """Finish or roll back a crashed compaction (two-phase intent file).
+    def _recover_compact(self, d: Path, owned: bool = False) -> None:
+        """Finish or roll back a CRASHED compaction (two-phase intent file).
 
-        phase 'prepare': hidden output may exist but nothing was published —
-        delete the partial output, keep the original log.  phase 'commit':
-        the output is complete — publish any still-hidden segments, unlink
-        the superseded files, drop the intent."""
+        Liveness is decided by an OS flock on ``compact.lock``: a running
+        compactor holds it for the whole operation, so recovery that cannot
+        acquire it does NOTHING — an in-progress compaction is never
+        mistaken for a crashed one (which would delete its output and then
+        lose the log at commit).  With the flock held: phase 'prepare'
+        rolls back (delete partial hidden output, original log intact);
+        phase 'commit' rolls forward (publish remaining hidden segments,
+        unlink superseded files, drop the intent)."""
+        import fcntl
+
         intent_path = d / self._COMPACT_INTENT
         if not intent_path.exists():
             return
+        lockf = None
         try:
-            intent = json.loads(intent_path.read_text())
-        except (json.JSONDecodeError, OSError):
-            intent = {"phase": "prepare", "old": [], "tag": ""}
-        tag = intent.get("tag", "")
-        if intent.get("phase") == "commit":
-            for hidden in d.glob(f".seg-{tag}-*.jsonl.tmp"):
-                hidden.rename(d / hidden.name[1:-4])
-            for name in intent.get("old", []):
-                (d / name).unlink(missing_ok=True)
-        else:
-            for hidden in d.glob(f".seg-{tag}-*.jsonl.tmp"):
-                hidden.unlink(missing_ok=True)
-            for pub in d.glob(f"seg-{tag}-*.jsonl"):
-                pub.unlink(missing_ok=True)
-        intent_path.unlink(missing_ok=True)
+            if not owned:
+                lockf = open(d / self._COMPACT_LOCK, "a")
+                try:
+                    fcntl.flock(lockf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    return  # a live compactor owns the intent; leave it alone
+            if not intent_path.exists():   # recovered while we waited
+                return
+            try:
+                intent = json.loads(intent_path.read_text())
+            except (json.JSONDecodeError, OSError):
+                intent = {"phase": "prepare", "old": [], "tag": ""}
+            tag = intent.get("tag", "")
+            if intent.get("phase") == "commit":
+                for hidden in d.glob(f".seg-{tag}-*.jsonl.tmp"):
+                    try:
+                        hidden.rename(d / hidden.name[1:-4])
+                    except FileNotFoundError:
+                        pass  # racing recoverer on another host won it
+                for name in intent.get("old", []):
+                    (d / name).unlink(missing_ok=True)
+            else:
+                for hidden in d.glob(f".seg-{tag}-*.jsonl.tmp"):
+                    hidden.unlink(missing_ok=True)
+                for pub in d.glob(f"seg-{tag}-*.jsonl"):
+                    pub.unlink(missing_ok=True)
+            intent_path.unlink(missing_ok=True)
+        finally:
+            if lockf is not None:
+                lockf.close()  # closing releases any held flock
 
     def compact(self, app_id: int, channel_id: Optional[int] = None,
                 before: Optional[_dt.datetime] = None) -> Dict[str, int]:
@@ -711,6 +739,8 @@ class FSEvents(base.LEvents, base.PEvents):
         straight from the read to hidden output files (O(1 event) memory).
         Returns {"kept", "expired", "segments"}.
         """
+        import fcntl
+
         from predictionio_tpu.events.event import parse_time
 
         if before is not None:
@@ -721,51 +751,69 @@ class FSEvents(base.LEvents, base.PEvents):
             if w is not None:
                 w.close()
             d.mkdir(parents=True, exist_ok=True)
-            self._recover_compact(d)
-            old_segs = self._list_segments(d)
-            old_tombs = sorted(d.glob("tombstones*.txt"))
-            tag = uuid.uuid4().hex[:8]
-            intent_path = d / self._COMPACT_INTENT
-            old_names = [p.name for p in old_segs] + [p.name for p in old_tombs]
-            _atomic_write(intent_path, json.dumps(
-                {"phase": "prepare", "tag": tag, "old": old_names}))
-            # phase 1: stream survivors into HIDDEN output (readers can't
-            # see it; a crash here rolls back)
-            kept = expired = n_new = 0
-            f = None
+            # own the operation for its whole duration: concurrent readers'
+            # recovery checks see the flock held and leave our intent alone
+            lockf = open(d / self._COMPACT_LOCK, "a")
             try:
-                # iterate the snapshot directly (NOT _iter_raw, whose
-                # segment_paths recovery branch would self-deadlock on the
-                # intent we just wrote); tombstones applied the same way
-                for e in self._iter_segments(old_segs, self._tombstones(d)):
-                    if before is not None and e.event_time < before:
-                        expired += 1
-                        continue
-                    if f is None or f.tell() >= SEGMENT_MAX_BYTES:
-                        if f is not None:
-                            f.flush()
-                            os.fsync(f.fileno())
-                            f.close()
-                        f = open(d / f".seg-{tag}-{n_new:05d}.jsonl.tmp", "w")
-                        n_new += 1
-                    f.write(e.to_json_line() + "\n")
-                    kept += 1
+                fcntl.flock(lockf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                lockf.close()
+                raise RuntimeError(
+                    "another compaction is in progress for this channel")
+            try:
+                return self._compact_locked(d, (app_id, channel_id), before)
             finally:
-                if f is not None:
-                    f.flush()
-                    os.fsync(f.fileno())
-                    f.close()
-            # phase 2: COMMIT — atomic intent flip, then publish + unlink
-            # (a crash after the flip rolls forward via _recover_compact)
-            _atomic_write(intent_path, json.dumps(
-                {"phase": "commit", "tag": tag, "old": old_names}))
-            for hidden in sorted(d.glob(f".seg-{tag}-*.jsonl.tmp")):
-                hidden.rename(d / hidden.name[1:-4])
-            for p in old_segs + old_tombs:
-                p.unlink(missing_ok=True)
-            intent_path.unlink(missing_ok=True)
-            self._indexes.pop((app_id, channel_id), None)
-            return {"kept": kept, "expired": expired, "segments": n_new}
+                lockf.close()
+
+    def _compact_locked(self, d: Path, key: tuple,
+                        before: Optional[_dt.datetime]) -> Dict[str, int]:
+        """compact() body; caller holds BOTH the instance lock and the
+        cross-process flock."""
+        self._recover_compact(d, owned=True)
+        old_segs = self._list_segments(d)
+        old_tombs = sorted(d.glob("tombstones*.txt"))
+        tag = uuid.uuid4().hex[:8]
+        intent_path = d / self._COMPACT_INTENT
+        old_names = [p.name for p in old_segs] + [p.name for p in old_tombs]
+        _atomic_write(intent_path, json.dumps(
+            {"phase": "prepare", "tag": tag, "old": old_names}))
+        # phase 1: stream survivors into HIDDEN output (readers can't
+        # see it; a crash here rolls back)
+        kept = expired = n_new = 0
+        f = None
+        try:
+            # iterate the snapshot directly (NOT _iter_raw, whose
+            # segment_paths recovery branch would self-deadlock on the
+            # intent we just wrote); tombstones applied the same way
+            for e in self._iter_segments(old_segs, self._tombstones(d)):
+                if before is not None and e.event_time < before:
+                    expired += 1
+                    continue
+                if f is None or f.tell() >= SEGMENT_MAX_BYTES:
+                    if f is not None:
+                        f.flush()
+                        os.fsync(f.fileno())
+                        f.close()
+                    f = open(d / f".seg-{tag}-{n_new:05d}.jsonl.tmp", "w")
+                    n_new += 1
+                f.write(e.to_json_line() + "\n")
+                kept += 1
+        finally:
+            if f is not None:
+                f.flush()
+                os.fsync(f.fileno())
+                f.close()
+        # phase 2: COMMIT — atomic intent flip, then publish + unlink
+        # (a crash after the flip rolls forward via _recover_compact)
+        _atomic_write(intent_path, json.dumps(
+            {"phase": "commit", "tag": tag, "old": old_names}))
+        for hidden in sorted(d.glob(f".seg-{tag}-*.jsonl.tmp")):
+            hidden.rename(d / hidden.name[1:-4])
+        for p in old_segs + old_tombs:
+            p.unlink(missing_ok=True)
+        intent_path.unlink(missing_ok=True)
+        self._indexes.pop(key, None)
+        return {"kept": kept, "expired": expired, "segments": n_new}
 
     @staticmethod
     def _iter_segments(segs: Sequence[Path], dead: set) -> Iterator[Event]:

@@ -594,7 +594,9 @@ class SQLEvents(base.LEvents, base.PEvents):
                 expired = cur.rowcount
             kept = self.c.conn.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]
             self.c.conn.commit()
-        self.c.conn.execute("VACUUM")
+            # VACUUM under the shared-connection lock: a concurrent writer's
+            # open transaction would otherwise make it raise
+            self.c.conn.execute("VACUUM")
         return {"kept": kept, "expired": expired, "segments": 0}
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:

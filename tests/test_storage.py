@@ -544,3 +544,73 @@ def test_compact_all_backends(storage):
     left = list(ev.find(9))
     assert left and all(e.event_time >= ts(10) for e in left)
     assert stats["kept"] == len(left)
+
+
+def test_recovery_never_touches_live_compaction(tmp_path):
+    """A reader that sees the intent of a LIVE compaction (flock held) must
+    leave it alone — recovering an in-progress compact would delete its
+    output and lose the whole log at commit."""
+    import fcntl
+    import json as _json
+
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    ev = FSEvents(tmp_path)
+    ev.insert_batch([Event(event="buy", entity_type="user", entity_id=f"u{k}")
+                     for k in range(10)], 1)
+    d = ev._chan_dir(1, None)
+    # simulate the live compactor: intent present AND flock held
+    (d / ev._COMPACT_INTENT).write_text(_json.dumps(
+        {"phase": "prepare", "tag": "live0001",
+         "old": [p.name for p in ev._list_segments(d)]}))
+    hidden = d / ".seg-live0001-00000.jsonl.tmp"
+    hidden.write_text("in progress\n")
+    lockf = open(d / ev._COMPACT_LOCK, "a")
+    fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+    try:
+        reader = FSEvents(tmp_path)
+        segs = reader.segment_paths(1)           # triggers the recovery check
+        assert hidden.exists()                   # output untouched
+        assert (d / ev._COMPACT_INTENT).exists() # intent untouched
+        assert len(list(reader._iter_raw(1, None))) == 10
+        assert segs  # original log still visible
+        # a second compactor is refused while the first runs
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="in progress"):
+            reader.compact(1)
+    finally:
+        fcntl.flock(lockf.fileno(), fcntl.LOCK_UN)
+        lockf.close()
+    # once the "compactor" is gone, recovery rolls the prepare phase back
+    reader2 = FSEvents(tmp_path)
+    reader2.segment_paths(1)
+    assert not hidden.exists()
+    assert not (d / ev._COMPACT_INTENT).exists()
+    assert len(list(reader2._iter_raw(1, None))) == 10
+
+
+def test_insert_after_crashed_commit_recovers_first(tmp_path):
+    """An insert arriving after a commit-phase crash must not land in a
+    superseded segment that roll-forward recovery then unlinks."""
+    import json as _json
+
+    from predictionio_tpu.storage.localfs import FSEvents
+
+    ev = FSEvents(tmp_path)
+    ev.insert_batch([Event(event="buy", entity_type="user", entity_id=f"u{k}")
+                     for k in range(8)], 1)
+    d = ev._chan_dir(1, None)
+    survivors = list(ev._iter_raw(1, None))[:5]
+    (d / ".seg-cafe0002-00000.jsonl.tmp").write_text(
+        "".join(e.to_json_line() + "\n" for e in survivors))
+    (d / ev._COMPACT_INTENT).write_text(_json.dumps(
+        {"phase": "commit", "tag": "cafe0002",
+         "old": [p.name for p in ev._list_segments(d)]}))
+    # fresh process: the FIRST operation is an insert
+    writer = FSEvents(tmp_path)
+    writer.insert(Event(event="buy", entity_type="user",
+                        entity_id="POSTCRASH"), 1)
+    got = [e.entity_id for e in FSEvents(tmp_path)._iter_raw(1, None)]
+    assert "POSTCRASH" in got
+    assert len(got) == 6  # 5 compacted survivors + the new event
