@@ -170,6 +170,11 @@ class URDataSourceParams(Params):
     app_name: str = "default"
     event_names: List[str] = dataclasses.field(default_factory=lambda: ["purchase", "view"])
     item_entity_type: str = "item"
+    # offline evaluation (`pio eval`): leave-one-out — hold out each
+    # qualifying user's LAST primary event; 0 disables, else caps how many
+    # users are evaluated
+    eval_users: int = 0
+    eval_num: int = 10
 
 
 @dataclasses.dataclass
@@ -234,6 +239,65 @@ class URDataSource(DataSource):
             interactions=interactions,
             item_properties={k: dict(v) for k, v in props.items()},
         )
+
+
+    def read_eval(self):
+        """Leave-one-out evaluation folds: each qualifying user's LAST
+        primary event (by eventTime) is held out; training sees the rest.
+        The reference UR ships no evaluation at all — this wires the
+        flagship template into the framework's `pio eval` workflow with
+        the standard implicit-feedback protocol."""
+        if self.params.eval_users <= 0:
+            return []
+        td = self.read_training()
+        primary = td.event_names[0]
+        u, i, item_dict, times = td.interactions[primary]
+        if len(u) == 0:
+            return []
+        order = np.lexsort((times, u))     # by user, then time
+        us, is_, ts_ = u[order], i[order], times[order]
+        last_of_user = np.flatnonzero(
+            np.concatenate((us[1:] != us[:-1], [True])))
+        counts = np.bincount(us, minlength=0)
+        holdout_rows = [r for r in last_of_user if counts[us[r]] >= 2]
+        holdout_rows = holdout_rows[: self.params.eval_users]
+        drop = np.zeros(len(us), bool)
+        drop[holdout_rows] = True
+        interactions = dict(td.interactions)
+        interactions[primary] = (us[~drop], is_[~drop], item_dict, ts_[~drop])
+        fold_td = URTrainingData(
+            event_names=td.event_names,
+            user_dict=td.user_dict,
+            interactions=interactions,
+            item_properties=td.item_properties,
+        )
+        qa = [
+            (URQuery(user=td.user_dict.str(int(us[r])), num=self.params.eval_num),
+             item_dict.str(int(is_[r])))
+            for r in holdout_rows
+        ]
+        return [(fold_td, {"fold": "leave-one-out"}, qa)]
+
+
+class HitRateMetric:
+    """hit@num over URResult predictions (larger is better)."""
+
+    higher_is_better = True
+
+    def header(self) -> str:
+        return "HitRate"
+
+    def calculate(self, eval_data) -> float:
+        hits = total = 0
+        for _info, qpa in eval_data:
+            for _q, p, actual in qpa:
+                total += 1
+                if any(s.item == actual for s in p.item_scores):
+                    hits += 1
+        return hits / total if total else 0.0
+
+    def compare(self, a: float, b: float) -> int:
+        return 0 if a == b else (1 if a > b else -1)
 
 
 class URPreparator(Preparator):
@@ -815,7 +879,25 @@ class URAlgorithm(Algorithm):
             total = s if total is None else total + s
         return total
 
-    def predict(self, model: URModel, query: URQuery) -> URResult:
+    def batch_predict(self, model: URModel, queries) -> List[URResult]:
+        """Eval-time predictions: user history comes from the MODEL's
+        training interactions (user_seen), never the live event store —
+        during `pio eval` the held-out events are still in the store and
+        would otherwise leak into history and the seen-item blacklist."""
+        out = []
+        for q in queries:
+            hist: Dict[str, np.ndarray] = {}
+            if q.user is not None:
+                uid = model.user_dict.id(q.user)
+                if uid is not None:
+                    row = model.user_seen.row(uid)
+                    if len(row):
+                        hist[model.primary_event] = row.astype(np.int32)
+            out.append(self.predict(model, q, hist_override=hist))
+        return out
+
+    def predict(self, model: URModel, query: URQuery,
+                hist_override: Optional[Dict[str, np.ndarray]] = None) -> URResult:
         """Device-final serving: signal accumulation, business-rule masks,
         blacklist, and BOTH top-ks (signal + backfill) run on device; only
         4 [k]-sized arrays and the small history/blacklist id lists cross
@@ -845,7 +927,8 @@ class URAlgorithm(Algorithm):
                         hist[name] = ids.astype(np.int32)
                 signal = self._score_history(model, hist)
         elif query.user is not None:
-            hist = self._user_history(model, query.user)
+            hist = (hist_override if hist_override is not None
+                    else self._user_history(model, query.user))
             signal = self._score_history(model, hist)
         have_signal = signal is not None
         if signal is None:
