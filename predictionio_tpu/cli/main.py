@@ -408,6 +408,11 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--engine-id", default=None)
     tr.add_argument("--engine-version", default="1")
     tr.add_argument("--variant", default="default")
+    tr.add_argument("--stop-after-read", action="store_true",
+                    help="sanity-check the data source, then stop "
+                         "(reference WorkflowParams stopAfterRead)")
+    tr.add_argument("--stop-after-prepare", action="store_true",
+                    help="run data source + preparator, then stop")
     tr.set_defaults(func=_cmd_train)
 
     dp = sub.add_parser("deploy")

@@ -95,6 +95,28 @@ def resolve_engine_id(
     return cli_engine_id or variant.get("id") or factory.engine_id()
 
 
+def _describe(obj) -> str:
+    """One-line structural summary of a training-data object for the
+    stop-after-read/prepare debug output."""
+    import dataclasses as _dc
+
+    import numpy as _np
+
+    bits = [type(obj).__name__]
+    if _dc.is_dataclass(obj) and not isinstance(obj, type):
+        for f in _dc.fields(obj):
+            v = getattr(obj, f.name)
+            if isinstance(v, _np.ndarray):
+                bits.append(f"{f.name}[{v.shape} {v.dtype}]")
+            elif isinstance(v, dict):
+                bits.append(f"{f.name}{{{len(v)}}}")
+            elif hasattr(v, "__len__"):
+                bits.append(f"{f.name}({len(v)})")
+    elif hasattr(obj, "__len__"):
+        bits.append(f"len={len(obj)}")
+    return " ".join(bits)
+
+
 def run_train_from_args(args) -> int:
     """`pio train` entry (reference: Console.train → RunWorkflow →
     CreateWorkflow.main)."""
@@ -107,6 +129,20 @@ def run_train_from_args(args) -> int:
         variant = load_engine_variant(resolve_variant_path(args), args.variant)
         factory, engine, engine_params = engine_from_variant(variant)
         engine_id = resolve_engine_id(args.engine_id, variant, factory)
+        stop_read = getattr(args, "stop_after_read", False)
+        stop_prepare = getattr(args, "stop_after_prepare", False)
+        if stop_read or stop_prepare:
+            # reference WorkflowParams stopAfterRead/stopAfterPrepare:
+            # sanity-check the data pipeline without training/persisting
+            data_source, preparator, _algos, _serving = engine.make_components(
+                engine_params)
+            td = data_source.read_training()
+            print(f"read_training -> {_describe(td)}")
+            if stop_prepare:
+                pd = preparator.prepare(td)
+                print(f"prepare -> {_describe(pd)}")
+            print("Stopped before training (debug flag).")
+            return 0
         instance = core_workflow.run_train(
             engine,
             engine_params,

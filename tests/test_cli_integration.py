@@ -212,3 +212,37 @@ def test_cli_loop_on_sharedfs_with_concurrent_importers(tmp_path):
             server.wait(timeout=20)
         except subprocess.TimeoutExpired:
             server.kill()
+
+
+def test_train_stop_after_read_and_prepare(tmp_path):
+    """--stop-after-read/--stop-after-prepare sanity-check the pipeline
+    without training or persisting an instance (reference WorkflowParams)."""
+    r = pio(["app", "new", "DbgApp"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    events = tmp_path / "ev.jsonl"
+    events.write_text("\n".join(
+        json.dumps({"event": "rate", "entityType": "user", "entityId": f"u{k}",
+                    "targetEntityType": "item", "targetEntityId": f"i{k % 4}",
+                    "properties": {"rating": 4.0}})
+        for k in range(12)) + "\n")
+    r = pio(["import", "--app-name", "DbgApp", "--input", str(events)], tmp_path)
+    assert r.returncode == 0, r.stderr
+    variant = {
+        "id": "dbg", "engineFactory":
+            "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "DbgApp"}},
+        "algorithms": [{"name": "als", "params": {"rank": 2,
+                                                  "numIterations": 2,
+                                                  "meshDp": 1}}],
+    }
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(variant))
+    r = pio(["train", "--engine-json", str(ej), "--stop-after-read"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "read_training ->" in r.stdout and "Stopped before training" in r.stdout
+    r = pio(["train", "--engine-json", str(ej), "--stop-after-prepare"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "prepare ->" in r.stdout
+    # no engine instance was persisted by the debug runs
+    r = pio(["deploy", "--engine-json", str(ej), "--port", "0"], tmp_path)
+    assert r.returncode != 0  # nothing trained yet
