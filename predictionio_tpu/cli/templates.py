@@ -63,6 +63,21 @@ TEMPLATE_VARIANTS: Dict[str, Dict] = {
                         "maxCorrelatorsPerItem": 50, "num": 20}},
         ],
     },
+    "ecommerce": {
+        "id": "my-ecommerce",
+        "description": "e-commerce recommender (implicit ALS + live business rules)",
+        "engineFactory": ENGINE_FACTORIES["ecommerce"],
+        "datasource": {"params": {"appName": "MyApp",
+                                  "eventNames": ["view", "buy"]}},
+        "algorithms": [
+            # appName again: seen/unavailable constraints are read live from
+            # the event store at query time
+            {"name": "ecomm",
+             "params": {"appName": "MyApp", "rank": 10, "numIterations": 20,
+                        "alpha": 1.0, "unseenOnly": True,
+                        "eventWeights": {"buy": 4.0}}},
+        ],
+    },
     "text": {
         "id": "my-text-classification",
         "description": "text classification (tf-idf logistic regression)",

@@ -7,6 +7,9 @@ Each subpackage is a complete DASE engine matching a BASELINE.json config:
 - ``similar_product``       — item-item cooccurrence / ALS item factors
 - ``universal_recommender`` — CCO cross-occurrence (ActionML UR analogue)
 - ``text``                  — text classification (tf-idf + classifier)
+- ``ecommerce``             — implicit-ALS e-commerce recommendations with
+                              live seen/unavailable constraints and
+                              category/white/black-list rules
 """
 
 ENGINE_FACTORIES = {
@@ -15,4 +18,5 @@ ENGINE_FACTORIES = {
     "similar_product": "predictionio_tpu.models.similar_product.SimilarProductEngine",
     "universal_recommender": "predictionio_tpu.models.universal_recommender.UniversalRecommenderEngine",
     "text": "predictionio_tpu.models.text.TextClassificationEngine",
+    "ecommerce": "predictionio_tpu.models.ecommerce.ECommerceEngine",
 }

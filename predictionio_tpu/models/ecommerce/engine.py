@@ -1,0 +1,437 @@
+"""E-Commerce Recommendation engine template.
+
+Capability parity with the reference E-Commerce Recommendation template
+(template repo referenced from the PredictionIO 0.9.x gallery —
+ECommAlgorithm.scala: MLlib ``ALS.trainImplicit`` on view events, with
+three-tier predict (known user → recent-similar → popular default),
+real-time "seen" and "unavailableItems" constraint reads from LEventStore,
+and category/whiteList/blackList business rules; DataSource.scala reads
+``$set`` item properties for categories and the ``constraint``
+``unavailableItems`` entity).
+
+TPU-first redesign, not a translation:
+
+- Training is ``ops.als`` implicit-feedback ALS (Hu/Koren confidence
+  weighting, the trainImplicit analogue) — blocked dense normal equations
+  on the MXU, mesh-sharded via shard_map, not MLlib's RDD block shuffles.
+- Serving is device-final: item factors AND per-category item bitmasks are
+  staged to device once at ``warm()``; a query ships three small padded id
+  lists (categories, whiteList, exclusions) and only the top-K
+  (ids, scores) crosses back.  The reference instead filters candidates
+  item-by-item in the serving JVM per query.
+- Real-time constraints keep reference semantics: seen events and the
+  latest ``unavailableItems`` ``$set`` are read from LEventStore at predict
+  time, so a constraint update takes effect without retraining.
+
+Wire format (reference template):
+  query    {"user": "u1", "num": 4, "categories": ["c"],
+            "whiteList": [...], "blackList": [...]}
+  response {"itemScores": [{"item": "i3", "score": 1.2}, ...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    PersistentModel,
+    Preparator,
+)
+from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
+from predictionio_tpu.ops import als as als_ops
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.store.event_store import LEventStore, PEventStore
+
+log = logging.getLogger("pio.ecommerce")
+
+
+@dataclasses.dataclass
+class ECommQuery:
+    user: str
+    num: int = 10
+    categories: Optional[List[str]] = None
+    white_list: Optional[List[str]] = None
+    black_list: Optional[List[str]] = None
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "ECommQuery":
+        # present-but-empty lists stay [] (an explicitly empty whiteList
+        # means "nothing qualifies", not "unconstrained" — see _rule_ids)
+        def opt(key):
+            return [str(v) for v in d[key]] if key in d and d[key] is not None else None
+
+        return cls(
+            user=str(d["user"]),
+            num=int(d.get("num", 10)),
+            categories=opt("categories"),
+            white_list=opt("whiteList"),
+            black_list=opt("blackList"),
+        )
+
+
+@dataclasses.dataclass
+class ECommDataSourceParams(Params):
+    app_name: str = "default"
+    # interaction events read for training (reference DataSource reads
+    # viewEvents and buyEvents separately; both feed the implicit matrix)
+    event_names: List[str] = dataclasses.field(default_factory=lambda: ["view", "buy"])
+    item_entity_type: str = "item"
+
+
+@dataclasses.dataclass
+class ECommTrainingData:
+    user_idx: np.ndarray      # per event
+    item_idx: np.ndarray
+    event_codes: np.ndarray   # index into event_names
+    event_names: List[str]
+    user_dict: IdDict
+    item_dict: IdDict
+    item_categories: Dict[str, List[str]]
+
+
+class ECommDataSource(DataSource):
+    """Columnar read of interaction events + item ``$set`` categories
+    (reference DataSource.scala: viewEvents/buyEvents RDDs + items with
+    ``categories`` property)."""
+
+    params_class = ECommDataSourceParams
+
+    def read_training(self) -> ECommTrainingData:
+        batch = PEventStore.batch(
+            self.params.app_name, event_names=list(self.params.event_names))
+        has_t = batch.target_ids >= 0
+        u_codes = batch.entity_ids[has_t]
+        t_codes = batch.target_ids[has_t]
+        ev_codes = batch.event_codes[has_t]
+        uu = np.unique(u_codes)
+        user_dict = IdDict([batch.entity_dict.str(int(c)) for c in uu])
+        u_map = np.full(max(len(batch.entity_dict), 1), -1, np.int32)
+        u_map[uu] = np.arange(len(uu), dtype=np.int32)
+        ti = np.unique(t_codes)
+        item_dict = IdDict([batch.target_dict.str(int(c)) for c in ti])
+        t_map = np.full(max(len(batch.target_dict), 1), -1, np.int32)
+        t_map[ti] = np.arange(len(ti), dtype=np.int32)
+        # event name -> position in self.params.event_names (event_dict codes
+        # are storage-order, not config-order)
+        name_of_code = {c: batch.event_dict.str(c) for c in np.unique(ev_codes)}
+        code_map = np.full(max(len(batch.event_dict), 1), -1, np.int32)
+        for c, nm in name_of_code.items():
+            if nm in self.params.event_names:
+                code_map[c] = self.params.event_names.index(nm)
+        props = PEventStore.aggregate_properties(
+            self.params.app_name, self.params.item_entity_type)
+        cats: Dict[str, List[str]] = {}
+        for item, pm in props.items():
+            v = pm.get("categories")
+            if v is not None:
+                cats[item] = [str(c) for c in (v if isinstance(v, list) else [v])]
+        return ECommTrainingData(
+            user_idx=u_map[u_codes].astype(np.int32),
+            item_idx=t_map[t_codes].astype(np.int32),
+            event_codes=code_map[ev_codes].astype(np.int32),
+            event_names=list(self.params.event_names),
+            user_dict=user_dict,
+            item_dict=item_dict,
+            item_categories=cats,
+        )
+
+
+class ECommPreparator(Preparator):
+    def prepare(self, td: ECommTrainingData) -> ECommTrainingData:
+        return td
+
+
+@dataclasses.dataclass
+class ECommAlgorithmParams(Params):
+    app_name: str = "default"   # for real-time LEventStore reads at predict
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0          # implicit-feedback confidence slope
+    seed: int = 7
+    mesh_dp: int = 0
+    # event-strength weights by training event name; unlisted events weigh 1
+    event_weights: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"buy": 4.0})
+    # reference ECommAlgorithmParams: unseenOnly + seenEvents read live
+    unseen_only: bool = False
+    seen_events: List[str] = dataclasses.field(default_factory=lambda: ["view", "buy"])
+    # events whose recent targets seed the unknown-user fallback
+    similar_events: List[str] = dataclasses.field(default_factory=lambda: ["view"])
+    recent_events_limit: int = 10
+    # constraint entity carrying the live unavailable-items list
+    unavailable_constraint: str = "unavailableItems"
+
+
+class ECommModel(PersistentModel):
+    """Factors + device-resident business-rule state.
+
+    ``cat_masks`` is a [C, n_items] bool matrix (category → items); it and
+    the item factors are staged to device once per load (``warm``), making
+    the rules scorer device-final (ops.als.recommend_scores_rules).
+    ``popular`` is the weighted interaction count per item — the
+    predictDefault tier for users with no factor and no recent history.
+    """
+
+    def __init__(self, user_factors, item_factors, user_dict, item_dict,
+                 cat_dict: IdDict, cat_masks: np.ndarray, popular: np.ndarray):
+        self.user_factors = user_factors
+        self.item_factors = item_factors
+        self.user_dict = user_dict
+        self.item_dict = item_dict
+        self.cat_dict = cat_dict
+        self.cat_masks = cat_masks
+        self.popular = popular
+
+    def __getstate__(self):
+        return {
+            "X": self.user_factors, "Y": self.item_factors,
+            "users": self.user_dict.to_state(), "items": self.item_dict.to_state(),
+            "cats": self.cat_dict.to_state(), "cat_masks": self.cat_masks,
+            "popular": self.popular,
+        }
+
+    def __setstate__(self, s):
+        self.user_factors = s["X"]
+        self.item_factors = s["Y"]
+        self.user_dict = IdDict.from_state(s["users"])
+        self.item_dict = IdDict.from_state(s["items"])
+        self.cat_dict = IdDict.from_state(s["cats"])
+        self.cat_masks = s["cat_masks"]
+        self.popular = s["popular"]
+
+    def _device(self, attr: str, build):
+        dev = self.__dict__.get(attr)
+        if dev is None:
+            dev = build()
+            self.__dict__[attr] = dev
+        return dev
+
+    def item_factors_device(self):
+        import jax, jax.numpy as jnp
+
+        return self._device(
+            "_y_dev", lambda: jax.device_put(jnp.asarray(self.item_factors, jnp.float32)))
+
+    def cat_masks_device(self):
+        import jax, jax.numpy as jnp
+
+        def build():
+            m = self.cat_masks
+            if m.shape[0] == 0:  # no categories declared: keep a 1-row dummy
+                m = np.zeros((1, max(len(self.item_dict), 1)), bool)
+            return jax.device_put(jnp.asarray(m))
+
+        return self._device("_cat_dev", build)
+
+    def warm(self) -> None:
+        if len(self.item_factors):
+            self.item_factors_device()
+            self.cat_masks_device()
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+
+    def train(self, td: ECommTrainingData) -> ECommModel:
+        import jax
+
+        n_users, n_items = len(td.user_dict), len(td.item_dict)
+        rank = self.params.rank
+        cat_dict, cat_masks = _category_masks(td.item_categories, td.item_dict)
+        if n_users == 0 or n_items == 0:
+            return ECommModel(
+                np.zeros((0, rank), np.float32), np.zeros((0, rank), np.float32),
+                td.user_dict, td.item_dict, cat_dict, cat_masks,
+                np.zeros(n_items, np.float32))
+        # event-weighted strengths, duplicates summed into one (u, i) cell —
+        # the confidence input r of trainImplicit (reference sums view counts)
+        w = np.ones(len(td.event_names), np.float32)
+        for name, weight in (self.params.event_weights or {}).items():
+            if name in td.event_names:
+                w[td.event_names.index(name)] = float(weight)
+        strength = w[np.maximum(td.event_codes, 0)]
+        cell = td.user_idx.astype(np.int64) * n_items + td.item_idx
+        uniq, inv = np.unique(cell, return_inverse=True)
+        r = np.zeros(len(uniq), np.float32)
+        np.add.at(r, inv, strength)
+        users = (uniq // n_items).astype(np.int32)
+        items = (uniq % n_items).astype(np.int32)
+        popular = np.zeros(n_items, np.float32)
+        np.add.at(popular, items, r)
+        dp = self.params.mesh_dp or len(jax.devices())
+        mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
+        data = als_ops.prepare_als_data(users, items, r, n_users, n_items, dp=dp)
+        X, Y = als_ops.als_train(
+            data, k=rank, reg=self.params.lambda_,
+            iterations=self.params.num_iterations, mesh=mesh,
+            seed=self.params.seed, implicit=True, alpha=self.params.alpha)
+        return ECommModel(X, Y, td.user_dict, td.item_dict, cat_dict, cat_masks, popular)
+
+    def warm(self, model: ECommModel) -> None:
+        model.warm()
+
+    # -- predict tiers (reference ECommAlgorithm.predict) --------------------
+
+    def predict(self, model: ECommModel, query: ECommQuery) -> PredictedResult:
+        if len(model.item_factors) == 0:
+            return PredictedResult([])
+        uid = model.user_dict.id(query.user)
+        if uid is not None and np.any(model.user_factors[uid]):
+            vec = np.asarray(model.user_factors[uid], np.float32)
+            return self._scored(model, query, vec)
+        recent = self._recent_item_ids(model, query.user)
+        if len(recent):
+            # predictSimilar: mean of recently-viewed item factors as the
+            # query vector (cosine-free: factors share one training scale)
+            vec = np.asarray(model.item_factors[recent].mean(axis=0), np.float32)
+            return self._scored(model, query, vec, exclude=recent)
+        return self._popular(model, query)
+
+    def _scored(self, model: ECommModel, query: ECommQuery,
+                vec: np.ndarray, exclude: Sequence[int] = ()) -> PredictedResult:
+        n_items = len(model.item_factors)
+        num = min(query.num, n_items)
+        k = min(als_ops.bucket_width(num), n_items)
+        cat_ids, white, excl, feasible = self._rule_ids(model, query, extra_excl=exclude)
+        if not feasible:
+            return PredictedResult([])
+        scores, idx = als_ops.recommend_scores_rules(
+            vec, model.item_factors_device(), model.cat_masks_device(),
+            als_ops.pad_ids(cat_ids), als_ops.pad_ids(white),
+            als_ops.pad_ids(excl), k)
+        return PredictedResult(
+            [ItemScore(model.item_dict.str(int(i)), float(s))
+             for s, i in zip(np.asarray(scores)[:num], np.asarray(idx)[:num])
+             if np.isfinite(s)])
+
+    def _popular(self, model: ECommModel, query: ECommQuery) -> PredictedResult:
+        """predictDefault: popularity ranking under the same business rules
+        (host numpy — no factors involved, and this tier is rare)."""
+        scores = model.popular.astype(np.float64).copy()
+        cat_ids, white, excl, feasible = self._rule_ids(model, query)
+        if not feasible:
+            return PredictedResult([])
+        if query.categories is not None:
+            allow = (model.cat_masks[cat_ids].any(axis=0)
+                     if len(cat_ids) else np.zeros(len(scores), bool))
+            scores[~allow] = -np.inf
+        if query.white_list is not None:
+            wmask = np.zeros(len(scores), bool)
+            wmask[white] = True
+            scores[~wmask] = -np.inf
+        scores[excl] = -np.inf
+        num = min(query.num, len(scores))
+        top = np.argsort(-scores)[:num]
+        return PredictedResult(
+            [ItemScore(model.item_dict.str(int(i)), float(scores[i]))
+             for i in top if np.isfinite(scores[i])])
+
+    def _rule_ids(self, model: ECommModel, query: ECommQuery,
+                  extra_excl: Sequence[int] = ()):
+        """Translate query rules + live constraints into dense id lists."""
+        cat_ids = np.asarray(
+            [c for c in (model.cat_dict.id(n) for n in query.categories or [])
+             if c is not None], np.int32)
+        white = np.asarray(
+            [i for i in (model.item_dict.id(n) for n in query.white_list or [])
+             if i is not None], np.int32)
+        excl: List[np.ndarray] = [np.asarray(extra_excl, np.int32)]
+        excl.append(np.asarray(
+            [i for i in (model.item_dict.id(n) for n in query.black_list or [])
+             if i is not None], np.int32))
+        excl.append(self._unavailable_ids(model))
+        if self.params.unseen_only:
+            excl.append(self._seen_ids(model, query.user))
+        merged = np.concatenate(excl) if excl else np.empty(0, np.int32)
+        # a constraint that resolves to NOTHING means no item can qualify
+        # (e.g. an unknown category name) — not "unconstrained"
+        feasible = not (
+            (query.categories is not None and len(cat_ids) == 0)
+            or (query.white_list is not None and len(white) == 0))
+        return cat_ids, white, merged, feasible
+
+    # -- live LEventStore reads (reference reads these per query) ------------
+    # Only ValueError (app not registered — the offline-eval case, same as
+    # the UR engine) is treated as "no data"; real storage failures
+    # propagate rather than silently disabling business constraints.
+
+    def _user_event_item_ids(self, model: ECommModel, user: str,
+                             event_names: List[str],
+                             limit: Optional[int] = None) -> np.ndarray:
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.app_name, "user", user,
+                event_names=list(event_names), limit=limit)
+        except ValueError:
+            log.debug("app %r not in event store; skipping live read",
+                      self.params.app_name)
+            return np.empty(0, np.int32)
+        ids = [model.item_dict.id(e.target_entity_id) for e in events
+               if e.target_entity_id is not None]
+        return np.asarray(sorted({i for i in ids if i is not None}), np.int32)
+
+    def _recent_item_ids(self, model: ECommModel, user: str) -> np.ndarray:
+        return self._user_event_item_ids(
+            model, user, self.params.similar_events,
+            limit=self.params.recent_events_limit)
+
+    def _seen_ids(self, model: ECommModel, user: str) -> np.ndarray:
+        return self._user_event_item_ids(model, user, self.params.seen_events)
+
+    def _unavailable_ids(self, model: ECommModel) -> np.ndarray:
+        """Latest ``$set`` on constraint/unavailableItems (property
+        ``items``) — reference semantics: takes effect immediately."""
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.app_name, "constraint",
+                self.params.unavailable_constraint,
+                event_names=["$set"], limit=1)
+        except ValueError:
+            return np.empty(0, np.int32)
+        if not events:
+            return np.empty(0, np.int32)
+        items = events[0].properties.get("items") or []
+        ids = [model.item_dict.id(str(i)) for i in items]
+        return np.asarray([i for i in ids if i is not None], np.int32)
+
+
+def _category_masks(item_categories: Dict[str, List[str]], item_dict: IdDict):
+    names = sorted({c for cats in item_categories.values() for c in cats})
+    cat_dict = IdDict(names)
+    masks = np.zeros((len(names), len(item_dict)), bool)
+    for item, cats in item_categories.items():
+        iid = item_dict.id(item)
+        if iid is None:
+            continue
+        for c in cats:
+            masks[cat_dict.id(c), iid] = True
+    return cat_dict, masks
+
+
+class ECommServing(FirstServing):
+    """Reference template serves the single algorithm's prediction."""
+
+
+class ECommerceEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=ECommDataSource,
+            preparator_class=ECommPreparator,
+            algorithm_classes={"ecomm": ECommAlgorithm},
+            serving_class=ECommServing,
+        )
+
+    query_class = ECommQuery

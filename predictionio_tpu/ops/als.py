@@ -139,49 +139,108 @@ def _half_step(
     return jax.scipy.linalg.cho_solve(cho, b[..., None])[..., 0]  # [rows, K]
 
 
-@functools.partial(jax.jit, static_argnames=("user_rows", "item_rows"))
+def _half_step_implicit(
+    other_full: jnp.ndarray,   # [dp*other_rows, K] gathered opposite factors
+    gram: jnp.ndarray,         # [K, K] = other_fullᵀ other_full (YᵀY term)
+    local_idx: jnp.ndarray,    # [E]
+    other_flat: jnp.ndarray,   # [E]
+    rating: jnp.ndarray,       # [E] raw counts/strengths r ≥ 0
+    mask: jnp.ndarray,         # [E]
+    rows: int,
+    reg: float,
+    alpha: jnp.ndarray,
+) -> jnp.ndarray:
+    """Implicit-feedback half-step (Hu/Koren/Volinsky; MLlib trainImplicit).
+
+    Preference p = 1 for every observed event, confidence c = 1 + α·r.
+    Per-row system: (YᵀY + Yᵀ(C−I)Y + λ·n_e·I) x = Yᵀ C p — the dense YᵀY
+    is the precomputed ``gram`` (one [N,K]×[K,N] MXU matmul per sweep),
+    and only the observed events contribute the (c−1)-weighted correction.
+    """
+    k = other_full.shape[-1]
+    y = other_full[other_flat] * mask[:, None]            # [E, K]
+    c1 = alpha * rating * mask                            # c − 1, 0 on padding
+    outer = (c1[:, None] * y)[:, :, None] * y[:, None, :]
+    A = jax.ops.segment_sum(outer, local_idx, num_segments=rows) + gram
+    b = jax.ops.segment_sum((1.0 + c1)[:, None] * y, local_idx, num_segments=rows)
+    n_e = jax.ops.segment_sum(mask, local_idx, num_segments=rows)
+    lam = reg * jnp.maximum(n_e, 1.0) + 1e-6
+    A = A + lam[:, None, None] * jnp.eye(k, dtype=A.dtype)
+    cho = jax.scipy.linalg.cho_factor(A)
+    return jax.scipy.linalg.cho_solve(cho, b[..., None])[..., 0]  # [rows, K]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("user_rows", "item_rows", "implicit"))
 def _als_run_single(
-    x0, y0, iters, reg,
+    x0, y0, iters, reg, alpha,
     uu, ui, ur, um, ii, iu, ir, im,
-    *, user_rows: int, item_rows: int,
+    *, user_rows: int, item_rows: int, implicit: bool = False,
 ):
     """Single-program ALS sweeps, vmapped over the shard axis.
 
-    Module-level jit with DYNAMIC iteration count and reg: one compiled
-    program per data/factor shape serves every (iterations, reg) setting —
-    retraining and hyperparameter grids never recompile.
+    Module-level jit with DYNAMIC iteration count, reg, and alpha: one
+    compiled program per data/factor shape/mode serves every (iterations,
+    reg, alpha) setting — retraining and hyperparameter grids never
+    recompile.
     """
     dp, _, k = y0.shape
 
     def sweep(_, carry):
         x, y = carry
         y_full = y.reshape(dp * item_rows, k)
-        x = jax.vmap(
-            lambda lo, ot, rr, mm: _half_step(y_full, lo, ot, rr, mm, user_rows, reg)
-        )(uu, ui, ur, um)
+        if implicit:
+            gram_y = y_full.T @ y_full
+            x = jax.vmap(
+                lambda lo, ot, rr, mm: _half_step_implicit(
+                    y_full, gram_y, lo, ot, rr, mm, user_rows, reg, alpha)
+            )(uu, ui, ur, um)
+        else:
+            x = jax.vmap(
+                lambda lo, ot, rr, mm: _half_step(y_full, lo, ot, rr, mm, user_rows, reg)
+            )(uu, ui, ur, um)
         x_full = x.reshape(dp * user_rows, k)
-        y = jax.vmap(
-            lambda lo, ot, rr, mm: _half_step(x_full, lo, ot, rr, mm, item_rows, reg)
-        )(ii, iu, ir, im)
+        if implicit:
+            gram_x = x_full.T @ x_full
+            y = jax.vmap(
+                lambda lo, ot, rr, mm: _half_step_implicit(
+                    x_full, gram_x, lo, ot, rr, mm, item_rows, reg, alpha)
+            )(ii, iu, ir, im)
+        else:
+            y = jax.vmap(
+                lambda lo, ot, rr, mm: _half_step(x_full, lo, ot, rr, mm, item_rows, reg)
+            )(ii, iu, ir, im)
         return (x, y)
 
     return jax.lax.fori_loop(0, iters, sweep, (x0, y0))
 
 
 @functools.lru_cache(maxsize=8)
-def _als_sharded_fn(mesh: Mesh, user_rows: int, item_rows: int):
-    """Build (and cache per mesh/layout) the shard_map'd ALS runner."""
+def _als_sharded_fn(mesh: Mesh, user_rows: int, item_rows: int, implicit: bool):
+    """Build (and cache per mesh/layout/mode) the shard_map'd ALS runner."""
 
-    def per_shard(x0_, y0_, iters, reg, uu, ui, ur, um, ii, iu, ir, im):
+    def per_shard(x0_, y0_, iters, reg, alpha, uu, ui, ur, um, ii, iu, ir, im):
         def sweep(_, carry):
             # Every array here is this shard's block: factors [1, rows, K],
             # events [1, E].  all_gather pulls the opposite side's blocks
-            # over ICI — the only communication in the sweep.
+            # over ICI — the only communication in the sweep.  The implicit
+            # Gram is computed from the gathered full matrix (replicated
+            # K×K work, negligible next to the solves).
             x, y = carry
             y_full = jax.lax.all_gather(y[0], "dp", tiled=True)  # [dp*item_rows, K]
-            x = _half_step(y_full, uu[0], ui[0], ur[0], um[0], user_rows, reg)[None]
+            if implicit:
+                gram_y = y_full.T @ y_full
+                x = _half_step_implicit(
+                    y_full, gram_y, uu[0], ui[0], ur[0], um[0], user_rows, reg, alpha)[None]
+            else:
+                x = _half_step(y_full, uu[0], ui[0], ur[0], um[0], user_rows, reg)[None]
             x_full = jax.lax.all_gather(x[0], "dp", tiled=True)
-            y = _half_step(x_full, ii[0], iu[0], ir[0], im[0], item_rows, reg)[None]
+            if implicit:
+                gram_x = x_full.T @ x_full
+                y = _half_step_implicit(
+                    x_full, gram_x, ii[0], iu[0], ir[0], im[0], item_rows, reg, alpha)[None]
+            else:
+                y = _half_step(x_full, ii[0], iu[0], ir[0], im[0], item_rows, reg)[None]
             return (x, y)
 
         return jax.lax.fori_loop(0, iters, sweep, (x0_, y0_))
@@ -189,13 +248,14 @@ def _als_sharded_fn(mesh: Mesh, user_rows: int, item_rows: int):
     spec, rep = P("dp"), P()
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(spec, spec, rep, rep) + (spec,) * 8,
+        in_specs=(spec, spec, rep, rep, rep) + (spec,) * 8,
         out_specs=(spec, spec),
     ))
 
 
-def _als_run_sharded(mesh, user_rows, item_rows, x0, y0, iters, reg, *args):
-    return _als_sharded_fn(mesh, user_rows, item_rows)(x0, y0, iters, reg, *args)
+def _als_run_sharded(mesh, user_rows, item_rows, implicit, x0, y0, iters, reg, alpha, *args):
+    return _als_sharded_fn(mesh, user_rows, item_rows, implicit)(
+        x0, y0, iters, reg, alpha, *args)
 
 
 def als_train(
@@ -207,6 +267,8 @@ def als_train(
     seed: int = 7,
     checkpoint=None,
     checkpoint_every: int = 0,
+    implicit: bool = False,
+    alpha: float = 1.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run ALS sweeps; returns (X [n_users, K], Y [n_items, K]) on host.
 
@@ -214,22 +276,39 @@ def als_train(
     all-gathers the opposite blocks (ICI); without, the same program runs on
     one device with dp=1.
 
+    ``implicit=True`` switches to implicit-feedback ALS (Hu/Koren/Volinsky,
+    the MLlib ``ALS.trainImplicit`` the reference e-commerce and
+    similar-product templates call): ratings become confidences
+    c = 1 + ``alpha``·r over binary preferences, and each half-step adds the
+    dense YᵀY Gram term.
+
     ``checkpoint`` (a utils.checkpoint.CheckpointStore) + ``checkpoint_every``
     snapshot the factor blocks every N sweeps and resume from the newest
     snapshot — sweeps already completed by a failed run are not repeated.
     """
     if checkpoint is not None and checkpoint_every > 0:
         return _als_train_checkpointed(
-            data, k, reg, iterations, mesh, seed, checkpoint, checkpoint_every
+            data, k, reg, iterations, mesh, seed, checkpoint, checkpoint_every,
+            implicit=implicit, alpha=alpha,
         )
     x0, y0 = _als_init(data, k, seed)
-    x, y = _als_sweeps(data, x0, y0, iterations, reg, mesh)
+    x, y = _als_sweeps(data, x0, y0, iterations, reg, mesh,
+                       implicit=implicit, alpha=alpha)
     return _als_deinterleave(data, x, y, k)
 
 
 def _als_init(data: ALSData, k: int, seed: int):
     key = jax.random.PRNGKey(seed)
     y0 = jax.random.normal(key, (data.dp, data.item_rows, k), jnp.float32) * 0.1
+    # zero the padding rows (shard s, local r holds item r*dp + s): real rows
+    # never read them in the explicit path, but the implicit path's Gram
+    # (YᵀY over the full gathered block) must not see init noise there —
+    # and they then stay exactly 0 (their normal equations have b = 0).
+    item_id = (
+        jnp.arange(data.item_rows, dtype=jnp.int32)[None, :] * data.dp
+        + jnp.arange(data.dp, dtype=jnp.int32)[:, None]
+    )
+    y0 = y0 * (item_id < data.n_items)[..., None]
     x0 = jnp.zeros((data.dp, data.user_rows, k), jnp.float32)
     return x0, y0
 
@@ -243,13 +322,15 @@ def _als_device_args(data: ALSData):
     )
 
 
-def _als_sweeps(data: ALSData, x0, y0, n_sweeps: int, reg: float, mesh, args=None):
+def _als_sweeps(data: ALSData, x0, y0, n_sweeps: int, reg: float, mesh, args=None,
+                implicit: bool = False, alpha: float = 1.0):
     if args is None:
         args = _als_device_args(data)
     if mesh is None:
         return _als_run_single(
-            x0, y0, jnp.int32(n_sweeps), jnp.float32(reg),
+            x0, y0, jnp.int32(n_sweeps), jnp.float32(reg), jnp.float32(alpha),
             *args, user_rows=data.user_rows, item_rows=data.item_rows,
+            implicit=implicit,
         )
     if mesh.shape.get("dp", 1) != data.dp:
         raise ValueError(
@@ -260,8 +341,8 @@ def _als_sweeps(data: ALSData, x0, y0, n_sweeps: int, reg: float, mesh, args=Non
     x0 = stage_global(np.asarray(x0), sharding)
     y0 = stage_global(np.asarray(y0), sharding)
     return _als_run_sharded(
-        mesh, data.user_rows, data.item_rows,
-        x0, y0, jnp.int32(n_sweeps), jnp.float32(reg), *args,
+        mesh, data.user_rows, data.item_rows, implicit,
+        x0, y0, jnp.int32(n_sweeps), jnp.float32(reg), jnp.float32(alpha), *args,
     )
 
 
@@ -281,25 +362,28 @@ def _als_deinterleave(data: ALSData, x, y, k: int):
     return x, y_arr
 
 
-def als_fingerprint(data: ALSData, k: int, reg: float, seed: int) -> str:
+def als_fingerprint(data: ALSData, k: int, reg: float, seed: int,
+                    implicit: bool = False, alpha: float = 1.0) -> str:
     """Identifies a training run well enough to reject foreign snapshots:
     hyperparams + data layout + a cheap content signature."""
     n_events = int(data.u_mask.sum())
     sig = int(np.int64(data.u_rating.sum() * 1000)) if n_events else 0
+    mode = f"-imp{alpha}" if implicit else ""
     return (
         f"k{k}-dp{data.dp}-u{data.n_users}x{data.user_rows}"
-        f"-i{data.n_items}x{data.item_rows}-e{n_events}-r{reg}-s{seed}-h{sig}"
+        f"-i{data.n_items}x{data.item_rows}-e{n_events}-r{reg}-s{seed}-h{sig}{mode}"
     )
 
 
 def _als_train_checkpointed(
     data: ALSData, k: int, reg: float, iterations: int, mesh,
     seed: int, checkpoint, checkpoint_every: int,
+    implicit: bool = False, alpha: float = 1.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Chunked sweeps with snapshot/resume (see als_train docstring)."""
     from predictionio_tpu.utils.checkpoint import maybe_inject
 
-    fingerprint = als_fingerprint(data, k, reg, seed)
+    fingerprint = als_fingerprint(data, k, reg, seed, implicit, alpha)
     done = 0
     x = y = None
     latest = checkpoint.latest()
@@ -317,7 +401,8 @@ def _als_train_checkpointed(
     args = _als_device_args(data)  # one host->device upload for all chunks
     while done < iterations:
         n = min(checkpoint_every, iterations - done)
-        x, y = _als_sweeps(data, x, y, n, reg, mesh, args=args)
+        x, y = _als_sweeps(data, x, y, n, reg, mesh, args=args,
+                           implicit=implicit, alpha=alpha)
         done += n
         maybe_inject("als.sweep")  # rehearse mid-training failure in tests
         checkpoint.save(done, {
@@ -356,6 +441,40 @@ def recommend_scores_excl(
     valid = excl_idx >= 0
     scores = scores.at[jnp.where(valid, excl_idx, 0)].min(
         jnp.where(valid, -jnp.inf, jnp.inf))
+    return jax.lax.top_k(scores, top_k)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def recommend_scores_rules(
+    user_vec: jnp.ndarray,        # [K]
+    item_factors: jnp.ndarray,    # [n_items, K] — device-resident
+    cat_masks: jnp.ndarray,       # [C, n_items] bool — device-resident at warm()
+    cat_ids: jnp.ndarray,         # [Wc] category ids to OR, -1 padding
+    white_idx: jnp.ndarray,       # [Ww] whitelist item ids, -1 padding
+    excl_idx: jnp.ndarray,        # [We] excluded item ids, -1 padding
+    top_k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-K with e-commerce business rules, fully device-final.
+
+    Category masks live on device (staged once per model load); a query
+    ships only three small padded id lists, and only the top-K crosses back
+    — at no point does an [n_items] vector transfer per query (the
+    reference template does this filtering in the ES/driver JVM instead).
+    Empty cat_ids/white_idx (all -1) mean "no constraint of that kind".
+    """
+    n_items = item_factors.shape[0]
+    scores = item_factors @ user_vec
+    cat_valid = cat_ids >= 0
+    sel = cat_masks[jnp.where(cat_valid, cat_ids, 0)] & cat_valid[:, None]
+    allow_cat = jnp.where(cat_valid.any(), sel.any(axis=0), True)
+    white_valid = white_idx >= 0
+    white_mask = jnp.zeros((n_items,), bool).at[
+        jnp.where(white_valid, white_idx, 0)].max(white_valid)
+    allow_white = jnp.where(white_valid.any(), white_mask, True)
+    scores = jnp.where(allow_cat & allow_white, scores, -jnp.inf)
+    excl_valid = excl_idx >= 0
+    scores = scores.at[jnp.where(excl_valid, excl_idx, 0)].min(
+        jnp.where(excl_valid, -jnp.inf, jnp.inf))
     return jax.lax.top_k(scores, top_k)
 
 

@@ -3,6 +3,7 @@
 gallery; dashboard module)."""
 
 import json
+import os
 import urllib.request
 
 import pytest
@@ -98,7 +99,7 @@ def test_template_list(capsys):
 
 @pytest.mark.parametrize("template", [
     "recommendation", "classification", "similar_product",
-    "universal_recommender", "text",
+    "universal_recommender", "text", "ecommerce",
 ])
 def test_template_scaffold_builds(template, mem_storage, tmp_path):
     """Every scaffolded engine.json must pass `pio build` (params bind)."""
@@ -248,3 +249,15 @@ def test_import_export_channel(mem_storage, tmp_path, capsys):
     # unknown channel rejected
     assert pio_main(["import", "--app-name", "ChApp", "--channel", "nope",
                      "--input", str(events)]) == 1
+
+
+def test_example_engine_jsons_bind(mem_storage):
+    """Every examples/*/engine.json must pass `pio build` (factory resolves,
+    params bind against the dataclasses)."""
+    import glob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(repo, "examples", "*", "engine.json")))
+    assert len(paths) >= 6
+    for p in paths:
+        assert pio_main(["build", "--engine-json", p]) == 0, p
