@@ -283,12 +283,20 @@ def bench_predict_p50(smoke: bool) -> float:
     item_factors = jnp.asarray(rng.normal(size=(n_items, k)), jnp.float32)
     seen = jnp.zeros(n_items, jnp.float32)
     user_vecs = jnp.asarray(rng.normal(size=(256, k)), jnp.float32)
+    from predictionio_tpu.ops.als import _stack_topk
+
+    pack = jax.jit(lambda a, b: _stack_topk(a, b))
     recommend_scores(user_vecs[0], item_factors, seen, 10)[0].block_until_ready()
+    np.asarray(pack(*recommend_scores(user_vecs[0], item_factors, seen, 10)))
     times = []
     for i in range(100 if not smoke else 10):
         t0 = time.perf_counter()
         s, idx = recommend_scores(user_vecs[i % 256], item_factors, seen, 10)
-        jax.block_until_ready((s, idx))
+        # fetch ONE stacked array, don't just block: on the tunneled chip
+        # block_until_ready returns before the device round trip completes,
+        # and the serving paths all do exactly one stacked readback — this
+        # times the same thing
+        np.asarray(pack(s, idx))
         times.append((time.perf_counter() - t0) * 1e3)
     return float(np.percentile(times, 50))
 

@@ -1,0 +1,51 @@
+"""Serving-side helpers shared by the engine templates.
+
+Small by design: the device-resident cache pattern and the wire-format
+list contract are load-bearing in several templates (ecommerce,
+similar_product, recommendation, UR); keeping one copy means a fix to the
+cache or to the empty-vs-absent semantics lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def opt_str_list(d: Dict, key: str) -> Optional[List[str]]:
+    """Wire contract for optional list fields: a present-but-empty list
+    stays ``[]`` (an explicitly empty whiteList means "nothing qualifies")
+    while an absent or null key is ``None`` ("unconstrained")."""
+    return [str(v) for v in d[key]] if key in d and d[key] is not None else None
+
+
+class DeviceCacheMixin:
+    """Lazy per-instance device staging, rebuilt after unpickle.
+
+    Cached device arrays live only in ``__dict__`` under their cache key
+    (never pickled); ``_device`` stages on first use so a model loaded from
+    storage pays the host→device transfer once, at warm()/first query.
+    """
+
+    def _device(self, attr: str, build):
+        dev = self.__dict__.get(attr)
+        if dev is None:
+            dev = build()
+            self.__dict__[attr] = dev
+        return dev
+
+    def cat_masks_device(self):
+        """The [C, n_items] category bitmask matrix, device-resident.
+        A model with no categories stages a 1-row all-False dummy so the
+        rules scorer keeps a static shape."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            m = self.cat_masks
+            if m.shape[0] == 0:
+                m = np.zeros((1, max(len(self.item_dict), 1)), bool)
+            return jax.device_put(jnp.asarray(m))
+
+        return self._device("_cat_dev", build)

@@ -50,7 +50,8 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.models.common import DeviceCacheMixin, opt_str_list
+from predictionio_tpu.store.columnar import IdDict, category_masks
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
 
 log = logging.getLogger("pio.ecommerce")
@@ -66,17 +67,13 @@ class ECommQuery:
 
     @classmethod
     def from_json(cls, d: Dict) -> "ECommQuery":
-        # present-but-empty lists stay [] (an explicitly empty whiteList
-        # means "nothing qualifies", not "unconstrained" — see _rule_ids)
-        def opt(key):
-            return [str(v) for v in d[key]] if key in d and d[key] is not None else None
-
+        # empty-vs-absent semantics: see models.common.opt_str_list
         return cls(
             user=str(d["user"]),
             num=int(d.get("num", 10)),
-            categories=opt("categories"),
-            white_list=opt("whiteList"),
-            black_list=opt("blackList"),
+            categories=opt_str_list(d, "categories"),
+            white_list=opt_str_list(d, "whiteList"),
+            black_list=opt_str_list(d, "blackList"),
         )
 
 
@@ -174,32 +171,33 @@ class ECommAlgorithmParams(Params):
     unavailable_constraint: str = "unavailableItems"
 
 
-class ECommModel(PersistentModel):
+class ECommModel(DeviceCacheMixin, PersistentModel):
     """Factors + device-resident business-rule state.
 
-    ``cat_masks`` is a [C, n_items] bool matrix (category → items); it and
-    the item factors are staged to device once per load (``warm``), making
-    the rules scorer device-final (ops.als.recommend_scores_rules).
+    ``cat_masks`` ([C, n_items] bool, category → items) is derived from
+    the sparse per-item category dict (persisted form — the dense matrix
+    would be ~100 MB at 100k items × 1k categories) and staged to device
+    once per load (``warm``) together with the item factors, making the
+    rules scorer device-final (ops.als.recommend_scores_rules).
     ``popular`` is the weighted interaction count per item — the
     predictDefault tier for users with no factor and no recent history.
     """
 
     def __init__(self, user_factors, item_factors, user_dict, item_dict,
-                 cat_dict: IdDict, cat_masks: np.ndarray, popular: np.ndarray):
+                 item_categories: Dict[str, List[str]], popular: np.ndarray):
         self.user_factors = user_factors
         self.item_factors = item_factors
         self.user_dict = user_dict
         self.item_dict = item_dict
-        self.cat_dict = cat_dict
-        self.cat_masks = cat_masks
+        self.item_categories = item_categories
+        self.cat_dict, self.cat_masks = category_masks(item_categories, item_dict)
         self.popular = popular
 
     def __getstate__(self):
         return {
             "X": self.user_factors, "Y": self.item_factors,
             "users": self.user_dict.to_state(), "items": self.item_dict.to_state(),
-            "cats": self.cat_dict.to_state(), "cat_masks": self.cat_masks,
-            "popular": self.popular,
+            "cats": self.item_categories, "popular": self.popular,
         }
 
     def __setstate__(self, s):
@@ -207,33 +205,16 @@ class ECommModel(PersistentModel):
         self.item_factors = s["Y"]
         self.user_dict = IdDict.from_state(s["users"])
         self.item_dict = IdDict.from_state(s["items"])
-        self.cat_dict = IdDict.from_state(s["cats"])
-        self.cat_masks = s["cat_masks"]
+        self.item_categories = s["cats"]
+        self.cat_dict, self.cat_masks = category_masks(
+            self.item_categories, self.item_dict)
         self.popular = s["popular"]
-
-    def _device(self, attr: str, build):
-        dev = self.__dict__.get(attr)
-        if dev is None:
-            dev = build()
-            self.__dict__[attr] = dev
-        return dev
 
     def item_factors_device(self):
         import jax, jax.numpy as jnp
 
         return self._device(
             "_y_dev", lambda: jax.device_put(jnp.asarray(self.item_factors, jnp.float32)))
-
-    def cat_masks_device(self):
-        import jax, jax.numpy as jnp
-
-        def build():
-            m = self.cat_masks
-            if m.shape[0] == 0:  # no categories declared: keep a 1-row dummy
-                m = np.zeros((1, max(len(self.item_dict), 1)), bool)
-            return jax.device_put(jnp.asarray(m))
-
-        return self._device("_cat_dev", build)
 
     def warm(self) -> None:
         if len(self.item_factors):
@@ -249,11 +230,10 @@ class ECommAlgorithm(Algorithm):
 
         n_users, n_items = len(td.user_dict), len(td.item_dict)
         rank = self.params.rank
-        cat_dict, cat_masks = _category_masks(td.item_categories, td.item_dict)
         if n_users == 0 or n_items == 0:
             return ECommModel(
                 np.zeros((0, rank), np.float32), np.zeros((0, rank), np.float32),
-                td.user_dict, td.item_dict, cat_dict, cat_masks,
+                td.user_dict, td.item_dict, td.item_categories,
                 np.zeros(n_items, np.float32))
         # event-weighted strengths, duplicates summed into one (u, i) cell —
         # the confidence input r of trainImplicit (reference sums view counts)
@@ -277,7 +257,7 @@ class ECommAlgorithm(Algorithm):
             data, k=rank, reg=self.params.lambda_,
             iterations=self.params.num_iterations, mesh=mesh,
             seed=self.params.seed, implicit=True, alpha=self.params.alpha)
-        return ECommModel(X, Y, td.user_dict, td.item_dict, cat_dict, cat_masks, popular)
+        return ECommModel(X, Y, td.user_dict, td.item_dict, td.item_categories, popular)
 
     def warm(self, model: ECommModel) -> None:
         model.warm()
@@ -307,13 +287,14 @@ class ECommAlgorithm(Algorithm):
         cat_ids, white, excl, feasible = self._rule_ids(model, query, extra_excl=exclude)
         if not feasible:
             return PredictedResult([])
-        scores, idx = als_ops.recommend_scores_rules(
+        out = np.asarray(als_ops.recommend_scores_rules(
             vec, model.item_factors_device(), model.cat_masks_device(),
             als_ops.pad_ids(cat_ids), als_ops.pad_ids(white),
-            als_ops.pad_ids(excl), k)
+            als_ops.pad_ids(excl), k))   # ONE [2, k] readback per query
+        scores, idx = out[0], out[1].astype(np.int32)
         return PredictedResult(
             [ItemScore(model.item_dict.str(int(i)), float(s))
-             for s, i in zip(np.asarray(scores)[:num], np.asarray(idx)[:num])
+             for s, i in zip(scores[:num], idx[:num])
              if np.isfinite(s)])
 
     def _popular(self, model: ECommModel, query: ECommQuery) -> PredictedResult:
@@ -405,19 +386,6 @@ class ECommAlgorithm(Algorithm):
         items = events[0].properties.get("items") or []
         ids = [model.item_dict.id(str(i)) for i in items]
         return np.asarray([i for i in ids if i is not None], np.int32)
-
-
-def _category_masks(item_categories: Dict[str, List[str]], item_dict: IdDict):
-    names = sorted({c for cats in item_categories.values() for c in cats})
-    cat_dict = IdDict(names)
-    masks = np.zeros((len(names), len(item_dict)), bool)
-    for item, cats in item_categories.items():
-        iid = item_dict.id(item)
-        if iid is None:
-            continue
-        for c in cats:
-            masks[cat_dict.id(c), iid] = True
-    return cat_dict, masks
 
 
 class ECommServing(FirstServing):

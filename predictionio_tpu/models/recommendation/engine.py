@@ -317,14 +317,17 @@ class ALSAlgorithm(Algorithm):
         num = min(query.num, len(model.item_factors))
         k = self._k_bucket(num, len(model.item_factors))
         excl = als_ops.pad_ids(self._exclusions(model, query, uid))
-        scores, idx = als_ops.recommend_scores_excl(
+        # ONE stacked [2, k] readback — each separate fetch is a device
+        # round trip (≈70 ms over a tunneled chip)
+        out = np.asarray(als_ops.recommend_scores_excl(
             np.asarray(model.user_factors[uid], np.float32),
             model.item_factors_device(), excl, k,
-        )
+        ))
+        scores, idx = out[0], out[1].astype(np.int32)
         return PredictedResult(
             [
                 ItemScore(model.item_dict.str(int(i)), float(s))
-                for s, i in zip(np.asarray(scores)[:num], np.asarray(idx)[:num])
+                for s, i in zip(scores[:num], idx[:num])
                 if np.isfinite(s)
             ]
         )
@@ -348,10 +351,10 @@ class ALSAlgorithm(Algorithm):
         excl = np.full((len(queries), width), -1, np.int32)
         for j, e in enumerate(excl_rows):
             excl[j, :len(e)] = e
-        scores, idx = als_ops.recommend_batch_excl(
+        out = np.asarray(als_ops.recommend_batch_excl(
             np.asarray(vecs, np.float32), model.item_factors_device(), excl, k,
-        )
-        scores, idx = np.asarray(scores), np.asarray(idx)
+        ))
+        scores, idx = out[:, 0], out[:, 1].astype(np.int32)
         out = []
         for j, q in enumerate(queries):
             if uids[j] < 0:

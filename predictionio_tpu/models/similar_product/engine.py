@@ -19,7 +19,7 @@ Algorithms:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -40,7 +40,8 @@ from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedRe
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.ops import cco as cco_ops
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.models.common import DeviceCacheMixin, opt_str_list
+from predictionio_tpu.store.columnar import IdDict, category_masks
 from predictionio_tpu.store.event_store import PEventStore
 
 
@@ -54,12 +55,13 @@ class SimilarProductQuery:
 
     @classmethod
     def from_json(cls, d: Dict) -> "SimilarProductQuery":
+        # empty-vs-absent semantics: see models.common.opt_str_list
         return cls(
             items=[str(i) for i in d["items"]],
             num=int(d.get("num", 10)),
-            categories=[str(c) for c in d["categories"]] if d.get("categories") else None,
-            white_list=[str(i) for i in d["whiteList"]] if d.get("whiteList") else None,
-            black_list=[str(i) for i in d["blackList"]] if d.get("blackList") else None,
+            categories=opt_str_list(d, "categories"),
+            white_list=opt_str_list(d, "whiteList"),
+            black_list=opt_str_list(d, "blackList"),
         )
 
 
@@ -122,9 +124,14 @@ class SPPreparator(Preparator):
         return td
 
 
-class SPModel(PersistentModel):
+class SPModel(DeviceCacheMixin, PersistentModel):
     """Either item factors (als) or an indicator table (cooccurrence);
-    scoring normalizes both to an item->similar-items lookup."""
+    scoring normalizes both to an item->similar-items lookup.
+
+    Serving state is device-resident (``warm``): row-normalized factors OR
+    the indicator table, plus the [C, n_items] category masks — per query
+    only small padded id lists upload and one stacked [2, k] array returns
+    (each extra device sync is a full round trip on a tunneled chip)."""
 
     def __init__(self, kind, item_dict, item_categories,
                  item_factors=None, indicator_idx=None, indicator_llr=None):
@@ -134,6 +141,7 @@ class SPModel(PersistentModel):
         self.item_factors = item_factors
         self.indicator_idx = indicator_idx
         self.indicator_llr = indicator_llr
+        self.cat_dict, self.cat_masks = category_masks(item_categories, item_dict)
 
     def __getstate__(self):
         return {
@@ -149,12 +157,46 @@ class SPModel(PersistentModel):
         self.item_factors = s["factors"]
         self.indicator_idx = s["idx"]
         self.indicator_llr = s["llr"]
+        self.cat_dict, self.cat_masks = category_masks(
+            self.item_categories, self.item_dict)
+
+    def factors_norm_device(self):
+        """Row-normalized factors so ``Yn @ q`` is cosine · |q| — staged
+        once; the |q| rescale happens host-side on k scores."""
+        def build():
+            f = np.asarray(self.item_factors, np.float32)
+            norms = np.maximum(np.linalg.norm(f, axis=1, keepdims=True), 1e-8)
+            return jax.device_put(jnp.asarray(f / norms))
+
+        return self._device("_fn_dev", build)
+
+    def indicators_device(self):
+        return self._device("_ind_dev", lambda: (
+            jax.device_put(jnp.asarray(self.indicator_idx)),
+            jax.device_put(jnp.asarray(self.indicator_llr))))
+
+    def warm(self) -> None:
+        if len(self.item_dict) == 0:
+            return
+        if self.kind == "als" and self.item_factors is not None and len(self.item_factors):
+            self.factors_norm_device()
+        if self.kind == "cooccurrence" and self.indicator_idx is not None and len(self.indicator_idx):
+            self.indicators_device()
+        self.cat_masks_device()
 
 
-@partial(jax.jit, static_argnames=())
-def _cosine_scores(factors: jnp.ndarray, query_vec: jnp.ndarray) -> jnp.ndarray:
-    norms = jnp.linalg.norm(factors, axis=1) * jnp.maximum(jnp.linalg.norm(query_vec), 1e-8)
-    return (factors @ query_vec) / jnp.maximum(norms, 1e-8)
+@jax.jit
+def _indicator_scatter_scores(idx: jnp.ndarray, llr: jnp.ndarray,
+                              q_ids: jnp.ndarray) -> jnp.ndarray:
+    """score[j] = Σ_{q ∈ query items} Σ_k 1[idx[q,k] = j] · llr[q,k] —
+    a gather of the query rows + one scatter-add, all on device."""
+    qv = q_ids >= 0
+    safe = jnp.where(qv, q_ids, 0)
+    rows = idx[safe]                              # [Wq, C]
+    vals = llr[safe] * qv[:, None]
+    valid = rows >= 0
+    return jnp.zeros((idx.shape[0],), jnp.float32).at[
+        jnp.where(valid, rows, 0)].add(jnp.where(valid, vals, 0.0))
 
 
 @dataclasses.dataclass
@@ -162,6 +204,7 @@ class SPALSParams(Params):
     rank: int = 10
     num_iterations: int = 10
     lambda_: float = 0.01
+    alpha: float = 1.0      # implicit-feedback confidence slope
     seed: int = 7
     mesh_dp: int = 0
 
@@ -176,16 +219,24 @@ class SPALSAlgorithm(Algorithm):
                            item_factors=np.zeros((0, self.params.rank), np.float32))
         dp = self.params.mesh_dp or len(jax.devices())
         mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
-        # implicit feedback: every view is preference 1.0
-        rating = np.ones(len(td.user_idx), np.float32)
+        # true implicit feedback (MLlib ALS.trainImplicit, as the reference
+        # template calls): view COUNTS become confidences c = 1 + alpha*r
+        cell = td.user_idx.astype(np.int64) * n_items + td.item_idx
+        uniq, counts = np.unique(cell, return_counts=True)
+        users = (uniq // n_items).astype(np.int32)
+        items = (uniq % n_items).astype(np.int32)
         data = als_ops.prepare_als_data(
-            td.user_idx, td.item_idx, rating, n_users, n_items, dp=dp
+            users, items, counts.astype(np.float32), n_users, n_items, dp=dp
         )
         _, Y = als_ops.als_train(
             data, k=self.params.rank, reg=self.params.lambda_,
             iterations=self.params.num_iterations, mesh=mesh, seed=self.params.seed,
+            implicit=True, alpha=self.params.alpha,
         )
         return SPModel("als", td.item_dict, td.item_categories, item_factors=Y)
+
+    def warm(self, model: SPModel) -> None:
+        model.warm()
 
     def predict(self, model: SPModel, query: SimilarProductQuery) -> PredictedResult:
         return _sp_predict(model, query)
@@ -226,11 +277,17 @@ class SPCooccurrenceAlgorithm(Algorithm):
             indicator_llr=np.where(np.isfinite(scores), scores, 0.0).astype(np.float32),
         )
 
+    def warm(self, model: SPModel) -> None:
+        model.warm()
+
     def predict(self, model: SPModel, query: SimilarProductQuery) -> PredictedResult:
         return _sp_predict(model, query)
 
 
 def _sp_predict(model: SPModel, query: SimilarProductQuery) -> PredictedResult:
+    """Device-final similarity serving (was: full-score-vector download +
+    O(n_items) Python filter loops per query): rules mask and top-k run on
+    device via ops.als, ONE stacked [2, k] readback per query."""
     n_items = len(model.item_dict)
     if n_items == 0:
         return PredictedResult([])
@@ -238,39 +295,45 @@ def _sp_predict(model: SPModel, query: SimilarProductQuery) -> PredictedResult:
     qids = [q for q in qids if q is not None]
     if not qids:
         return PredictedResult([])
-    if model.kind == "als":
-        qvec = model.item_factors[np.asarray(qids)].mean(axis=0)
-        scores = np.array(_cosine_scores(jnp.asarray(model.item_factors), jnp.asarray(qvec)))
-    else:
-        scores = np.zeros(n_items, np.float32)
-        for q in qids:
-            for k_, j in enumerate(model.indicator_idx[q]):
-                if j >= 0:
-                    scores[j] += model.indicator_llr[q, k_]
-    for q in qids:  # never recommend the query items themselves
-        scores[q] = -np.inf
-    if query.categories:
-        want = set(query.categories)
-        for j in range(n_items):
-            cats = model.item_categories.get(model.item_dict.str(j), [])
-            if not want.intersection(cats):
-                scores[j] = -np.inf
-    if query.white_list:
-        allowed = {model.item_dict.id(i) for i in query.white_list}
-        for j in range(n_items):
-            if j not in allowed:
-                scores[j] = -np.inf
-    if query.black_list:
-        for b in query.black_list:
-            bid = model.item_dict.id(b)
-            if bid is not None:
-                scores[bid] = -np.inf
+    # rule id lists (present-but-unresolvable constraint => nothing matches)
+    cat_ids = np.asarray(
+        [c for c in (model.cat_dict.id(n) for n in query.categories or [])
+         if c is not None], np.int32)
+    if query.categories is not None and len(cat_ids) == 0:
+        return PredictedResult([])   # constraint present, nothing matches
+    white = np.asarray(
+        [i for i in (model.item_dict.id(n) for n in query.white_list or [])
+         if i is not None], np.int32)
+    if query.white_list is not None and len(white) == 0:
+        return PredictedResult([])
+    excl = list(qids)  # never recommend the query items themselves
+    for b in query.black_list or []:
+        bid = model.item_dict.id(b)
+        if bid is not None:
+            excl.append(bid)
     num = min(query.num, n_items)
-    top = np.argpartition(-np.nan_to_num(scores, neginf=-1e30), min(num, n_items - 1))[:num]
-    top = top[np.argsort(-scores[top], kind="stable")]
+    k = min(als_ops.bucket_width(num), n_items)
+    q_pad = als_ops.pad_ids(qids)
+    scale = 1.0
+    if model.kind == "als":
+        qvec = np.asarray(model.item_factors, np.float32)[np.asarray(qids)].mean(axis=0)
+        qnorm = float(np.linalg.norm(qvec))
+        scale = 1.0 / max(qnorm, 1e-8)   # Yn @ qvec = cosine · |qvec|
+        out = als_ops.recommend_scores_rules(
+            jnp.asarray(qvec), model.factors_norm_device(),
+            model.cat_masks_device(), als_ops.pad_ids(cat_ids),
+            als_ops.pad_ids(white), als_ops.pad_ids(np.asarray(excl, np.int32)), k)
+    else:
+        idx_dev, llr_dev = model.indicators_device()
+        scores = _indicator_scatter_scores(idx_dev, llr_dev, jnp.asarray(q_pad))
+        out = als_ops.scores_rules_topk(
+            scores, model.cat_masks_device(), als_ops.pad_ids(cat_ids),
+            als_ops.pad_ids(white), als_ops.pad_ids(np.asarray(excl, np.int32)), k)
+    out = np.asarray(out)                # the single device sync per query
+    st, si = out[0] * scale, out[1].astype(np.int32)
     return PredictedResult(
-        [ItemScore(model.item_dict.str(int(j)), float(scores[j]))
-         for j in top if np.isfinite(scores[j]) and scores[j] > 0]
+        [ItemScore(model.item_dict.str(int(j)), float(s))
+         for s, j in zip(st[:num], si[:num]) if np.isfinite(s) and s > 0]
     )
 
 

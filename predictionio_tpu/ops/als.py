@@ -424,13 +424,22 @@ def recommend_scores(
     return jax.lax.top_k(scores, top_k)
 
 
+def _stack_topk(scores: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Pack (scores, idx) as one [2, k] f32 array so serving does ONE
+    device→host readback per query.  Each sync is a full round trip on a
+    tunneled accelerator (~70 ms measured on the axon relay), so k-sized
+    result arrays must never be fetched separately.  Item indices are exact
+    in f32 up to 2^24 — far beyond any catalog this serves per device."""
+    return jnp.stack([scores, idx.astype(jnp.float32)])
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def recommend_scores_excl(
     user_vec: jnp.ndarray,        # [K]
     item_factors: jnp.ndarray,    # [n_items, K] — device-resident
     excl_idx: jnp.ndarray,        # [W] item ids to exclude, -1 padding
     top_k: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:                 # [2, top_k]: scores row, item-id row
     """Top-K scores with an exclusion LIST instead of a dense mask.
 
     The serving path stages ``item_factors`` to device once at model load;
@@ -441,7 +450,7 @@ def recommend_scores_excl(
     valid = excl_idx >= 0
     scores = scores.at[jnp.where(valid, excl_idx, 0)].min(
         jnp.where(valid, -jnp.inf, jnp.inf))
-    return jax.lax.top_k(scores, top_k)
+    return _stack_topk(*jax.lax.top_k(scores, top_k))
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))
@@ -453,7 +462,7 @@ def recommend_scores_rules(
     white_idx: jnp.ndarray,       # [Ww] whitelist item ids, -1 padding
     excl_idx: jnp.ndarray,        # [We] excluded item ids, -1 padding
     top_k: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:                 # [2, top_k]: scores row, item-id row
     """Top-K with e-commerce business rules, fully device-final.
 
     Category masks live on device (staged once per model load); a query
@@ -462,8 +471,14 @@ def recommend_scores_rules(
     reference template does this filtering in the ES/driver JVM instead).
     Empty cat_ids/white_idx (all -1) mean "no constraint of that kind".
     """
-    n_items = item_factors.shape[0]
-    scores = item_factors @ user_vec
+    return _rules_topk(item_factors @ user_vec, cat_masks,
+                       cat_ids, white_idx, excl_idx, top_k)
+
+
+def _rules_topk(scores, cat_masks, cat_ids, white_idx, excl_idx, top_k: int):
+    """Shared traced epilogue: category/whitelist allow-masks, exclusion
+    list, and the stacked [2, top_k] result (see recommend_scores_rules)."""
+    n_items = scores.shape[0]
     cat_valid = cat_ids >= 0
     sel = cat_masks[jnp.where(cat_valid, cat_ids, 0)] & cat_valid[:, None]
     allow_cat = jnp.where(cat_valid.any(), sel.any(axis=0), True)
@@ -475,7 +490,22 @@ def recommend_scores_rules(
     excl_valid = excl_idx >= 0
     scores = scores.at[jnp.where(excl_valid, excl_idx, 0)].min(
         jnp.where(excl_valid, -jnp.inf, jnp.inf))
-    return jax.lax.top_k(scores, top_k)
+    return _stack_topk(*jax.lax.top_k(scores, top_k))
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def scores_rules_topk(
+    scores: jnp.ndarray,          # [n_items] precomputed device scores
+    cat_masks: jnp.ndarray,       # [C, n_items] bool — device-resident
+    cat_ids: jnp.ndarray,         # [Wc] -1-padded
+    white_idx: jnp.ndarray,       # [Ww] -1-padded
+    excl_idx: jnp.ndarray,        # [We] -1-padded
+    top_k: int,
+) -> jnp.ndarray:                 # [2, top_k]
+    """Business-rule mask + top-k over an already-computed score vector
+    (e.g. indicator-table similarity) — same contract as
+    recommend_scores_rules without the factor matmul."""
+    return _rules_topk(scores, cat_masks, cat_ids, white_idx, excl_idx, top_k)
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))
@@ -484,13 +514,14 @@ def recommend_batch_excl(
     item_factors: jnp.ndarray,    # [n_items, K]
     excl_idx: jnp.ndarray,        # [B, W] per-row exclusions, -1 padding
     top_k: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:                 # [B, 2, top_k]: scores row, item-id row
     scores = user_vecs @ item_factors.T
     valid = excl_idx >= 0
     b = jnp.arange(scores.shape[0], dtype=jnp.int32)[:, None]
     scores = scores.at[b, jnp.where(valid, excl_idx, 0)].min(
         jnp.where(valid, -jnp.inf, jnp.inf))
-    return jax.lax.top_k(scores, top_k)
+    st, si = jax.lax.top_k(scores, top_k)
+    return jnp.stack([st, si.astype(jnp.float32)], axis=1)
 
 
 def bucket_width(n: int, min_width: int = 16) -> int:

@@ -380,3 +380,21 @@ def fold_properties(batch: EventBatch, entity_type: Optional[str] = None):
                 cur.pop(key, None)
             cur.last_updated = max(cur.last_updated, when)
     return snap
+
+
+def category_masks(item_categories, item_dict: "IdDict"):
+    """(category IdDict, [C, n_items] bool matrix) from per-item category
+    lists — the device-resident form of an engine's category business
+    rules (items are columns so a query ORs a few mask ROWS on device)."""
+    import numpy as _np
+
+    names = sorted({c for cats in item_categories.values() for c in cats})
+    cat_dict = IdDict(names)
+    masks = _np.zeros((len(names), len(item_dict)), bool)
+    for item, cats in item_categories.items():
+        iid = item_dict.id(item)
+        if iid is None:
+            continue
+        for c in cats:
+            masks[cat_dict.id(c), iid] = True
+    return cat_dict, masks
