@@ -205,7 +205,18 @@ class ECommModel(DeviceCacheMixin, PersistentModel):
         self.item_factors = s["Y"]
         self.user_dict = IdDict.from_state(s["users"])
         self.item_dict = IdDict.from_state(s["items"])
-        self.item_categories = s["cats"]
+        if "cat_masks" in s:
+            # migrate the first-revision format (dense masks + cat-name
+            # dict) back to the sparse per-item category lists
+            names = IdDict.from_state(s["cats"])
+            masks = s["cat_masks"]
+            self.item_categories = {}
+            for c in range(masks.shape[0]):
+                for i in np.flatnonzero(masks[c]):
+                    self.item_categories.setdefault(
+                        self.item_dict.str(int(i)), []).append(names.str(c))
+        else:
+            self.item_categories = s["cats"]
         self.cat_dict, self.cat_masks = category_masks(
             self.item_categories, self.item_dict)
         self.popular = s["popular"]

@@ -28,6 +28,7 @@ from predictionio_tpu.controller import (
     PersistentModel,
     Preparator,
 )
+from predictionio_tpu.models.common import DeviceCacheMixin
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.mesh import create_mesh, MeshSpec
 from predictionio_tpu.store.columnar import CSRLookup, EventBatch, IdDict
@@ -184,7 +185,7 @@ class ALSAlgorithmParams(Params):
     checkpoint_dir: str = ""
 
 
-class ALSModel(PersistentModel):
+class ALSModel(DeviceCacheMixin, PersistentModel):
     """Factor matrices + id dictionaries (+ per-user seen items as a CSR
     lookup for unseen-only serving — flat arrays, not a dict of arrays, so
     model size and load time stay sub-linear in users)."""
@@ -220,14 +221,12 @@ class ALSModel(PersistentModel):
     def item_factors_device(self):
         """Item factors staged to device ONCE (never per query); cached on
         the instance and rebuilt lazily after unpickle."""
-        dev = self.__dict__.get("_item_factors_dev")
-        if dev is None:
-            import jax
-            import jax.numpy as jnp
+        import jax
+        import jax.numpy as jnp
 
-            dev = jax.device_put(jnp.asarray(self.item_factors, jnp.float32))
-            self.__dict__["_item_factors_dev"] = dev
-        return dev
+        return self._device(
+            "_item_factors_dev",
+            lambda: jax.device_put(jnp.asarray(self.item_factors, jnp.float32)))
 
     def warm(self) -> None:
         """Pre-stage serving state to device (called at deploy/reload)."""

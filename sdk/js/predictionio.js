@@ -1,0 +1,138 @@
+/**
+ * JavaScript SDK — clients for the Event Server and Query Server REST APIs.
+ *
+ * Reference: the PredictionIO-JavaScript/Node SDK repos (EventClient /
+ * EngineClient; SURVEY.md §2 'SDKs' — separate repos speaking the same REST
+ * wire format).  Dependency-free: uses the global fetch() (Node ≥18,
+ * browsers, Deno, Bun).  Mirrors predictionio_tpu/sdk/client.py.
+ *
+ * Usage:
+ *   const { EventClient, EngineClient } = require("./predictionio");
+ *   const events = new EventClient("ACCESS_KEY", "http://localhost:7070");
+ *   await events.createEvent({event: "buy", entityType: "user",
+ *                             entityId: "u1", targetEntityType: "item",
+ *                             targetEntityId: "i3"});
+ *   const engine = new EngineClient("http://localhost:8000");
+ *   const res = await engine.sendQuery({user: "u1", num: 10});
+ */
+
+"use strict";
+
+class PIOError extends Error {
+  constructor(status, message) {
+    super(`HTTP ${status}: ${message}`);
+    this.name = "PIOError";
+    this.status = status;
+    this.pioMessage = message;
+  }
+}
+
+async function request(method, url, body, timeoutMs) {
+  const ctl = new AbortController();
+  const timer = setTimeout(() => ctl.abort(), timeoutMs);
+  let resp;
+  try {
+    resp = await fetch(url, {
+      method,
+      headers: { "Content-Type": "application/json" },
+      body: body === undefined ? undefined : JSON.stringify(body),
+      signal: ctl.signal,
+    });
+  } finally {
+    clearTimeout(timer);
+  }
+  const text = await resp.text();
+  if (!resp.ok) {
+    let message = text;
+    try {
+      message = JSON.parse(text).message || text;
+    } catch (_) { /* non-JSON error body */ }
+    throw new PIOError(resp.status, message);
+  }
+  return text ? JSON.parse(text) : null;
+}
+
+class EventClient {
+  constructor(accessKey, url = "http://localhost:7070",
+              { channel = null, timeoutMs = 10000 } = {}) {
+    this.accessKey = accessKey;
+    this.base = url.replace(/\/+$/, "");
+    this.channel = channel;
+    this.timeoutMs = timeoutMs;
+  }
+
+  qs(extra = {}) {
+    const params = new URLSearchParams({ accessKey: this.accessKey, ...extra });
+    if (this.channel) params.set("channel", this.channel);
+    return params.toString();
+  }
+
+  /** event: {event, entityType, entityId, targetEntityType?,
+   *  targetEntityId?, properties?, eventTime? (Date or ISO string)} */
+  async createEvent(event) {
+    const body = { ...event };
+    if (body.eventTime instanceof Date) body.eventTime = body.eventTime.toISOString();
+    const out = await request(
+      "POST", `${this.base}/events.json?${this.qs()}`, body, this.timeoutMs);
+    return out.eventId;
+  }
+
+  /** Batch insert (server caps each request at 50 events, mirroring the
+   *  reference Event Server; chunk client-side for larger arrays). */
+  async createEvents(events) {
+    return request("POST", `${this.base}/batch/events.json?${this.qs()}`,
+                   events, this.timeoutMs);
+  }
+
+  // convenience wrappers matching the reference SDK surface
+  setUser(uid, properties = {}) {
+    return this.createEvent({ event: "$set", entityType: "user",
+                              entityId: String(uid), properties });
+  }
+
+  setItem(iid, properties = {}) {
+    return this.createEvent({ event: "$set", entityType: "item",
+                              entityId: String(iid), properties });
+  }
+
+  recordUserActionOnItem(action, uid, iid, properties = undefined) {
+    return this.createEvent({
+      event: action, entityType: "user", entityId: String(uid),
+      targetEntityType: "item", targetEntityId: String(iid),
+      ...(properties ? { properties } : {}),
+    });
+  }
+
+  getEvent(eventId) {
+    return request("GET",
+      `${this.base}/events/${encodeURIComponent(eventId)}.json?${this.qs()}`,
+      undefined, this.timeoutMs);
+  }
+
+  deleteEvent(eventId) {
+    return request("DELETE",
+      `${this.base}/events/${encodeURIComponent(eventId)}.json?${this.qs()}`,
+      undefined, this.timeoutMs);
+  }
+
+  findEvents(filters = {}) {
+    return request("GET", `${this.base}/events.json?${this.qs(filters)}`,
+                   undefined, this.timeoutMs);
+  }
+}
+
+class EngineClient {
+  constructor(url = "http://localhost:8000", { timeoutMs = 10000 } = {}) {
+    this.base = url.replace(/\/+$/, "");
+    this.timeoutMs = timeoutMs;
+  }
+
+  sendQuery(query) {
+    return request("POST", `${this.base}/queries.json`, query, this.timeoutMs);
+  }
+}
+
+/* CommonJS + ES module interop */
+const api = { EventClient, EngineClient, PIOError };
+if (typeof module !== "undefined" && module.exports) module.exports = api;
+if (typeof globalThis !== "undefined") globalThis.predictionio = api;

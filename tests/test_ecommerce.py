@@ -160,3 +160,28 @@ def test_explicitly_empty_whitelist_returns_nothing(ecomm_app):
     q = ECommQuery.from_json({"user": "u0", "num": 4, "whiteList": []})
     assert q.white_list == []
     assert ECommQuery.from_json({"user": "u0"}).white_list is None
+
+
+def test_first_revision_pickle_format_migrates(ecomm_app):
+    """Models persisted by the first ECommModel revision (dense cat_masks +
+    cat-name dict in state) still load and serve identically."""
+    import pickle
+
+    engine = ECommerceEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    m = models[0]
+    old_state = {
+        "X": m.user_factors, "Y": m.item_factors,
+        "users": m.user_dict.to_state(), "items": m.item_dict.to_state(),
+        "cats": m.cat_dict.to_state(), "cat_masks": m.cat_masks,
+        "popular": m.popular,
+    }
+    restored = type(m).__new__(type(m))
+    restored.__setstate__(old_state)
+    assert sorted(restored.item_categories) == sorted(m.item_categories)
+    assert (restored.cat_masks == m.cat_masks).all()
+    q = ECommQuery(user="u0", num=4, categories=["alpha"])
+    a = engine.predictor(ep, models)(q).to_json()
+    b = engine.predictor(ep, [restored])(q).to_json()
+    assert a == b
