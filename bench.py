@@ -543,8 +543,10 @@ def main() -> int:
     args = ap.parse_args()
 
     from predictionio_tpu.utils import apply_platform_override
+    from predictionio_tpu.utils.config import enable_compilation_cache
 
     apply_platform_override()
+    enable_compilation_cache()
 
     if args.profile and args.only != "ur":
         ap.error("--profile requires --only ur (the traced iteration)")

@@ -449,8 +449,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     from predictionio_tpu.utils import apply_platform_override
+    from predictionio_tpu.utils.config import enable_compilation_cache
 
     apply_platform_override()
+    enable_compilation_cache()
     args = build_parser().parse_args(argv)
     return args.func(args)
 

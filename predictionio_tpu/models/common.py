@@ -35,6 +35,11 @@ class DeviceCacheMixin:
             self.__dict__[attr] = dev
         return dev
 
+
+class CategoryRulesMixin(DeviceCacheMixin):
+    """For models carrying category business rules: requires
+    ``self.cat_masks`` ([C, n_items] bool) and ``self.item_dict``."""
+
     def cat_masks_device(self):
         """The [C, n_items] category bitmask matrix, device-resident.
         A model with no categories stages a 1-row all-False dummy so the

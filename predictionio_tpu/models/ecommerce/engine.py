@@ -50,7 +50,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.models.common import DeviceCacheMixin, opt_str_list
+from predictionio_tpu.models.common import CategoryRulesMixin, opt_str_list
 from predictionio_tpu.store.columnar import IdDict, category_masks
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
 
@@ -171,7 +171,7 @@ class ECommAlgorithmParams(Params):
     unavailable_constraint: str = "unavailableItems"
 
 
-class ECommModel(DeviceCacheMixin, PersistentModel):
+class ECommModel(CategoryRulesMixin, PersistentModel):
     """Factors + device-resident business-rule state.
 
     ``cat_masks`` ([C, n_items] bool, category → items) is derived from

@@ -40,7 +40,7 @@ from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedRe
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.ops import cco as cco_ops
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.models.common import DeviceCacheMixin, opt_str_list
+from predictionio_tpu.models.common import CategoryRulesMixin, opt_str_list
 from predictionio_tpu.store.columnar import IdDict, category_masks
 from predictionio_tpu.store.event_store import PEventStore
 
@@ -124,7 +124,7 @@ class SPPreparator(Preparator):
         return td
 
 
-class SPModel(DeviceCacheMixin, PersistentModel):
+class SPModel(CategoryRulesMixin, PersistentModel):
     """Either item factors (als) or an indicator table (cooccurrence);
     scoring normalizes both to an item->similar-items lookup.
 

@@ -320,8 +320,7 @@ def _densify_global(gu, gi, valid, n_rows: int, n_cols: int):
     ].max(valid.astype(dtype))
 
 
-@partial(jax.jit, static_argnames=("tile", "top_k", "exclude_self", "pallas", "mm"))
-def _cco_tile_step_resident(
+def _cco_tile_body_resident(
     P, rc, a_gu, a_gi, a_valid,
     n_total, best_scores, best_idx, tile_start,
     tile: int, top_k: int, llr_threshold,
@@ -343,6 +342,43 @@ def _cco_tile_step_resident(
                               llr_threshold, pallas)
     return _merge_topk(best_scores, best_idx, scores, tile_start, tile,
                        top_k, n_items_p, exclude_self)
+
+
+def _scan_tiles(step, n_items_p: int, n_tiles: int, tile: int, top_k: int):
+    """Shared scan harness for the tiled strategies: run ``step(bs, bi,
+    tile_start)`` over every tile start in ONE compiled program.
+
+    A Python-level tile loop pays a tunnel/dispatch round trip per tile
+    (~70 ms × n_tiles × event types measured on the axon relay) and blocks
+    XLA from pipelining the scatter of tile t+1 under the matmul of tile t;
+    the scan removes both."""
+    init = (jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32),
+            jnp.zeros((n_items_p, top_k), jnp.int32))
+    starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+
+    def body(carry, tile_start):
+        return step(*carry, tile_start), None
+
+    (best_scores, best_idx), _ = jax.lax.scan(body, init, starts)
+    return best_scores, best_idx
+
+
+@partial(jax.jit, static_argnames=(
+    "n_tiles", "tile", "top_k", "exclude_self", "pallas", "mm"))
+def _cco_resident_all_tiles(
+    P, rc, a_gu, a_gi, a_valid, n_total,
+    n_tiles: int, tile: int, top_k: int, llr_threshold,
+    exclude_self: bool, pallas: str, mm: str,
+):
+    """All RESIDENT-path item tiles in one compiled program (_scan_tiles)."""
+
+    def step(bs, bi, tile_start):
+        return _cco_tile_body_resident(
+            P, rc, a_gu, a_gi, a_valid, n_total, bs, bi, tile_start,
+            tile=tile, top_k=top_k, llr_threshold=llr_threshold,
+            exclude_self=exclude_self, pallas=pallas, mm=mm)
+
+    return _scan_tiles(step, P.shape[1], n_tiles, tile, top_k)
 
 
 def _resident_p_ok(n_users: int, n_items_p: int, item_tile: int = 4096) -> bool:
@@ -378,18 +414,15 @@ def _cco_indicators_resident(
     a_valid = jnp.ones(len(au), bool)
     tile = min(item_tile, max(n_items_t, 1))
     n_tiles = math.ceil(n_items_t / tile)
-    best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)
-    best_idx = jnp.zeros((n_items_p, top_k), jnp.int32)
 
     from predictionio_tpu.ops.pallas_kernels import pallas_mode
 
-    for t in range(n_tiles):
-        best_scores, best_idx = _cco_tile_step_resident(
-            P, rc, a_gu, a_gi, a_valid,
-            float(n_total_users), best_scores, best_idx, t * tile,
-            tile=tile, top_k=top_k, llr_threshold=float(llr_threshold),
-            exclude_self=exclude_self, pallas=pallas_mode(), mm=mm,
-        )
+    best_scores, best_idx = _cco_resident_all_tiles(
+        P, rc, a_gu, a_gi, a_valid, float(n_total_users),
+        n_tiles=n_tiles, tile=tile, top_k=top_k,
+        llr_threshold=float(llr_threshold),
+        exclude_self=exclude_self, pallas=pallas_mode(), mm=mm,
+    )
     return _finalize_topk(best_scores, best_idx, n_items_t)
 
 
@@ -470,6 +503,30 @@ def _cco_tile_step(
         n_total, llr_threshold, pallas)
     return _merge_topk(best_scores, best_idx, scores, tile_start, tile,
                        top_k, n_items_p, exclude_self)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_tiles", "block", "n_items_p", "tile", "top_k", "pallas",
+        "exclude_self",
+    ),
+)
+def _cco_chunked_all_tiles(
+    p_lu, p_it, p_mk, a_lu, a_it, a_mk, n_total,
+    n_tiles: int, block: int, n_items_p: int, tile: int, top_k: int,
+    llr_threshold, pallas: str, exclude_self: bool,
+):
+    """All chunked-path item tiles in one compiled program (_scan_tiles)."""
+
+    def step(bs, bi, tile_start):
+        return _cco_tile_step(
+            p_lu, p_it, p_mk, a_lu, a_it, a_mk, n_total, bs, bi, tile_start,
+            block=block, n_items_p=n_items_p, tile=tile, top_k=top_k,
+            llr_threshold=llr_threshold, pallas=pallas,
+            exclude_self=exclude_self)
+
+    return _scan_tiles(step, n_items_p, n_tiles, tile, top_k)
 
 
 # ---------------------------------------------------------------------------
@@ -899,14 +956,12 @@ def cco_indicators(
             jnp.asarray(primary.local_u), jnp.asarray(primary.item), jnp.asarray(primary.mask),
             jnp.asarray(other.local_u), jnp.asarray(other.item), jnp.asarray(other.mask),
         )
-        for t in range(n_tiles):
-            best_scores, best_idx = _cco_tile_step(
-                *args, float(n_total_users),
-                best_scores, best_idx, t * tile,
-                block=primary.user_block, n_items_p=n_items_p,
-                tile=tile, top_k=top_k, llr_threshold=llr_threshold,
-                pallas=pallas, exclude_self=exclude_self,
-            )
+        best_scores, best_idx = _cco_chunked_all_tiles(
+            *args, float(n_total_users),
+            n_tiles=n_tiles, block=primary.user_block, n_items_p=n_items_p,
+            tile=tile, top_k=top_k, llr_threshold=float(llr_threshold),
+            pallas=pallas, exclude_self=exclude_self,
+        )
     else:
         dp = mesh.shape["dp"]
         nb = primary.n_blocks

@@ -75,3 +75,36 @@ def apply_platform_override() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+
+
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache shared across pio processes.
+
+    Every `pio train` / `pio deploy` is a fresh process; without this the
+    big CCO/ALS programs recompile each run (~76 s of a 108 s end-to-end
+    UR train at a 100k-item catalog measured on TPU v5e — 70% of the
+    wall clock).  The on-disk cache makes every run after the first skip
+    straight to execution, like the reference's long-lived warmed JVM.
+    PIO_JAX_CACHE overrides the location; PIO_JAX_CACHE=off disables.
+    """
+    loc = os.environ.get("PIO_JAX_CACHE", "")
+    if loc.lower() == "off":
+        return
+    if not loc:
+        loc = os.path.join(
+            os.path.expanduser("~"), ".cache", "predictionio_tpu", "xla")
+    try:
+        os.makedirs(loc, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", loc)
+        # cache everything that took meaningful compile time; tiny programs
+        # stay in-memory only (PIO_JAX_CACHE_MIN_S tunes the cutoff)
+        min_s = float(os.environ.get("PIO_JAX_CACHE_MIN_S", "1.0"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never a hard failure
+        import logging
+
+        logging.getLogger("pio.config").warning(
+            "persistent XLA cache unavailable at %s: %s", loc, e)

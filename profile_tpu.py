@@ -50,8 +50,10 @@ def main():
     args = ap.parse_args()
 
     from predictionio_tpu.utils import apply_platform_override
+    from predictionio_tpu.utils.config import enable_compilation_cache
 
-    apply_platform_override()   # PIO_JAX_PLATFORM=cpu for off-chip testing
+    apply_platform_override()
+    enable_compilation_cache()   # PIO_JAX_PLATFORM=cpu for off-chip testing
 
     import jax
     import jax.numpy as jnp

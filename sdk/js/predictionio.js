@@ -30,18 +30,20 @@ class PIOError extends Error {
 async function request(method, url, body, timeoutMs) {
   const ctl = new AbortController();
   const timer = setTimeout(() => ctl.abort(), timeoutMs);
-  let resp;
+  let resp, text;
   try {
+    // the timer must also cover the body read: a server that sends
+    // headers then stalls mid-body would otherwise hang past timeoutMs
     resp = await fetch(url, {
       method,
       headers: { "Content-Type": "application/json" },
       body: body === undefined ? undefined : JSON.stringify(body),
       signal: ctl.signal,
     });
+    text = await resp.text();
   } finally {
     clearTimeout(timer);
   }
-  const text = await resp.text();
   if (!resp.ok) {
     let message = text;
     try {

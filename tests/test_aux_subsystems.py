@@ -146,3 +146,46 @@ def test_timed_tracer():
     with timed("span", sink):
         pass
     assert "span" in sink and sink["span"] >= 0
+
+
+def test_persistent_compilation_cache_config(tmp_path, monkeypatch):
+    import jax
+
+    from predictionio_tpu.utils.config import enable_compilation_cache
+
+    loc = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("PIO_JAX_CACHE", loc)
+    enable_compilation_cache()
+    import os
+
+    assert os.path.isdir(loc)
+    assert jax.config.jax_compilation_cache_dir == loc
+    # a fresh-process compile lands in the cache (threshold forced to 0
+    # for the test; production keeps >=1s programs only)
+    saved_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return (x @ x).sum()
+
+        np.asarray(f(jnp.ones((64, 64))))
+        assert len(os.listdir(loc)) >= 1
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", saved_min)
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_compilation_cache_off_switch(tmp_path, monkeypatch):
+    import jax
+
+    from predictionio_tpu.utils.config import enable_compilation_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv("PIO_JAX_CACHE", "off")
+    enable_compilation_cache()
+    assert jax.config.jax_compilation_cache_dir == before
