@@ -78,6 +78,18 @@ TEMPLATE_VARIANTS: Dict[str, Dict] = {
                         "eventWeights": {"buy": 4.0}}},
         ],
     },
+    "complementary_purchase": {
+        "id": "my-complementary-purchase",
+        "description": "shopping-basket rules: cart -> complementary items",
+        "engineFactory": ENGINE_FACTORIES["complementary_purchase"],
+        "datasource": {"params": {"appName": "MyApp", "eventName": "buy",
+                                  "basketWindow": "1 hour"}},
+        "algorithms": [
+            {"name": "rules",
+             "params": {"minSupport": 0.001, "minConfidence": 0.1,
+                        "maxRulesPerItem": 20}},
+        ],
+    },
     "text": {
         "id": "my-text-classification",
         "description": "text classification (tf-idf logistic regression)",

@@ -10,6 +10,8 @@ Each subpackage is a complete DASE engine matching a BASELINE.json config:
 - ``ecommerce``             — implicit-ALS e-commerce recommendations with
                               live seen/unavailable constraints and
                               category/white/black-list rules
+- ``complementary_purchase``— basket association rules (support/confidence/
+                              lift from one BᵀB pair-count matmul)
 """
 
 ENGINE_FACTORIES = {
@@ -19,4 +21,6 @@ ENGINE_FACTORIES = {
     "universal_recommender": "predictionio_tpu.models.universal_recommender.UniversalRecommenderEngine",
     "text": "predictionio_tpu.models.text.TextClassificationEngine",
     "ecommerce": "predictionio_tpu.models.ecommerce.ECommerceEngine",
+    "complementary_purchase":
+        "predictionio_tpu.models.complementary_purchase.ComplementaryPurchaseEngine",
 }

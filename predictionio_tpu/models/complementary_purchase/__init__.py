@@ -1,0 +1,4 @@
+from predictionio_tpu.models.complementary_purchase.engine import (  # noqa: F401
+    ComplementaryPurchaseEngine,
+    CPQuery,
+)

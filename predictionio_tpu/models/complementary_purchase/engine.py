@@ -1,0 +1,225 @@
+"""Complementary Purchase engine template (shopping-basket rules).
+
+Capability parity with the reference Complementary Purchase template
+(PredictionIO 0.9.x gallery — DataSource.scala groups a user's ``buy``
+events into baskets by time window; the algorithm mines frequent itemsets
+with FP-Growth on Spark and emits rules filtered by minSupport /
+minConfidence, ranked by lift; query = current cart → complementary
+items).
+
+TPU-first redesign, not a translation: FP-Growth's tree mining is a
+sequential pointer-chasing algorithm with no MXU mapping.  The dominant
+rule mass is pairwise, and pair counts over all item pairs at once are
+exactly one basket×item scatter-densify plus one MXU matmul (BᵀB) —
+``ops.cco.basket_rules`` computes every support/confidence/lift in a
+single compiled program and keeps the per-item top-k by lift.  Larger
+antecedent carts are served by aggregating the single-item rules over the
+cart on device (same gather+scatter scorer the similar-product template
+uses), which is the cross-occurrence analogue of set rules.
+
+Wire format (reference template):
+  query    {"items": ["i1", "i2"], "num": 3}
+  response {"itemScores": [{"item": "i9", "score": 1.7}, ...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    PersistentModel,
+    Preparator,
+)
+from predictionio_tpu.models.common import CategoryRulesMixin
+from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
+from predictionio_tpu.models.similar_product.engine import _indicator_scatter_scores
+from predictionio_tpu.ops import als as als_ops
+from predictionio_tpu.ops import cco as cco_ops
+from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.store.event_store import PEventStore
+from predictionio_tpu.models.universal_recommender.popmodel import parse_duration
+
+
+@dataclasses.dataclass
+class CPQuery:
+    items: List[str]
+    num: int = 10
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "CPQuery":
+        return cls(items=[str(i) for i in d["items"]],
+                   num=int(d.get("num", 10)))
+
+
+@dataclasses.dataclass
+class CPDataSourceParams(Params):
+    app_name: str = "default"
+    event_name: str = "buy"
+    # events of one user closer together than this belong to one basket
+    # (reference DataSource basketWindow)
+    basket_window: str = "1 hour"
+
+
+@dataclasses.dataclass
+class CPTrainingData:
+    basket_idx: np.ndarray    # int32 per event
+    item_idx: np.ndarray
+    n_baskets: int
+    item_dict: IdDict
+
+
+class CPDataSource(DataSource):
+    """Reads buy events and sessionizes them into baskets: one columnar
+    read, then a vectorized (user, time)-sort with baskets split on user
+    change or a time gap beyond basket_window."""
+
+    params_class = CPDataSourceParams
+
+    def read_training(self) -> CPTrainingData:
+        batch = PEventStore.batch(
+            self.params.app_name, event_names=[self.params.event_name])
+        has_t = batch.target_ids >= 0
+        users = batch.entity_ids[has_t]
+        t_codes = batch.target_ids[has_t]
+        times = batch.times_us[has_t].astype(np.int64)
+        uniq = np.unique(t_codes)
+        item_dict = IdDict([batch.target_dict.str(int(c)) for c in uniq])
+        t_map = np.full(max(len(batch.target_dict), 1), -1, np.int32)
+        t_map[uniq] = np.arange(len(uniq), dtype=np.int32)
+        items = t_map[t_codes]
+        if len(users) == 0:
+            return CPTrainingData(np.empty(0, np.int32), np.empty(0, np.int32),
+                                  0, item_dict)
+        order = np.lexsort((times, users))
+        users, items, times = users[order], items[order], times[order]
+        window_us = int(parse_duration(self.params.basket_window) * 1e6)
+        new_basket = np.ones(len(users), bool)
+        new_basket[1:] = (users[1:] != users[:-1]) | (
+            (times[1:] - times[:-1]) > window_us)
+        basket_idx = (np.cumsum(new_basket) - 1).astype(np.int32)
+        return CPTrainingData(
+            basket_idx=basket_idx,
+            item_idx=items.astype(np.int32),
+            n_baskets=int(basket_idx[-1]) + 1,
+            item_dict=item_dict,
+        )
+
+
+class CPPreparator(Preparator):
+    def prepare(self, td: CPTrainingData) -> CPTrainingData:
+        return td
+
+
+@dataclasses.dataclass
+class CPAlgorithmParams(Params):
+    # reference Complementary Purchase: minSupport / minConfidence cuts,
+    # rules ranked by lift
+    min_support: float = 0.0
+    min_confidence: float = 0.0
+    max_rules_per_item: int = 20
+
+
+class CPModel(CategoryRulesMixin, PersistentModel):
+    """Per-item complement lists: ids + lift scores.  Staged to device at
+    warm(); a query ships only the padded cart ids and one stacked [2, k]
+    array returns.  (Rule confidences are an op-level output —
+    ops.cco.basket_rules — not serving state.)"""
+
+    def __init__(self, item_dict: IdDict, comp_idx: np.ndarray,
+                 comp_lift: np.ndarray):
+        self.item_dict = item_dict
+        self.comp_idx = comp_idx
+        self.comp_lift = comp_lift
+        # no category rules in this template: empty mask set (the shared
+        # rules scorer still wants its device-resident dummy)
+        self.cat_masks = np.zeros((0, max(len(item_dict), 1)), bool)
+
+    def __getstate__(self):
+        return {"items": self.item_dict.to_state(), "idx": self.comp_idx,
+                "lift": self.comp_lift}
+
+    def __setstate__(self, s):
+        self.item_dict = IdDict.from_state(s["items"])
+        self.comp_idx = s["idx"]
+        self.comp_lift = s["lift"]
+        self.cat_masks = np.zeros((0, max(len(self.item_dict), 1)), bool)
+
+    def tables_device(self):
+        return self._device("_tab_dev", lambda: (
+            jax.device_put(jnp.asarray(self.comp_idx)),
+            jax.device_put(jnp.asarray(
+                np.where(np.isfinite(self.comp_lift), self.comp_lift, 0.0)
+                .astype(np.float32)))))
+
+    def warm(self) -> None:
+        if len(self.item_dict):
+            self.tables_device()
+
+
+class CPAlgorithm(Algorithm):
+    params_class = CPAlgorithmParams
+
+    def train(self, td: CPTrainingData) -> CPModel:
+        n_items = len(td.item_dict)
+        if n_items == 0 or td.n_baskets == 0:
+            k = max(self.params.max_rules_per_item, 1)
+            return CPModel(td.item_dict,
+                           np.full((n_items, k), -1, np.int32),
+                           np.full((n_items, k), -np.inf, np.float32))
+        lift, idx, _conf = cco_ops.basket_rules(
+            td.basket_idx, td.item_idx, td.n_baskets, n_items,
+            top_k=self.params.max_rules_per_item,
+            min_support=self.params.min_support,
+            min_confidence=self.params.min_confidence)
+        return CPModel(td.item_dict, idx, lift)
+
+    def warm(self, model: CPModel) -> None:
+        model.warm()
+
+    def predict(self, model: CPModel, query: CPQuery) -> PredictedResult:
+        n_items = len(model.item_dict)
+        if n_items == 0:
+            return PredictedResult([])
+        cart = [model.item_dict.id(i) for i in query.items]
+        cart = [c for c in cart if c is not None]
+        if not cart:
+            return PredictedResult([])
+        idx_dev, lift_dev = model.tables_device()
+        q_pad = als_ops.pad_ids(cart)
+        # aggregate lift over the cart items (device gather+scatter), then
+        # top-k excluding the cart itself — ONE stacked readback
+        scores = _indicator_scatter_scores(idx_dev, lift_dev, jnp.asarray(q_pad))
+        num = min(query.num, n_items)
+        k = min(als_ops.bucket_width(num), n_items)
+        out = np.asarray(als_ops.scores_rules_topk(
+            scores, model.cat_masks_device(), als_ops.pad_ids([]),
+            als_ops.pad_ids([]), als_ops.pad_ids(np.asarray(cart, np.int32)), k))
+        st, si = out[0], out[1].astype(np.int32)
+        return PredictedResult(
+            [ItemScore(model.item_dict.str(int(j)), float(s))
+             for s, j in zip(st[:num], si[:num])
+             if np.isfinite(s) and s > 0])
+
+
+class ComplementaryPurchaseEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=CPDataSource,
+            preparator_class=CPPreparator,
+            algorithm_classes={"rules": CPAlgorithm},
+            serving_class=FirstServing,
+        )
+
+    query_class = CPQuery

@@ -1005,3 +1005,87 @@ def cco_indicators(
             )
 
     return _finalize_topk(best_scores, best_idx, n_items_t)
+
+
+# ---------------------------------------------------------------------------
+# basket association rules (Complementary Purchase template)
+# ---------------------------------------------------------------------------
+
+
+# the rule matrix is dense [I, I]: complement catalogs are modest by
+# domain (the reference's FP-Growth also materializes frequent pairs);
+# past this, the count matrix alone exceeds a v5e chip's HBM budget
+_BASKET_RULES_MAX_ITEMS = 40_000
+_BASKET_CHUNK = 8192   # basket rows densified per scan step
+
+
+@partial(jax.jit, static_argnames=("n_chunks", "n_items", "top_k"))
+def _basket_rules(gb, gi, valid, n_baskets, n_chunks: int, n_items: int,
+                  top_k: int, min_support, min_confidence):
+    """Pairwise association rules from basket×item co-occurrence.
+
+    Baskets are densified in fixed chunks (lax.scan) and pair counts
+    accumulate as exact int32 — ``C += int32(Bcᵀ Bc)`` with each chunk's
+    f32 product < 2²⁴ by construction, the same exactness recipe as
+    ``_count_matmul``'s chunked callers — so billions of baskets stay
+    exact and HBM holds one chunk + the [I, I] counts.  Then per (i, j):
+
+      support_ij    = c_ij / N            confidence_i→j = c_ij / c_i
+      lift_i→j      = confidence / (c_j / N)
+
+    Rules failing min_support/min_confidence are -inf; per-row top-k by
+    LIFT (the reference Complementary Purchase template also ranks rules
+    by lift after support/confidence cuts — its FP-Growth mines item-SET
+    antecedents, which serving approximates by aggregating single-item
+    rules over the cart).  Self-pairs are excluded.
+    """
+    mm = _matmul_dtype()
+
+    def body(c_acc, chunk_start):
+        in_chunk = valid & (gb >= chunk_start) & (gb < chunk_start + _BASKET_CHUNK)
+        B = _densify(jnp.where(in_chunk, gb - chunk_start, 0), gi,
+                     in_chunk.astype(jnp.float32), _BASKET_CHUNK, n_items,
+                     _mm_in_dtype())
+        return c_acc + _count_matmul(B, B, mm), None
+
+    starts = jnp.arange(n_chunks, dtype=jnp.int32) * _BASKET_CHUNK
+    c, _ = jax.lax.scan(body, jnp.zeros((n_items, n_items), jnp.int32), starts)
+    c = c.astype(jnp.float32)
+    ci = jnp.diagonal(c)                             # per-item basket counts
+    n = jnp.maximum(n_baskets.astype(jnp.float32), 1.0)
+    support = c / n
+    confidence = c / jnp.maximum(ci[:, None], 1.0)
+    lift = confidence / jnp.maximum(ci[None, :] / n, 1e-9)
+    ok = (support >= min_support) & (confidence >= min_confidence) & (c > 0)
+    eye = jnp.eye(n_items, dtype=bool)
+    scores = jnp.where(ok & ~eye, lift, -jnp.inf)
+    st, si = jax.lax.top_k(scores, top_k)
+    conf_at = jnp.take_along_axis(confidence, si, axis=1)
+    return st, si, conf_at
+
+
+def basket_rules(
+    basket_idx: np.ndarray, item_idx: np.ndarray,
+    n_baskets: int, n_items: int,
+    top_k: int = 20,
+    min_support: float = 0.0,
+    min_confidence: float = 0.0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host wrapper: (lift [I, K], complement ids [I, K], confidence
+    [I, K]) with -1 ids where no rule passed the cuts."""
+    if n_items > _BASKET_RULES_MAX_ITEMS:
+        raise ValueError(
+            f"basket_rules materializes a dense [{n_items}, {n_items}] rule "
+            f"matrix; catalogs past {_BASKET_RULES_MAX_ITEMS} items need a "
+            "tiled variant (see the UR tiled CCO path)")
+    k = min(max(top_k, 1), max(n_items, 1))
+    n_chunks = max(math.ceil(n_baskets / _BASKET_CHUNK), 1)
+    st, si, conf = _basket_rules(
+        jnp.asarray(basket_idx, jnp.int32), jnp.asarray(item_idx, jnp.int32),
+        jnp.ones(len(basket_idx), bool), jnp.int32(n_baskets), n_chunks,
+        n_items, k, jnp.float32(min_support), jnp.float32(min_confidence))
+    st, si, conf = np.asarray(st), np.asarray(si), np.asarray(conf)
+    dead = ~np.isfinite(st)
+    return (np.where(dead, -np.inf, st),
+            np.where(dead, -1, si).astype(np.int32),
+            np.where(dead, 0.0, conf))

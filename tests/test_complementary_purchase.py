@@ -1,0 +1,154 @@
+"""Complementary Purchase template tests: basket sessionization, rule
+mining (support/confidence/lift), cart-aggregated serving."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import Event
+from predictionio_tpu.models.complementary_purchase import (
+    ComplementaryPurchaseEngine,
+    CPQuery,
+)
+from predictionio_tpu.models.complementary_purchase.engine import (
+    CPAlgorithmParams,
+    CPDataSourceParams,
+)
+from predictionio_tpu.ops.cco import basket_rules
+from predictionio_tpu.storage import App
+
+APP = "cpapp"
+T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+
+
+@pytest.fixture()
+def cp_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, APP))
+    rng = np.random.default_rng(6)
+    events = []
+    # coffee+filter bought together; tea+kettle together; bread alone.
+    # One basket per (user, day): events inside a basket are seconds apart,
+    # different days are far beyond the 1-hour window.
+    for u in range(60):
+        for day in range(3):
+            base = T0 + dt.timedelta(days=day, hours=u % 12)
+            basket = (["coffee", "filter"] if (u + day) % 2 == 0
+                      else ["tea", "kettle"])
+            if rng.random() < 0.3:
+                basket = basket + ["bread"]
+            for k, item in enumerate(basket):
+                events.append(Event(
+                    event="buy", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=item,
+                    event_time=base + dt.timedelta(seconds=k)))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage, app_id
+
+
+def make_ep(**algo):
+    return EngineParams(
+        data_source_params=CPDataSourceParams(app_name=APP),
+        algorithm_params_list=[("rules", CPAlgorithmParams(**algo))],
+    )
+
+
+def test_basket_sessionization(cp_app):
+    engine = ComplementaryPurchaseEngine.apply()
+    ds = engine.make_components(make_ep())[0]
+    td = ds.read_training()
+    # 60 users x 3 days = 180 baskets
+    assert td.n_baskets == 180
+    # every basket holds 2 or 3 items
+    sizes = np.bincount(td.basket_idx)
+    assert set(sizes.tolist()) <= {2, 3}
+
+
+def test_complements_found_and_ranked(cp_app):
+    engine = ComplementaryPurchaseEngine.apply()
+    ep = make_ep(min_support=0.01, min_confidence=0.2)
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    res = predict(CPQuery(items=["coffee"], num=2))
+    items = [s.item for s in res.item_scores]
+    assert items and items[0] == "filter", items
+    assert "coffee" not in items
+    res = predict(CPQuery(items=["tea"], num=2))
+    assert [s.item for s in res.item_scores][0] == "kettle"
+    # cart aggregation: two antecedents still exclude the cart itself
+    res = predict(CPQuery(items=["coffee", "tea"], num=4))
+    items = [s.item for s in res.item_scores]
+    assert not {"coffee", "tea"} & set(items)
+    assert {"filter", "kettle"} <= set(items)
+
+
+def test_min_confidence_prunes_weak_rules(cp_app):
+    engine = ComplementaryPurchaseEngine.apply()
+    # bread co-occurs randomly (30%) with everything: a high confidence
+    # cut keeps the deterministic pairs and drops bread rules
+    ep = make_ep(min_support=0.01, min_confidence=0.9)
+    models = engine.train(ep)
+    predict = engine.predictor(ep, models)
+    res = predict(CPQuery(items=["bread"], num=5))
+    assert res.item_scores == []
+    res = predict(CPQuery(items=["coffee"], num=5))
+    assert [s.item for s in res.item_scores] == ["filter"]
+
+
+def test_basket_rules_op_exact_metrics():
+    # 5 baskets: {0,1} x4, {2} x1 -> conf(0->1)=1, lift=1/(4/5)=1.25
+    b = np.array([0, 0, 1, 1, 2, 2, 3, 3, 4], np.int32)
+    i = np.array([0, 1, 0, 1, 0, 1, 0, 1, 2], np.int32)
+    lift, idx, conf = basket_rules(b, i, 5, 3, top_k=2)
+    assert idx[0][0] == 1 and conf[0][0] == 1.0
+    assert abs(lift[0][0] - 1.25) < 1e-6
+    assert idx[2][0] == -1
+    # duplicate items in one basket do not inflate counts (scatter-max)
+    b2 = np.concatenate([b, [0, 0]]).astype(np.int32)
+    i2 = np.concatenate([i, [0, 1]]).astype(np.int32)
+    lift2, idx2, conf2 = basket_rules(b2, i2, 5, 3, top_k=2)
+    assert np.allclose(lift[np.isfinite(lift)], lift2[np.isfinite(lift2)])
+
+
+def test_model_roundtrip(cp_app):
+    import pickle
+
+    engine = ComplementaryPurchaseEngine.apply()
+    ep = make_ep(min_support=0.01, min_confidence=0.2)
+    models = engine.train(ep)
+    restored = [pickle.loads(pickle.dumps(m)) for m in models]
+    q = CPQuery(items=["coffee"], num=3)
+    assert (engine.predictor(ep, models)(q).to_json()
+            == engine.predictor(ep, restored)(q).to_json())
+
+
+def test_basket_rules_chunked_exact(monkeypatch):
+    """Counts stay exact when baskets span many scan chunks."""
+    from predictionio_tpu.ops import cco
+
+    monkeypatch.setattr(cco, "_BASKET_CHUNK", 4)
+    rng = np.random.default_rng(1)
+    n_baskets, n_items = 50, 8
+    b = rng.integers(0, n_baskets, 400).astype(np.int32)
+    i = rng.integers(0, n_items, 400).astype(np.int32)
+    lift, idx, conf = basket_rules(b, i, n_baskets, n_items, top_k=n_items)
+    # dense numpy reference
+    B = np.zeros((n_baskets, n_items))
+    B[b, i] = 1.0
+    C = B.T @ B
+    ci = np.diag(C)
+    for row in range(n_items):
+        for k_, j in enumerate(idx[row]):
+            if j < 0:
+                continue
+            conf_ref = C[row, j] / max(ci[row], 1)
+            lift_ref = conf_ref / (ci[j] / n_baskets)
+            assert abs(conf[row, k_] - conf_ref) < 1e-5
+            assert abs(lift[row, k_] - lift_ref) < 1e-4
+
+
+def test_basket_rules_item_cap():
+    with pytest.raises(ValueError, match="tiled variant"):
+        basket_rules(np.zeros(1, np.int32), np.zeros(1, np.int32),
+                     1, 100_000, top_k=5)
