@@ -90,6 +90,28 @@ TEMPLATE_VARIANTS: Dict[str, Dict] = {
                         "maxRulesPerItem": 20}},
         ],
     },
+    "product_ranking": {
+        "id": "my-product-ranking",
+        "description": "rank a provided item list for a user (ALS scores)",
+        "engineFactory": ENGINE_FACTORIES["product_ranking"],
+        "datasource": {"params": {"appName": "MyApp",
+                                  "eventNames": ["view", "buy"]}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 10, "numIterations": 10, "alpha": 1.0}},
+        ],
+    },
+    "lead_scoring": {
+        "id": "my-lead-scoring",
+        "description": "session conversion scoring from first-view attributes",
+        "engineFactory": ENGINE_FACTORIES["lead_scoring"],
+        "datasource": {"params": {"appName": "MyApp", "viewEvent": "view",
+                                  "buyEvent": "buy",
+                                  "sessionProperty": "sessionId"}},
+        "algorithms": [
+            {"name": "logreg", "params": {"iterations": 200, "l2": 0.001}},
+        ],
+    },
     "text": {
         "id": "my-text-classification",
         "description": "text classification (tf-idf logistic regression)",

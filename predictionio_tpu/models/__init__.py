@@ -12,6 +12,10 @@ Each subpackage is a complete DASE engine matching a BASELINE.json config:
                               category/white/black-list rules
 - ``complementary_purchase``— basket association rules (support/confidence/
                               lift from one BᵀB pair-count matmul)
+- ``product_ranking``       — rank a query-provided item list for a user
+                              (implicit-ALS scores, gather-only serving)
+- ``lead_scoring``          — session conversion probability from
+                              categorical first-view features (logreg)
 """
 
 ENGINE_FACTORIES = {
@@ -23,4 +27,7 @@ ENGINE_FACTORIES = {
     "ecommerce": "predictionio_tpu.models.ecommerce.ECommerceEngine",
     "complementary_purchase":
         "predictionio_tpu.models.complementary_purchase.ComplementaryPurchaseEngine",
+    "product_ranking":
+        "predictionio_tpu.models.product_ranking.ProductRankingEngine",
+    "lead_scoring": "predictionio_tpu.models.lead_scoring.LeadScoringEngine",
 }

@@ -54,3 +54,37 @@ class CategoryRulesMixin(DeviceCacheMixin):
             return jax.device_put(jnp.asarray(m))
 
         return self._device("_cat_dev", build)
+
+
+def reindex_interactions(batch, event_names=None, return_rows=False):
+    """Compact (user, item) interaction encoding from a columnar batch.
+
+    The batch's entity/target dictionaries cover EVERY id the scan saw
+    ($set item ids, other event types, ...); training wants a dense id
+    space of only the entities that actually interact.  Returns
+    (user_idx, item_idx, user_dict, item_dict) with rows lacking a target
+    dropped.  ``event_names`` optionally narrows to those event types
+    first (via batch.select_events); ``return_rows`` appends the kept row
+    indices (into the narrowed batch) so callers can subset sibling
+    columns like event_codes consistently.
+    """
+    from predictionio_tpu.store.columnar import IdDict
+
+    if event_names is not None:
+        batch = batch.select_events(list(event_names))
+    has_t = batch.target_ids >= 0
+    u_codes = batch.entity_ids[has_t]
+    t_codes = batch.target_ids[has_t]
+    uu = np.unique(u_codes)
+    user_dict = IdDict([batch.entity_dict.str(int(c)) for c in uu])
+    u_map = np.full(max(len(batch.entity_dict), 1), -1, np.int32)
+    u_map[uu] = np.arange(len(uu), dtype=np.int32)
+    ti = np.unique(t_codes)
+    item_dict = IdDict([batch.target_dict.str(int(c)) for c in ti])
+    t_map = np.full(max(len(batch.target_dict), 1), -1, np.int32)
+    t_map[ti] = np.arange(len(ti), dtype=np.int32)
+    out = (u_map[u_codes].astype(np.int32), t_map[t_codes].astype(np.int32),
+           user_dict, item_dict)
+    if return_rows:
+        return out + (np.nonzero(has_t)[0],)
+    return out

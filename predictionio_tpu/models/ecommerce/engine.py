@@ -50,7 +50,11 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.models.common import CategoryRulesMixin, opt_str_list
+from predictionio_tpu.models.common import (
+    CategoryRulesMixin,
+    opt_str_list,
+    reindex_interactions,
+)
 from predictionio_tpu.store.columnar import IdDict, category_masks
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
 
@@ -107,18 +111,9 @@ class ECommDataSource(DataSource):
     def read_training(self) -> ECommTrainingData:
         batch = PEventStore.batch(
             self.params.app_name, event_names=list(self.params.event_names))
-        has_t = batch.target_ids >= 0
-        u_codes = batch.entity_ids[has_t]
-        t_codes = batch.target_ids[has_t]
-        ev_codes = batch.event_codes[has_t]
-        uu = np.unique(u_codes)
-        user_dict = IdDict([batch.entity_dict.str(int(c)) for c in uu])
-        u_map = np.full(max(len(batch.entity_dict), 1), -1, np.int32)
-        u_map[uu] = np.arange(len(uu), dtype=np.int32)
-        ti = np.unique(t_codes)
-        item_dict = IdDict([batch.target_dict.str(int(c)) for c in ti])
-        t_map = np.full(max(len(batch.target_dict), 1), -1, np.int32)
-        t_map[ti] = np.arange(len(ti), dtype=np.int32)
+        user_idx, item_idx, user_dict, item_dict, rows = reindex_interactions(
+            batch, return_rows=True)
+        ev_codes = batch.event_codes[rows]
         # event name -> position in self.params.event_names (event_dict codes
         # are storage-order, not config-order)
         name_of_code = {c: batch.event_dict.str(c) for c in np.unique(ev_codes)}
@@ -134,8 +129,8 @@ class ECommDataSource(DataSource):
             if v is not None:
                 cats[item] = [str(c) for c in (v if isinstance(v, list) else [v])]
         return ECommTrainingData(
-            user_idx=u_map[u_codes].astype(np.int32),
-            item_idx=t_map[t_codes].astype(np.int32),
+            user_idx=user_idx,
+            item_idx=item_idx,
             event_codes=code_map[ev_codes].astype(np.int32),
             event_names=list(self.params.event_names),
             user_dict=user_dict,

@@ -1,0 +1,4 @@
+from predictionio_tpu.models.lead_scoring.engine import (  # noqa: F401
+    LeadScoringEngine,
+    LSQuery,
+)

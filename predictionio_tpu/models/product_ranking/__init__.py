@@ -1,0 +1,4 @@
+from predictionio_tpu.models.product_ranking.engine import (  # noqa: F401
+    PRQuery,
+    ProductRankingEngine,
+)

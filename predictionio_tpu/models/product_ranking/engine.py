@@ -1,0 +1,208 @@
+"""Product Ranking engine template.
+
+Capability parity with the reference Product Ranking template
+(PredictionIO 0.9.x gallery — ranks a QUERY-PROVIDED item list for a
+user with MLlib ALS scores; when the user or items are unknown the
+original order is returned with ``isOriginal: true``).
+
+TPU-first: training is the shared implicit-feedback ALS op
+(ops.als.als_train, MXU-blocked normal equations over the mesh); serving
+gathers ONLY the queried items' factors on device — score = x_u · Y[ids]
+for the handful of queried ids, one [2, W] stacked readback, never an
+[n_items] pass (the list to rank is small by definition).
+
+Wire format (reference template):
+  query    {"user": "u1", "items": ["i3", "i1", "i9"]}
+  response {"itemScores": [...], "isOriginal": false}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    PersistentModel,
+    Preparator,
+)
+from predictionio_tpu.models.common import DeviceCacheMixin, reindex_interactions
+from predictionio_tpu.models.recommendation.engine import ItemScore
+from predictionio_tpu.ops import als as als_ops
+from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.store.columnar import IdDict
+from predictionio_tpu.store.event_store import PEventStore
+
+
+@dataclasses.dataclass
+class PRQuery:
+    user: str
+    items: List[str]
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "PRQuery":
+        return cls(user=str(d["user"]), items=[str(i) for i in d["items"]])
+
+
+@dataclasses.dataclass
+class PRResult:
+    item_scores: List[ItemScore]
+    is_original: bool
+
+    def to_json(self) -> Dict:
+        return {"itemScores": [s.to_json() for s in self.item_scores],
+                "isOriginal": self.is_original}
+
+
+@dataclasses.dataclass
+class PRDataSourceParams(Params):
+    app_name: str = "default"
+    event_names: List[str] = dataclasses.field(default_factory=lambda: ["view", "buy"])
+
+
+@dataclasses.dataclass
+class PRTrainingData:
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    user_dict: IdDict
+    item_dict: IdDict
+
+
+class PRDataSource(DataSource):
+    params_class = PRDataSourceParams
+
+    def read_training(self) -> PRTrainingData:
+        batch = PEventStore.batch(
+            self.params.app_name, event_names=list(self.params.event_names))
+        user_idx, item_idx, user_dict, item_dict = reindex_interactions(batch)
+        return PRTrainingData(
+            user_idx=user_idx, item_idx=item_idx,
+            user_dict=user_dict, item_dict=item_dict,
+        )
+
+
+class PRPreparator(Preparator):
+    def prepare(self, td: PRTrainingData) -> PRTrainingData:
+        return td
+
+
+@dataclasses.dataclass
+class PRAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 7
+    mesh_dp: int = 0
+
+
+class PRModel(DeviceCacheMixin, PersistentModel):
+    def __init__(self, user_factors, item_factors, user_dict, item_dict):
+        self.user_factors = user_factors
+        self.item_factors = item_factors
+        self.user_dict = user_dict
+        self.item_dict = item_dict
+
+    def __getstate__(self):
+        return {"X": self.user_factors, "Y": self.item_factors,
+                "users": self.user_dict.to_state(),
+                "items": self.item_dict.to_state()}
+
+    def __setstate__(self, s):
+        self.user_factors = s["X"]
+        self.item_factors = s["Y"]
+        self.user_dict = IdDict.from_state(s["users"])
+        self.item_dict = IdDict.from_state(s["items"])
+
+    def item_factors_device(self):
+        return self._device("_y_dev", lambda: jax.device_put(
+            jnp.asarray(self.item_factors, jnp.float32)))
+
+    def warm(self) -> None:
+        if len(self.item_factors):
+            self.item_factors_device()
+
+
+@jax.jit
+def _rank_scores(user_vec, item_factors, ids):
+    """Scores for ONLY the queried ids (gather of a few factor rows) —
+    one [W] readback per query; -1 padding scores to -inf.  The caller
+    already holds the ids host-side, so only scores cross back."""
+    valid = ids >= 0
+    y = item_factors[jnp.where(valid, ids, 0)]
+    return jnp.where(valid, y @ user_vec, -jnp.inf)
+
+
+class PRAlgorithm(Algorithm):
+    params_class = PRAlgorithmParams
+
+    def train(self, td: PRTrainingData) -> PRModel:
+        n_users, n_items = len(td.user_dict), len(td.item_dict)
+        rank = self.params.rank
+        if n_users == 0 or n_items == 0:
+            return PRModel(np.zeros((0, rank), np.float32),
+                           np.zeros((0, rank), np.float32),
+                           td.user_dict, td.item_dict)
+        # implicit: interaction counts as confidences (trainImplicit)
+        cell = td.user_idx.astype(np.int64) * n_items + td.item_idx
+        uniq, counts = np.unique(cell, return_counts=True)
+        users = (uniq // n_items).astype(np.int32)
+        items = (uniq % n_items).astype(np.int32)
+        dp = self.params.mesh_dp or len(jax.devices())
+        mesh = create_mesh(MeshSpec(dp=dp, mp=1)) if dp > 1 else None
+        data = als_ops.prepare_als_data(
+            users, items, counts.astype(np.float32), n_users, n_items, dp=dp)
+        X, Y = als_ops.als_train(
+            data, k=rank, reg=self.params.lambda_,
+            iterations=self.params.num_iterations, mesh=mesh,
+            seed=self.params.seed, implicit=True, alpha=self.params.alpha)
+        return PRModel(X, Y, td.user_dict, td.item_dict)
+
+    def warm(self, model: PRModel) -> None:
+        model.warm()
+
+    def predict(self, model: PRModel, query: PRQuery) -> PRResult:
+        uid = model.user_dict.id(query.user)
+        known = [(i, model.item_dict.id(i)) for i in query.items]
+        if (uid is None or len(model.item_factors) == 0
+                or all(iid is None for _, iid in known)):
+            # reference semantics: cannot rank -> original order, marked
+            return PRResult(
+                [ItemScore(i, 0.0) for i in query.items], is_original=True)
+        ids = als_ops.pad_ids([iid if iid is not None else -1
+                               for _, iid in known])
+        scores = np.asarray(_rank_scores(
+            np.asarray(model.user_factors[uid], np.float32),
+            model.item_factors_device(), jnp.asarray(ids)))[: len(known)]
+        # unknown items sink to the bottom with score 0 (reference ranks
+        # only known items and appends the rest)
+        ranked = sorted(
+            ((name, float(s) if np.isfinite(s) else None)
+             for (name, _), s in zip(known, scores)),
+            key=lambda t: (t[1] is None, -(t[1] or 0.0)))
+        return PRResult(
+            [ItemScore(n, s if s is not None else 0.0) for n, s in ranked],
+            is_original=False)
+
+
+class ProductRankingEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            data_source_class=PRDataSource,
+            preparator_class=PRPreparator,
+            algorithm_classes={"als": PRAlgorithm},
+            serving_class=FirstServing,
+        )
+
+    query_class = PRQuery

@@ -40,7 +40,11 @@ from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedRe
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.ops import cco as cco_ops
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
-from predictionio_tpu.models.common import CategoryRulesMixin, opt_str_list
+from predictionio_tpu.models.common import (
+    CategoryRulesMixin,
+    opt_str_list,
+    reindex_interactions,
+)
 from predictionio_tpu.store.columnar import IdDict, category_masks
 from predictionio_tpu.store.event_store import PEventStore
 
@@ -89,19 +93,7 @@ class SPDataSource(DataSource):
         vectorized dictionary translation — no per-event Python loop."""
         batch = PEventStore.batch(
             self.params.app_name, event_names=list(self.params.event_names))
-        has_t = batch.target_ids >= 0
-        u_codes = batch.entity_ids[has_t]
-        t_codes = batch.target_ids[has_t]
-        uu = np.unique(u_codes)
-        user_dict = IdDict([batch.entity_dict.str(int(c)) for c in uu])
-        u_map = np.full(max(len(batch.entity_dict), 1), -1, np.int32)
-        u_map[uu] = np.arange(len(uu), dtype=np.int32)
-        ti = np.unique(t_codes)
-        item_dict = IdDict([batch.target_dict.str(int(c)) for c in ti])
-        t_map = np.full(max(len(batch.target_dict), 1), -1, np.int32)
-        t_map[ti] = np.arange(len(ti), dtype=np.int32)
-        users = u_map[u_codes]
-        items = t_map[t_codes]
+        users, items, user_dict, item_dict = reindex_interactions(batch)
         props = PEventStore.aggregate_properties(
             self.params.app_name, self.params.item_entity_type
         )
@@ -111,8 +103,8 @@ class SPDataSource(DataSource):
             if v is not None:
                 cats[item] = [str(c) for c in (v if isinstance(v, list) else [v])]
         return SPTrainingData(
-            user_idx=np.asarray(users, np.int32),
-            item_idx=np.asarray(items, np.int32),
+            user_idx=users,
+            item_idx=items,
             user_dict=user_dict,
             item_dict=item_dict,
             item_categories=cats,

@@ -120,3 +120,57 @@ def logreg_predict_proba(w: jnp.ndarray, b: jnp.ndarray, x: jnp.ndarray) -> jnp.
 
 def logreg_predict(w: np.ndarray, b: np.ndarray, x: np.ndarray) -> np.ndarray:
     return np.asarray(jnp.argmax(logreg_predict_proba(jnp.asarray(w), jnp.asarray(b), jnp.asarray(x, jnp.float32)), axis=-1))
+
+
+@functools.partial(jax.jit, static_argnames=("iterations",))
+def _gather_logreg_run(w0, b0, flat_idx, valid, y, l2, lr, iterations: int):
+    def z_of(params):
+        w, b = params
+        contrib = jnp.where(valid, w[jnp.maximum(flat_idx, 0)], 0.0)
+        return contrib.sum(axis=0) + b          # [N]
+
+    def loss_fn(params):
+        w, _ = params
+        z = z_of(params)
+        ll = optax.sigmoid_binary_cross_entropy(z, y).mean()
+        return ll + l2 * jnp.sum(w * w)
+
+    opt = optax.adam(lr)
+
+    def step(carry, _):
+        params, state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params)
+        return (optax.apply_updates(params, updates), state), loss
+
+    (params, _), _ = jax.lax.scan(
+        step, ((w0, b0), opt.init((w0, b0))), None, length=iterations)
+    return params
+
+
+def logreg_gather_train(
+    attr_idx: np.ndarray,     # int32 [A, N], -1 = attribute missing
+    dims,                     # per-attribute dictionary sizes
+    y: np.ndarray,            # [N] binary labels
+    l2: float = 1e-3,
+    iterations: int = 200,
+    learning_rate: float = 0.1,
+):
+    """Binary logistic regression over categorical ids WITHOUT one-hot
+    materialization: z = Σ_a w_a[id_a] + b via embedding gathers, so
+    memory is O(N·A + Σdims) instead of the dense N×Σdims design matrix
+    (at 1M sessions × 10k pages that matrix would be ~40 GB).  Returns
+    (per-attribute weight tables, bias) in margin form.
+    """
+    dims = [max(int(d), 1) for d in dims]
+    offsets = np.concatenate([[0], np.cumsum(dims)]).astype(np.int64)
+    flat = np.where(attr_idx >= 0,
+                    attr_idx + offsets[:-1][:, None], -1).astype(np.int32)
+    w, b = _gather_logreg_run(
+        jnp.zeros(int(offsets[-1]), jnp.float32), jnp.float32(0.0),
+        jnp.asarray(flat), jnp.asarray(attr_idx >= 0),
+        jnp.asarray(np.asarray(y, np.float32)),
+        jnp.float32(l2), jnp.float32(learning_rate), iterations)
+    w = np.asarray(w)
+    tables = [w[offsets[a]:offsets[a + 1]].copy() for a in range(len(dims))]
+    return tables, float(b)

@@ -100,6 +100,7 @@ def test_template_list(capsys):
 @pytest.mark.parametrize("template", [
     "recommendation", "classification", "similar_product",
     "universal_recommender", "text", "ecommerce", "complementary_purchase",
+    "product_ranking", "lead_scoring",
 ])
 def test_template_scaffold_builds(template, mem_storage, tmp_path):
     """Every scaffolded engine.json must pass `pio build` (params bind)."""
@@ -258,6 +259,6 @@ def test_example_engine_jsons_bind(mem_storage):
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = sorted(glob.glob(os.path.join(repo, "examples", "*", "engine.json")))
-    assert len(paths) >= 7
+    assert len(paths) >= 9
     for p in paths:
         assert pio_main(["build", "--engine-json", p]) == 0, p

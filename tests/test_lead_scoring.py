@@ -1,0 +1,98 @@
+"""Lead Scoring template tests: sessionization, conversion scoring,
+fallback for unseen attribute combos."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.lead_scoring import LeadScoringEngine, LSQuery
+from predictionio_tpu.models.lead_scoring.engine import (
+    LSAlgorithmParams,
+    LSDataSourceParams,
+)
+from predictionio_tpu.storage import App
+
+APP = "lsapp"
+
+
+@pytest.fixture()
+def ls_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, APP))
+    rng = np.random.default_rng(9)
+    events = []
+    sid = 0
+    # /sale + google sessions convert 90%; /home + direct convert 10%
+    for k in range(300):
+        sid += 1
+        hot = k % 2 == 0
+        attrs = ({"sessionId": f"s{sid}", "landingPageId": "/sale",
+                  "referrerId": "google", "browser": "Chrome"} if hot else
+                 {"sessionId": f"s{sid}", "landingPageId": "/home",
+                  "referrerId": "direct", "browser": "Firefox"})
+        events.append(Event(event="view", entity_type="user",
+                            entity_id=f"u{k}", properties=DataMap(attrs)))
+        if rng.random() < (0.9 if hot else 0.1):
+            events.append(Event(event="buy", entity_type="user",
+                                entity_id=f"u{k}", target_entity_type="item",
+                                target_entity_id="i1",
+                                properties=DataMap({"sessionId": f"s{sid}"})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+def make_ep():
+    return EngineParams(
+        data_source_params=LSDataSourceParams(app_name=APP),
+        algorithm_params_list=[("logreg", LSAlgorithmParams(
+            iterations=150))],
+    )
+
+
+def trained():
+    engine = LeadScoringEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    return engine, ep, models, engine.predictor(ep, models)
+
+
+def test_hot_sessions_score_higher(ls_app):
+    _, _, _, predict = trained()
+    hot = predict(LSQuery.from_json({
+        "landingPageId": "/sale", "referrerId": "google",
+        "browser": "Chrome"})).score
+    cold = predict(LSQuery.from_json({
+        "landingPageId": "/home", "referrerId": "direct",
+        "browser": "Firefox"})).score
+    assert 0.0 < cold < 0.35 < 0.65 < hot < 1.0, (hot, cold)
+
+
+def test_unseen_combo_falls_back_to_base_rate(ls_app):
+    engine, ep, models, predict = trained()
+    res = predict(LSQuery(landing_page_id="/unknown", referrer_id="nobody",
+                          browser="Lynx"))
+    assert abs(res.score - models[0].base_rate) < 1e-9
+    assert 0.2 < res.score < 0.8  # overall ~50% conversion in fixture
+
+
+def test_sessionization_first_view_wins(ls_app):
+    engine, ep, models, _ = trained()
+    ds = engine.make_components(ep)[0]
+    td = ds.read_training()
+    assert td.attr_idx.shape[1] == 300
+    # two attribute values per dimension in the fixture
+    assert all(len(d) == 2 for d in td.attr_dicts)
+
+
+def test_wire_format_and_roundtrip(ls_app):
+    import pickle
+
+    engine, ep, models, predict = trained()
+    out = predict(LSQuery.from_json({"landingPageId": "/sale",
+                                     "referrerId": "google",
+                                     "browser": "Chrome"})).to_json()
+    assert set(out) == {"score"}
+    restored = [pickle.loads(pickle.dumps(m)) for m in models]
+    q = LSQuery(landing_page_id="/sale", referrer_id="google", browser="Chrome")
+    assert (engine.predictor(ep, models)(q).to_json()
+            == engine.predictor(ep, restored)(q).to_json())

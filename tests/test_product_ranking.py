@@ -1,0 +1,96 @@
+"""Product Ranking template tests: ranking a provided list, isOriginal
+fallback, unknown-item handling."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import Event
+from predictionio_tpu.models.product_ranking import ProductRankingEngine, PRQuery
+from predictionio_tpu.models.product_ranking.engine import (
+    PRAlgorithmParams,
+    PRDataSourceParams,
+)
+from predictionio_tpu.storage import App
+
+APP = "prapp"
+
+
+@pytest.fixture()
+def pr_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, APP))
+    rng = np.random.default_rng(12)
+    events = []
+    # even users love a-items (repeat buys), odd users love z-items
+    for u in range(40):
+        love = [f"a{i}" for i in range(4)] if u % 2 == 0 else [f"z{i}" for i in range(4)]
+        meh = [f"z{i}" for i in range(4)] if u % 2 == 0 else [f"a{i}" for i in range(4)]
+        for it in love:
+            for _ in range(3):
+                if rng.random() < 0.9:
+                    events.append(Event(event="buy", entity_type="user",
+                                        entity_id=f"u{u}", target_entity_type="item",
+                                        target_entity_id=it))
+        for it in meh:
+            if rng.random() < 0.2:
+                events.append(Event(event="view", entity_type="user",
+                                    entity_id=f"u{u}", target_entity_type="item",
+                                    target_entity_id=it))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+def make_ep():
+    return EngineParams(
+        data_source_params=PRDataSourceParams(app_name=APP),
+        algorithm_params_list=[("als", PRAlgorithmParams(
+            rank=6, num_iterations=12, alpha=2.0, mesh_dp=1))],
+    )
+
+
+def trained():
+    engine = ProductRankingEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    return engine, ep, engine.predictor(ep, models), models
+
+
+def test_ranks_loved_items_first(pr_app):
+    _, _, predict, _ = trained()
+    res = predict(PRQuery(user="u0", items=["z0", "a1", "z1", "a0"]))
+    assert not res.is_original
+    order = [s.item for s in res.item_scores]
+    assert set(order[:2]) <= {"a0", "a1"}, order
+    res = predict(PRQuery(user="u1", items=["z0", "a1", "z1", "a0"]))
+    assert set(s.item for s in res.item_scores[:2]) <= {"z0", "z1"}
+
+
+def test_unknown_user_returns_original_order(pr_app):
+    _, _, predict, _ = trained()
+    res = predict(PRQuery(user="nobody", items=["z0", "a1", "a0"]))
+    assert res.is_original
+    assert [s.item for s in res.item_scores] == ["z0", "a1", "a0"]
+
+
+def test_unknown_items_sink_to_bottom(pr_app):
+    _, _, predict, _ = trained()
+    res = predict(PRQuery(user="u0", items=["mystery", "a1", "a0"]))
+    assert not res.is_original
+    assert res.item_scores[-1].item == "mystery"
+
+
+def test_wire_format(pr_app):
+    _, _, predict, _ = trained()
+    q = PRQuery.from_json({"user": "u0", "items": ["a0", "z0"]})
+    out = predict(q).to_json()
+    assert set(out) == {"itemScores", "isOriginal"}
+
+
+def test_model_roundtrip(pr_app):
+    import pickle
+
+    engine, ep, _, models = trained()
+    restored = [pickle.loads(pickle.dumps(m)) for m in models]
+    q = PRQuery(user="u0", items=["a0", "z0", "a1"])
+    assert (engine.predictor(ep, models)(q).to_json()
+            == engine.predictor(ep, restored)(q).to_json())
