@@ -8,7 +8,7 @@ bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
   channel new|delete                      channels
   build                                   validate engine.json + register manifest
   template list|new                       built-in template gallery / scaffolding
-  train / deploy / eval                   DASE workflow (workflow module)
+  train / deploy/undeploy / eval                   DASE workflow (workflow module)
   import / export                         event batch files
   eventserver / adminserver / dashboard   REST ingestion / admin API / eval dashboard
   status                                  storage + env sanity report
@@ -304,6 +304,23 @@ def _cmd_deploy(args) -> int:
     return run_server_from_args(args)
 
 
+def _cmd_undeploy(args) -> int:
+    """Stop a deployed query server (reference Console.undeploy: contacts
+    the running server rather than killing a pid)."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            resp.read()
+        print(f"Undeployed {args.ip}:{args.port}.")
+        return 0
+    except urllib.error.URLError as e:
+        print(f"No deployment reachable at {args.ip}:{args.port}: {e.reason}")
+        return 1
+
+
 def _cmd_eval(args) -> int:
     from predictionio_tpu.workflow.create_workflow import run_eval_from_args
 
@@ -425,6 +442,12 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--engine-instance-id", default=None)
     dp.add_argument("--feedback", action="store_true")
     dp.set_defaults(func=_cmd_deploy)
+
+    ud = sub.add_parser("undeploy")
+    ud.add_argument("--ip", default="127.0.0.1")
+    ud.add_argument("--port", type=int, default=8000)
+    ud.add_argument("--timeout", type=float, default=10.0)
+    ud.set_defaults(func=_cmd_undeploy)
 
     ev = sub.add_parser("eval")
     ev.add_argument("evaluation_class")

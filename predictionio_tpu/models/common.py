@@ -56,22 +56,18 @@ class CategoryRulesMixin(DeviceCacheMixin):
         return self._device("_cat_dev", build)
 
 
-def reindex_interactions(batch, event_names=None, return_rows=False):
+def reindex_interactions(batch, return_rows=False):
     """Compact (user, item) interaction encoding from a columnar batch.
 
     The batch's entity/target dictionaries cover EVERY id the scan saw
     ($set item ids, other event types, ...); training wants a dense id
     space of only the entities that actually interact.  Returns
     (user_idx, item_idx, user_dict, item_dict) with rows lacking a target
-    dropped.  ``event_names`` optionally narrows to those event types
-    first (via batch.select_events); ``return_rows`` appends the kept row
-    indices (into the narrowed batch) so callers can subset sibling
-    columns like event_codes consistently.
+    dropped; ``return_rows`` appends the kept row indices so callers can
+    subset sibling columns like event_codes consistently.
     """
     from predictionio_tpu.store.columnar import IdDict
 
-    if event_names is not None:
-        batch = batch.select_events(list(event_names))
     has_t = batch.target_ids >= 0
     u_codes = batch.entity_ids[has_t]
     t_codes = batch.target_ids[has_t]

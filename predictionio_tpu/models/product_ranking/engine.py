@@ -8,7 +8,7 @@ original order is returned with ``isOriginal: true``).
 TPU-first: training is the shared implicit-feedback ALS op
 (ops.als.als_train, MXU-blocked normal equations over the mesh); serving
 gathers ONLY the queried items' factors on device — score = x_u · Y[ids]
-for the handful of queried ids, one [2, W] stacked readback, never an
+for the handful of queried ids, one [W] score readback, never an
 [n_items] pass (the list to rank is small by definition).
 
 Wire format (reference template):
@@ -19,7 +19,6 @@ Wire format (reference template):
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Dict, List
 
 import jax

@@ -185,7 +185,16 @@ def make_handler(state: QueryServerState):
                     self.send_error_json(500, f"reload failed: {e}")
             elif path == "/stop":
                 self.send_json({"stopping": True})
-                threading.Thread(target=self.server.shutdown, daemon=True).start()
+
+                def _stop(server):
+                    server.shutdown()
+                    # close the listening socket too: shutdown() alone
+                    # keeps accepting connections that nothing serves
+                    # (clients would hang instead of being refused)
+                    server.server_close()
+
+                threading.Thread(target=_stop, args=(self.server,),
+                                 daemon=True).start()
             else:
                 self.send_error_json(404, "not found")
 

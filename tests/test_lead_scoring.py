@@ -76,12 +76,26 @@ def test_unseen_combo_falls_back_to_base_rate(ls_app):
 
 
 def test_sessionization_first_view_wins(ls_app):
+    import datetime as dt
+
+    from predictionio_tpu.events.event import DataMap, Event
+
+    storage = ls_app
+    app_id = storage.apps.get_by_name(APP).id
+    # a LATER second view of session s1 with different attributes must NOT
+    # replace the first view's attributes
+    storage.l_events.insert(Event(
+        event="view", entity_type="user", entity_id="u-late",
+        event_time=dt.datetime(2030, 1, 1, tzinfo=dt.timezone.utc),
+        properties=DataMap({"sessionId": "s1", "landingPageId": "/changed",
+                            "referrerId": "elsewhere", "browser": "Edge"})), app_id)
     engine, ep, models, _ = trained()
     ds = engine.make_components(ep)[0]
     td = ds.read_training()
     assert td.attr_idx.shape[1] == 300
-    # two attribute values per dimension in the fixture
+    # the late duplicate's values never enroll: still two values per attr
     assert all(len(d) == 2 for d in td.attr_dicts)
+    assert all("/changed" not in list(d.strings()) for d in td.attr_dicts[:1])
 
 
 def test_wire_format_and_roundtrip(ls_app):

@@ -205,3 +205,30 @@ def test_query_server_web_ui(deployed_engine):
     body = urllib.request.urlopen(req).read().decode()
     assert "Engine server: qs-engine" in body
     assert "queries.json" in body
+
+
+def test_cli_undeploy_stops_server(deployed_engine):
+    """`pio undeploy` contacts the deployed server's /stop (reference
+    Console.undeploy semantics) and reports failure when nothing listens."""
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.cli.main import main as pio_main
+
+    base = deployed_engine["base"]
+    port = int(base.rsplit(":", 1)[1])
+    assert pio_main(["undeploy", "--ip", "127.0.0.1",
+                     "--port", str(port)]) == 0
+    # server is gone: queries now fail at the connection level
+    import time
+
+    for _ in range(50):
+        try:
+            urllib.request.urlopen(base + "/", timeout=2)
+            time.sleep(0.1)
+        except (urllib.error.URLError, ConnectionError):
+            break
+    else:
+        raise AssertionError("server still reachable after undeploy")
+    assert pio_main(["undeploy", "--ip", "127.0.0.1",
+                     "--port", str(port), "--timeout", "2"]) == 1
