@@ -316,6 +316,12 @@ def _cmd_undeploy(args) -> int:
             resp.read()
         print(f"Undeployed {args.ip}:{args.port}.")
         return 0
+    except urllib.error.HTTPError as e:
+        # something IS listening but refused /stop (e.g. the event server):
+        # distinguish from "nothing deployed" so the user checks the port
+        print(f"Server at {args.ip}:{args.port} rejected /stop "
+              f"(HTTP {e.code}) — is this a query server?")
+        return 1
     except urllib.error.URLError as e:
         print(f"No deployment reachable at {args.ip}:{args.port}: {e.reason}")
         return 1

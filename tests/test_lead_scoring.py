@@ -93,9 +93,10 @@ def test_sessionization_first_view_wins(ls_app):
     ds = engine.make_components(ep)[0]
     td = ds.read_training()
     assert td.attr_idx.shape[1] == 300
-    # the late duplicate's values never enroll: still two values per attr
+    # the late duplicate's values never enroll, in ANY attribute dict
     assert all(len(d) == 2 for d in td.attr_dicts)
-    assert all("/changed" not in list(d.strings()) for d in td.attr_dicts[:1])
+    for d, late_value in zip(td.attr_dicts, ("/changed", "elsewhere", "Edge")):
+        assert late_value not in list(d.strings())
 
 
 def test_wire_format_and_roundtrip(ls_app):
