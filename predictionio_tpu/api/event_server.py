@@ -21,7 +21,7 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.events.event import Event, parse_time
@@ -180,19 +180,32 @@ def make_handler(state: EventServerState):
             if len(body) > MAX_BATCH:
                 self.send_error_json(400, f"batch size {len(body)} exceeds limit {MAX_BATCH}")
                 return
-            results = []
+            # access-key event filter first (needs only the name), then ONE
+            # storage batch for everything allowed — the per-item Event
+            # round trip and per-item locked append were the ingest
+            # bottleneck (~70 µs + a lock acquisition per event)
+            results: List[Optional[Dict[str, Any]]] = []
+            allowed = []
             for item in body:
-                try:
-                    event = Event.from_json(item)
-                    err = self._check_allowed(ak, event.event)
-                    if err:
-                        results.append({"status": 403, "message": err})
-                        continue
-                    event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
-                    state.record(ak.app_id, event.event)
-                    results.append({"status": 201, "eventId": event_id})
-                except (ValueError, KeyError, TypeError) as e:
-                    results.append({"status": 400, "message": str(e)})
+                name = item.get("event") if isinstance(item, dict) else None
+                # authorize only well-formed names; malformed items flow to
+                # storage validation and 400 (the pre-batching order)
+                err = (self._check_allowed(ak, name)
+                       if isinstance(name, str) and name else None)
+                if err:
+                    results.append({"status": 403, "message": err})
+                else:
+                    allowed.append(item if isinstance(item, dict) else {})
+                    results.append(None)
+            inserted = state.storage.l_events.insert_json_batch(
+                allowed, ak.app_id, channel_id) if allowed else []
+            it = iter(inserted)
+            for k, r in enumerate(results):
+                if r is None:
+                    results[k] = next(it)
+            for item, r in zip(body, results):
+                if r.get("status") == 201 and isinstance(item, dict):
+                    state.record(ak.app_id, item.get("event", ""))
             self.send_json(results)
 
         def _find(self, ak, channel_id, query):

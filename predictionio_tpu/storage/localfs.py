@@ -25,7 +25,7 @@ import uuid
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Sequence
 
-from predictionio_tpu.events.event import Event
+from predictionio_tpu.events.event import Event, canonical_event_json
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import (
     AccessKey,
@@ -657,7 +657,33 @@ class FSEvents(base.LEvents, base.PEvents):
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> List[str]:
-        lines = "".join(e.to_json_line() + "\n" for e in events)
+        self._append_lines("".join(e.to_json_line() + "\n" for e in events),
+                           app_id, channel_id)
+        return [e.event_id for e in events]
+
+    def insert_json_batch(
+        self, items: Sequence, app_id: int, channel_id: Optional[int] = None
+    ) -> List[dict]:
+        """Ingest fast path: wire dicts are canonicalized WITHOUT building
+        Event objects (events.canonical_event_json — byte-identical lines,
+        ~5× cheaper) and all valid items land in one locked append."""
+        results: List[dict] = []
+        lines: List[str] = []
+        for item in items:
+            try:
+                d = canonical_event_json(item)
+                lines.append(json.dumps(d, separators=(",", ":"),
+                                        sort_keys=True))
+                results.append({"status": 201, "eventId": d["eventId"]})
+            except (ValueError, KeyError, TypeError) as e:
+                results.append({"status": 400, "message": str(e)})
+        if lines:
+            self._append_lines("".join(ln + "\n" for ln in lines),
+                               app_id, channel_id)
+        return results
+
+    def _append_lines(self, lines: str, app_id: int,
+                      channel_id: Optional[int]) -> None:
         key = (app_id, channel_id)
         with self._lock:
             w = self._writers.get(key)
@@ -670,7 +696,6 @@ class FSEvents(base.LEvents, base.PEvents):
                     self._recover_compact(d)
                 w = self._writers[key] = self._new_writer(d)
             w.append(lines)
-        return [e.event_id for e in events]
 
     _COMPACT_INTENT = "compact-intent.json"
     _COMPACT_LOCK = "compact.lock"

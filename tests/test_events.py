@@ -98,3 +98,83 @@ def test_aggregate_orders_by_event_time_not_arrival():
               properties=DataMap({"v": "early"}), event_time=ts(1)),
     ]
     assert aggregate_properties(events)["u1"]["v"] == "late"
+
+
+def test_canonical_event_json_matches_event_round_trip():
+    """The ingest fast path must produce byte-identical storage lines to
+    the Event object path for the same eventId/creationTime."""
+    import json as _json
+
+    from predictionio_tpu.events.event import Event, canonical_event_json
+
+    corpus = [
+        {"event": "buy", "entityType": "user", "entityId": 7,
+         "targetEntityType": "item", "targetEntityId": 3,
+         "eventTime": "2026-01-02T03:04:05Z"},
+        {"event": "view", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"k": [1, 2], "s": "x", "b": True, "n": None},
+         "eventTime": "2026-01-02T03:04:05+02:00", "tags": ["a", "b"],
+         "prId": "p1"},
+        {"event": "$set", "entityType": "item", "entityId": "i9",
+         "properties": {"categories": ["c1"]}},
+        {"event": "$unset", "entityType": "item", "entityId": "i9",
+         "properties": {"categories": None}},
+        {"event": "$delete", "entityType": "item", "entityId": "i9"},
+        {"event": "rate", "entityType": "user", "entityId": "u",
+         "targetEntityType": "item", "targetEntityId": "i",
+         "properties": {"rating": 4.5}, "eventTime": 1750000000},
+        # falsy-but-present eventId is preserved identically on both paths
+        {"event": "buy", "entityType": "u", "entityId": "x", "eventId": ""},
+    ]
+    for d in corpus:
+        fixed = dict(d, creationTime="2026-02-03T04:05:06+00:00")
+        fixed.setdefault("eventId", "fixedid")   # keeps the corpus's "" case
+        fixed.setdefault("eventTime", "2026-02-03T04:05:06+00:00")
+        fast = _json.dumps(canonical_event_json(fixed),
+                           separators=(",", ":"), sort_keys=True)
+        slow = Event.from_json(fixed).to_json_line()
+        assert fast == slow, (d, fast, slow)
+
+
+def test_canonical_event_json_rejects_what_from_json_rejects():
+    import pytest as _pytest
+
+    from predictionio_tpu.events.event import Event, canonical_event_json
+
+    bad = [
+        {"event": "buy", "entityType": "user"},                    # no id
+        {"event": "buy", "entityType": "user", "entityId": None},  # null id
+        {"event": "", "entityType": "user", "entityId": "u"},      # empty verb
+        {"event": 5, "entityType": "user", "entityId": "u"},       # non-str verb
+        {"event": "buy", "entityType": "u", "entityId": "x",
+         "properties": [["a", 1]]},                                # non-object props
+        {"event": "$set", "entityType": "u", "entityId": "x",
+         "targetEntityId": "t"},                                   # target on $set
+        {"event": "$unset", "entityType": "u", "entityId": "x"},   # empty unset
+        {"event": "$frobnicate", "entityType": "u", "entityId": "x"},
+        {"event": "buy", "entityType": "u", "entityId": "x", "nope": 1},
+    ]
+    for d in bad:
+        with _pytest.raises((ValueError, KeyError, TypeError)):
+            canonical_event_json(d)
+        with _pytest.raises((ValueError, KeyError, TypeError)):
+            Event.from_json(d)
+
+
+def test_insert_json_batch_statuses_and_readback(mem_storage):
+    from predictionio_tpu.storage import App
+
+    app_id = mem_storage.apps.insert(App(0, "jb"))
+    items = [
+        {"event": "buy", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i1"},
+        {"event": "buy", "entityType": "user"},   # invalid: no entityId
+        {"event": "$set", "entityType": "item", "entityId": "i1",
+         "properties": {"categories": ["c"]}},
+    ]
+    out = mem_storage.l_events.insert_json_batch(items, app_id)
+    assert [r["status"] for r in out] == [201, 400, 201]
+    got = list(mem_storage.l_events.find(app_id))
+    assert len(got) == 2
+    assert {e.event for e in got} == {"buy", "$set"}
