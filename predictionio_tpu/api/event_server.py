@@ -188,12 +188,19 @@ def make_handler(state: EventServerState):
             allowed = []
             for item in body:
                 name = item.get("event") if isinstance(item, dict) else None
-                # authorize only well-formed names; malformed items flow to
-                # storage validation and 400 (the pre-batching order)
                 err = (self._check_allowed(ak, name)
                        if isinstance(name, str) and name else None)
                 if err:
-                    results.append({"status": 403, "message": err})
+                    # validate-then-authorize, exactly like /events.json and
+                    # the old per-event loop: a malformed item is 400 even
+                    # when its event name is also disallowed (disallowed
+                    # items are the rare case, so validating them here
+                    # doesn't cost the batch fast path anything)
+                    try:
+                        Event.from_json(item)
+                        results.append({"status": 403, "message": err})
+                    except (ValueError, KeyError, TypeError) as e:
+                        results.append({"status": 400, "message": str(e)})
                 else:
                     allowed.append(item if isinstance(item, dict) else {})
                     results.append(None)

@@ -247,9 +247,15 @@ def canonical_event_json(d: Mapping[str, Any]) -> Dict[str, Any]:
     props = d.get("properties") or {}
     if not isinstance(props, Mapping):
         raise ValueError("properties must be a JSON object")
-    tet, tei = d.get("targetEntityType"), d.get("targetEntityId")
+    tet = d.get("targetEntityType")
+    tei = d.get("targetEntityId")
+    # coerce BEFORE the special-event check, exactly as Event.from_json →
+    # _validate does: a numeric-falsy target (0) becomes truthy "0" and must
+    # be rejected on $set/$unset/$delete, or the stored line would fail
+    # Event.from_json on every subsequent read of the log
+    tei_s = str(tei) if tei is not None else None
     if event in SPECIAL_EVENTS:
-        if tet or tei:
+        if tet or tei_s:
             raise ValueError(f"{event} must not have a target entity")
         if event == UNSET_EVENT and not props:
             raise ValueError("$unset requires a non-empty properties map")
@@ -271,7 +277,7 @@ def canonical_event_json(d: Mapping[str, Any]) -> Dict[str, Any]:
     if tet is not None:
         out["targetEntityType"] = tet
     if tei is not None:
-        out["targetEntityId"] = str(tei)
+        out["targetEntityId"] = tei_s
     if d.get("tags"):
         out["tags"] = list(d["tags"])
     if d.get("prId") is not None:

@@ -178,3 +178,18 @@ def test_insert_json_batch_statuses_and_readback(mem_storage):
     got = list(mem_storage.l_events.find(app_id))
     assert len(got) == 2
     assert {e.event for e in got} == {"buy", "$set"}
+
+
+def test_canonical_rejects_falsy_numeric_target_on_special_events():
+    """A numeric-falsy target (0) coerces to truthy \"0\" — both paths must
+    reject it on $set, or the stored line would poison every log read."""
+    import pytest as _pytest
+
+    from predictionio_tpu.events.event import Event, canonical_event_json
+
+    bad = {"event": "$set", "entityType": "u", "entityId": "x",
+           "targetEntityId": 0}
+    with _pytest.raises(ValueError):
+        canonical_event_json(bad)
+    with _pytest.raises(ValueError):
+        Event.from_json(bad)
