@@ -213,9 +213,12 @@ def _resolve_channel(st, app, channel_name: Optional[str]):
 
 
 def _cmd_import(args) -> int:
-    """Reference: tools Import — bulk load a JSON-lines event file."""
-    from predictionio_tpu.events.event import Event
+    """Reference: tools Import — bulk load a JSON-lines event file.
 
+    Rides the batch-ingest fast path (insert_json_batch: canonical dict
+    lines, one locked append per chunk).  A bad line aborts with its exact
+    line number; valid lines of the failing chunk are already committed
+    (re-run after `pio app data-delete` for a clean slate)."""
     st = get_storage()
     app = st.apps.get(args.appid) if args.appid else _resolve_app(st, args.app_name)
     if app is None:
@@ -225,20 +228,37 @@ def _cmd_import(args) -> int:
     if not ok:
         return 1
     count = 0
-    batch = []
+    batch = []          # [(lineno, wire dict)]
+
+    def flush():
+        nonlocal count
+        results = st.l_events.insert_json_batch(
+            [d for _, d in batch], app.id, channel_id)
+        for (lineno, _), r in zip(batch, results):
+            if r.get("status") != 201:
+                print(f"Error: line {lineno}: {r.get('message')}",
+                      file=sys.stderr)
+                return False
+        count += len(batch)
+        return True
+
     with open(args.input) as f:
-        for line in f:
+        for lineno, line in enumerate(f, start=1):
             line = line.strip()
             if not line:
                 continue
-            batch.append(Event.from_json(json.loads(line)))
+            try:
+                batch.append((lineno, json.loads(line)))
+            except json.JSONDecodeError as e:
+                print(f"Error: line {lineno}: invalid JSON: {e}",
+                      file=sys.stderr)
+                return 1
             if len(batch) >= 10000:
-                st.l_events.insert_batch(batch, app.id, channel_id)
-                count += len(batch)
+                if not flush():
+                    return 1
                 batch = []
-    if batch:
-        st.l_events.insert_batch(batch, app.id, channel_id)
-        count += len(batch)
+    if batch and not flush():
+        return 1
     where = f"app {app.id}" + (f" channel {args.channel}" if args.channel else "")
     print(f"Imported {count} events to {where}.")
     return 0

@@ -262,3 +262,44 @@ def test_example_engine_jsons_bind(mem_storage):
     assert len(paths) >= 9
     for p in paths:
         assert pio_main(["build", "--engine-json", p]) == 0, p
+
+
+def test_import_reports_bad_line_number(mem_storage, tmp_path):
+    """A malformed line aborts `pio import` with its exact line number."""
+    from predictionio_tpu.storage import App
+
+    mem_storage.apps.insert(App(0, "ImpApp"))
+    f = tmp_path / "events.jsonl"
+    f.write_text(
+        '{"event": "buy", "entityType": "u", "entityId": "a"}\n'
+        "\n"   # blank lines are skipped and don't shift reported numbers
+        '{"event": "buy", "entityType": "u"}\n'
+        '{"event": "buy", "entityType": "u", "entityId": "c"}\n')
+    import contextlib
+    import io
+
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = pio_main(["import", "--app-name", "ImpApp", "--input", str(f)])
+    assert rc == 1
+    assert "line 3" in err.getvalue(), err.getvalue()
+    # syntactically invalid JSON also aborts with the line number, not a
+    # traceback
+    f.write_text('{"event": "buy", "entityType": "u", "entityId": "a"}\n'
+                 '{"event": "buy",\n')
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = pio_main(["import", "--app-name", "ImpApp", "--input", str(f)])
+    assert rc == 1 and "line 2" in err.getvalue(), err.getvalue()
+
+
+def test_import_good_file_counts(mem_storage, tmp_path):
+    from predictionio_tpu.storage import App
+
+    app_id = mem_storage.apps.insert(App(0, "ImpApp2"))
+    f = tmp_path / "events.jsonl"
+    f.write_text("".join(
+        json.dumps({"event": "buy", "entityType": "u", "entityId": f"u{k}"}) + "\n"
+        for k in range(25)))
+    assert pio_main(["import", "--app-name", "ImpApp2", "--input", str(f)]) == 0
+    assert len(list(mem_storage.l_events.find(app_id))) == 25
