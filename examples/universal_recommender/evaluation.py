@@ -10,6 +10,9 @@ from predictionio_tpu.controller.evaluation import EngineParamsGenerator, params
 from predictionio_tpu.models.universal_recommender import UniversalRecommenderEngine
 from predictionio_tpu.models.universal_recommender.engine import (
     HitRateMetric,
+    MRRMetric,
+    NDCGMetric,
+    PrecisionAtKMetric,
     URAlgorithmParams,
     URDataSourceParams,
 )
@@ -25,6 +28,8 @@ _BASE = EngineParams(
 class UREvaluation(Evaluation):
     engine = UniversalRecommenderEngine.apply()
     metric = HitRateMetric()
+    # side metrics reported per candidate alongside the selection metric
+    other_metrics = (NDCGMetric(), PrecisionAtKMetric(10), MRRMetric())
 
 
 class MinLlrGrid(EngineParamsGenerator):

@@ -217,8 +217,9 @@ def _cmd_import(args) -> int:
 
     Rides the batch-ingest fast path (insert_json_batch: canonical dict
     lines, one locked append per chunk).  A bad line aborts with its exact
-    line number; valid lines of the failing chunk are already committed
-    (re-run after `pio app data-delete` for a clean slate)."""
+    line number; earlier chunks — and, for a validation error, the failing
+    chunk's valid lines — may already be committed (re-run after
+    `pio app data-delete` for a clean slate)."""
     st = get_storage()
     app = st.apps.get(args.appid) if args.appid else _resolve_app(st, args.app_name)
     if app is None:

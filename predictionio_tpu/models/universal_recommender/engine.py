@@ -25,6 +25,7 @@ Wire format (UR):
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -279,25 +280,78 @@ class URDataSource(DataSource):
         return [(fold_td, {"fold": "leave-one-out"}, qa)]
 
 
-class HitRateMetric:
-    """hit@num over URResult predictions (larger is better)."""
+class _RankMetric:
+    """Base for rank metrics over URResult predictions with a single
+    held-out relevant item (the leave-one-out protocol of read_eval).
+    Subclasses score one ranked list by the 0-based rank of the actual
+    item, or None when it is absent."""
 
     higher_is_better = True
 
     def header(self) -> str:
-        return "HitRate"
+        raise NotImplementedError   # subclasses name themselves
+
+    def score_rank(self, rank) -> float:
+        raise NotImplementedError
 
     def calculate(self, eval_data) -> float:
-        hits = total = 0
+        total = 0
+        score = 0.0
         for _info, qpa in eval_data:
             for _q, p, actual in qpa:
                 total += 1
-                if any(s.item == actual for s in p.item_scores):
-                    hits += 1
-        return hits / total if total else 0.0
+                rank = next((r for r, s in enumerate(p.item_scores)
+                             if s.item == actual), None)
+                score += self.score_rank(rank)
+        return score / total if total else 0.0
 
     def compare(self, a: float, b: float) -> int:
         return 0 if a == b else (1 if a > b else -1)
+
+
+class HitRateMetric(_RankMetric):
+    """hit@num: fraction of held-out items anywhere in the result list."""
+
+    def header(self) -> str:
+        return "HitRate"
+
+    def score_rank(self, rank) -> float:
+        return 1.0 if rank is not None else 0.0
+
+
+class NDCGMetric(_RankMetric):
+    """NDCG@num with one relevant item: 1/log2(rank+2), 0 on a miss —
+    the ideal DCG is 1, so no normalization divisor is needed."""
+
+    def header(self) -> str:
+        return "NDCG"
+
+    def score_rank(self, rank) -> float:
+        return 1.0 / math.log2(rank + 2) if rank is not None else 0.0
+
+
+class PrecisionAtKMetric(_RankMetric):
+    """precision@k with one relevant item: 1/k when the item ranks in the
+    top k, else 0 (reference e2 evaluation's precision family)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def score_rank(self, rank) -> float:
+        return 1.0 / self.k if rank is not None and rank < self.k else 0.0
+
+
+class MRRMetric(_RankMetric):
+    """Mean reciprocal rank: 1/(rank+1), 0 on a miss."""
+
+    def header(self) -> str:
+        return "MRR"
+
+    def score_rank(self, rank) -> float:
+        return 1.0 / (rank + 1) if rank is not None else 0.0
 
 
 class URPreparator(Preparator):

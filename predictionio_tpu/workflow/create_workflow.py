@@ -222,6 +222,13 @@ def run_eval_from_args(args) -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     print(f"Evaluation completed: {result.metric_header} best={result.best_score:.6f}")
+    # per-candidate table incl. side metrics (reference MetricEvaluator
+    # prints the full candidate/metric matrix, not only the winner)
+    headers = [result.metric_header] + list(result.other_metric_headers)
+    for i, (_ep, score, others) in enumerate(result.engine_params_scores):
+        marker = "*" if i == result.best_index else " "
+        cells = "  ".join(f"{h}={v:.6f}" for h, v in zip(headers, [score] + list(others)))
+        print(f"  {marker} candidate {i}: {cells}")
     print("Best engine params:")
     print(json.dumps(result.best_engine_params.to_json(), indent=2))
     return 0

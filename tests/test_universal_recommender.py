@@ -666,3 +666,35 @@ def test_ur_offline_eval_hit_rate(ur_app):
             app_name="urapp", mesh_dp=1))],
     )
     assert engine.eval(ep0) == []
+
+
+def test_rank_metrics_family():
+    """NDCG / precision@k / MRR over the leave-one-out protocol."""
+    import math
+
+    from predictionio_tpu.models.universal_recommender.engine import (
+        HitRateMetric,
+        ItemScore,
+        MRRMetric,
+        NDCGMetric,
+        PrecisionAtKMetric,
+        URResult,
+    )
+
+    def res(*items):
+        return URResult([ItemScore(i, 1.0) for i in items])
+
+    # actual at rank 0, rank 2, and missing
+    data = [({}, [
+        (None, res("a", "b", "c"), "a"),
+        (None, res("x", "y", "z"), "z"),
+        (None, res("p", "q"), "missing"),
+    ])]
+    assert abs(HitRateMetric().calculate(data) - 2 / 3) < 1e-9
+    expected_ndcg = (1.0 + 1.0 / math.log2(4) + 0.0) / 3
+    assert abs(NDCGMetric().calculate(data) - expected_ndcg) < 1e-9
+    assert abs(MRRMetric().calculate(data) - (1.0 + 1 / 3) / 3) < 1e-9
+    p2 = PrecisionAtKMetric(2)
+    assert p2.header() == "Precision@2"
+    # rank 0 counts, rank 2 does not, miss does not -> (1/2) / 3
+    assert abs(p2.calculate(data) - (0.5) / 3) < 1e-9
