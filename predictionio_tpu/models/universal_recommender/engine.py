@@ -44,7 +44,11 @@ from predictionio_tpu.controller import (
     Preparator,
 )
 from predictionio_tpu.ops import cco as cco_ops
-from predictionio_tpu.ops.als import bucket_width, pad_ids as als_pad_ids
+from predictionio_tpu.ops.als import (
+    bucket_width,
+    check_f32_id_range,
+    pad_ids as als_pad_ids,
+)
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
 from predictionio_tpu.store.columnar import CSRLookup, IdDict
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
@@ -176,6 +180,7 @@ class URDataSourceParams(Params):
     # users are evaluated
     eval_users: int = 0
     eval_num: int = 10
+    eval_seed: int = 0  # seeds the holdout-user sample when eval_users caps
 
 
 @dataclasses.dataclass
@@ -260,8 +265,12 @@ class URDataSource(DataSource):
         last_of_user = np.flatnonzero(
             np.concatenate((us[1:] != us[:-1], [True])))
         counts = np.bincount(us, minlength=0)
-        holdout_rows = [r for r in last_of_user if counts[us[r]] >= 2]
-        holdout_rows = holdout_rows[: self.params.eval_users]
+        holdout_rows = last_of_user[counts[us[last_of_user]] >= 2]
+        # sample (not first-N) when capping: stores are commonly sorted by
+        # entity id, so taking qualifying users in array order would bias a
+        # grid search toward whichever users sort first
+        rng = np.random.default_rng(self.params.eval_seed)
+        holdout_rows = rng.permutation(holdout_rows)[: self.params.eval_users]
         drop = np.zeros(len(us), bool)
         drop[holdout_rows] = True
         interactions = dict(td.interactions)
@@ -657,7 +666,9 @@ def _serve_topk(signal, mask, bf, black_ids, k: int):
     [n_items] vector (at 100k+ items the old full-vector download plus
     host masking/argpartition was the serving bottleneck) and never
     multiple fetches (each sync is a device round trip, ≈70 ms on a
-    tunneled chip).  Index rows are exact in f32 below 2^24 items."""
+    tunneled chip).  Index rows are exact in f32 below 2^24 items —
+    enforced at trace time."""
+    check_f32_id_range(signal.shape[0])
     valid = black_ids >= 0
     excl = jnp.zeros_like(signal).at[
         jnp.where(valid, black_ids, 0)

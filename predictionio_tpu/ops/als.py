@@ -424,12 +424,25 @@ def recommend_scores(
     return jax.lax.top_k(scores, top_k)
 
 
+def check_f32_id_range(n_items: int) -> None:
+    """The stacked-readback serving paths pack item indices as f32, which
+    is exact only below 2**24.  Callers invoke this with the static catalog
+    size at trace time (shapes are static under jit, so every new catalog
+    shape passes through here exactly once) — violating catalogs fail
+    loudly instead of silently serving corrupted item ids."""
+    if n_items >= 1 << 24:
+        raise ValueError(
+            f"catalog of {n_items} items exceeds the 2**24 exact-int range "
+            "of the f32-packed top-k serving path; shard the catalog across "
+            "devices or split the app")
+
+
 def _stack_topk(scores: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Pack (scores, idx) as one [2, k] f32 array so serving does ONE
     device→host readback per query.  Each sync is a full round trip on a
     tunneled accelerator (~70 ms measured on the axon relay), so k-sized
     result arrays must never be fetched separately.  Item indices are exact
-    in f32 up to 2^24 — far beyond any catalog this serves per device."""
+    in f32 up to 2^24 — enforced at trace time by check_f32_id_range."""
     return jnp.stack([scores, idx.astype(jnp.float32)])
 
 
@@ -446,6 +459,7 @@ def recommend_scores_excl(
     per query only the K-vector and a small padded id list transfer, so the
     full [n_items] mask (400 KB at 100k items) never crosses PCIe/tunnel.
     """
+    check_f32_id_range(item_factors.shape[0])
     scores = item_factors @ user_vec
     valid = excl_idx >= 0
     scores = scores.at[jnp.where(valid, excl_idx, 0)].min(
@@ -479,6 +493,7 @@ def _rules_topk(scores, cat_masks, cat_ids, white_idx, excl_idx, top_k: int):
     """Shared traced epilogue: category/whitelist allow-masks, exclusion
     list, and the stacked [2, top_k] result (see recommend_scores_rules)."""
     n_items = scores.shape[0]
+    check_f32_id_range(n_items)
     cat_valid = cat_ids >= 0
     sel = cat_masks[jnp.where(cat_valid, cat_ids, 0)] & cat_valid[:, None]
     allow_cat = jnp.where(cat_valid.any(), sel.any(axis=0), True)

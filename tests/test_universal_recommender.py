@@ -668,6 +668,39 @@ def test_ur_offline_eval_hit_rate(ur_app):
     assert engine.eval(ep0) == []
 
 
+def test_ur_eval_holdout_is_sampled_not_first_n(ur_app):
+    """When eval_users caps the fold, holdout users are a seeded random
+    sample over ALL qualifying users — not the first N in array order
+    (stores are commonly sorted by entity id, which would order-bias a
+    grid search)."""
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URDataSource,
+    )
+
+    def users(seed):
+        ds = URDataSource(URDataSourceParams(
+            app_name="urapp", event_names=["purchase", "view"],
+            eval_users=5, eval_num=4, eval_seed=seed))
+        folds = ds.read_eval()
+        assert len(folds) == 1
+        _, _, qa = folds[0]
+        assert len(qa) == 5
+        return [q.user for q, _ in qa]
+
+    all_ds = URDataSource(URDataSourceParams(
+        app_name="urapp", event_names=["purchase", "view"],
+        eval_users=10_000, eval_num=4))
+    qualifying = {q.user for q, _ in all_ds.read_eval()[0][2]}
+
+    s0a, s0b, s1 = users(0), users(0), users(1)
+    assert s0a == s0b                      # same seed -> deterministic
+    assert s0a != s1                       # different seed -> different sample
+    assert set(s0a) <= qualifying and set(s1) <= qualifying
+    # not simply the first five qualifying users in store order
+    first_n = sorted(qualifying, key=lambda u: int(u[1:]))[:5]
+    assert set(s0a) != set(first_n) or set(s1) != set(first_n)
+
+
 def test_rank_metrics_family():
     """NDCG / precision@k / MRR over the leave-one-out protocol."""
     import math
