@@ -530,6 +530,7 @@ def recommend_batch_excl(
     excl_idx: jnp.ndarray,        # [B, W] per-row exclusions, -1 padding
     top_k: int,
 ) -> jnp.ndarray:                 # [B, 2, top_k]: scores row, item-id row
+    check_f32_id_range(item_factors.shape[0])
     scores = user_vecs @ item_factors.T
     valid = excl_idx >= 0
     b = jnp.arange(scores.shape[0], dtype=jnp.int32)[:, None]

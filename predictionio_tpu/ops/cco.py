@@ -200,14 +200,57 @@ def _llr_mask_scores(c, row_counts, col_counts, n_total, llr_threshold,
     return jnp.where(scores >= llr_threshold, scores, -jnp.inf)
 
 
+def topk_impl() -> str:
+    """'lax' | 'pallas' for the tiled running top-k merge.
+
+    ``PIO_CCO_TOPK`` overrides; auto currently selects **lax** everywhere —
+    the Pallas bitonic kernel (pallas_kernels.tile_topk_desc) removes the
+    measured 78%-of-device-time lax.top_k merge, but its TPU compile+run
+    has not been hardware-verified yet (no tunnel this session), and an
+    unmeasured default is how round 3 lost its bench.  Flip auto to
+    'pallas'-on-TPU once profile_tpu.py's merge ablation confirms it."""
+    conf = _os.environ.get("PIO_CCO_TOPK", "auto").lower()
+    if conf in ("pallas", "bitonic"):
+        return "pallas"
+    if conf == "lax":
+        return "lax"
+    return "lax"
+
+
+def _carry_width(top_k: int, impl: str) -> int:
+    """Running-merge carry width: the Pallas network needs a pow2 block."""
+    if impl == "pallas":
+        from predictionio_tpu.ops.topk import block_width
+
+        return block_width(top_k)
+    return top_k
+
+
 def _merge_topk(best_scores, best_idx, scores, tile_start, tile: int,
-                top_k: int, n_items_p: int, exclude_self: bool):
+                top_k: int, n_items_p: int, exclude_self: bool,
+                impl: str = "lax"):
     """Shared running top-k merge for the tiled strategies; masks self-pairs
-    BEFORE the merge so every row still gets a full top_k correlators."""
+    BEFORE the merge so every row still gets a full top_k correlators.
+
+    impl='lax': top_k over concat(carry, tile) — XLA's full variadic row
+    sort, measured 78% of tiled steady-state device time (PERF.md r3).
+    impl='pallas': one in-VMEM bitonic pass selects the tile's top block
+    (pallas_kernels.tile_topk_desc), then a log2(b)-stage sorted merge
+    with the carry on [I, 2b] — the tile-wide sort never happens.  The
+    carry is then [I, block_width(top_k)], sorted desc; _finalize_topk
+    slices back to top_k.
+    """
     tile_idx = tile_start + jnp.arange(tile, dtype=jnp.int32)[None, :]
     if exclude_self:
         row_ids = jnp.arange(n_items_p, dtype=jnp.int32)[:, None]
         scores = jnp.where(tile_idx == row_ids, -jnp.inf, scores)
+    if impl == "pallas":
+        from predictionio_tpu.ops.pallas_kernels import tile_topk_desc
+        from predictionio_tpu.ops.topk import merge_desc
+
+        b = best_scores.shape[1]
+        ts, ti = tile_topk_desc(scores, b)
+        return merge_desc(best_scores, best_idx, ts, tile_start + ti)
     all_scores = jnp.concatenate([best_scores, scores], axis=1)
     all_idx = jnp.concatenate(
         [best_idx, jnp.broadcast_to(tile_idx, scores.shape)], axis=1)
@@ -215,10 +258,14 @@ def _merge_topk(best_scores, best_idx, scores, tile_start, tile: int,
     return new_scores, jnp.take_along_axis(all_idx, pos, axis=1)
 
 
-def _finalize_topk(best_scores, best_idx, n_items_t: int):
-    """Shared host epilogue: -1-pad entries that are -inf or tile padding."""
+def _finalize_topk(best_scores, best_idx, n_items_t: int,
+                   top_k: Optional[int] = None):
+    """Shared host epilogue: -1-pad entries that are -inf or tile padding;
+    slice a pow2-widened pallas-merge carry back to the requested top_k."""
     scores = np.asarray(best_scores)
     idx = np.asarray(best_idx)
+    if top_k is not None and scores.shape[1] > top_k:
+        scores, idx = scores[:, :top_k], idx[:, :top_k]
     idx = np.where((scores > -np.inf) & (idx < n_items_t), idx, -1)
     return np.where(idx >= 0, scores, -np.inf), idx
 
@@ -324,7 +371,7 @@ def _cco_tile_body_resident(
     P, rc, a_gu, a_gi, a_valid,
     n_total, best_scores, best_idx, tile_start,
     tile: int, top_k: int, llr_threshold,
-    exclude_self: bool, pallas: str, mm: str,
+    exclude_self: bool, pallas: str, mm: str, topk: str = "lax",
 ):
     """One item tile against the RESIDENT densified primary: densify only
     this tile's slice of A (one scatter), one matmul, LLR, top-k merge —
@@ -341,19 +388,21 @@ def _cco_tile_body_resident(
     scores = _llr_mask_scores(c, rc.astype(jnp.float32), cct, n_total,
                               llr_threshold, pallas)
     return _merge_topk(best_scores, best_idx, scores, tile_start, tile,
-                       top_k, n_items_p, exclude_self)
+                       top_k, n_items_p, exclude_self, impl=topk)
 
 
-def _scan_tiles(step, n_items_p: int, n_tiles: int, tile: int, top_k: int):
+def _scan_tiles(step, n_items_p: int, n_tiles: int, tile: int, top_k: int,
+                carry_k: Optional[int] = None):
     """Shared scan harness for the tiled strategies: run ``step(bs, bi,
     tile_start)`` over every tile start in ONE compiled program.
 
     A Python-level tile loop pays a tunnel/dispatch round trip per tile
     (~70 ms × n_tiles × event types measured on the axon relay) and blocks
     XLA from pipelining the scatter of tile t+1 under the matmul of tile t;
-    the scan removes both."""
-    init = (jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32),
-            jnp.zeros((n_items_p, top_k), jnp.int32))
+    the scan removes both.  ``carry_k`` widens the running-merge carry to
+    the pallas merge's pow2 block (see _carry_width)."""
+    init = (jnp.full((n_items_p, carry_k or top_k), -jnp.inf, jnp.float32),
+            jnp.zeros((n_items_p, carry_k or top_k), jnp.int32))
     starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
 
     def body(carry, tile_start):
@@ -364,11 +413,11 @@ def _scan_tiles(step, n_items_p: int, n_tiles: int, tile: int, top_k: int):
 
 
 @partial(jax.jit, static_argnames=(
-    "n_tiles", "tile", "top_k", "exclude_self", "pallas", "mm"))
+    "n_tiles", "tile", "top_k", "exclude_self", "pallas", "mm", "topk"))
 def _cco_resident_all_tiles(
     P, rc, a_gu, a_gi, a_valid, n_total,
     n_tiles: int, tile: int, top_k: int, llr_threshold,
-    exclude_self: bool, pallas: str, mm: str,
+    exclude_self: bool, pallas: str, mm: str, topk: str = "lax",
 ):
     """All RESIDENT-path item tiles in one compiled program (_scan_tiles)."""
 
@@ -376,9 +425,10 @@ def _cco_resident_all_tiles(
         return _cco_tile_body_resident(
             P, rc, a_gu, a_gi, a_valid, n_total, bs, bi, tile_start,
             tile=tile, top_k=top_k, llr_threshold=llr_threshold,
-            exclude_self=exclude_self, pallas=pallas, mm=mm)
+            exclude_self=exclude_self, pallas=pallas, mm=mm, topk=topk)
 
-    return _scan_tiles(step, P.shape[1], n_tiles, tile, top_k)
+    return _scan_tiles(step, P.shape[1], n_tiles, tile, top_k,
+                       carry_k=_carry_width(top_k, topk))
 
 
 def _resident_p_ok(n_users: int, n_items_p: int, item_tile: int = 4096) -> bool:
@@ -422,8 +472,9 @@ def _cco_indicators_resident(
         n_tiles=n_tiles, tile=tile, top_k=top_k,
         llr_threshold=float(llr_threshold),
         exclude_self=exclude_self, pallas=pallas_mode(), mm=mm,
+        topk=topk_impl(),
     )
-    return _finalize_topk(best_scores, best_idx, n_items_t)
+    return _finalize_topk(best_scores, best_idx, n_items_t, top_k)
 
 
 # ---------------------------------------------------------------------------
@@ -477,7 +528,7 @@ def _cooccurrence_tile(
     jax.jit,
     static_argnames=(
         "block", "n_items_p", "tile", "top_k", "axis_name", "pallas",
-        "exclude_self",
+        "exclude_self", "topk",
     ),
 )
 def _cco_tile_step(
@@ -490,6 +541,7 @@ def _cco_tile_step(
     axis_name: Optional[str] = None,
     pallas: str = "off",
     exclude_self: bool = False,
+    topk: str = "lax",
 ):
     """Process one item tile: cooccurrence counts → LLR → merge into top-k."""
     c, rc, cct = _cooccurrence_tile(
@@ -502,20 +554,20 @@ def _cco_tile_step(
         c.astype(jnp.float32), rc.astype(jnp.float32), cct.astype(jnp.float32),
         n_total, llr_threshold, pallas)
     return _merge_topk(best_scores, best_idx, scores, tile_start, tile,
-                       top_k, n_items_p, exclude_self)
+                       top_k, n_items_p, exclude_self, impl=topk)
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "n_tiles", "block", "n_items_p", "tile", "top_k", "pallas",
-        "exclude_self",
+        "exclude_self", "topk",
     ),
 )
 def _cco_chunked_all_tiles(
     p_lu, p_it, p_mk, a_lu, a_it, a_mk, n_total,
     n_tiles: int, block: int, n_items_p: int, tile: int, top_k: int,
-    llr_threshold, pallas: str, exclude_self: bool,
+    llr_threshold, pallas: str, exclude_self: bool, topk: str = "lax",
 ):
     """All chunked-path item tiles in one compiled program (_scan_tiles)."""
 
@@ -524,9 +576,10 @@ def _cco_chunked_all_tiles(
             p_lu, p_it, p_mk, a_lu, a_it, a_mk, n_total, bs, bi, tile_start,
             block=block, n_items_p=n_items_p, tile=tile, top_k=top_k,
             llr_threshold=llr_threshold, pallas=pallas,
-            exclude_self=exclude_self)
+            exclude_self=exclude_self, topk=topk)
 
-    return _scan_tiles(step, n_items_p, n_tiles, tile, top_k)
+    return _scan_tiles(step, n_items_p, n_tiles, tile, top_k,
+                       carry_k=_carry_width(top_k, topk))
 
 
 # ---------------------------------------------------------------------------
@@ -609,10 +662,10 @@ def _cco_counts_dense(
     return C, rc, cc
 
 
-@partial(jax.jit, static_argnames=("top_k", "exclude_self", "pallas"))
+@partial(jax.jit, static_argnames=("top_k", "exclude_self", "pallas", "topk"))
 def _llr_topk_dense(
     C, rc, cc, n_total, llr_threshold,
-    top_k: int, exclude_self: bool, pallas: str,
+    top_k: int, exclude_self: bool, pallas: str, topk: str = "lax",
 ):
     scores = _llr_mask_scores(
         C.astype(jnp.float32), rc.astype(jnp.float32), cc.astype(jnp.float32),
@@ -622,6 +675,12 @@ def _llr_topk_dense(
         eye = jnp.arange(n_p, dtype=jnp.int32)[:, None] == jnp.arange(
             n_t, dtype=jnp.int32)[None, :]
         scores = jnp.where(eye, -jnp.inf, scores)
+    if topk == "pallas":
+        from predictionio_tpu.ops.pallas_kernels import tile_topk_desc
+        from predictionio_tpu.ops.topk import block_width
+
+        bs, bi = tile_topk_desc(scores, block_width(top_k))
+        return bs[:, :top_k], bi[:, :top_k]
     best_scores, best_idx = jax.lax.top_k(scores, top_k)
     return best_scores, best_idx.astype(jnp.int32)
 
@@ -750,6 +809,7 @@ class _DenseRunner:
         s, i = _llr_topk_dense(
             C, rc, cc, float(self.n_total_users), float(llr_threshold),
             top_k=k, exclude_self=bool(exclude_self), pallas=pallas_mode(),
+            topk=topk_impl(),
         )
         return s, i, n_items_t, top_k
 
@@ -944,8 +1004,10 @@ def cco_indicators(
     tile = min(item_tile, max(n_items_t, 1))
     n_tiles = math.ceil(n_items_t / tile)
 
-    best_scores = jnp.full((n_items_p, top_k), -jnp.inf, jnp.float32)
-    best_idx = jnp.zeros((n_items_p, top_k), jnp.int32)
+    topk = topk_impl()
+    carry_k = _carry_width(top_k, topk)
+    best_scores = jnp.full((n_items_p, carry_k), -jnp.inf, jnp.float32)
+    best_idx = jnp.zeros((n_items_p, carry_k), jnp.int32)
 
     from predictionio_tpu.ops.pallas_kernels import pallas_mode
 
@@ -960,7 +1022,7 @@ def cco_indicators(
             *args, float(n_total_users),
             n_tiles=n_tiles, block=primary.user_block, n_items_p=n_items_p,
             tile=tile, top_k=top_k, llr_threshold=float(llr_threshold),
-            pallas=pallas, exclude_self=exclude_self,
+            pallas=pallas, exclude_self=exclude_self, topk=topk,
         )
     else:
         dp = mesh.shape["dp"]
@@ -997,6 +1059,7 @@ def cco_indicators(
                 block=primary.user_block, n_items_p=n_items_p,
                 tile=tile, top_k=top_k, llr_threshold=llr_threshold,
                 axis_name="dp", pallas=pallas, exclude_self=exclude_self,
+                topk=topk,
             )
 
         for t in range(n_tiles):
@@ -1004,7 +1067,7 @@ def cco_indicators(
                 *args, best_scores, best_idx, jnp.int32(t * tile),
             )
 
-    return _finalize_topk(best_scores, best_idx, n_items_t)
+    return _finalize_topk(best_scores, best_idx, n_items_t, top_k)
 
 
 # ---------------------------------------------------------------------------
