@@ -356,3 +356,64 @@ def test_resident_tiled_self_pair(monkeypatch):
     np.testing.assert_allclose(s1, s2, rtol=1e-4)
     for r in range(n_items):
         assert r not in set(i1[r][i1[r] >= 0])
+
+def test_sparse_host_matches_dense_and_tiled(monkeypatch):
+    """The host sparse-count strategy (CPU-backend cross-join + bincount)
+    is bit-identical to the device dense path — same integer counts, same
+    device LLR/top-k tail — and set-identical to tiled under ties."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_ip, n_it = 70, 13, 19
+    pu, pi = random_interactions(n_users, n_ip, 350, 51)
+    ou, oi = random_interactions(n_users, n_it, 600, 52)
+
+    def run():
+        return cco_ops.cco_indicators_coo(
+            pu, pi, ou, oi, n_users, n_ip, n_it,
+            top_k=6, llr_threshold=0.3, item_tile=8)
+
+    monkeypatch.setenv("PIO_CCO_SPARSE", "1")
+    ss, si = run()
+    monkeypatch.setenv("PIO_CCO_SPARSE", "0")
+    monkeypatch.setenv("PIO_CCO_DENSE", "1")
+    ds, di = run()
+    monkeypatch.setenv("PIO_CCO_DENSE", "0")
+    ts, ti = run()
+    np.testing.assert_array_equal(ss, ds)      # same counts, same tail: exact
+    np.testing.assert_array_equal(si, di)
+    np.testing.assert_allclose(ss, ts, rtol=1e-5)
+    for r in range(n_ip):
+        assert set(si[r][ss[r] > -np.inf]) == set(ti[r][ts[r] > -np.inf])
+
+    # over-budget expansion bails to the device path with identical output
+    monkeypatch.setenv("PIO_CCO_SPARSE", "1")
+    monkeypatch.delenv("PIO_CCO_DENSE", raising=False)
+    monkeypatch.setattr(cco_ops, "_SPARSE_PAIR_BUDGET", 0)
+    bs, bi_ = run()
+    np.testing.assert_array_equal(bs, ds)
+    np.testing.assert_array_equal(bi_, di)
+
+
+def test_sparse_host_self_pair_and_train_indicators(monkeypatch):
+    """cco_train_indicators on the sparse path: self-pair reuses the
+    primary CSR, exclude_self masks the diagonal, multi-type results match
+    the device dense runner exactly."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_items = 50, 11
+    pu, pi = random_interactions(n_users, n_items, 260, 61)
+    vu, vi = random_interactions(n_users, n_items, 500, 62)
+    others = [("buy", pu, pi, n_items), ("view", vu, vi, n_items)]
+
+    monkeypatch.setenv("PIO_CCO_SPARSE", "1")
+    r_sparse = cco_ops.cco_train_indicators(
+        pu, pi, others, n_users, n_items, top_k=4, exclude_self_for="buy")
+    monkeypatch.setenv("PIO_CCO_SPARSE", "0")
+    r_dense = cco_ops.cco_train_indicators(
+        pu, pi, others, n_users, n_items, top_k=4, exclude_self_for="buy")
+    for name in ("buy", "view"):
+        np.testing.assert_array_equal(r_sparse[name][0], r_dense[name][0])
+        np.testing.assert_array_equal(r_sparse[name][1], r_dense[name][1])
+    for r in range(n_items):
+        idx = r_sparse["buy"][1][r]
+        assert r not in set(idx[idx >= 0])
