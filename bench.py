@@ -235,8 +235,10 @@ def bench_http(smoke: bool) -> dict:
             body_fn = (lambda q: {"user": f"u{(q * 37) % n_users}", "num": 10}
                        if q % 5 else {"user": f"cold{q}", "num": 10})  # 20% cold
             ur_p50, ur_p95 = measure(httpd, body_fn, n_q)
-            ur_qps = measure_qps(httpd, body_fn,
-                                 seconds=1.0 if smoke else 5.0)
+            secs = 1.0 if smoke else 5.0
+            ur_qps_c = {w: measure_qps(httpd, body_fn, seconds=secs, workers=w)
+                        for w in (1, 8, 32)}
+            ur_qps = ur_qps_c[8]
         finally:
             httpd.shutdown()
             httpd.server_close()
@@ -283,6 +285,8 @@ def bench_http(smoke: bool) -> dict:
         return {
             "ur_http_p50_ms": ur_p50, "ur_http_p95_ms": ur_p95,
             "ur_http_qps": ur_qps,
+            "ur_http_qps_c1": ur_qps_c[1], "ur_http_qps_c8": ur_qps_c[8],
+            "ur_http_qps_c32": ur_qps_c[32],
             "als_http_p50_ms": als_p50, "als_http_p95_ms": als_p95,
             "ur_catalog_items": n_items, "ur_train_e2e_s": ur_train_s,
             "ur_train_e2e_events_per_sec": (n_buy + n_view) / ur_train_s,
@@ -414,17 +418,60 @@ def bench_ingest(smoke: bool) -> dict:
                     [ev(k) for k in range(s, min(s + 50, n_batch_events))])
                 assert status == 200, body
             batch_rate = n_batch_events / (time.perf_counter() - t0)
+
+            # single events over ONE keep-alive connection, minimal client
+            # (server-throughput measurement: the lean framing isolates the
+            # server's per-request cost from http.client's own ~0.2 ms)
+            import socket
+
+            port = httpd.server_address[1]
+            sock = socket.create_connection(("127.0.0.1", port))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            f = sock.makefile("rwb")
+
+            def raw_post(k):
+                b = json.dumps(ev(k)).encode()
+                f.write(b"POST /events.json?accessKey=%s HTTP/1.1\r\n"
+                        b"Host: bench\r\nContent-Type: application/json\r\n"
+                        b"Content-Length: %d\r\n\r\n"
+                        % (key.encode(), len(b)) + b)
+                f.flush()
+                line = f.readline()
+                clen = 0
+                while True:
+                    h = f.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    if h.lower().startswith(b"content-length:"):
+                        clen = int(h.split(b":")[1])
+                f.read(clen)
+                return line
+
+            raw_post(0)
             t0 = time.perf_counter()
             for k in range(n_single):
-                status, body = _http_post(f"{base}/events.json?accessKey={key}", ev(k))
-                assert status == 201, body
+                assert b"201" in raw_post(k)
             single_rate = n_single / (time.perf_counter() - t0)
+            sock.close()
+
+            # the same loop through the Python SDK's persistent client —
+            # what real SDK traffic achieves per connection
+            from predictionio_tpu.sdk.client import EventClient
+
+            client = EventClient(key, base)
+            client.create_event("buy", "user", "u0", "item", "i0")
+            t0 = time.perf_counter()
+            for k in range(n_single):
+                client.record_user_action_on_item(
+                    "buy", f"u{k % 1000}", f"i{k % 5000}")
+            sdk_rate = n_single / (time.perf_counter() - t0)
         finally:
             httpd.shutdown()
             httpd.server_close()
         return {
             "ingest_batch_events_per_sec": batch_rate,
             "ingest_single_events_per_sec": single_rate,
+            "ingest_single_sdk_events_per_sec": sdk_rate,
             "fsync_policy": "rotate",
         }
     finally:
@@ -629,6 +676,9 @@ def main() -> int:
             "predict_p50_vs_10ms_target": round(10.0 / max(p50, 1e-9), 2),
             "predict_p95_ms": round(http["ur_http_p95_ms"], 3),
             "ur_http_qps": round(http["ur_http_qps"], 1),
+            "ur_http_qps_c1": round(http["ur_http_qps_c1"], 1),
+            "ur_http_qps_c8": round(http["ur_http_qps_c8"], 1),
+            "ur_http_qps_c32": round(http["ur_http_qps_c32"], 1),
             "als_http_p50_ms": round(http["als_http_p50_ms"], 3),
             "predict_kernel_p50_ms": round(kernel_p50, 3),
             "ur_train_e2e_events_per_sec": round(http["ur_train_e2e_events_per_sec"], 1),
@@ -646,6 +696,8 @@ def main() -> int:
             "scale_parity": scale["parity"],
             "ingest_batch_events_per_sec": round(ingest["ingest_batch_events_per_sec"], 1),
             "ingest_single_events_per_sec": round(ingest["ingest_single_events_per_sec"], 1),
+            "ingest_single_sdk_events_per_sec": round(
+                ingest["ingest_single_sdk_events_per_sec"], 1),
             "ingest_fsync_policy": ingest["fsync_policy"],
         },
     }

@@ -21,6 +21,8 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
+import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from predictionio_tpu.api.http_util import JsonHandler, start_server
@@ -38,6 +40,13 @@ class EventServerState:
         self.storage = storage or get_storage()
         self.stats_enabled = stats
         self.counts: Dict[int, Dict[str, int]] = {}
+        # (accessKey, channel) → (result, stamp): the metadata store read
+        # behind auth costs ~0.08 ms/request on localfs, which dominates a
+        # hot ingest loop.  TTL-bounded so key revocation/channel changes
+        # take effect within PIO_AUTH_CACHE_S seconds (default 2; 0 turns
+        # the cache off).
+        self._auth_cache: Dict[Tuple[str, str], Tuple[tuple, float]] = {}
+        self._auth_ttl = float(os.environ.get("PIO_AUTH_CACHE_S", "2"))
 
     def record(self, app_id: int, event_name: str) -> None:
         if self.stats_enabled:
@@ -49,11 +58,23 @@ class EventServerState:
         key = query.get("accessKey")
         if not key:
             return None, None, "missing accessKey parameter"
+        chan_name = query.get("channel") or ""
+        if self._auth_ttl > 0:
+            hit = self._auth_cache.get((key, chan_name))
+            if hit is not None and time.monotonic() - hit[1] < self._auth_ttl:
+                return hit[0]
+        result = self._auth_uncached(key, chan_name)
+        if self._auth_ttl > 0:
+            if len(self._auth_cache) > 4096:   # bound invalid-key churn
+                self._auth_cache.clear()
+            self._auth_cache[(key, chan_name)] = (result, time.monotonic())
+        return result
+
+    def _auth_uncached(self, key: str, chan_name: str):
         ak = self.storage.access_keys.get(key)
         if ak is None:
             return None, None, "invalid accessKey"
         channel_id: Optional[int] = None
-        chan_name = query.get("channel")
         if chan_name:
             chan = next(
                 (c for c in self.storage.channels.get_by_app_id(ak.app_id) if c.name == chan_name),

@@ -232,3 +232,46 @@ def test_cli_undeploy_stops_server(deployed_engine):
         raise AssertionError("server still reachable after undeploy")
     assert pio_main(["undeploy", "--ip", "127.0.0.1",
                      "--port", str(port), "--timeout", "2"]) == 1
+
+
+def test_keepalive_unread_body_drained(event_server):
+    """An early-error response (401 auth) must not leave the POST body in
+    the stream — the next request on the same keep-alive connection is
+    parsed from the request line, not body bytes."""
+    import http.client
+    import json as _json
+    from urllib.parse import urlsplit
+
+    base, key = event_server["base"], event_server["key"]
+    u = urlsplit(base)
+    conn = http.client.HTTPConnection(u.hostname, u.port)
+    body = _json.dumps({"event": "buy", "entityType": "user",
+                        "entityId": "u1"})
+    conn.request("POST", "/events.json?accessKey=WRONG", body,
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 401
+    r.read()
+    # same connection, now a valid request: must succeed, not 400
+    conn.request("POST", f"/events.json?accessKey={key}", body,
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 201, r.read()
+    r.read()
+    conn.close()
+
+
+def test_header_count_cap(event_server):
+    """More than 100 headers on one request is rejected, not accumulated."""
+    import socket
+    from urllib.parse import urlsplit
+
+    u = urlsplit(event_server["base"])
+    s = socket.create_connection((u.hostname, u.port))
+    req = b"GET / HTTP/1.1\r\nHost: x\r\n"
+    req += b"".join(b"X-Flood-%d: y\r\n" % i for i in range(150))
+    req += b"\r\n"
+    s.sendall(req)
+    data = s.recv(65536)
+    assert b"400" in data.split(b"\r\n", 1)[0], data[:100]
+    s.close()
