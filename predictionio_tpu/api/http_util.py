@@ -105,7 +105,16 @@ class JsonHandler(socketserver.StreamRequestHandler):
             or (version == "HTTP/1.0" and conn_tok != "keep-alive"))
         if (headers.get("expect") or "").lower() == "100-continue":
             self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
-        self._body_unread = int(headers.get("content-length") or 0)
+        try:
+            self._body_unread = int(headers.get("content-length") or 0)
+        except ValueError:
+            self._body_unread = -1
+        if self._body_unread < 0:   # non-numeric or negative: reject, and
+            # never rfile.read(-1) (reads to EOF, pinning the thread)
+            self.close_connection = True
+            self._body_unread = 0
+            self._send_raw(400, b'{"message": "bad Content-Length"}')
+            return False
         method = getattr(self, "do_" + self.command, None)
         try:
             if method is None:
@@ -117,7 +126,8 @@ class JsonHandler(socketserver.StreamRequestHandler):
             return False
         # a handler that errored before read_json (auth failure, 404 route)
         # leaves the request body in the stream; drain it or the next
-        # keep-alive request would be parsed out of body bytes
+        # keep-alive request would be parsed out of body bytes (>1 MB:
+        # close instead — _send_raw already advertised Connection: close)
         if self._body_unread:
             if self._body_unread > (1 << 20):
                 self.close_connection = True
@@ -156,6 +166,12 @@ class JsonHandler(socketserver.StreamRequestHandler):
 
     def _send_raw(self, status: int, body: bytes,
                   ctype: str = "application/json; charset=utf-8") -> None:
+        # if the request body is too large to drain after this response,
+        # the connection will close — say so in the header we send NOW
+        # (advertising keep-alive and then closing makes well-behaved
+        # clients see spurious mid-pipeline disconnects)
+        if getattr(self, "_body_unread", 0) > (1 << 20):
+            self.close_connection = True
         head = (
             f"HTTP/1.1 {status} {_REASON.get(status, '')}\r\n"
             f"Server: {self.server_version}\r\n"

@@ -468,6 +468,10 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--port", type=int, default=8000)
     dp.add_argument("--engine-instance-id", default=None)
     dp.add_argument("--feedback", action="store_true")
+    dp.add_argument("--auto-reload", type=float, default=0.0, metavar="SECS",
+                    help="poll EngineInstances every SECS seconds and "
+                         "hot-swap when a retrain completes (reference "
+                         "MasterActor behavior); 0 disables")
     dp.set_defaults(func=_cmd_deploy)
 
     ud = sub.add_parser("undeploy")

@@ -1221,23 +1221,44 @@ def cco_indicators(
 # ---------------------------------------------------------------------------
 
 
-# the rule matrix is dense [I, I]: complement catalogs are modest by
-# domain (the reference's FP-Growth also materializes frequent pairs);
-# past this, the count matrix alone exceeds a v5e chip's HBM budget
-_BASKET_RULES_MAX_ITEMS = 40_000
-_BASKET_CHUNK = 8192   # basket rows densified per scan step
+# Dense [I, I] rule matrix up to here (int32 counts + fused f32 score
+# pass ≈ 2 GB at the cap); past it the item-tiled variant runs — no
+# catalog-size cliff (the reference's FP-Growth scales by distributing
+# frequent-pair mining; here the tile loop plays that role)
+_BASKET_RULES_DENSE_MAX_ITEMS = 16_384
+_BASKET_CHUNK = 8192          # basket rows densified per scan step
+_BASKET_CHUNK_BYTES = 512 << 20   # per-chunk densified-B budget (tiled)
+_BASKET_TILE_BYTES = 2 << 30      # per-tile [I, tile] working-set budget
+
+# Exactness: pair counts accumulate as int32 — exact to 2³¹, and
+# c_ij ≤ n_baskets so overflow is impossible below the guard in
+# basket_rules.  Ratio math (support/confidence/lift) runs in f32, so
+# counts above 2²⁴ lose ULP-level precision there: rule RANKING can
+# perturb only among near-ties; the counts themselves stay exact.
+
+
+def _basket_scores(c, ci_row, ci_col, n, min_support, min_confidence):
+    """Fused per-cell rule scoring: lift where support/confidence cuts
+    pass, else -inf.  All intermediates are elementwise expressions XLA
+    fuses into one pass — nothing beyond the scores is materialized (the
+    old path take_along_axis'd a full confidence matrix)."""
+    support = c / n
+    confidence = c / jnp.maximum(ci_row, 1.0)
+    lift = confidence / jnp.maximum(ci_col / n, 1e-9)
+    ok = (support >= min_support) & (confidence >= min_confidence) & (c > 0)
+    return jnp.where(ok, lift, -jnp.inf)
 
 
 @partial(jax.jit, static_argnames=("n_chunks", "n_items", "top_k"))
 def _basket_rules(gb, gi, valid, n_baskets, n_chunks: int, n_items: int,
                   top_k: int, min_support, min_confidence):
-    """Pairwise association rules from basket×item co-occurrence.
+    """Pairwise association rules from basket×item co-occurrence (dense).
 
     Baskets are densified in fixed chunks (lax.scan) and pair counts
     accumulate as exact int32 — ``C += int32(Bcᵀ Bc)`` with each chunk's
     f32 product < 2²⁴ by construction, the same exactness recipe as
-    ``_count_matmul``'s chunked callers — so billions of baskets stay
-    exact and HBM holds one chunk + the [I, I] counts.  Then per (i, j):
+    ``_count_matmul``'s chunked callers — and HBM holds one chunk + the
+    [I, I] counts.  Then per (i, j):
 
       support_ij    = c_ij / N            confidence_i→j = c_ij / c_i
       lift_i→j      = confidence / (c_j / N)
@@ -1246,7 +1267,8 @@ def _basket_rules(gb, gi, valid, n_baskets, n_chunks: int, n_items: int,
     LIFT (the reference Complementary Purchase template also ranks rules
     by lift after support/confidence cuts — its FP-Growth mines item-SET
     antecedents, which serving approximates by aggregating single-item
-    rules over the cart).  Self-pairs are excluded.
+    rules over the cart).  Self-pairs are excluded.  See the exactness
+    note above _basket_scores.
     """
     mm = _matmul_dtype()
 
@@ -1262,15 +1284,60 @@ def _basket_rules(gb, gi, valid, n_baskets, n_chunks: int, n_items: int,
     c = c.astype(jnp.float32)
     ci = jnp.diagonal(c)                             # per-item basket counts
     n = jnp.maximum(n_baskets.astype(jnp.float32), 1.0)
-    support = c / n
-    confidence = c / jnp.maximum(ci[:, None], 1.0)
-    lift = confidence / jnp.maximum(ci[None, :] / n, 1e-9)
-    ok = (support >= min_support) & (confidence >= min_confidence) & (c > 0)
+    scores = _basket_scores(c, ci[:, None], ci[None, :], n,
+                            min_support, min_confidence)
     eye = jnp.eye(n_items, dtype=bool)
-    scores = jnp.where(ok & ~eye, lift, -jnp.inf)
+    scores = jnp.where(eye, -jnp.inf, scores)
     st, si = jax.lax.top_k(scores, top_k)
-    conf_at = jnp.take_along_axis(confidence, si, axis=1)
-    return st, si, conf_at
+    return st, si.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=(
+    "n_chunks", "chunk", "n_items", "n_tiles", "tile", "top_k", "topk"))
+def _basket_rules_tiled(
+    gb, gi, valid, n_baskets, ci,
+    n_chunks: int, chunk: int, n_items: int, n_tiles: int, tile: int,
+    top_k: int, min_support, min_confidence, topk: str,
+):
+    """Item-tiled basket rules: the [I, I] matrix never materializes —
+    per tile, C_tile [I, tile] accumulates over basket chunks on the MXU
+    and merges into a running top-k (_merge_topk, same lax/pallas switch
+    as the UR tiled path).  ``ci`` is the exact per-item basket count
+    computed on host from deduped pairs (== the dense path's diagonal)."""
+    mm = _matmul_dtype()
+    n = jnp.maximum(n_baskets.astype(jnp.float32), 1.0)
+    ci_f = ci.astype(jnp.float32)
+
+    def tile_step(bs, bi_, tile_start):
+        def body(c_acc, chunk_start):
+            in_chunk = valid & (gb >= chunk_start) & (gb < chunk_start + chunk)
+            B = _densify(jnp.where(in_chunk, gb - chunk_start, 0), gi,
+                         in_chunk.astype(jnp.float32), chunk, n_items,
+                         _mm_in_dtype())
+            a_local = gi - tile_start
+            in_tile = in_chunk & (a_local >= 0) & (a_local < tile)
+            Bt = _densify(jnp.where(in_tile, gb - chunk_start, 0),
+                          jnp.where(in_tile, a_local, 0),
+                          in_tile.astype(jnp.float32), chunk, tile,
+                          _mm_in_dtype())
+            return c_acc + _count_matmul(B, Bt, mm), None
+
+        starts = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+        c, _ = jax.lax.scan(
+            body, jnp.zeros((n_items, tile), jnp.int32), starts)
+        tile_ids = tile_start + jnp.arange(tile, dtype=jnp.int32)
+        in_range = tile_ids < n_items
+        ci_col = ci_f[jnp.where(in_range, tile_ids, 0)]
+        scores = _basket_scores(
+            c.astype(jnp.float32), ci_f[:, None], ci_col[None, :], n,
+            min_support, min_confidence)
+        scores = jnp.where(in_range[None, :], scores, -jnp.inf)
+        # exclude_self masks the diagonal inside the merge
+        return _merge_topk(bs, bi_, scores, tile_start, tile, top_k,
+                           n_items, exclude_self=True, impl=topk)
+
+    return _scan_tiles(tile_step, n_items, n_tiles, tile, top_k,
+                       carry_k=_carry_width(top_k, topk))
 
 
 def basket_rules(
@@ -1279,22 +1346,58 @@ def basket_rules(
     top_k: int = 20,
     min_support: float = 0.0,
     min_confidence: float = 0.0,
+    item_tile: int = 4096,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host wrapper: (lift [I, K], complement ids [I, K], confidence
-    [I, K]) with -1 ids where no rule passed the cuts."""
-    if n_items > _BASKET_RULES_MAX_ITEMS:
+    [I, K]) with -1 ids where no rule passed the cuts.
+
+    Dense [I, I] strategy below _BASKET_RULES_DENSE_MAX_ITEMS, item-tiled
+    beyond — any catalog size works.  Confidence is derived from the
+    top-k lift (conf = lift·c_j/N), so no full confidence matrix is ever
+    materialized on either strategy.
+    """
+    if n_baskets >= (1 << 31):
         raise ValueError(
-            f"basket_rules materializes a dense [{n_items}, {n_items}] rule "
-            f"matrix; catalogs past {_BASKET_RULES_MAX_ITEMS} items need a "
-            "tiled variant (see the UR tiled CCO path)")
+            f"{n_baskets} baskets would overflow the int32 pair-count "
+            "accumulator (exact to 2^31); shard the basket log first")
     k = min(max(top_k, 1), max(n_items, 1))
-    n_chunks = max(math.ceil(n_baskets / _BASKET_CHUNK), 1)
-    st, si, conf = _basket_rules(
-        jnp.asarray(basket_idx, jnp.int32), jnp.asarray(item_idx, jnp.int32),
-        jnp.ones(len(basket_idx), bool), jnp.int32(n_baskets), n_chunks,
-        n_items, k, jnp.float32(min_support), jnp.float32(min_confidence))
-    st, si, conf = np.asarray(st), np.asarray(si), np.asarray(conf)
-    dead = ~np.isfinite(st)
-    return (np.where(dead, -np.inf, st),
-            np.where(dead, -1, si).astype(np.int32),
-            np.where(dead, 0.0, conf))
+    gb = jnp.asarray(basket_idx, jnp.int32)
+    gi = jnp.asarray(item_idx, jnp.int32)
+    valid = jnp.ones(len(basket_idx), bool)
+    # exact per-item basket counts from deduped pairs (== dense diagonal)
+    _, di = dedup_pairs(basket_idx, item_idx, n_items)
+    ci = np.bincount(di, minlength=n_items).astype(np.int64)
+    if n_items <= _BASKET_RULES_DENSE_MAX_ITEMS:
+        n_chunks = max(math.ceil(n_baskets / _BASKET_CHUNK), 1)
+        st, si = _basket_rules(
+            gb, gi, valid, jnp.int32(n_baskets), n_chunks, n_items, k,
+            jnp.float32(min_support), jnp.float32(min_confidence))
+    else:
+        bytes_per = 2 if _matmul_dtype() == "bf16" else 1
+        chunk = max(256, min(
+            _BASKET_CHUNK,
+            (_BASKET_CHUNK_BYTES // max(n_items * bytes_per, 1)) // 256 * 256,
+            math.ceil(max(n_baskets, 1) / 256) * 256))  # few baskets: no pad waste
+        n_chunks = max(math.ceil(n_baskets / chunk), 1)
+        # the per-tile working set ([I, tile] int32 counts + f32 scores +
+        # the top-k merge buffer ≈ 12 bytes/cell) scales with the CATALOG,
+        # so the tile auto-shrinks to the budget — no size cliff, just
+        # more tiles for very large catalogs
+        tile_cap = max((_BASKET_TILE_BYTES // max(n_items * 12, 1))
+                       // 128 * 128, 128)
+        tile = min(item_tile, tile_cap, max(n_items, 1))
+        n_tiles = math.ceil(n_items / tile)
+        st, si = _basket_rules_tiled(
+            gb, gi, valid, jnp.int32(n_baskets), jnp.asarray(ci, jnp.float32),
+            n_chunks, chunk, n_items, n_tiles, tile, k,
+            jnp.float32(min_support), jnp.float32(min_confidence),
+            topk_impl())
+    st, si = np.asarray(st)[:, :k], np.asarray(si)[:, :k]
+    dead = ~np.isfinite(st) | (si < 0) | (si >= n_items)
+    si = np.where(dead, -1, si).astype(np.int32)
+    st = np.where(dead, -np.inf, st)
+    # conf = lift·c_j/N, from the exact int64 host counts (-inf lifts are
+    # zeroed before the multiply so no NaN transient appears)
+    n = max(float(n_baskets), 1.0)
+    conf = np.where(dead, 0.0, st) * ci[np.maximum(si, 0)] / n
+    return st, si, conf

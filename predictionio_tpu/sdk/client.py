@@ -28,9 +28,11 @@ class _Conn:
 
     Event traffic is many small requests; a fresh TCP connect per event
     (the old urllib path) caps a client at ~1.2k events/s against a local
-    server, while connection reuse measures ~4-10k/s.  Reconnects
-    transparently once per request if the server closed the idle socket;
-    a lock serializes requests so a client is thread-safe."""
+    server, while connection reuse measures ~4-10k/s.  Connections are
+    PER-THREAD (threading.local), so a client shared across N worker
+    threads issues N parallel keep-alive connections instead of
+    serializing on one socket.  Reconnects transparently once per request
+    only when the request provably never reached the server."""
 
     def __init__(self, base_url: str, timeout: float):
         u = urllib.parse.urlsplit(base_url)
@@ -41,53 +43,51 @@ class _Conn:
             self._make = lambda: http.client.HTTPConnection(
                 u.hostname, u.port or 80, timeout=timeout)
         self.prefix = u.path.rstrip("/")
-        self._conn: Optional[http.client.HTTPConnection] = None
-        self._lock = threading.Lock()
-        self._last_use = 0.0
+        self._tl = threading.local()
 
     def request(self, method: str, path_qs: str, body: Any = None) -> Any:
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
-        with self._lock:
-            # a long-idle keep-alive socket may have been reaped by the
-            # server; reconnecting up front keeps the no-retry-after-send
-            # rule below from surfacing errors for that routine case
-            if (self._conn is not None
-                    and time.monotonic() - self._last_use > 30.0):
-                self._conn.close()
-                self._conn = None
-            self._last_use = time.monotonic()
-            for attempt in (0, 1):
-                if self._conn is None:
-                    self._conn = self._make()
-                sent = False
-                try:
-                    self._conn.request(
-                        method, self.prefix + path_qs, data, headers)
-                    sent = True
-                    resp = self._conn.getresponse()
-                    payload = resp.read()
-                    break
-                except Exception as e:
-                    # any failure leaves http.client's state machine
-                    # unusable — always drop the socket so the NEXT call
-                    # starts clean (a kept-but-wedged connection raises
-                    # CannotSendRequest forever)
-                    self._conn.close()
-                    self._conn = None
-                    # retry once, but only when the request provably did
-                    # not reach the server: connection refused, or the
-                    # send itself failed (Content-Length framing means a
-                    # partially-received request is never processed).
-                    # A failure AFTER the send may mean the server already
-                    # processed a non-idempotent POST — re-sending would
-                    # silently duplicate the event, so surface it instead.
-                    retriable = isinstance(e, (
-                        ConnectionRefusedError, ConnectionResetError,
-                        BrokenPipeError, http.client.RemoteDisconnected,
-                    )) and (not sent or method in ("GET", "DELETE"))
-                    if attempt or not retriable:
-                        raise
+        tl = self._tl
+        # a long-idle keep-alive socket may have been reaped by the
+        # server; reconnecting up front keeps the no-retry-after-send
+        # rule below from surfacing errors for that routine case
+        if (getattr(tl, "conn", None) is not None
+                and time.monotonic() - tl.last_use > 30.0):
+            tl.conn.close()
+            tl.conn = None
+        tl.last_use = time.monotonic()
+        for attempt in (0, 1):
+            if getattr(tl, "conn", None) is None:
+                tl.conn = self._make()
+            sent = False
+            try:
+                tl.conn.request(
+                    method, self.prefix + path_qs, data, headers)
+                sent = True
+                resp = tl.conn.getresponse()
+                payload = resp.read()
+                break
+            except Exception as e:
+                # any failure leaves http.client's state machine
+                # unusable — always drop the socket so the NEXT call
+                # starts clean (a kept-but-wedged connection raises
+                # CannotSendRequest forever)
+                tl.conn.close()
+                tl.conn = None
+                # retry once, but only when the request provably did
+                # not reach the server: connection refused, or the
+                # send itself failed (Content-Length framing means a
+                # partially-received request is never processed).
+                # A failure AFTER the send may mean the server already
+                # processed a non-idempotent POST — re-sending would
+                # silently duplicate the event, so surface it instead.
+                retriable = isinstance(e, (
+                    ConnectionRefusedError, ConnectionResetError,
+                    BrokenPipeError, http.client.RemoteDisconnected,
+                )) and (not sent or method in ("GET", "DELETE"))
+                if attempt or not retriable:
+                    raise
         if resp.status >= 400:
             try:
                 message = json.loads(payload).get("message", "")

@@ -62,6 +62,7 @@ class QueryServerState:
         feedback: bool = False,
         feedback_app_name: str = "",
         plugins=None,
+        auto_reload: float = 0.0,
     ):
         from predictionio_tpu.api.plugins import PluginRegistry
 
@@ -86,6 +87,40 @@ class QueryServerState:
         for p in plugins or []:
             self.plugins.register(p)
             p.start(self)
+        # auto hot-swap (reference: MasterActor watching for retrained
+        # instances): poll EngineInstances; when a newer COMPLETED
+        # instance appears, reload without dropping the port.  Opt-in via
+        # `pio deploy --auto-reload SECS`.
+        self._auto_stop = threading.Event()
+        if auto_reload > 0:
+            t = threading.Thread(
+                target=self._auto_reload_loop, args=(float(auto_reload),),
+                daemon=True, name="pio-auto-reload")
+            t.start()
+
+    def _auto_reload_loop(self, interval: float) -> None:
+        while not self._auto_stop.wait(interval):
+            try:
+                latest = self.storage.engine_instances.get_latest_completed(
+                    self.engine_id, self.engine_version, self.engine_variant)
+            except Exception:
+                log.exception("auto-reload: instance lookup failed")
+                continue
+            current = self.instance
+            if latest is not None and (
+                    current is None or latest.id != current.id):
+                try:
+                    self.reload()
+                    log.info("auto-reload: hot-swapped to instance %s",
+                             latest.id)
+                except Exception:
+                    # the newer instance's models may still be mid-write;
+                    # keep serving the current model and retry next tick
+                    log.exception("auto-reload: reload failed; keeping "
+                                  "current instance")
+
+    def stop_auto_reload(self) -> None:
+        self._auto_stop.set()
 
     def reload(self) -> str:
         with self._lock:
@@ -187,6 +222,7 @@ def make_handler(state: QueryServerState):
                 self.send_json({"stopping": True})
 
                 def _stop(server):
+                    state.stop_auto_reload()
                     server.shutdown()
                     # close the listening socket too: shutdown() alone
                     # keeps accepting connections that nothing serves
@@ -236,6 +272,7 @@ def deploy(
     storage: Optional[Storage] = None,
     background: bool = False,
     plugins=None,
+    auto_reload: float = 0.0,
 ):
     """Programmatic deploy; returns the HTTPServer (background=True) or blocks."""
     doc = load_engine_variant(engine_json, variant)
@@ -249,11 +286,21 @@ def deploy(
     state = QueryServerState(
         engine, engine_params, query_class, eid, engine_version, variant,
         storage=storage, feedback=feedback, feedback_app_name=feedback_app,
-        plugins=plugins,
+        plugins=plugins, auto_reload=auto_reload,
     )
     httpd = start_server(make_handler(state), host, port, background=background)
     log.info("Query server for %s listening on %s:%d", eid, host, httpd.server_address[1])
     httpd.pio_state = state  # handle for tests/tools
+    # the auto-reload poller must die with the server, however it is shut
+    # down (shutdown()/server_close(), /stop, or pio undeploy) — a leaked
+    # poller would keep loading models into a dead state forever
+    _orig_close = httpd.server_close
+
+    def _close_and_stop_poller():
+        state.stop_auto_reload()
+        _orig_close()
+
+    httpd.server_close = _close_and_stop_poller
     if background:
         return httpd
     try:
@@ -277,6 +324,7 @@ def run_server_from_args(args) -> int:
             host=args.ip,
             port=args.port,
             feedback=args.feedback,
+            auto_reload=getattr(args, "auto_reload", 0.0) or 0.0,
         )
     except Exception as e:
         print(f"Error: {e}", file=sys.stderr)

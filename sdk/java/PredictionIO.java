@@ -1,0 +1,475 @@
+/**
+ * Java SDK — clients for the Event Server and Query Server REST APIs.
+ *
+ * Reference: the PredictionIO-Java-SDK repo (EventClient / EngineClient;
+ * SURVEY.md §2 "SDKs" — separate repos speaking the same REST wire format).
+ * Dependency-free: JDK 11+ {@code java.net.http.HttpClient} (persistent
+ * keep-alive connections built in) plus a self-contained minimal JSON
+ * encoder/parser.  Mirrors {@code predictionio_tpu/sdk/client.py} and
+ * {@code sdk/js/predictionio.js}; the wire format is documented in
+ * {@code sdk/js/README.md}.
+ *
+ * Compile: {@code javac PredictionIO.java} (no classpath entries needed).
+ *
+ * Usage:
+ * <pre>
+ *   var events = new PredictionIO.EventClient("ACCESS_KEY",
+ *                                             "http://localhost:7070");
+ *   String id = events.createEvent(Map.of(
+ *       "event", "buy", "entityType", "user", "entityId", "u1",
+ *       "targetEntityType", "item", "targetEntityId", "i3"));
+ *   var engine = new PredictionIO.EngineClient("http://localhost:8000");
+ *   Map&lt;String, Object&gt; res = engine.sendQuery(
+ *       Map.of("user", "u1", "num", 10));
+ * </pre>
+ */
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.URLEncoder;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public final class PredictionIO {
+
+    private PredictionIO() {}
+
+    /** Error response from a server ({@code {"message": ...}} body). */
+    public static final class PIOException extends IOException {
+        public final int status;
+        public final String pioMessage;
+
+        PIOException(int status, String message) {
+            super("HTTP " + status + ": " + message);
+            this.status = status;
+            this.pioMessage = message;
+        }
+    }
+
+    // -- shared HTTP -------------------------------------------------------
+
+    private static Object request(HttpClient http, String method, String url,
+                                  Object body, Duration timeout)
+            throws IOException, InterruptedException {
+        HttpRequest.BodyPublisher pub = body == null
+                ? HttpRequest.BodyPublishers.noBody()
+                : HttpRequest.BodyPublishers.ofString(Json.encode(body));
+        HttpRequest req = HttpRequest.newBuilder(URI.create(url))
+                .method(method, pub)
+                .header("Content-Type", "application/json")
+                .timeout(timeout)
+                .build();
+        HttpResponse<String> resp =
+                http.send(req, HttpResponse.BodyHandlers.ofString());
+        String text = resp.body();
+        if (resp.statusCode() >= 400) {
+            String message = text;
+            try {
+                Object parsed = Json.parse(text);
+                if (parsed instanceof Map) {
+                    Object m = ((Map<?, ?>) parsed).get("message");
+                    if (m != null) message = m.toString();
+                }
+            } catch (RuntimeException ignored) { /* non-JSON error body */ }
+            throw new PIOException(resp.statusCode(), message);
+        }
+        return (text == null || text.isEmpty()) ? null : Json.parse(text);
+    }
+
+    private static String enc(String v) {
+        return URLEncoder.encode(v, StandardCharsets.UTF_8);
+    }
+
+    // -- Event Server client ----------------------------------------------
+
+    /** Client for the Event Server (reference: EventClient in the SDKs). */
+    public static final class EventClient {
+        private final String base;
+        private final String accessKey;
+        private final String channel;
+        private final Duration timeout;
+        private final HttpClient http;
+
+        public EventClient(String accessKey, String url) {
+            this(accessKey, url, null, Duration.ofSeconds(10));
+        }
+
+        public EventClient(String accessKey, String url, String channel,
+                           Duration timeout) {
+            this.accessKey = accessKey;
+            this.base = url.endsWith("/")
+                    ? url.substring(0, url.length() - 1) : url;
+            this.channel = channel;
+            this.timeout = timeout;
+            this.http = HttpClient.newBuilder()
+                    .connectTimeout(timeout).build();
+        }
+
+        private String qs() {
+            String q = "accessKey=" + enc(accessKey);
+            if (channel != null) q += "&channel=" + enc(channel);
+            return q;
+        }
+
+        /**
+         * POST /events.json — one event; returns the created eventId.
+         * The map uses the wire field names: event, entityType, entityId,
+         * optionally targetEntityType, targetEntityId, properties,
+         * eventTime (ISO-8601).
+         */
+        @SuppressWarnings("unchecked")
+        public String createEvent(Map<String, Object> event)
+                throws IOException, InterruptedException {
+            Map<String, Object> out = (Map<String, Object>) request(
+                    http, "POST", base + "/events.json?" + qs(), event,
+                    timeout);
+            return (String) out.get("eventId");
+        }
+
+        /** POST /batch/events.json — up to 50 events per call. */
+        @SuppressWarnings("unchecked")
+        public List<Map<String, Object>> createEvents(
+                List<Map<String, Object>> events)
+                throws IOException, InterruptedException {
+            return (List<Map<String, Object>>) request(
+                    http, "POST", base + "/batch/events.json?" + qs(),
+                    events, timeout);
+        }
+
+        /** Convenience: {@code $set} user properties. */
+        public String setUser(String uid, Map<String, Object> properties)
+                throws IOException, InterruptedException {
+            Map<String, Object> e = new LinkedHashMap<>();
+            e.put("event", "$set");
+            e.put("entityType", "user");
+            e.put("entityId", uid);
+            e.put("properties", properties == null ? Map.of() : properties);
+            return createEvent(e);
+        }
+
+        /** Convenience: {@code $set} item properties. */
+        public String setItem(String iid, Map<String, Object> properties)
+                throws IOException, InterruptedException {
+            Map<String, Object> e = new LinkedHashMap<>();
+            e.put("event", "$set");
+            e.put("entityType", "item");
+            e.put("entityId", iid);
+            e.put("properties", properties == null ? Map.of() : properties);
+            return createEvent(e);
+        }
+
+        /** Convenience: a user-action-on-item event (buy, view, rate…). */
+        public String recordUserActionOnItem(
+                String action, String uid, String iid,
+                Map<String, Object> properties)
+                throws IOException, InterruptedException {
+            Map<String, Object> e = new LinkedHashMap<>();
+            e.put("event", action);
+            e.put("entityType", "user");
+            e.put("entityId", uid);
+            e.put("targetEntityType", "item");
+            e.put("targetEntityId", iid);
+            if (properties != null) e.put("properties", properties);
+            return createEvent(e);
+        }
+
+        /** GET /events/{id}.json */
+        @SuppressWarnings("unchecked")
+        public Map<String, Object> getEvent(String eventId)
+                throws IOException, InterruptedException {
+            return (Map<String, Object>) request(
+                    http, "GET",
+                    base + "/events/" + enc(eventId) + ".json?" + qs(),
+                    null, timeout);
+        }
+
+        /** DELETE /events/{id}.json */
+        public void deleteEvent(String eventId)
+                throws IOException, InterruptedException {
+            request(http, "DELETE",
+                    base + "/events/" + enc(eventId) + ".json?" + qs(),
+                    null, timeout);
+        }
+
+        /** GET /events.json with entityType/entityId/event/limit filters. */
+        @SuppressWarnings("unchecked")
+        public List<Map<String, Object>> findEvents(
+                Map<String, String> filters)
+                throws IOException, InterruptedException {
+            StringBuilder q = new StringBuilder(qs());
+            for (Map.Entry<String, String> f : filters.entrySet()) {
+                q.append('&').append(enc(f.getKey()))
+                 .append('=').append(enc(f.getValue()));
+            }
+            return (List<Map<String, Object>>) request(
+                    http, "GET", base + "/events.json?" + q, null, timeout);
+        }
+    }
+
+    // -- Query Server client ----------------------------------------------
+
+    /** Client for a deployed engine (reference: EngineClient in the SDKs). */
+    public static final class EngineClient {
+        private final String base;
+        private final Duration timeout;
+        private final HttpClient http;
+
+        public EngineClient(String url) {
+            this(url, Duration.ofSeconds(10));
+        }
+
+        public EngineClient(String url, Duration timeout) {
+            this.base = url.endsWith("/")
+                    ? url.substring(0, url.length() - 1) : url;
+            this.timeout = timeout;
+            this.http = HttpClient.newBuilder()
+                    .connectTimeout(timeout).build();
+        }
+
+        /** POST /queries.json — returns the engine's prediction object. */
+        @SuppressWarnings("unchecked")
+        public Map<String, Object> sendQuery(Map<String, Object> query)
+                throws IOException, InterruptedException {
+            return (Map<String, Object>) request(
+                    http, "POST", base + "/queries.json", query, timeout);
+        }
+    }
+
+    // -- minimal JSON ------------------------------------------------------
+
+    /**
+     * Self-contained JSON encode/parse for the SDK wire format (objects,
+     * arrays, strings, numbers, booleans, null).  Parse returns
+     * {@code Map<String,Object> / List<Object> / String / Double /
+     * Boolean / null}.  Deliberately minimal — not a general-purpose
+     * library — so the SDK stays dependency-free like the reference
+     * SDK's users expected of a thin client.
+     */
+    public static final class Json {
+
+        private Json() {}
+
+        public static String encode(Object v) {
+            StringBuilder sb = new StringBuilder();
+            write(sb, v);
+            return sb.toString();
+        }
+
+        private static void write(StringBuilder sb, Object v) {
+            if (v == null) {
+                sb.append("null");
+            } else if (v instanceof String) {
+                writeString(sb, (String) v);
+            } else if (v instanceof Boolean || v instanceof Integer
+                       || v instanceof Long) {
+                sb.append(v);
+            } else if (v instanceof Number) {
+                double d = ((Number) v).doubleValue();
+                if (Double.isFinite(d) && d == Math.rint(d)
+                        && Math.abs(d) < 1e15) {
+                    sb.append((long) d);
+                } else {
+                    sb.append(d);
+                }
+            } else if (v instanceof Map) {
+                sb.append('{');
+                boolean first = true;
+                for (Map.Entry<?, ?> e : ((Map<?, ?>) v).entrySet()) {
+                    if (!first) sb.append(',');
+                    first = false;
+                    writeString(sb, String.valueOf(e.getKey()));
+                    sb.append(':');
+                    write(sb, e.getValue());
+                }
+                sb.append('}');
+            } else if (v instanceof Iterable) {
+                sb.append('[');
+                boolean first = true;
+                for (Object o : (Iterable<?>) v) {
+                    if (!first) sb.append(',');
+                    first = false;
+                    write(sb, o);
+                }
+                sb.append(']');
+            } else {
+                throw new IllegalArgumentException(
+                        "cannot encode " + v.getClass());
+            }
+        }
+
+        private static void writeString(StringBuilder sb, String s) {
+            sb.append('"');
+            for (int i = 0; i < s.length(); i++) {
+                char c = s.charAt(i);
+                switch (c) {
+                    case '"': sb.append("\\\""); break;
+                    case '\\': sb.append("\\\\"); break;
+                    case '\b': sb.append("\\b"); break;
+                    case '\f': sb.append("\\f"); break;
+                    case '\n': sb.append("\\n"); break;
+                    case '\r': sb.append("\\r"); break;
+                    case '\t': sb.append("\\t"); break;
+                    default:
+                        if (c < 0x20) {
+                            sb.append(String.format("\\u%04x", (int) c));
+                        } else {
+                            sb.append(c);
+                        }
+                }
+            }
+            sb.append('"');
+        }
+
+        public static Object parse(String text) {
+            Parser p = new Parser(text);
+            Object v = p.value();
+            p.skipWs();
+            if (p.pos != text.length()) {
+                throw new IllegalArgumentException(
+                        "trailing JSON at offset " + p.pos);
+            }
+            return v;
+        }
+
+        private static final class Parser {
+            final String s;
+            int pos;
+
+            Parser(String s) { this.s = s; }
+
+            void skipWs() {
+                while (pos < s.length()
+                       && Character.isWhitespace(s.charAt(pos))) pos++;
+            }
+
+            Object value() {
+                skipWs();
+                if (pos >= s.length()) {
+                    throw new IllegalArgumentException("unexpected end");
+                }
+                char c = s.charAt(pos);
+                switch (c) {
+                    case '{': return object();
+                    case '[': return array();
+                    case '"': return string();
+                    case 't': expect("true"); return Boolean.TRUE;
+                    case 'f': expect("false"); return Boolean.FALSE;
+                    case 'n': expect("null"); return null;
+                    default: return number();
+                }
+            }
+
+            void expect(String lit) {
+                if (!s.startsWith(lit, pos)) {
+                    throw new IllegalArgumentException(
+                            "bad literal at " + pos);
+                }
+                pos += lit.length();
+            }
+
+            Map<String, Object> object() {
+                Map<String, Object> m = new LinkedHashMap<>();
+                pos++;                       // '{'
+                skipWs();
+                if (pos < s.length() && s.charAt(pos) == '}') {
+                    pos++;
+                    return m;
+                }
+                while (true) {
+                    skipWs();
+                    String k = string();
+                    skipWs();
+                    if (s.charAt(pos) != ':') {
+                        throw new IllegalArgumentException(
+                                "expected ':' at " + pos);
+                    }
+                    pos++;
+                    m.put(k, value());
+                    skipWs();
+                    char c = s.charAt(pos);
+                    pos++;
+                    if (c == '}') return m;
+                    if (c != ',') {
+                        throw new IllegalArgumentException(
+                                "expected ',' or '}' at " + (pos - 1));
+                    }
+                }
+            }
+
+            List<Object> array() {
+                List<Object> l = new ArrayList<>();
+                pos++;                       // '['
+                skipWs();
+                if (pos < s.length() && s.charAt(pos) == ']') {
+                    pos++;
+                    return l;
+                }
+                while (true) {
+                    l.add(value());
+                    skipWs();
+                    char c = s.charAt(pos);
+                    pos++;
+                    if (c == ']') return l;
+                    if (c != ',') {
+                        throw new IllegalArgumentException(
+                                "expected ',' or ']' at " + (pos - 1));
+                    }
+                }
+            }
+
+            String string() {
+                if (s.charAt(pos) != '"') {
+                    throw new IllegalArgumentException(
+                            "expected string at " + pos);
+                }
+                pos++;
+                StringBuilder sb = new StringBuilder();
+                while (true) {
+                    char c = s.charAt(pos);
+                    pos++;
+                    if (c == '"') return sb.toString();
+                    if (c == '\\') {
+                        char e = s.charAt(pos);
+                        pos++;
+                        switch (e) {
+                            case '"': sb.append('"'); break;
+                            case '\\': sb.append('\\'); break;
+                            case '/': sb.append('/'); break;
+                            case 'b': sb.append('\b'); break;
+                            case 'f': sb.append('\f'); break;
+                            case 'n': sb.append('\n'); break;
+                            case 'r': sb.append('\r'); break;
+                            case 't': sb.append('\t'); break;
+                            case 'u':
+                                sb.append((char) Integer.parseInt(
+                                        s.substring(pos, pos + 4), 16));
+                                pos += 4;
+                                break;
+                            default:
+                                throw new IllegalArgumentException(
+                                        "bad escape \\" + e);
+                        }
+                    } else {
+                        sb.append(c);
+                    }
+                }
+            }
+
+            Double number() {
+                int start = pos;
+                while (pos < s.length()
+                       && "+-0123456789.eE".indexOf(s.charAt(pos)) >= 0) {
+                    pos++;
+                }
+                return Double.valueOf(s.substring(start, pos));
+            }
+        }
+    }
+}

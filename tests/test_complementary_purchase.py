@@ -148,7 +148,80 @@ def test_basket_rules_chunked_exact(monkeypatch):
             assert abs(lift[row, k_] - lift_ref) < 1e-4
 
 
-def test_basket_rules_item_cap():
-    with pytest.raises(ValueError, match="tiled variant"):
-        basket_rules(np.zeros(1, np.int32), np.zeros(1, np.int32),
-                     1, 100_000, top_k=5)
+def _host_reference_rules(gb, gi, n_baskets, n_items, top_k,
+                          min_support=0.0, min_confidence=0.0):
+    """Exact numpy reference from sparse pairs (no dense matrix)."""
+    pairs = sorted(set(zip(gb.tolist(), gi.tolist())))
+    by_basket = {}
+    ci = np.zeros(n_items, np.int64)
+    for b, i in pairs:
+        by_basket.setdefault(b, []).append(i)
+        ci[i] += 1
+    counts = {}
+    for items in by_basket.values():
+        for i in items:
+            for j in items:
+                if i != j:
+                    counts[(i, j)] = counts.get((i, j), 0) + 1
+    n = max(float(n_baskets), 1.0)
+    rules = {}
+    for (i, j), c in counts.items():
+        support, conf = c / n, c / ci[i]
+        lift = conf / (ci[j] / n)
+        if support >= min_support and conf >= min_confidence:
+            rules.setdefault(i, []).append((lift, j, conf))
+    out = {}
+    for i, rs in rules.items():
+        rs.sort(key=lambda t: (-t[0], t[1]))
+        out[i] = rs[:top_k]
+    return out
+
+
+def test_basket_rules_tiled_matches_dense(monkeypatch):
+    """Forcing the tiled strategy at a dense-feasible size: identical
+    lift/ids/confidence (modulo tie order) to the dense path."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    rng = np.random.default_rng(8)
+    n_baskets, n_items = 300, 90
+    gb = rng.integers(0, n_baskets, 2_000).astype(np.int32)
+    gi = rng.integers(0, n_items, 2_000).astype(np.int32)
+    dense = basket_rules(gb, gi, n_baskets, n_items, top_k=6,
+                         min_support=0.004, min_confidence=0.1)
+    monkeypatch.setattr(cco_ops, "_BASKET_RULES_DENSE_MAX_ITEMS", 8)
+    tiled = basket_rules(gb, gi, n_baskets, n_items, top_k=6,
+                         min_support=0.004, min_confidence=0.1,
+                         item_tile=32)
+    np.testing.assert_allclose(dense[0], tiled[0], rtol=1e-5)
+    for r in range(n_items):
+        fin = np.isfinite(dense[0][r])
+        assert set(dense[1][r][fin]) == set(tiled[1][r][fin])
+    np.testing.assert_allclose(np.sort(dense[2], axis=1),
+                               np.sort(tiled[2], axis=1), rtol=1e-5)
+
+
+def test_basket_rules_past_old_cap():
+    """The 40k-item cliff is gone: a 41k-item catalog trains on the tiled
+    strategy and matches an exact sparse host reference row for row."""
+    rng = np.random.default_rng(9)
+    n_baskets, n_items = 200, 41_000
+    # clustered baskets so real rules exist among high ids too
+    gb = np.repeat(np.arange(n_baskets, dtype=np.int32), 6)
+    base = rng.integers(0, n_items - 8, n_baskets)
+    gi = (base[:, None] + rng.integers(0, 8, (n_baskets, 6))).astype(np.int32).ravel()
+    st, si, conf = basket_rules(gb, gi, n_baskets, n_items, top_k=5,
+                                item_tile=8192)
+    assert st.shape == (n_items, 5)
+    ref = _host_reference_rules(gb, gi, n_baskets, n_items, top_k=5)
+    checked = 0
+    for i, rs in list(ref.items())[:300]:
+        got_lift = st[i][np.isfinite(st[i])]
+        want_lift = np.array([t[0] for t in rs], np.float64)
+        np.testing.assert_allclose(
+            got_lift, want_lift[: len(got_lift)], rtol=1e-4)
+        want_conf = {j: c for (_, j, c) in rs}
+        for lift_v, j, cv in zip(st[i], si[i], conf[i]):
+            if j >= 0 and j in want_conf:
+                np.testing.assert_allclose(cv, want_conf[j], rtol=1e-4)
+                checked += 1
+    assert checked > 100

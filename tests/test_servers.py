@@ -275,3 +275,127 @@ def test_header_count_cap(event_server):
     data = s.recv(65536)
     assert b"400" in data.split(b"\r\n", 1)[0], data[:100]
     s.close()
+
+
+def test_auto_reload_hot_swaps_on_retrain(tmp_path, mem_storage):
+    """MasterActor parity: train -> deploy --auto-reload -> retrain on new
+    data -> queries reflect the NEW model with no manual /reload."""
+    import time as _time
+
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.recommendation import RecommendationEngine
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import deploy
+
+    app_id = mem_storage.apps.insert(App(0, "arapp"))
+    rng = np.random.default_rng(4)
+
+    def rate_cluster(flip):
+        evs = []
+        for u in range(12):
+            for i in range(8):
+                liked = ((u < 6) == (i < 4)) != flip
+                evs.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0 if liked else 1.0})))
+        return evs
+
+    mem_storage.l_events.insert_batch(rate_cluster(False), app_id)
+    variant = {
+        "id": "ar-engine",
+        "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "arapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "numIterations": 6,
+                                   "lambda": 0.05, "meshDp": 1}}],
+    }
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps(variant))
+    engine = RecommendationEngine.apply()
+    ep = engine.engine_params_from_variant(variant)
+    core_workflow.run_train(engine, ep, engine_id="ar-engine",
+                            storage=mem_storage)
+    httpd = deploy(engine_json=str(engine_json), host="127.0.0.1", port=0,
+                   storage=mem_storage, background=True, auto_reload=0.05)
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        first_instance = httpd.pio_state.instance.id
+        status, r1 = http("POST", base + "/queries.json",
+                          {"user": "u1", "num": 3})
+        assert status == 200 and r1["itemScores"]
+
+        # retrain against flipped preferences: a NEW engine instance
+        mem_storage.l_events.insert_batch(rate_cluster(True) * 3, app_id)
+        core_workflow.run_train(engine, ep, engine_id="ar-engine",
+                                storage=mem_storage)
+        deadline = _time.time() + 10
+        while (httpd.pio_state.instance.id == first_instance
+               and _time.time() < deadline):
+            _time.sleep(0.05)
+        assert httpd.pio_state.instance.id != first_instance, \
+            "watcher never hot-swapped to the retrained instance"
+        status, r2 = http("POST", base + "/queries.json",
+                          {"user": "u1", "num": 3})
+        assert status == 200 and r2["itemScores"]
+    finally:
+        httpd.pio_state.stop_auto_reload()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_java_sdk_wire_format(event_server):
+    """Replays the exact requests sdk/java/PredictionIO.java constructs
+    (method, path, query, headers, JSON body shape) against a live event
+    server — the wire-format contract the Java client compiles against."""
+    import http.client
+    import json as _json
+    from urllib.parse import urlsplit
+
+    base, key = event_server["base"], event_server["key"]
+    u = urlsplit(base)
+    conn = http.client.HTTPConnection(u.hostname, u.port)
+
+    # EventClient.createEvent: POST /events.json?accessKey=K
+    body = ('{"event":"buy","entityType":"user","entityId":"u1",'
+            '"targetEntityType":"item","targetEntityId":"i3",'
+            '"properties":{"price":9.5}}')
+    conn.request("POST", f"/events.json?accessKey={key}", body,
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    out = _json.loads(r.read())
+    assert r.status == 201 and out["eventId"]
+    eid = out["eventId"]
+
+    # EventClient.createEvents: POST /batch/events.json
+    batch = _json.dumps([
+        {"event": "view", "entityType": "user", "entityId": "u1",
+         "targetEntityType": "item", "targetEntityId": "i9"}])
+    conn.request("POST", f"/batch/events.json?accessKey={key}", batch,
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    out = _json.loads(r.read())
+    assert r.status == 200 and out[0]["status"] == 201
+
+    # EventClient.getEvent: GET /events/{id}.json
+    conn.request("GET", f"/events/{eid}.json?accessKey={key}", None,
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    got = _json.loads(r.read())
+    assert r.status == 200 and got["properties"]["price"] == 9.5
+
+    # EventClient.findEvents: GET /events.json with filters
+    conn.request("GET",
+                 f"/events.json?accessKey={key}&entityType=user&entityId=u1",
+                 None, {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    found = _json.loads(r.read())
+    assert r.status == 200 and len(found) == 2
+
+    # EventClient.deleteEvent: DELETE /events/{id}.json
+    conn.request("DELETE", f"/events/{eid}.json?accessKey={key}", None,
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 200
+    r.read()
+    conn.close()
