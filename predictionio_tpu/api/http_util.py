@@ -105,12 +105,17 @@ class JsonHandler(socketserver.StreamRequestHandler):
             or (version == "HTTP/1.0" and conn_tok != "keep-alive"))
         if (headers.get("expect") or "").lower() == "100-continue":
             self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
-        try:
-            self._body_unread = int(headers.get("content-length") or 0)
-        except ValueError:
-            self._body_unread = -1
-        if self._body_unread < 0:   # non-numeric or negative: reject, and
-            # never rfile.read(-1) (reads to EOF, pinning the thread)
+        cl = headers.get("content-length")
+        # strict 1*DIGIT per RFC 9110 — int() alone accepts '1_0', ' 10 ',
+        # and non-ASCII digits, values an intermediary may interpret
+        # differently and desync the body boundary on
+        if cl is None:
+            self._body_unread = 0
+        elif cl.isascii() and cl.isdigit():
+            self._body_unread = int(cl)
+        else:
+            # reject without ever calling rfile.read(-1) (reads to EOF,
+            # pinning the thread)
             self.close_connection = True
             self._body_unread = 0
             self._send_raw(400, b'{"message": "bad Content-Length"}')

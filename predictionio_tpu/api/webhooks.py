@@ -4,6 +4,21 @@ webhooks/segmentio/mailchimp connectors — SURVEY.md §2 'Event server').
 A connector turns a third-party JSON or form payload into the canonical
 Event.  POST /webhooks/<name>.json?accessKey=K dispatches to the registered
 connector; unknown names 404 like the reference.
+
+**Extension point** (this is the whole integration contract): a connector
+is any ``Callable[[Mapping], Event]`` — raise ``ValueError`` for a payload
+you cannot map.  Register it before the event server starts:
+
+    from predictionio_tpu.api.webhooks import register_connector
+    def my_connector(payload):
+        return Event(event=payload["action"], entity_type="user",
+                     entity_id=str(payload["uid"]))
+    register_connector("mysystem", my_connector)
+
+after which ``POST /webhooks/mysystem.json?accessKey=K`` ingests that
+system's payloads.  The reference shipped exactly this shape as a small
+family of bundled connectors (segmentio JSON, mailchimp form); both are
+built in below, and anything else is one function away.
 """
 
 from __future__ import annotations
@@ -79,3 +94,57 @@ def form_connector(payload: Mapping) -> Event:
 
 
 register_connector("form", form_connector)
+
+
+# -- built-in: mailchimp (reference: webhooks/mailchimp/MailChimpConnector) --
+
+
+def mailchimp_connector(payload: Mapping) -> Event:
+    """Maps MailChimp webhook notifications (subscribe/unsubscribe/
+    profile/cleaned/upemail/campaign) to Events, mirroring the reference
+    connector: the list member is the entity; the notification type is
+    the event verb; the flattened data[...] form fields are properties.
+
+    MailChimp posts form-encoded ``type=subscribe&data[email]=…`` bodies;
+    the event server's form decoding (or a JSON re-post) delivers them
+    here as a flat mapping with bracketed keys.
+    """
+    typ = payload.get("type")
+    if not typ:
+        raise ValueError("mailchimp payload requires 'type'")
+    known = ("subscribe", "unsubscribe", "profile", "cleaned", "upemail",
+             "campaign")
+    if typ not in known:
+        raise ValueError(f"unsupported mailchimp type {typ!r}")
+    # data[...] fields arrive either nested ({"data": {...}}) or flattened
+    # ("data[email]": ...) depending on the posting agent
+    data = payload.get("data")
+    if not isinstance(data, Mapping):
+        data = {k[5:-1]: v for k, v in payload.items()
+                if k.startswith("data[") and k.endswith("]")}
+    entity = (data.get("email") or data.get("new_email")
+              or data.get("id") or data.get("list_id"))
+    if not entity:
+        raise ValueError(
+            "mailchimp payload carries no member email/id to key the event")
+    props = {k: v for k, v in data.items()}
+    if payload.get("fired_at"):
+        props["fired_at"] = payload["fired_at"]
+    return Event(event=typ, entity_type="user", entity_id=str(entity),
+                 properties=DataMap(props),
+                 event_time=_mailchimp_time(payload.get("fired_at")))
+
+
+def _mailchimp_time(fired_at):
+    """MailChimp's 'YYYY-MM-DD HH:MM:SS' (UTC, no zone) → ISO-8601.
+    A value that already looks ISO (a 'T', a zone suffix) — e.g. from a
+    normalizing JSON re-poster — passes through untouched."""
+    if not fired_at:
+        return None
+    s = str(fired_at)
+    if "T" in s or s.endswith("Z") or "+" in s:
+        return s
+    return s.replace(" ", "T") + "+00:00"
+
+
+register_connector("mailchimp", mailchimp_connector)

@@ -1,0 +1,208 @@
+<?php
+/**
+ * PHP SDK — clients for the Event Server and Query Server REST APIs.
+ *
+ * Reference: the PredictionIO-PHP-SDK repo (EventClient / EngineClient;
+ * SURVEY.md §2 "SDKs" — separate repos speaking the same REST wire
+ * format).  Dependency-free: ext-curl only (bundled with virtually every
+ * PHP build); the cURL handle is reused across calls so requests ride one
+ * keep-alive connection.  Mirrors predictionio_tpu/sdk/client.py; the
+ * wire format is documented in sdk/js/README.md and replay-tested in
+ * tests/test_servers.py::test_java_sdk_wire_format (same byte-level
+ * surface all four SDKs speak).
+ *
+ * Usage:
+ *   require 'PredictionIO.php';
+ *   $events = new PredictionIO\EventClient('ACCESS_KEY',
+ *                                          'http://localhost:7070');
+ *   $id = $events->recordUserActionOnItem('buy', 'u1', 'i3');
+ *   $engine = new PredictionIO\EngineClient('http://localhost:8000');
+ *   $res = $engine->sendQuery(['user' => 'u1', 'num' => 10]);
+ */
+
+namespace PredictionIO;
+
+class PIOException extends \RuntimeException
+{
+    public int $status;
+    public string $pioMessage;
+
+    public function __construct(int $status, string $message)
+    {
+        parent::__construct("HTTP $status: $message");
+        $this->status = $status;
+        $this->pioMessage = $message;
+    }
+}
+
+/** Shared cURL plumbing: one reusable keep-alive handle per client. */
+trait HttpClient
+{
+    private $curl = null;
+    private string $base;
+    private float $timeout;
+
+    private function initHttp(string $url, float $timeout): void
+    {
+        $this->base = rtrim($url, '/');
+        $this->timeout = $timeout;
+    }
+
+    private function request(string $method, string $pathQs, $body = null)
+    {
+        if ($this->curl === null) {
+            $this->curl = curl_init();
+        }
+        $opts = [
+            CURLOPT_URL => $this->base . $pathQs,
+            CURLOPT_CUSTOMREQUEST => $method,
+            CURLOPT_RETURNTRANSFER => true,
+            CURLOPT_TIMEOUT_MS => (int) ($this->timeout * 1000),
+            CURLOPT_HTTPHEADER => ['Content-Type: application/json'],
+            CURLOPT_TCP_NODELAY => true,
+            CURLOPT_POSTFIELDS => $body === null ? '' : json_encode($body),
+        ];
+        curl_setopt_array($this->curl, $opts);
+        $text = curl_exec($this->curl);
+        if ($text === false) {
+            $err = curl_error($this->curl);
+            curl_close($this->curl);
+            $this->curl = null;
+            throw new \RuntimeException("request failed: $err");
+        }
+        $status = curl_getinfo($this->curl, CURLINFO_RESPONSE_CODE);
+        if ($status >= 400) {
+            $decoded = json_decode($text, true);
+            $message = is_array($decoded) && isset($decoded['message'])
+                ? $decoded['message'] : $text;
+            throw new PIOException($status, $message);
+        }
+        return $text === '' ? null : json_decode($text, true);
+    }
+
+    public function close(): void
+    {
+        if ($this->curl !== null) {
+            curl_close($this->curl);
+            $this->curl = null;
+        }
+    }
+}
+
+/** Client for the Event Server (reference: EventClient in the SDKs). */
+class EventClient
+{
+    use HttpClient;
+
+    private string $accessKey;
+    private ?string $channel;
+
+    public function __construct(
+        string $accessKey,
+        string $url = 'http://localhost:7070',
+        ?string $channel = null,
+        float $timeout = 10.0
+    ) {
+        $this->accessKey = $accessKey;
+        $this->channel = $channel;
+        $this->initHttp($url, $timeout);
+    }
+
+    private function qs(): string
+    {
+        $q = 'accessKey=' . rawurlencode($this->accessKey);
+        if ($this->channel !== null) {
+            $q .= '&channel=' . rawurlencode($this->channel);
+        }
+        return $q;
+    }
+
+    /**
+     * POST /events.json — one event (wire field names: event, entityType,
+     * entityId, targetEntityType?, targetEntityId?, properties?,
+     * eventTime? ISO-8601).  Returns the created eventId.
+     */
+    public function createEvent(array $event): string
+    {
+        $out = $this->request('POST', '/events.json?' . $this->qs(), $event);
+        return $out['eventId'];
+    }
+
+    /** POST /batch/events.json — up to 50 events per call. */
+    public function createEvents(array $events): array
+    {
+        return $this->request(
+            'POST', '/batch/events.json?' . $this->qs(), $events);
+    }
+
+    public function setUser(string $uid, array $properties = []): string
+    {
+        return $this->createEvent([
+            'event' => '$set', 'entityType' => 'user', 'entityId' => $uid,
+            'properties' => (object) $properties,
+        ]);
+    }
+
+    public function setItem(string $iid, array $properties = []): string
+    {
+        return $this->createEvent([
+            'event' => '$set', 'entityType' => 'item', 'entityId' => $iid,
+            'properties' => (object) $properties,
+        ]);
+    }
+
+    public function recordUserActionOnItem(
+        string $action, string $uid, string $iid, ?array $properties = null
+    ): string {
+        $e = [
+            'event' => $action, 'entityType' => 'user', 'entityId' => $uid,
+            'targetEntityType' => 'item', 'targetEntityId' => $iid,
+        ];
+        if ($properties !== null) {
+            $e['properties'] = (object) $properties;
+        }
+        return $this->createEvent($e);
+    }
+
+    public function getEvent(string $eventId): array
+    {
+        return $this->request(
+            'GET',
+            '/events/' . rawurlencode($eventId) . '.json?' . $this->qs());
+    }
+
+    public function deleteEvent(string $eventId): void
+    {
+        $this->request(
+            'DELETE',
+            '/events/' . rawurlencode($eventId) . '.json?' . $this->qs());
+    }
+
+    /** GET /events.json with entityType/entityId/event/limit filters. */
+    public function findEvents(array $filters = []): array
+    {
+        $q = $this->qs();
+        foreach ($filters as $k => $v) {
+            $q .= '&' . rawurlencode($k) . '=' . rawurlencode((string) $v);
+        }
+        return $this->request('GET', '/events.json?' . $q);
+    }
+}
+
+/** Client for a deployed engine (reference: EngineClient in the SDKs). */
+class EngineClient
+{
+    use HttpClient;
+
+    public function __construct(
+        string $url = 'http://localhost:8000', float $timeout = 10.0
+    ) {
+        $this->initHttp($url, $timeout);
+    }
+
+    /** POST /queries.json — returns the engine's prediction array. */
+    public function sendQuery(array $query): array
+    {
+        return $this->request('POST', '/queries.json', $query);
+    }
+}

@@ -1,0 +1,184 @@
+# Ruby SDK — clients for the Event Server and Query Server REST APIs.
+#
+# Reference: the PredictionIO-Ruby-SDK repo (EventClient / EngineClient;
+# SURVEY.md §2 "SDKs" — separate repos speaking the same REST wire
+# format).  Dependency-free: stdlib net/http + json only.  Each client
+# holds one keep-alive Net::HTTP session (re-opened transparently if the
+# server closes it).  Mirrors predictionio_tpu/sdk/client.py; the wire
+# format is documented in sdk/js/README.md and replay-tested in
+# tests/test_servers.py::test_java_sdk_wire_format (same byte-level
+# surface all four SDKs speak).
+#
+# Usage:
+#   require_relative "predictionio"
+#   events = PredictionIO::EventClient.new("ACCESS_KEY",
+#                                          url: "http://localhost:7070")
+#   id = events.record_user_action_on_item("buy", "u1", "i3")
+#   engine = PredictionIO::EngineClient.new(url: "http://localhost:8000")
+#   res = engine.send_query("user" => "u1", "num" => 10)
+
+require "json"
+require "net/http"
+require "uri"
+
+module PredictionIO
+  class PIOError < StandardError
+    attr_reader :status, :pio_message
+
+    def initialize(status, message)
+      super("HTTP #{status}: #{message}")
+      @status = status
+      @pio_message = message
+    end
+  end
+
+  # One keep-alive Net::HTTP session per client; re-opened if closed.
+  class HttpConn
+    def initialize(url, timeout)
+      uri = URI.parse(url)
+      @host = uri.host
+      @port = uri.port
+      @use_ssl = uri.scheme == "https"
+      @prefix = uri.path.chomp("/")
+      @timeout = timeout
+      @http = nil
+    end
+
+    def request(method, path_qs, body = nil)
+      http = connection
+      req = Net::HTTP.const_get(method.capitalize).new(@prefix + path_qs)
+      req["Content-Type"] = "application/json"
+      req.body = JSON.generate(body) unless body.nil?
+      begin
+        resp = http.request(req)
+      rescue IOError, Errno::ECONNRESET, Errno::EPIPE, EOFError
+        # always drop the broken session so the NEXT call starts clean,
+        # but only re-send idempotent methods: these exceptions can fire
+        # while READING the response, after the server already processed
+        # a POST — re-sending would silently duplicate the event (same
+        # policy as the Python SDK)
+        @http = nil
+        raise unless %w[Get Delete].include?(method)
+        resp = connection.request(req)
+      end
+      status = resp.code.to_i
+      text = resp.body || ""
+      if status >= 400
+        message = begin
+          JSON.parse(text)["message"] || text
+        rescue JSON::ParserError
+          text
+        end
+        raise PIOError.new(status, message)
+      end
+      text.empty? ? nil : JSON.parse(text)
+    end
+
+    def close
+      @http&.finish if @http&.started?
+      @http = nil
+    end
+
+    private
+
+    def connection
+      if @http.nil? || !@http.started?
+        @http = Net::HTTP.new(@host, @port)
+        @http.use_ssl = @use_ssl
+        @http.open_timeout = @timeout
+        @http.read_timeout = @timeout
+        @http.keep_alive_timeout = 30
+        @http.start
+      end
+      @http
+    end
+  end
+
+  # Client for the Event Server (reference: EventClient in the SDKs).
+  class EventClient
+    def initialize(access_key, url: "http://localhost:7070",
+                   channel: nil, timeout: 10)
+      @access_key = access_key
+      @channel = channel
+      @conn = HttpConn.new(url, timeout)
+    end
+
+    # POST /events.json — one event (wire field names: event, entityType,
+    # entityId, targetEntityType?, targetEntityId?, properties?,
+    # eventTime? ISO-8601).  Returns the created eventId.
+    def create_event(event)
+      @conn.request("Post", "/events.json?#{qs}", event).fetch("eventId")
+    end
+
+    # POST /batch/events.json — up to 50 events per call.
+    def create_events(events)
+      @conn.request("Post", "/batch/events.json?#{qs}", events)
+    end
+
+    def set_user(uid, properties = {})
+      create_event("event" => "$set", "entityType" => "user",
+                   "entityId" => uid, "properties" => properties)
+    end
+
+    def set_item(iid, properties = {})
+      create_event("event" => "$set", "entityType" => "item",
+                   "entityId" => iid, "properties" => properties)
+    end
+
+    def record_user_action_on_item(action, uid, iid, properties = nil)
+      e = { "event" => action, "entityType" => "user", "entityId" => uid,
+            "targetEntityType" => "item", "targetEntityId" => iid }
+      e["properties"] = properties unless properties.nil?
+      create_event(e)
+    end
+
+    def get_event(event_id)
+      @conn.request(
+        "Get", "/events/#{URI.encode_www_form_component(event_id)}.json?#{qs}")
+    end
+
+    def delete_event(event_id)
+      @conn.request(
+        "Delete",
+        "/events/#{URI.encode_www_form_component(event_id)}.json?#{qs}")
+      nil
+    end
+
+    # GET /events.json with entityType/entityId/event/limit filters.
+    def find_events(filters = {})
+      extra = filters.map do |k, v|
+        "&#{URI.encode_www_form_component(k.to_s)}=" \
+          "#{URI.encode_www_form_component(v.to_s)}"
+      end.join
+      @conn.request("Get", "/events.json?#{qs}#{extra}")
+    end
+
+    def close
+      @conn.close
+    end
+
+    private
+
+    def qs
+      q = "accessKey=#{URI.encode_www_form_component(@access_key)}"
+      q += "&channel=#{URI.encode_www_form_component(@channel)}" if @channel
+      q
+    end
+  end
+
+  # Client for a deployed engine (reference: EngineClient in the SDKs).
+  class EngineClient
+    def initialize(url: "http://localhost:8000", timeout: 10)
+      @conn = HttpConn.new(url, timeout)
+    end
+
+    # POST /queries.json — returns the engine's prediction hash.
+    def send_query(query)
+      @conn.request("Post", "/queries.json", query)
+    end
+
+    def close
+      @conn.close
+    end
+  end
+end

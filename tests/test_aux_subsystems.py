@@ -64,6 +64,52 @@ def test_form_webhook(server):
     assert evs and evs[0].properties["price"] == 3
 
 
+def test_mailchimp_webhook(server):
+    base, key = server["base"], server["key"]
+    # nested data form (JSON re-post)
+    status, _ = post(f"{base}/webhooks/mailchimp.json?accessKey={key}", {
+        "type": "subscribe", "fired_at": "2026-02-01 12:00:00",
+        "data": {"email": "a@example.com", "list_id": "L1"}})
+    assert status == 201
+    # flattened data[...] form fields (MailChimp's native shape)
+    status, _ = post(f"{base}/webhooks/mailchimp.json?accessKey={key}", {
+        "type": "unsubscribe", "data[email]": "a@example.com",
+        "data[reason]": "manual"})
+    assert status == 201
+    evs = {e.event: e for e in server["storage"].l_events.find(server["app_id"])}
+    sub = evs["subscribe"]
+    assert sub.entity_id == "a@example.com"
+    assert sub.properties["list_id"] == "L1"
+    assert sub.event_time.isoformat().startswith("2026-02-01T12:00:00")
+    assert evs["unsubscribe"].properties["reason"] == "manual"
+    # unsupported type and missing member key are 400s
+    status, _ = post(f"{base}/webhooks/mailchimp.json?accessKey={key}",
+                     {"type": "bogus"})
+    assert status == 400
+    status, _ = post(f"{base}/webhooks/mailchimp.json?accessKey={key}",
+                     {"type": "cleaned", "data": {}})
+    assert status == 400
+
+
+def test_register_custom_connector(server):
+    """The documented extension point: one function, one register call."""
+    from predictionio_tpu.api.webhooks import register_connector
+    from predictionio_tpu.events.event import Event
+
+    def my_connector(payload):
+        return Event(event=payload["action"], entity_type="user",
+                     entity_id=str(payload["uid"]))
+
+    register_connector("mysystem", my_connector)
+    base, key = server["base"], server["key"]
+    status, _ = post(f"{base}/webhooks/mysystem.json?accessKey={key}",
+                     {"action": "signup", "uid": 7})
+    assert status == 201
+    evs = list(server["storage"].l_events.find(
+        server["app_id"], event_names=["signup"]))
+    assert evs and evs[0].entity_id == "7"
+
+
 def test_plugins_blocker_and_sniffer():
     from predictionio_tpu.api.plugins import (
         OutputBlocker, OutputSniffer, PluginRegistry,
