@@ -141,23 +141,66 @@ class Engine(BaseEngine):
         Reference: CreateServer's ServerActor closing over (engine, models);
         each query runs every algorithm's predict then serving.serve.
         """
-        _, _, algorithms, serving = self.make_components(engine_params)
-        if len(models) != len(algorithms):
-            raise ValueError(
-                f"{len(models)} model(s) for {len(algorithms)} algorithm(s)"
-            )
-        # pre-stage serving state to device at deploy/reload time, so the
-        # first query never pays the host→device model transfer
-        for algo, model in zip(algorithms, models):
-            warm = getattr(algo, "warm", None)
-            if warm is not None:
-                warm(model)
+        algorithms, serving = self._serving_components(engine_params, models)
 
         def predict(query: Any) -> Any:
             preds = [algo.predict(model, query) for algo, model in zip(algorithms, models)]
             return serving.serve(query, preds)
 
         return predict
+
+    def _serving_components(self, engine_params: EngineParams,
+                            models: Sequence[Any]):
+        """Shared deploy prologue: build components, validate the model
+        count, and pre-stage serving state to device (warm) so the first
+        query never pays the host→device model transfer."""
+        _, _, algorithms, serving = self.make_components(engine_params)
+        if len(models) != len(algorithms):
+            raise ValueError(
+                f"{len(models)} model(s) for {len(algorithms)} algorithm(s)"
+            )
+        for algo, model in zip(algorithms, models):
+            warm = getattr(algo, "warm", None)
+            if warm is not None:
+                warm(model)
+        return algorithms, serving
+
+    def batch_predictor(
+        self, engine_params: EngineParams, models: Sequence[Any]
+    ) -> Optional[Callable[[Sequence[Any]], List[Any]]]:
+        """Build a queries→predictions function that scores a whole batch
+        in ONE device program, or None when any algorithm lacks
+        ``predict_batch``.
+
+        The reference has no analogue (spray served queries one actor
+        message at a time); on an accelerator one [B, …] dispatch
+        amortizes the per-dispatch overhead — and, behind a tunneled
+        device, the per-readback round trip — across the batch, which is
+        what lets a single chip serve concurrent load (see
+        create_server's micro-batching).  Serving still runs per query.
+
+        Engages only when every algorithm declares ``serving_batchable``
+        (batch_predict must read the same state as predict; some
+        overrides are eval-only).
+        """
+        algorithms, serving = self._serving_components(engine_params, models)
+        if not all(getattr(a, "serving_batchable", False) for a in algorithms):
+            return None
+
+        def predict_batch(queries: Sequence[Any]) -> List[Any]:
+            per_algo = []
+            for algo, model in zip(algorithms, models):
+                col = algo.batch_predict(model, queries)
+                if len(col) != len(queries):
+                    raise RuntimeError(
+                        f"{type(algo).__name__}.batch_predict returned "
+                        f"{len(col)} results for {len(queries)} queries — "
+                        "serving batch_predict must be 1:1")
+                per_algo.append(col)
+            return [serving.serve(q, [col[i] for col in per_algo])
+                    for i, q in enumerate(queries)]
+
+        return predict_batch
 
     # -- params binding (engine.json) ----------------------------------------
 

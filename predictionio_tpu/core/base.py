@@ -73,6 +73,14 @@ class BaseAlgorithm(Doer[P], Generic[P, PD, M, Q, PR], abc.ABC):
     @abc.abstractmethod
     def predict(self, model: M, query: Q) -> PR: ...
 
+    #: True when batch_predict is safe to use for DEPLOY-TIME serving —
+    #: i.e. it reads exactly the same state per query as predict() (some
+    #: overrides are eval-only: UR's substitutes model-recorded history
+    #: for live-store lookups to avoid leaking held-out events).  Serving
+    #: micro-batching (create_server) engages only when every algorithm
+    #: sets this.
+    serving_batchable: bool = False
+
     def batch_predict(self, model: M, queries: Sequence[Q]) -> List[PR]:
         """Vectorized predict used by evaluation (reference:
         PAlgorithm.batchPredict). Override for a jit/vmap fast path."""

@@ -159,8 +159,21 @@ class LogRegParams(Params):
     mesh_dp: int = 0
 
 
+def _pad_batch(x: np.ndarray) -> np.ndarray:
+    """Pad the batch dim to a power-of-two bucket (repeat the last row):
+    serving batch sizes fluctuate with load, and an unbucketed leading
+    dim would retrace the jitted predict per distinct size."""
+    from predictionio_tpu.ops.als import bucket_width
+
+    b = bucket_width(len(x), min_width=1)
+    if b == len(x):
+        return x
+    return np.concatenate([x, np.repeat(x[-1:], b - len(x), axis=0)])
+
+
 class LogisticRegressionAlgorithm(Algorithm):
     params_class = LogRegParams
+    serving_batchable = True   # batch_predict reads only model state
 
     def train(self, td: LabeledData) -> LogRegModel:
         import jax
@@ -184,9 +197,10 @@ class LogisticRegressionAlgorithm(Algorithm):
     def batch_predict(self, model: LogRegModel, queries: Sequence[ClassificationQuery]):
         if not queries:
             return []
-        x = np.concatenate([model.featurize(q) for q in queries])
+        x = _pad_batch(np.concatenate([model.featurize(q) for q in queries]))
         preds = lr_ops.logreg_predict(model.w, model.b, x)
-        return [ClassifiedResult(label=model.labels[int(p)]) for p in preds]
+        return [ClassifiedResult(label=model.labels[int(p)])
+                for p in preds[:len(queries)]]
 
 
 class NBModel(_ClassifierModelBase):
@@ -203,6 +217,7 @@ class NaiveBayesParams(Params):
 
 class NaiveBayesAlgorithm(Algorithm):
     params_class = NaiveBayesParams
+    serving_batchable = True   # batch_predict reads only model state
 
     def train(self, td: LabeledData) -> NBModel:
         if self.params.model_type == "gaussian":
@@ -224,12 +239,13 @@ class NaiveBayesAlgorithm(Algorithm):
     def batch_predict(self, model: NBModel, queries: Sequence[ClassificationQuery]):
         if not queries:
             return []
-        x = np.concatenate([model.featurize(q) for q in queries])
+        x = _pad_batch(np.concatenate([model.featurize(q) for q in queries]))
         if isinstance(model.inner, nb_ops.GaussianNBModel):
             preds = nb_ops.gaussian_nb_predict(model.inner, x)
         else:
             preds = nb_ops.multinomial_nb_predict(model.inner, x)
-        return [ClassifiedResult(label=model.labels[int(p)]) for p in preds]
+        return [ClassifiedResult(label=model.labels[int(p)])
+                for p in preds[:len(queries)]]
 
 
 class ClassificationEngine(EngineFactory):

@@ -236,6 +236,7 @@ class ALSModel(DeviceCacheMixin, PersistentModel):
 
 class ALSAlgorithm(Algorithm):
     params_class = ALSAlgorithmParams
+    serving_batchable = True   # batch_predict reads only model state
 
     def train(self, pd: PreparedRatings) -> ALSModel:
         import jax
@@ -343,11 +344,15 @@ class ALSAlgorithm(Algorithm):
              for q in queries], np.int32,
         )
         safe = np.maximum(uids, 0)
-        vecs = model.user_factors[safe]
         excl_rows = [self._exclusions(model, q, int(u) if u >= 0 else None)
                      for q, u in zip(queries, uids)]
         width = als_ops.bucket_width(max(len(e) for e in excl_rows))
-        excl = np.full((len(queries), width), -1, np.int32)
+        # bucket the BATCH dim too (serving batch sizes fluctuate with
+        # load; an unbucketed B would retrace per distinct size)
+        bp = als_ops.bucket_width(len(queries), min_width=1)
+        vecs = model.user_factors[np.pad(safe, (0, bp - len(queries)),
+                                         mode="edge")]
+        excl = np.full((bp, width), -1, np.int32)
         for j, e in enumerate(excl_rows):
             excl[j, :len(e)] = e
         out = np.asarray(als_ops.recommend_batch_excl(

@@ -168,6 +168,8 @@ class TextNBParams(Params):
 
 class TextNBAlgorithm(Algorithm):
     params_class = TextNBParams
+    # not serving_batchable: batch_predict is a per-query loop, so the
+    # micro-batcher would add coordination overhead with no amortization
 
     def train(self, td: TextTrainingData) -> TextModel:
         counts = text_ops.hashing_vectorize(td.texts, self.params.dim)
@@ -195,6 +197,7 @@ class TextLogRegParams(Params):
 
 class TextLogRegAlgorithm(Algorithm):
     params_class = TextLogRegParams
+    serving_batchable = True   # batch_predict reads only model state
 
     def train(self, td: TextTrainingData) -> TextModel:
         counts = text_ops.hashing_vectorize(td.texts, self.params.dim)
@@ -219,7 +222,13 @@ class TextLogRegAlgorithm(Algorithm):
             return []
         counts = text_ops.hashing_vectorize([q.text for q in queries], model.dim)
         x, _ = text_ops.tfidf_transform(counts, model.payload["idf"])
-        probs = np.asarray(lr_ops.logreg_predict_proba(model.payload["w"], model.payload["b"], x))
+        # pow2-bucket the batch dim (see TextMLPAlgorithm.batch_predict)
+        from predictionio_tpu.ops.als import bucket_width
+        bp = bucket_width(len(x), min_width=1)
+        if bp != len(x):
+            x = np.concatenate([x, np.repeat(x[-1:], bp - len(x), axis=0)])
+        probs = np.asarray(lr_ops.logreg_predict_proba(
+            model.payload["w"], model.payload["b"], x))[:len(queries)]
         out = []
         for row in probs:
             j = int(np.argmax(row))
@@ -240,6 +249,7 @@ class TextMLPParams(Params):
 
 class TextMLPAlgorithm(Algorithm):
     params_class = TextMLPParams
+    serving_batchable = True   # batch_predict reads only model state
 
     def train(self, td: TextTrainingData) -> TextModel:
         p = self.params
@@ -261,7 +271,16 @@ class TextMLPAlgorithm(Algorithm):
         ids, mask = text_ops.tokens_to_ids(
             [q.text for q in queries], model.dim, model.payload["max_len"]
         )
-        logits = np.asarray(text_ops.mlp_predict_logits(model.payload["params"], ids, mask))
+        # pow2-bucket the batch dim: serving batch sizes fluctuate and an
+        # unbucketed leading dim would retrace per distinct size
+        from predictionio_tpu.ops.als import bucket_width
+        bp = bucket_width(len(queries), min_width=1)
+        if bp != len(queries):
+            pad = bp - len(queries)
+            ids = np.concatenate([ids, np.repeat(ids[-1:], pad, axis=0)])
+            mask = np.concatenate([mask, np.repeat(mask[-1:], pad, axis=0)])
+        logits = np.asarray(text_ops.mlp_predict_logits(
+            model.payload["params"], ids, mask))[:len(queries)]
         out = []
         for row in logits:
             probs = _softmax(row)

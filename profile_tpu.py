@@ -167,6 +167,48 @@ def main():
     print(f"=> {total / wall:,.0f} events/s  "
           f"(vs_baseline {total / wall / 200_000:.2f}, target >= 20)")
 
+    # 7. THE ROUND-4 DECISION: lax vs pallas tiled merge (run on hardware
+    # before flipping topk_impl()'s auto — round 3 measured the lax merge
+    # at 78% of tiled device time; the kernel models ~10× on that stage).
+    # Shapes mirror the round-3 ablation: [100k rows, 4096-wide tiles].
+    from predictionio_tpu.ops.pallas_kernels import tile_topk_desc
+    from predictionio_tpu.ops.topk import block_width, merge_desc
+
+    rows, tile_w, k = min(n_users, 100_000), 4096, 50
+    b = block_width(k)
+    rng = np.random.default_rng(0)
+    tile_scores = jnp.asarray(
+        rng.standard_normal((rows, tile_w)).astype(np.float32))
+    sync(tile_scores)
+
+    @jax.jit
+    def merge_lax(bs, bi, ts):
+        idx = jnp.broadcast_to(
+            jnp.arange(tile_w, dtype=jnp.int32)[None, :], ts.shape)
+        s, pos = jax.lax.top_k(jnp.concatenate([bs, ts], axis=1), k)
+        ai = jnp.concatenate([bi, idx], axis=1)
+        return s, jnp.take_along_axis(ai, pos, axis=1)
+
+    @jax.jit
+    def merge_pallas(bs, bi, ts):
+        s, i = tile_topk_desc(ts, b)
+        return merge_desc(bs, bi, s, i)
+
+    bs_l = jnp.full((rows, k), -jnp.inf); bi_l = jnp.zeros((rows, k), jnp.int32)
+    bs_p = jnp.full((rows, b), -jnp.inf); bi_p = jnp.zeros((rows, b), jnp.int32)
+    tl = t(f"tile merge LAX      [{rows}, {tile_w}]", lambda: sync(
+        merge_lax(bs_l, bi_l, tile_scores)))
+    # compile the kernel separately first so a compile blowup is visible
+    # (and killable) in isolation — NEVER timeout-kill this process
+    t0 = time.perf_counter()
+    out = merge_pallas(bs_p, bi_p, tile_scores)
+    sync(out)
+    print(f"  pallas merge compile+first-run: {time.perf_counter()-t0:.1f}s")
+    tp = t(f"tile merge PALLAS   [{rows}, {tile_w}]", lambda: sync(
+        merge_pallas(bs_p, bi_p, tile_scores)))
+    print(f"=> merge speedup {tl / tp:.2f}x  "
+          f"({'FLIP topk_impl auto to pallas-on-tpu' if tp < tl else 'keep lax'})")
+
 
 if __name__ == "__main__":
     main()
