@@ -141,13 +141,7 @@ class Engine(BaseEngine):
         Reference: CreateServer's ServerActor closing over (engine, models);
         each query runs every algorithm's predict then serving.serve.
         """
-        algorithms, serving = self._serving_components(engine_params, models)
-
-        def predict(query: Any) -> Any:
-            preds = [algo.predict(model, query) for algo, model in zip(algorithms, models)]
-            return serving.serve(query, preds)
-
-        return predict
+        return self.serving_bundle(engine_params, models)[0]
 
     def _serving_components(self, engine_params: EngineParams,
                             models: Sequence[Any]):
@@ -179,28 +173,54 @@ class Engine(BaseEngine):
         what lets a single chip serve concurrent load (see
         create_server's micro-batching).  Serving still runs per query.
 
-        Engages only when every algorithm declares ``serving_batchable``
-        (batch_predict must read the same state as predict; some
-        overrides are eval-only).
+        Engages when every algorithm offers a serving-correct batch path:
+        either an explicit ``serve_batch_predict`` (UR — its plain
+        batch_predict is eval-only semantics) or ``serving_batchable``
+        marking batch_predict itself as deploy-safe.
         """
+        return self.serving_bundle(engine_params, models)[1]
+
+    def serving_bundle(
+        self, engine_params: EngineParams, models: Sequence[Any]
+    ) -> Tuple[Callable[[Any], Any],
+               Optional[Callable[[Sequence[Any]], List[Any]]]]:
+        """(predict, predict_batch-or-None) built from ONE component
+        construction + warm pass — deploy/hot-reload should call this
+        rather than predictor()+batch_predictor(), which would build and
+        warm everything twice."""
         algorithms, serving = self._serving_components(engine_params, models)
-        if not all(getattr(a, "serving_batchable", False) for a in algorithms):
+
+        def predict(query: Any) -> Any:
+            preds = [algo.predict(model, query)
+                     for algo, model in zip(algorithms, models)]
+            return serving.serve(query, preds)
+
+        def batch_fn(algo):
+            fn = getattr(algo, "serve_batch_predict", None)
+            if fn is not None:
+                return fn
+            if getattr(algo, "serving_batchable", False):
+                return algo.batch_predict
             return None
+
+        fns = [batch_fn(a) for a in algorithms]
+        if any(f is None for f in fns):
+            return predict, None
 
         def predict_batch(queries: Sequence[Any]) -> List[Any]:
             per_algo = []
-            for algo, model in zip(algorithms, models):
-                col = algo.batch_predict(model, queries)
+            for fn, algo, model in zip(fns, algorithms, models):
+                col = fn(model, queries)
                 if len(col) != len(queries):
                     raise RuntimeError(
-                        f"{type(algo).__name__}.batch_predict returned "
-                        f"{len(col)} results for {len(queries)} queries — "
-                        "serving batch_predict must be 1:1")
+                        f"{type(algo).__name__}'s serving batch path "
+                        f"returned {len(col)} results for {len(queries)} "
+                        "queries — it must be 1:1")
                 per_algo.append(col)
             return [serving.serve(q, [col[i] for col in per_algo])
                     for i, q in enumerate(queries)]
 
-        return predict_batch
+        return predict, predict_batch
 
     # -- params binding (engine.json) ----------------------------------------
 

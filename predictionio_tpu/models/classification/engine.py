@@ -159,16 +159,7 @@ class LogRegParams(Params):
     mesh_dp: int = 0
 
 
-def _pad_batch(x: np.ndarray) -> np.ndarray:
-    """Pad the batch dim to a power-of-two bucket (repeat the last row):
-    serving batch sizes fluctuate with load, and an unbucketed leading
-    dim would retrace the jitted predict per distinct size."""
-    from predictionio_tpu.ops.als import bucket_width
-
-    b = bucket_width(len(x), min_width=1)
-    if b == len(x):
-        return x
-    return np.concatenate([x, np.repeat(x[-1:], b - len(x), axis=0)])
+from predictionio_tpu.models.common import pad_batch_rows as _pad_batch
 
 
 class LogisticRegressionAlgorithm(Algorithm):

@@ -56,6 +56,19 @@ class CategoryRulesMixin(DeviceCacheMixin):
         return self._device("_cat_dev", build)
 
 
+def pad_batch_rows(x: np.ndarray) -> np.ndarray:
+    """Pad a [B, ...] batch to a power-of-two row count (repeating the
+    last row): serving micro-batch sizes fluctuate with load, and an
+    unbucketed leading dim would retrace the jitted predict per distinct
+    size.  Callers slice results back to the true batch length."""
+    from predictionio_tpu.ops.als import bucket_width
+
+    b = bucket_width(len(x), min_width=1)
+    if b == len(x):
+        return x
+    return np.concatenate([x, np.repeat(x[-1:], b - len(x), axis=0)])
+
+
 def reindex_interactions(batch, return_rows=False):
     """Compact (user, item) interaction encoding from a columnar batch.
 

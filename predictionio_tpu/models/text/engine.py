@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from predictionio_tpu.models.common import pad_batch_rows
 from predictionio_tpu.controller import (
     Algorithm,
     DataSource,
@@ -222,11 +223,7 @@ class TextLogRegAlgorithm(Algorithm):
             return []
         counts = text_ops.hashing_vectorize([q.text for q in queries], model.dim)
         x, _ = text_ops.tfidf_transform(counts, model.payload["idf"])
-        # pow2-bucket the batch dim (see TextMLPAlgorithm.batch_predict)
-        from predictionio_tpu.ops.als import bucket_width
-        bp = bucket_width(len(x), min_width=1)
-        if bp != len(x):
-            x = np.concatenate([x, np.repeat(x[-1:], bp - len(x), axis=0)])
+        x = pad_batch_rows(x)   # pow2-bucket the batch dim (no retrace/size)
         probs = np.asarray(lr_ops.logreg_predict_proba(
             model.payload["w"], model.payload["b"], x))[:len(queries)]
         out = []
@@ -271,14 +268,8 @@ class TextMLPAlgorithm(Algorithm):
         ids, mask = text_ops.tokens_to_ids(
             [q.text for q in queries], model.dim, model.payload["max_len"]
         )
-        # pow2-bucket the batch dim: serving batch sizes fluctuate and an
-        # unbucketed leading dim would retrace per distinct size
-        from predictionio_tpu.ops.als import bucket_width
-        bp = bucket_width(len(queries), min_width=1)
-        if bp != len(queries):
-            pad = bp - len(queries)
-            ids = np.concatenate([ids, np.repeat(ids[-1:], pad, axis=0)])
-            mask = np.concatenate([mask, np.repeat(mask[-1:], pad, axis=0)])
+        ids = pad_batch_rows(ids)    # pow2-bucket the batch dim
+        mask = pad_batch_rows(mask)  # (no retrace per distinct size)
         logits = np.asarray(text_ops.mlp_predict_logits(
             model.payload["params"], ids, mask))[:len(queries)]
         out = []

@@ -598,6 +598,52 @@ class URModel(PersistentModel):
 
 
 @partial(jax.jit, static_argnames=("n_items_t",))
+@partial(jax.jit, static_argnames=("n_items_t",))
+def _indicator_score_ids_batch(
+    idx: jnp.ndarray,       # [I_p, K] device-resident indicator table
+    llr: jnp.ndarray,       # [I_p, K] LLR strengths
+    hist_ids: jnp.ndarray,  # [B, W] per-query history ids, -1 padding
+    use_llr: jnp.ndarray,
+    n_items_t: int,
+) -> jnp.ndarray:           # [B, I_p]
+    """Batched _indicator_score_ids: one device program scores a whole
+    micro-batch's histories against the resident indicator table (rows
+    whose history is all -1 padding score 0 everywhere, so event types
+    missing for some queries need no host-side regrouping)."""
+    h_valid = hist_ids >= 0
+    b = hist_ids.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    hvec = jnp.zeros((b, n_items_t), jnp.float32).at[
+        rows, jnp.where(h_valid, hist_ids, 0)
+    ].max(h_valid.astype(jnp.float32))
+    valid = idx >= 0
+    matched = hvec[:, jnp.where(valid, idx, 0)] * valid    # [B, I_p, K]
+    w = jnp.where(use_llr, jnp.where(valid, llr, 0.0), 1.0)
+    return (matched * w).sum(-1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _serve_topk_batch(signal, mask, bf, black_ids, k: int):
+    """Batched _serve_topk: both top-ks for B queries in one program, ONE
+    [B, 4, k] readback for the whole micro-batch — behind a tunneled
+    accelerator that is one ~70 ms round trip amortized over B queries
+    instead of B of them."""
+    check_f32_id_range(signal.shape[1])
+    b = signal.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    valid = black_ids >= 0
+    excl = jnp.zeros_like(signal).at[
+        rows, jnp.where(valid, black_ids, 0)
+    ].max(valid.astype(signal.dtype))
+    s = jnp.where(excl > 0, -jnp.inf, signal * mask)
+    st, si = jax.lax.top_k(s, k)
+    bfm = jnp.where((mask > 0) & (excl <= 0), bf[None, :] * mask, -jnp.inf)
+    bt, bi = jax.lax.top_k(bfm, k)
+    return jnp.stack(
+        [st, si.astype(jnp.float32), bt, bi.astype(jnp.float32)], axis=1)
+
+
+@partial(jax.jit, static_argnames=("n_items_t",))
 def _indicator_score_ids(
     idx: jnp.ndarray,       # [I_p, K] device-resident indicator table
     llr: jnp.ndarray,       # [I_p, K] LLR strengths
@@ -974,30 +1020,8 @@ class URAlgorithm(Algorithm):
         n_items = len(model.item_dict)
         if n_items == 0:
             return URResult([])
-        signal = None
-        set_ids = [model.item_dict.id(i) for i in query.item_set]
-        set_ids = [i for i in set_ids if i is not None]
-        if query.item is not None or set_ids:
-            # item-similarity / itemSet (cart): the query items' OWN
-            # indicator lists act as a virtual history on each event type's
-            # field (reference URAlgorithm getBiasedSimilarItems / itemSet
-            # queries building the ES query from item-document indicators)
-            if query.item is not None:
-                iid = model.item_dict.id(query.item)
-                if iid is not None:
-                    set_ids.append(iid)
-            if set_ids:
-                hist: Dict[str, np.ndarray] = {}
-                for name, idx in model.indicator_idx.items():
-                    rows = idx[np.asarray(set_ids, np.int32)]
-                    ids = np.unique(rows[rows >= 0])
-                    if len(ids):
-                        hist[name] = ids.astype(np.int32)
-                signal = self._score_history(model, hist)
-        elif query.user is not None:
-            hist = (hist_override if hist_override is not None
-                    else self._user_history(model, query.user))
-            signal = self._score_history(model, hist)
+        hist = self._query_hist(model, query, hist_override)
+        signal = self._score_history(model, hist) if hist is not None else None
         have_signal = signal is not None
         if signal is None:
             signal = model.device_zeros()
@@ -1010,8 +1034,45 @@ class URAlgorithm(Algorithm):
         out = np.asarray(_serve_topk(
             signal, mask, model.device_popularity(),
             jnp.asarray(als_pad_ids(black_ids)), k))  # ONE [4, k] readback
-        st, si = out[0], out[1].astype(np.int32)
-        bt, bi = out[2], out[3].astype(np.int32)
+        return self._assemble(model, num, have_signal,
+                              out[0], out[1].astype(np.int32),
+                              out[2], out[3].astype(np.int32))
+
+    def _query_hist(self, model: URModel, query: URQuery,
+                    hist_override: Optional[Dict[str, np.ndarray]] = None,
+                    ) -> Optional[Dict[str, np.ndarray]]:
+        """Per-event-type history ids driving the signal, or None when the
+        query carries no personalization handle (pure backfill)."""
+        set_ids = [model.item_dict.id(i) for i in query.item_set]
+        set_ids = [i for i in set_ids if i is not None]
+        if query.item is not None or set_ids:
+            # item-similarity / itemSet (cart): the query items' OWN
+            # indicator lists act as a virtual history on each event type's
+            # field (reference URAlgorithm getBiasedSimilarItems / itemSet
+            # queries building the ES query from item-document indicators)
+            if query.item is not None:
+                iid = model.item_dict.id(query.item)
+                if iid is not None:
+                    set_ids.append(iid)
+            if not set_ids:
+                return None
+            hist: Dict[str, np.ndarray] = {}
+            for name, idx in model.indicator_idx.items():
+                rows = idx[np.asarray(set_ids, np.int32)]
+                ids = np.unique(rows[rows >= 0])
+                if len(ids):
+                    hist[name] = ids.astype(np.int32)
+            return hist
+        if query.user is not None:
+            return (hist_override if hist_override is not None
+                    else self._user_history(model, query.user))
+        return None
+
+    def _assemble(self, model: URModel, num: int, have_signal: bool,
+                  st, si, bt, bi) -> URResult:
+        """Host tail shared by predict and serve_batch_predict: signal
+        picks first, then popularity backfill PADS short lists up to num
+        (reference UR appends popRank-ordered items)."""
         results: List[ItemScore] = []
         chosen = set()
         if have_signal:
@@ -1019,8 +1080,6 @@ class URAlgorithm(Algorithm):
                 if np.isfinite(s) and s > 0 and len(results) < num:
                     results.append(ItemScore(model.item_dict.str(int(j)), float(s)))
                     chosen.add(int(j))
-        # backfill: fills the whole list when there is no signal, and PADS
-        # short lists up to num (reference UR appends popRank-ordered items)
         if len(results) < num and self.params.backfill_type != "none":
             norm = model.pop_norm()
             for s, j in zip(bt, bi):
@@ -1030,6 +1089,63 @@ class URAlgorithm(Algorithm):
                     continue
                 results.append(ItemScore(model.item_dict.str(int(j)), float(s) / norm))
         return URResult(results)
+
+    def serve_batch_predict(self, model: URModel,
+                            queries: Sequence[URQuery]) -> List[URResult]:
+        """Deploy-time micro-batch scoring: every query's history scores
+        against the resident indicator tables in ONE device program per
+        event type, and both top-ks for the whole batch come back in ONE
+        [B, 4, k] readback (vs 1 readback per query serially — the
+        difference between 70 ms and 70/B ms per query on a tunneled
+        chip).  Live-store semantics identical to predict(); the separate
+        eval-only batch_predict (model-history, anti-leakage) is
+        untouched.
+        """
+        n_items = len(model.item_dict)
+        if not queries or n_items == 0:
+            return [URResult([]) for _ in queries]
+        b = len(queries)
+        bp = bucket_width(b, min_width=1)
+        hists = [self._query_hist(model, q) for q in queries]
+        have_signal = [h is not None and any(len(v) for v in h.values())
+                       for h in hists]
+        use_llr = jnp.asarray(self.params.use_llr_weights)
+        total = None
+        for name, (idx_dev, llr_dev) in model.device_indicators().items():
+            lens = [len(h[name]) if h and name in h else 0 for h in hists]
+            if not any(lens):
+                continue
+            w = bucket_width(max(lens))
+            hm = np.full((bp, w), -1, np.int32)
+            for r, h in enumerate(hists):
+                if h and name in h and len(h[name]):
+                    hm[r, : len(h[name])] = h[name]
+            n_t = max(len(model.event_item_dicts[name]), 1)
+            s = _indicator_score_ids_batch(
+                idx_dev, llr_dev, jnp.asarray(hm), use_llr, n_t)
+            weight = float(self.params.indicator_weights.get(name, 1.0))
+            s = s * weight if weight != 1.0 else s
+            total = s if total is None else total + s
+        if total is None:
+            total = jnp.zeros((bp, n_items), jnp.float32)
+        masks = jnp.stack(
+            [self._device_mask(model, q) for q in queries]
+            + [model.device_zeros()] * (bp - b))
+        blacks = [self._blacklist_ids(model, q) for q in queries]
+        wb = bucket_width(max((len(x) for x in blacks), default=1))
+        bm = np.full((bp, wb), -1, np.int32)
+        for r, ids in enumerate(blacks):
+            bm[r, : len(ids)] = ids
+        nums = [min(q.num, n_items) for q in queries]
+        k = min(bucket_width(2 * max(nums), 16), n_items)
+        out = np.asarray(_serve_topk_batch(
+            total, masks, model.device_popularity(), jnp.asarray(bm), k))
+        return [
+            self._assemble(model, nums[r], have_signal[r],
+                           out[r, 0], out[r, 1].astype(np.int32),
+                           out[r, 2], out[r, 3].astype(np.int32))
+            for r in range(b)
+        ]
 
     def _blacklist_ids(self, model: URModel, query: URQuery) -> List[int]:
         """Item ids to exclude: the user's seen items under every configured

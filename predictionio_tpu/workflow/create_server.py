@@ -76,36 +76,66 @@ class _MicroBatcher:
         item = {"q": query, "ev": threading.Event()}
         with self._lock:
             self._queue.append(item)
-            am_leader = not self._leader_active
-            if am_leader:
+            lead = not self._leader_active
+            if lead:
                 self._leader_active = True
-        if am_leader:
-            while True:
-                with self._lock:
-                    batch = self._queue[: self._max]
-                    del self._queue[: self._max]
-                    if not batch:
-                        self._leader_active = False
-                        break
-                try:
-                    results = self._run([i["q"] for i in batch])
-                    for i, r in zip(batch, results):
-                        i["r"] = r
-                except Exception:
-                    # one poisoned query must not 500 its batchmates:
-                    # re-run the batch serially so only the offender errors
-                    for i in batch:
-                        try:
-                            i["r"] = self._run_one(i["q"])
-                        except Exception as e:
-                            i["e"] = e
-                for i in batch:
-                    i["ev"].set()
-        if not item["ev"].wait(timeout=60.0):
-            raise TimeoutError("micro-batch leader never completed")
+        while True:
+            if lead:
+                self._lead_until_served(item)
+            # generous bound: a fresh shape bucket on TPU can compile for
+            # minutes; only a genuinely dead leader should trip this
+            if not item["ev"].wait(timeout=600.0):
+                raise TimeoutError(
+                    "micro-batch not served within 600 s (leader died?)")
+            if item.pop("lead", False) and "r" not in item and "e" not in item:
+                # a finishing leader promoted us: drain until our own
+                # result lands, then hand off again
+                item["ev"].clear()
+                lead = True
+                continue
+            break
         if "e" in item:
             raise item["e"]
         return item["r"]
+
+    def _lead_until_served(self, own: dict) -> None:
+        """Run batches until ``own`` is served, then hand leadership to a
+        queued waiter (or release it).  Draining until the queue empties
+        would starve the leader's own client under sustained load —
+        leadership rotates instead, so every request is served after at
+        most a few batches."""
+        while True:
+            with self._lock:
+                batch = self._queue[: self._max]
+                del self._queue[: self._max]
+                if not batch:
+                    self._leader_active = False
+                    return
+            try:
+                results = self._run([i["q"] for i in batch])
+                for i, r in zip(batch, results):
+                    i["r"] = r
+            except Exception:
+                # one poisoned query must not 500 its batchmates:
+                # re-run the batch serially so only the offender errors
+                for i in batch:
+                    try:
+                        i["r"] = self._run_one(i["q"])
+                    except Exception as e:
+                        i["e"] = e
+            served_self = own in batch
+            if served_self:
+                with self._lock:
+                    nxt = self._queue[0] if self._queue else None
+                    if nxt is None:
+                        self._leader_active = False
+                if nxt is not None:
+                    nxt["lead"] = True       # leadership transfers with it
+                    nxt["ev"].set()
+            for i in batch:
+                i["ev"].set()
+            if served_self:
+                return
 
 
 class QueryServerState:
@@ -193,24 +223,22 @@ class QueryServerState:
             instance, models = core_workflow.load_latest_models(
                 self.engine_id, self.engine_version, self.engine_variant, self.storage
             )
-            self.predictor = self.engine.predictor(self.engine_params, models)
             # Micro-batch concurrent queries when every algorithm supports
-            # serving-safe batch_predict.  PIO_SERVE_BATCH: on | off |
+            # serving-safe batch prediction.  PIO_SERVE_BATCH: on | off |
             # auto (default).  Auto engages only on an accelerator
             # backend: there a batch amortizes the per-dispatch/readback
             # overhead that dominates concurrent serving (~70 ms/readback
             # behind the axon tunnel), while on CPU the scoring math is so
             # cheap that the batcher's coordination measurably LOSES
             # (2.4k → 0.4k q/s at 32 clients — see PERF.md round 4).
-            self.batcher = None
             conf = os.environ.get("PIO_SERVE_BATCH", "auto").lower()
             enable = (conf in ("1", "on", "true")
                       or (conf == "auto"
                           and jax.default_backend() not in ("cpu",)))
-            if enable:
-                bp = self.engine.batch_predictor(self.engine_params, models)
-                if bp is not None:
-                    self.batcher = _MicroBatcher(bp, self.predictor)
+            self.predictor, bp = self.engine.serving_bundle(
+                self.engine_params, models)
+            self.batcher = (_MicroBatcher(bp, self.predictor)
+                            if enable and bp is not None else None)
             self.instance = instance
             return instance.id
 

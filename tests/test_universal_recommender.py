@@ -731,3 +731,36 @@ def test_rank_metrics_family():
     assert p2.header() == "Precision@2"
     # rank 0 counts, rank 2 does not, miss does not -> (1/2) / 3
     assert abs(p2.calculate(data) - (0.5) / 3) < 1e-9
+
+
+def test_ur_serve_batch_matches_serial(ur_app):
+    """serve_batch_predict ≡ predict across every query shape in one
+    batch: user, cold user, item-similarity, itemSet, business rules,
+    blacklist — live-store semantics, one batched readback."""
+    from predictionio_tpu.models.universal_recommender.engine import (
+        FieldRule,
+        URAlgorithm,
+    )
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep(min_llr=0.0)
+    models = engine.train(ep)
+    model = models[0]
+    algo = URAlgorithm(dict(ep.algorithm_params_list)["ur"])
+    queries = [
+        URQuery(user="u2", num=5),
+        URQuery(user="cold-user", num=4),
+        URQuery(item="e1", num=4),
+        URQuery(item_set=["e0", "e2"], num=6),
+        URQuery(user="u20", num=5,
+                fields=[FieldRule(name="category", values=["books"], bias=-1)]),
+        URQuery(user="u3", num=3, blacklist_items=["e0", "e1"]),
+        URQuery(user="u21", num=7),
+    ]
+    serial = [algo.predict(model, q) for q in queries]
+    batched = algo.serve_batch_predict(model, queries)
+    assert len(batched) == len(queries)
+    for q, s, b in zip(queries, serial, batched):
+        s_items = [(r.item, round(r.score, 4)) for r in s.item_scores]
+        b_items = [(r.item, round(r.score, 4)) for r in b.item_scores]
+        assert s_items == b_items, (q, s_items, b_items)
