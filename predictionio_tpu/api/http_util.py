@@ -198,9 +198,26 @@ class JsonHandler(socketserver.StreamRequestHandler):
 
 
 def start_server(
-    handler_cls, host: str, port: int, background: bool = False
+    handler_cls, host: str, port: int, background: bool = False,
+    reuse_port: bool = False,
 ) -> ThreadingHTTPServer:
-    httpd = ThreadingHTTPServer((host, port), handler_cls)
+    """``reuse_port`` binds with SO_REUSEPORT so several OS processes can
+    serve one port (the prefork `pio deploy --workers N` path: the kernel
+    load-balances accepts across workers — the CPython-GIL answer to
+    multi-core serving, where the reference scaled by adding spray
+    nodes behind a balancer)."""
+    if reuse_port:
+        import socket
+
+        class _ReusePortServer(ThreadingHTTPServer):
+            def server_bind(self):
+                self.socket.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                super().server_bind()
+
+        httpd = _ReusePortServer((host, port), handler_cls)
+    else:
+        httpd = ThreadingHTTPServer((host, port), handler_cls)
     if background:
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
         t.start()

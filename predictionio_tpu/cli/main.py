@@ -327,25 +327,40 @@ def _cmd_deploy(args) -> int:
 
 def _cmd_undeploy(args) -> int:
     """Stop a deployed query server (reference Console.undeploy: contacts
-    the running server rather than killing a pid)."""
+    the running server rather than killing a pid).
+
+    With `deploy --workers N` several processes share the port via
+    SO_REUSEPORT and the kernel routes each /stop to ONE of them; the
+    parent tears its children down when it stops, but /stop may land on
+    a CHILD first — so keep stopping until nothing answers."""
+    import time as _time
     import urllib.error
     import urllib.request
 
     url = f"http://{args.ip}:{args.port}/stop"
-    try:
-        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
-            resp.read()
-        print(f"Undeployed {args.ip}:{args.port}.")
-        return 0
-    except urllib.error.HTTPError as e:
-        # something IS listening but refused /stop (e.g. the event server):
-        # distinguish from "nothing deployed" so the user checks the port
-        print(f"Server at {args.ip}:{args.port} rejected /stop "
-              f"(HTTP {e.code}) — is this a query server?")
-        return 1
-    except urllib.error.URLError as e:
-        print(f"No deployment reachable at {args.ip}:{args.port}: {e.reason}")
-        return 1
+    stopped = 0
+    for _ in range(34):   # bound: far above any sane --workers count
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                resp.read()
+            stopped += 1
+            _time.sleep(0.3)   # let the listener actually close
+        except urllib.error.HTTPError as e:
+            # something IS listening but refused /stop (e.g. the event
+            # server): distinguish from "nothing deployed"
+            print(f"Server at {args.ip}:{args.port} rejected /stop "
+                  f"(HTTP {e.code}) — is this a query server?")
+            return 1
+        except urllib.error.URLError as e:
+            if stopped:
+                extra = f" ({stopped} listener(s) stopped)" if stopped > 1 else ""
+                print(f"Undeployed {args.ip}:{args.port}.{extra}")
+                return 0
+            print(f"No deployment reachable at {args.ip}:{args.port}: {e.reason}")
+            return 1
+    print(f"Undeployed {args.ip}:{args.port} ({stopped} listeners stopped; "
+          "more may remain)")
+    return 0
 
 
 def _cmd_eval(args) -> int:
@@ -472,6 +487,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poll EngineInstances every SECS seconds and "
                          "hot-swap when a retrain completes (reference "
                          "MasterActor behavior); 0 disables")
+    dp.add_argument("--workers", type=int, default=1,
+                    help="prefork N processes all serving this port via "
+                         "SO_REUSEPORT (CPU backends: scales query "
+                         "throughput past the per-process GIL)")
+    dp.add_argument("--reuse-port", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: prefork child
     dp.set_defaults(func=_cmd_deploy)
 
     ud = sub.add_parser("undeploy")

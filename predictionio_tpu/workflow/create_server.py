@@ -19,6 +19,7 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
+import os
 import sys
 import threading
 import uuid
@@ -215,8 +216,6 @@ class QueryServerState:
         self._auto_stop.set()
 
     def reload(self) -> str:
-        import os
-
         import jax
 
         with self._lock:
@@ -373,6 +372,24 @@ def make_handler(state: QueryServerState):
     return QueryHandler
 
 
+def _watch_parent_process() -> None:
+    """Prefork child: exit when the spawning parent is gone (reparented),
+    so a killed/crashed parent never strands orphan workers on the port."""
+    parent = os.getppid()
+
+    def watch():
+        import time as _time
+
+        while True:
+            _time.sleep(2.0)
+            if os.getppid() != parent:
+                log.info("prefork worker: parent gone; exiting")
+                os._exit(0)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="pio-parent-watch").start()
+
+
 def deploy(
     engine_json: str = "engine.json",
     variant: str = "default",
@@ -385,8 +402,44 @@ def deploy(
     background: bool = False,
     plugins=None,
     auto_reload: float = 0.0,
+    workers: int = 1,
+    reuse_port: bool = False,
 ):
-    """Programmatic deploy; returns the HTTPServer (background=True) or blocks."""
+    """Programmatic deploy; returns the HTTPServer (background=True) or blocks.
+
+    ``workers > 1`` preforks N−1 extra OS processes all serving the SAME
+    port via SO_REUSEPORT (the kernel load-balances accepts): CPython's
+    GIL caps one process at roughly single-core query throughput, so
+    CPU-backend deployments scale across cores this way — the analogue of
+    the reference running several spray nodes behind a balancer.  Only
+    meaningful on CPU backends: a TPU chip is single-process-exclusive,
+    so workers>1 on a TPU backend raises.  Workers resolve storage from
+    the PIO_STORAGE_* environment (a programmatic ``storage`` object
+    cannot cross the process boundary).
+
+    A manual GET /reload reaches only the ONE worker the kernel routes
+    it to — pair --workers with --auto-reload so every worker converges
+    on a retrained instance within the polling interval.  `pio undeploy`
+    handles the multi-listener teardown (it stops until the port stops
+    answering).
+    """
+    # cheap preconditions FIRST: raising after QueryServerState exists
+    # would leak its auto-reload poller and started plugins
+    if workers > 1:
+        import jax
+
+        if jax.default_backend() not in ("cpu",):
+            raise ValueError(
+                "deploy --workers requires a CPU backend: an accelerator "
+                "chip is single-process-exclusive (scale TPU serving with "
+                "micro-batching or more chips, not prefork workers)")
+        if storage is not None:
+            raise ValueError(
+                "deploy --workers resolves storage from PIO_STORAGE_* env "
+                "in each worker; a programmatic storage object cannot "
+                "cross the process boundary")
+    if reuse_port and workers == 1:
+        _watch_parent_process()   # prefork child: die when orphaned
     doc = load_engine_variant(engine_json, variant)
     factory, engine, engine_params = engine_from_variant(doc)
     eid = resolve_engine_id(engine_id, doc, factory)
@@ -400,16 +453,60 @@ def deploy(
         storage=storage, feedback=feedback, feedback_app_name=feedback_app,
         plugins=plugins, auto_reload=auto_reload,
     )
-    httpd = start_server(make_handler(state), host, port, background=background)
-    log.info("Query server for %s listening on %s:%d", eid, host, httpd.server_address[1])
+    child_procs: list = []
+    httpd = start_server(make_handler(state), host, port,
+                         background=background,
+                         reuse_port=workers > 1 or reuse_port)
+    bound_port = httpd.server_address[1]
+    if workers > 1:
+        import subprocess
+
+        cores = os.cpu_count() or 1
+        if workers > cores:
+            log.warning(
+                "deploy --workers %d exceeds %d CPU core(s): extra "
+                "workers contend instead of scaling", workers, cores)
+        for w in range(workers - 1):
+            child_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.cli.main",
+                 "deploy", "--engine-json", str(engine_json),
+                 "--variant", variant,
+                 "--engine-version", engine_version,
+                 "--ip", host, "--port", str(bound_port), "--reuse-port"]
+                + (["--engine-id", engine_id] if engine_id else [])
+                + (["--feedback"] if feedback else [])
+                + (["--auto-reload", str(auto_reload)] if auto_reload else []),
+            ))
+        # surface child exits (a worker that dies at startup — bad env,
+        # bind failure — would otherwise silently leave the port at 1/N
+        # capacity); the reaper also wait()s so no zombies accumulate
+        def _reap(p, idx):
+            rc = p.wait()
+            if rc not in (0, -15):   # -15: our own terminate()
+                log.warning("prefork worker %d exited with code %s", idx, rc)
+
+        for idx, p in enumerate(child_procs):
+            threading.Thread(target=_reap, args=(p, idx), daemon=True).start()
+        log.info("prefork: %d extra worker process(es) on port %d",
+                 workers - 1, bound_port)
+    log.info("Query server for %s listening on %s:%d", eid, host, bound_port)
     httpd.pio_state = state  # handle for tests/tools
-    # the auto-reload poller must die with the server, however it is shut
-    # down (shutdown()/server_close(), /stop, or pio undeploy) — a leaked
-    # poller would keep loading models into a dead state forever
+    httpd.pio_workers = child_procs
+    # the auto-reload poller (and any prefork workers) must die with the
+    # server, however it is shut down (shutdown()/server_close(), /stop,
+    # or pio undeploy)
     _orig_close = httpd.server_close
 
     def _close_and_stop_poller():
         state.stop_auto_reload()
+        for p in child_procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in child_procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
         _orig_close()
 
     httpd.server_close = _close_and_stop_poller
@@ -437,6 +534,8 @@ def run_server_from_args(args) -> int:
             port=args.port,
             feedback=args.feedback,
             auto_reload=getattr(args, "auto_reload", 0.0) or 0.0,
+            workers=getattr(args, "workers", 1) or 1,
+            reuse_port=getattr(args, "reuse_port", False),
         )
     except Exception as e:
         print(f"Error: {e}", file=sys.stderr)

@@ -477,3 +477,86 @@ def test_serve_micro_batching_matches_serial(tmp_path, mem_storage, monkeypatch)
         assert [r["item"] for r in s] == [r["item"] for r in b], (u, s, b)
         np.testing.assert_allclose([r["score"] for r in s],
                                    [r["score"] for r in b], rtol=2e-5)
+
+
+def test_prefork_workers_share_port_and_die_with_server(tmp_path, monkeypatch):
+    """deploy --workers: N processes bind one port via SO_REUSEPORT, all
+    answer queries, and children terminate when the parent closes.
+    (This VM has one core, so only lifecycle — not scaling — is
+    assertable here.)"""
+    import http.client
+    import time as _time
+
+    store = tmp_path / "store"
+    env_vars = {
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(store),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        "PIO_JAX_PLATFORM": "cpu",
+    }
+    for k, v in env_vars.items():
+        monkeypatch.setenv(k, v)
+    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
+    st = Storage(StorageConfig(
+        sources={"FS": {"type": "localfs", "path": str(store)}},
+        repositories={r: "FS" for r in ("METADATA", "EVENTDATA", "MODELDATA")}))
+    set_storage(st)
+    try:
+        app_id = st.apps.insert(App(0, "pfapp"))
+        rng = np.random.default_rng(5)
+        evs = []
+        for u in range(20):
+            for i in rng.integers(0, 30, 6):
+                evs.append(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(rng.integers(1, 6))})))
+        st.l_events.insert_batch(evs, app_id)
+        variant = {
+            "id": "pf-engine",
+            "engineFactory": "predictionio_tpu.models.recommendation.RecommendationEngine",
+            "datasource": {"params": {"appName": "pfapp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "numIterations": 2, "lambda": 0.05, "meshDp": 1}}],
+        }
+        ej = tmp_path / "engine.json"
+        ej.write_text(json.dumps(variant))
+        from predictionio_tpu.models.recommendation import RecommendationEngine
+        from predictionio_tpu.workflow import core_workflow
+        from predictionio_tpu.workflow.create_server import deploy
+
+        engine = RecommendationEngine.apply()
+        ep = engine.engine_params_from_variant(variant)
+        core_workflow.run_train(engine, ep, engine_id="pf-engine", storage=st)
+        httpd = deploy(engine_json=str(ej), host="127.0.0.1", port=0,
+                       background=True, workers=2)
+        try:
+            assert len(httpd.pio_workers) == 1
+            port = httpd.server_address[1]
+            deadline = _time.time() + 60
+            while (httpd.pio_workers[0].poll() is None
+                   and _time.time() < deadline):
+                # parent serves regardless; just confirm it answers while
+                # the child boots
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.request("POST", "/queries.json",
+                             json.dumps({"user": "u1", "num": 3}),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                assert r.status == 200
+                r.read()
+                conn.close()
+                _time.sleep(1.0)
+                # child came up and stayed: good enough
+                if _time.time() > deadline - 50:
+                    break
+            assert httpd.pio_workers[0].poll() is None, "child worker died"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        httpd.pio_workers[0].wait(timeout=10)
+        assert httpd.pio_workers[0].poll() is not None
+    finally:
+        set_storage(None)
