@@ -192,7 +192,13 @@ def make_handler(state: EventServerState):
                 return
             event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
             state.record(ak.app_id, event.event)
-            self.send_json({"eventId": event_id}, status=201)
+            if type(event_id) is str and event_id.isalnum():
+                # hand-built body: alnum ids (every server-generated id is
+                # hex) need no JSON escaping, and this is the single-event
+                # hot loop (~8 µs per dumps)
+                self._send_raw(201, b'{"eventId": "%s"}' % event_id.encode())
+            else:   # client-supplied exotic id: full encoder
+                self.send_json({"eventId": event_id}, status=201)
 
         def _insert_batch(self, ak, channel_id, body):
             if not isinstance(body, list):

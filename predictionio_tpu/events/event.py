@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import os as _os
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Mapping, Optional
@@ -112,12 +113,18 @@ class Event:
         self.event_time = parse_time(self.event_time)
         self.creation_time = parse_time(self.creation_time)
         if self.event_id is None:
-            self.event_id = uuid.uuid4().hex
+            # 128 random bits like uuid4().hex, minus the UUID object
+            # construction (~6 µs/event on the single-event ingest path)
+            self.event_id = _os.urandom(16).hex()
         self._validate()
 
     def _validate(self):
         if not self.event or not isinstance(self.event, str):
             raise ValueError("event must be a non-empty string")
+        # '' is preserved verbatim (batch fast-path parity contract);
+        # non-strings would crash the wire encoders downstream
+        if not isinstance(self.event_id, str):
+            raise ValueError("eventId must be a string")
         if not self.entity_type or self.entity_id is None or self.entity_id == "":
             raise ValueError("entityType and entityId must be non-empty")
         if self.event in SPECIAL_EVENTS:

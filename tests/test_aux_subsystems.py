@@ -215,15 +215,28 @@ def test_persistent_compilation_cache_config(tmp_path, monkeypatch):
 
         import jax.numpy as jnp
 
+        # jax pins the persistent-cache singleton to the dir in effect at
+        # the FIRST in-process compile; reset it so this test's dir takes
+        # (otherwise the test is order-sensitive: any earlier compile —
+        # e.g. a deploy test — pins the default dir and nothing lands
+        # here)
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+        # and a never-before-compiled program, so the in-memory executable
+        # cache can't satisfy it without touching disk
+        c = float(np.random.default_rng().uniform(2.0, 3.0))
+
         @jax.jit
         def f(x):
-            return (x @ x).sum()
+            return (x @ x * c).sum()
 
-        np.asarray(f(jnp.ones((64, 64))))
+        np.asarray(f(jnp.ones((63, 63))))
         assert len(os.listdir(loc)) >= 1
     finally:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", saved_min)
         jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()   # unpin our tmp dir for later tests
 
 
 def test_compilation_cache_off_switch(tmp_path, monkeypatch):
