@@ -268,11 +268,15 @@ def canonical_event_json(d: Mapping[str, Any]) -> Dict[str, Any]:
             raise ValueError("$unset requires a non-empty properties map")
     if event.startswith("$") and event not in SPECIAL_EVENTS:
         raise ValueError(f"unsupported reserved event verb {event!r}")
+    eid = d.get("eventId")
+    if eid is not None and not isinstance(eid, str):
+        # mirror _validate: a non-string id written to the log would crash
+        # Event.from_json on every subsequent read of that segment
+        raise ValueError("eventId must be a string")
     out: Dict[str, Any] = {
         # `is None` (not truthiness) to mirror Event.__post_init__ exactly:
         # a client-supplied empty-string eventId is preserved on both paths
-        "eventId": (d["eventId"] if d.get("eventId") is not None
-                    else uuid.uuid4().hex),
+        "eventId": eid if eid is not None else _os.urandom(16).hex(),
         "event": event,
         "entityType": entity_type,
         "entityId": str(entity_id),

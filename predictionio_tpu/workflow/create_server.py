@@ -231,9 +231,15 @@ class QueryServerState:
             # cheap that the batcher's coordination measurably LOSES
             # (2.4k → 0.4k q/s at 32 clients — see PERF.md round 4).
             conf = os.environ.get("PIO_SERVE_BATCH", "auto").lower()
-            enable = (conf in ("1", "on", "true")
-                      or (conf == "auto"
-                          and jax.default_backend() not in ("cpu",)))
+            enable = conf in ("1", "on", "true")
+            if not enable and conf == "auto":
+                # probe the backend ONLY for auto — "off" must never touch
+                # the accelerator (init can hang for minutes on a dead
+                # tunnel), and a broken backend must not kill deploy
+                try:
+                    enable = jax.default_backend() not in ("cpu",)
+                except RuntimeError:
+                    enable = False
             self.predictor, bp = self.engine.serving_bundle(
                 self.engine_params, models)
             self.batcher = (_MicroBatcher(bp, self.predictor)

@@ -196,6 +196,7 @@ def test_timed_tracer():
 
 def test_persistent_compilation_cache_config(tmp_path, monkeypatch):
     import jax
+    from jax._src import compilation_cache as _cc
 
     from predictionio_tpu.utils.config import enable_compilation_cache
 
@@ -220,8 +221,6 @@ def test_persistent_compilation_cache_config(tmp_path, monkeypatch):
         # (otherwise the test is order-sensitive: any earlier compile —
         # e.g. a deploy test — pins the default dir and nothing lands
         # here)
-        from jax._src import compilation_cache as _cc
-
         _cc.reset_cache()
         # and a never-before-compiled program, so the in-memory executable
         # cache can't satisfy it without touching disk
