@@ -19,7 +19,6 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import os as _os
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Mapping, Optional
 

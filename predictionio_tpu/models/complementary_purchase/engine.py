@@ -43,7 +43,7 @@ from predictionio_tpu.controller import (
 )
 from predictionio_tpu.models.common import CategoryRulesMixin
 from predictionio_tpu.models.recommendation.engine import ItemScore, PredictedResult
-from predictionio_tpu.models.similar_product.engine import _indicator_scatter_scores
+from predictionio_tpu.ops.als import indicator_scatter_scores as _indicator_scatter_scores
 from predictionio_tpu.ops import als as als_ops
 from predictionio_tpu.ops import cco as cco_ops
 from predictionio_tpu.store.columnar import IdDict
@@ -210,6 +210,44 @@ class CPAlgorithm(Algorithm):
             [ItemScore(model.item_dict.str(int(j)), float(s))
              for s, j in zip(st[:num], si[:num])
              if np.isfinite(s) and s > 0])
+
+    def serve_batch_predict(self, model: CPModel, queries):
+        """Micro-batch serving: every cart's rule aggregation + top-k in
+        ONE device program and one [B, 2, k] readback; empty/unresolvable
+        carts answer host-side like predict."""
+        n_items = len(model.item_dict)
+        results = [None] * len(queries)
+        live, carts = [], []
+        for qi, query in enumerate(queries):
+            cart = [model.item_dict.id(i) for i in query.items]
+            cart = [c for c in cart if c is not None]
+            if n_items == 0 or not cart:
+                results[qi] = PredictedResult([])
+            else:
+                live.append(qi)
+                carts.append(cart)
+        if not live:
+            return [r for r in results]
+        bp = als_ops.bucket_width(len(live), min_width=1)
+        qm = als_ops.pad_id_rows(carts + [[]] * (bp - len(live)))
+        idx_dev, lift_dev = model.tables_device()
+        scores = als_ops.indicator_scatter_scores_batch(
+            idx_dev, lift_dev, jnp.asarray(qm))
+        nums = [min(queries[i].num, n_items) for i in live]
+        k = min(als_ops.bucket_width(max(nums)), n_items)
+        none = np.full((bp, 16), -1, np.int32)
+        out = np.asarray(als_ops.scores_rules_topk_batch(
+            scores, model.cat_masks_device(), jnp.asarray(none),
+            jnp.asarray(none), jnp.asarray(qm), k))
+        for r, qi in enumerate(live):
+            st = out[r, 0]
+            si = out[r, 1].astype(np.int32)
+            n = nums[r]
+            results[qi] = PredictedResult(
+                [ItemScore(model.item_dict.str(int(j)), float(s))
+                 for s, j in zip(st[:n], si[:n])
+                 if np.isfinite(s) and s > 0])
+        return [r for r in results]
 
 
 class ComplementaryPurchaseEngine(EngineFactory):

@@ -35,6 +35,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.controller import (
@@ -285,6 +286,64 @@ class ECommAlgorithm(Algorithm):
             return self._scored(model, query, vec, exclude=recent)
         return self._popular(model, query)
 
+    def serve_batch_predict(self, model: ECommModel,
+                            queries) -> List[PredictedResult]:
+        """Micro-batch serving: tiers 1 and 2 (known-user factors /
+        recent-similar mean vectors) share one batched rules+top-k device
+        program and ONE [B, 2, k] readback; the rare popularity tier and
+        infeasible queries answer host-side exactly as predict does."""
+        results: List[Optional[PredictedResult]] = [None] * len(queries)
+        if len(model.item_factors) == 0:
+            return [PredictedResult([]) for _ in queries]
+        n_items = len(model.item_factors)
+        # query-independent live read: once per batch, not per query
+        unavailable = self._unavailable_ids(model)
+        live, vecs, rules, nums = [], [], [], []
+        for qi, query in enumerate(queries):
+            uid = model.user_dict.id(query.user)
+            if uid is not None and np.any(model.user_factors[uid]):
+                vec, exclude = np.asarray(
+                    model.user_factors[uid], np.float32), ()
+            else:
+                recent = self._recent_item_ids(model, query.user)
+                if len(recent):
+                    vec = np.asarray(
+                        model.item_factors[recent].mean(axis=0), np.float32)
+                    exclude = recent
+                else:
+                    results[qi] = self._popular(model, query)
+                    continue
+            cat_ids, white, excl, feasible = self._rule_ids(
+                model, query, extra_excl=exclude, unavailable=unavailable)
+            if not feasible:
+                results[qi] = PredictedResult([])
+                continue
+            live.append(qi)
+            vecs.append(vec)
+            rules.append((cat_ids, white, excl))
+            nums.append(min(query.num, n_items))
+        if not live:
+            return [r for r in results]
+        bp = als_ops.bucket_width(len(live), min_width=1)
+        pad_tail = [[]] * (bp - len(live))
+        v = np.zeros((bp, vecs[0].shape[0]), np.float32)
+        v[: len(live)] = np.stack(vecs)
+        k = min(als_ops.bucket_width(max(nums)), n_items)
+        out = np.asarray(als_ops.recommend_batch_rules(
+            jnp.asarray(v), model.item_factors_device(),
+            model.cat_masks_device(),
+            jnp.asarray(als_ops.pad_id_rows([r[0] for r in rules] + pad_tail)),
+            jnp.asarray(als_ops.pad_id_rows([r[1] for r in rules] + pad_tail)),
+            jnp.asarray(als_ops.pad_id_rows([r[2] for r in rules] + pad_tail)), k))
+        for r, qi in enumerate(live):
+            scores = out[r, 0]
+            idx = out[r, 1].astype(np.int32)
+            n = nums[r]
+            results[qi] = PredictedResult(
+                [ItemScore(model.item_dict.str(int(i)), float(s))
+                 for s, i in zip(scores[:n], idx[:n]) if np.isfinite(s)])
+        return [r for r in results]
+
     def _scored(self, model: ECommModel, query: ECommQuery,
                 vec: np.ndarray, exclude: Sequence[int] = ()) -> PredictedResult:
         n_items = len(model.item_factors)
@@ -326,8 +385,11 @@ class ECommAlgorithm(Algorithm):
              for i in top if np.isfinite(scores[i])])
 
     def _rule_ids(self, model: ECommModel, query: ECommQuery,
-                  extra_excl: Sequence[int] = ()):
-        """Translate query rules + live constraints into dense id lists."""
+                  extra_excl: Sequence[int] = (),
+                  unavailable: Optional[np.ndarray] = None):
+        """Translate query rules + live constraints into dense id lists.
+        ``unavailable`` lets a batch caller hoist the query-independent
+        live unavailableItems read to once per batch."""
         cat_ids = np.asarray(
             [c for c in (model.cat_dict.id(n) for n in query.categories or [])
              if c is not None], np.int32)
@@ -338,7 +400,8 @@ class ECommAlgorithm(Algorithm):
         excl.append(np.asarray(
             [i for i in (model.item_dict.id(n) for n in query.black_list or [])
              if i is not None], np.int32))
-        excl.append(self._unavailable_ids(model))
+        excl.append(unavailable if unavailable is not None
+                    else self._unavailable_ids(model))
         if self.params.unseen_only:
             excl.append(self._seen_ids(model, query.user))
         merged = np.concatenate(excl) if excl else np.empty(0, np.int32)

@@ -19,7 +19,7 @@ Wire format (reference template):
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -142,6 +142,16 @@ def _rank_scores(user_vec, item_factors, ids):
     return jnp.where(valid, y @ user_vec, -jnp.inf)
 
 
+@jax.jit
+def _rank_scores_batch(user_vecs, item_factors, ids):
+    """Batched _rank_scores: [B, W] item-id rows × [B, K] user vectors →
+    [B, W] scores, one program + one readback for a micro-batch."""
+    valid = ids >= 0
+    y = item_factors[jnp.where(valid, ids, 0)]          # [B, W, K]
+    s = jnp.einsum("bwk,bk->bw", y, user_vecs)
+    return jnp.where(valid, s, -jnp.inf)
+
+
 class PRAlgorithm(Algorithm):
     params_class = PRAlgorithmParams
 
@@ -192,6 +202,52 @@ class PRAlgorithm(Algorithm):
         return PRResult(
             [ItemScore(n, s if s is not None else 0.0) for n, s in ranked],
             is_original=False)
+
+    def serve_batch_predict(self, model: PRModel, queries) -> List[PRResult]:
+        """Micro-batch serving: every rankable query's gathered scores in
+        ONE device program and one [B, W] readback; unrankable queries
+        (unknown user / no known items) answer host-side in original
+        order exactly as predict does."""
+        from predictionio_tpu.ops.als import bucket_width
+
+        results: List[Optional[PRResult]] = [None] * len(queries)
+        live, knowns, uids = [], [], []
+        for qi, query in enumerate(queries):
+            uid = model.user_dict.id(query.user)
+            known = [(i, model.item_dict.id(i)) for i in query.items]
+            if (uid is None or len(model.item_factors) == 0
+                    or all(iid is None for _, iid in known)):
+                results[qi] = PRResult(
+                    [ItemScore(i, 0.0) for i in query.items],
+                    is_original=True)
+            else:
+                live.append(qi)
+                knowns.append(known)
+                uids.append(uid)
+        if not live:
+            return [r for r in results]
+        bp = bucket_width(len(live), min_width=1)
+        w = bucket_width(max(len(k) for k in knowns))
+        ids = np.full((bp, w), -1, np.int32)
+        for r, known in enumerate(knowns):
+            ids[r, : len(known)] = [iid if iid is not None else -1
+                                    for _, iid in known]
+        vecs = model.user_factors[
+            np.asarray(uids + [uids[-1]] * (bp - len(live)))]
+        out = np.asarray(_rank_scores_batch(
+            np.asarray(vecs, np.float32), model.item_factors_device(),
+            jnp.asarray(ids)))
+        for r, qi in enumerate(live):
+            known = knowns[r]
+            scores = out[r, : len(known)]
+            ranked = sorted(
+                ((name, float(s) if np.isfinite(s) else None)
+                 for (name, _), s in zip(known, scores)),
+                key=lambda t: (t[1] is None, -(t[1] or 0.0)))
+            results[qi] = PRResult(
+                [ItemScore(n, s if s is not None else 0.0)
+                 for n, s in ranked], is_original=False)
+        return [r for r in results]
 
 
 class ProductRankingEngine(EngineFactory):

@@ -177,18 +177,10 @@ class SPModel(CategoryRulesMixin, PersistentModel):
         self.cat_masks_device()
 
 
-@jax.jit
-def _indicator_scatter_scores(idx: jnp.ndarray, llr: jnp.ndarray,
-                              q_ids: jnp.ndarray) -> jnp.ndarray:
-    """score[j] = Σ_{q ∈ query items} Σ_k 1[idx[q,k] = j] · llr[q,k] —
-    a gather of the query rows + one scatter-add, all on device."""
-    qv = q_ids >= 0
-    safe = jnp.where(qv, q_ids, 0)
-    rows = idx[safe]                              # [Wq, C]
-    vals = llr[safe] * qv[:, None]
-    valid = rows >= 0
-    return jnp.zeros((idx.shape[0],), jnp.float32).at[
-        jnp.where(valid, rows, 0)].add(jnp.where(valid, vals, 0.0))
+# shared indicator-table serving kernels (also used by the
+# complementary-purchase template) live beside the other serving ops
+_indicator_scatter_scores = als_ops.indicator_scatter_scores
+_indicator_scatter_scores_batch = als_ops.indicator_scatter_scores_batch
 
 
 @dataclasses.dataclass
@@ -233,6 +225,9 @@ class SPALSAlgorithm(Algorithm):
     def predict(self, model: SPModel, query: SimilarProductQuery) -> PredictedResult:
         return _sp_predict(model, query)
 
+    def serve_batch_predict(self, model: SPModel, queries):
+        return _sp_predict_batch(model, queries)
+
 
 @dataclasses.dataclass
 class SPCooccurrenceParams(Params):
@@ -275,6 +270,9 @@ class SPCooccurrenceAlgorithm(Algorithm):
     def predict(self, model: SPModel, query: SimilarProductQuery) -> PredictedResult:
         return _sp_predict(model, query)
 
+    def serve_batch_predict(self, model: SPModel, queries):
+        return _sp_predict_batch(model, queries)
+
 
 def _sp_predict(model: SPModel, query: SimilarProductQuery) -> PredictedResult:
     """Device-final similarity serving (was: full-score-vector download +
@@ -283,26 +281,12 @@ def _sp_predict(model: SPModel, query: SimilarProductQuery) -> PredictedResult:
     n_items = len(model.item_dict)
     if n_items == 0:
         return PredictedResult([])
-    qids = [model.item_dict.id(i) for i in query.items]
-    qids = [q for q in qids if q is not None]
-    if not qids:
+    prepped = _sp_rule_ids(model, query)
+    if prepped is None:   # no resolvable items, or unresolvable constraint
         return PredictedResult([])
-    # rule id lists (present-but-unresolvable constraint => nothing matches)
-    cat_ids = np.asarray(
-        [c for c in (model.cat_dict.id(n) for n in query.categories or [])
-         if c is not None], np.int32)
-    if query.categories is not None and len(cat_ids) == 0:
-        return PredictedResult([])   # constraint present, nothing matches
-    white = np.asarray(
-        [i for i in (model.item_dict.id(n) for n in query.white_list or [])
-         if i is not None], np.int32)
-    if query.white_list is not None and len(white) == 0:
-        return PredictedResult([])
-    excl = list(qids)  # never recommend the query items themselves
-    for b in query.black_list or []:
-        bid = model.item_dict.id(b)
-        if bid is not None:
-            excl.append(bid)
+    qids, cat_ids, white, excl = prepped
+    cat_ids = np.asarray(cat_ids, np.int32)
+    white = np.asarray(white, np.int32)
     num = min(query.num, n_items)
     k = min(als_ops.bucket_width(num), n_items)
     q_pad = als_ops.pad_ids(qids)
@@ -327,6 +311,88 @@ def _sp_predict(model: SPModel, query: SimilarProductQuery) -> PredictedResult:
         [ItemScore(model.item_dict.str(int(j)), float(s))
          for s, j in zip(st[:num], si[:num]) if np.isfinite(s) and s > 0]
     )
+
+
+def _sp_rule_ids(model: SPModel, query: SimilarProductQuery):
+    """(qids, cat_ids, white, excl) for one query, or None when a host
+    short-circuit applies (no resolvable query items, or a present-but-
+    unresolvable category/whiteList constraint) — mirrors _sp_predict's
+    early returns exactly."""
+    qids = [model.item_dict.id(i) for i in query.items]
+    qids = [q for q in qids if q is not None]
+    if not qids:
+        return None
+    cat_ids = [c for c in (model.cat_dict.id(n) for n in query.categories or [])
+               if c is not None]
+    if query.categories is not None and len(cat_ids) == 0:
+        return None
+    white = [i for i in (model.item_dict.id(n) for n in query.white_list or [])
+             if i is not None]
+    if query.white_list is not None and len(white) == 0:
+        return None
+    excl = list(qids)
+    for bl in query.black_list or []:
+        bid = model.item_dict.id(bl)
+        if bid is not None:
+            excl.append(bid)
+    return qids, cat_ids, white, excl
+
+
+def _sp_predict_batch(model: SPModel,
+                      queries) -> List[PredictedResult]:
+    """Micro-batch serving: every query's rules + top-k in ONE device
+    program and one [B, 2, k] readback (see create_server._MicroBatcher);
+    host short-circuits (empty/unresolvable queries) answer without
+    touching the device, exactly as _sp_predict does."""
+    n_items = len(model.item_dict)
+    results: List[Optional[PredictedResult]] = [None] * len(queries)
+    live: List[int] = []
+    prepped = []
+    for i, q in enumerate(queries):
+        p = _sp_rule_ids(model, q) if n_items else None
+        if p is None:
+            results[i] = PredictedResult([])
+        else:
+            live.append(i)
+            prepped.append(p)
+    if not live:
+        return [r for r in results]
+    bp = als_ops.bucket_width(len(live), min_width=1)
+    pad = bp - len(live)
+    qm = als_ops.pad_id_rows([p[0] for p in prepped] + [[]] * pad)
+    cm = als_ops.pad_id_rows([p[1] for p in prepped] + [[]] * pad)
+    wm = als_ops.pad_id_rows([p[2] for p in prepped] + [[]] * pad)
+    em = als_ops.pad_id_rows([p[3] for p in prepped] + [[]] * pad)
+    nums = [min(queries[i].num, n_items) for i in live]
+    k = min(als_ops.bucket_width(max(nums)), n_items)
+    scales = np.ones(len(live), np.float64)
+    if model.kind == "als":
+        f = np.asarray(model.item_factors, np.float32)
+        vecs = np.zeros((bp, f.shape[1]), np.float32)
+        for r, p in enumerate(prepped):
+            v = f[np.asarray(p[0])].mean(axis=0)
+            vecs[r] = v
+            scales[r] = 1.0 / max(float(np.linalg.norm(v)), 1e-8)
+        out = als_ops.recommend_batch_rules(
+            jnp.asarray(vecs), model.factors_norm_device(),
+            model.cat_masks_device(), jnp.asarray(cm), jnp.asarray(wm),
+            jnp.asarray(em), k)
+    else:
+        idx_dev, llr_dev = model.indicators_device()
+        scores = _indicator_scatter_scores_batch(
+            idx_dev, llr_dev, jnp.asarray(qm))
+        out = als_ops.scores_rules_topk_batch(
+            scores, model.cat_masks_device(), jnp.asarray(cm),
+            jnp.asarray(wm), jnp.asarray(em), k)
+    out = np.asarray(out)                # ONE readback for the batch
+    for r, i in enumerate(live):
+        st = out[r, 0] * scales[r]
+        si = out[r, 1].astype(np.int32)
+        n = nums[r]
+        results[i] = PredictedResult(
+            [ItemScore(model.item_dict.str(int(j)), float(s))
+             for s, j in zip(st[:n], si[:n]) if np.isfinite(s) and s > 0])
+    return [r for r in results]
 
 
 class SimilarProductEngine(EngineFactory):

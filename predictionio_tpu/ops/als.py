@@ -523,6 +523,106 @@ def scores_rules_topk(
     return _rules_topk(scores, cat_masks, cat_ids, white_idx, excl_idx, top_k)
 
 
+def pad_id_rows(rows, min_width: int = 16) -> "np.ndarray":
+    """-1-padded [B, W] id matrix with W pow2-bucketed (the 2-D sibling of
+    pad_ids) — the shared scaffold for every serve_batch_predict."""
+    w = bucket_width(max((len(r) for r in rows), default=1), min_width)
+    out = np.full((len(rows), w), -1, np.int32)
+    for r, ids in enumerate(rows):
+        out[r, : len(ids)] = ids
+    return out
+
+
+@jax.jit
+def indicator_scatter_scores(idx: jnp.ndarray, llr: jnp.ndarray,
+                             q_ids: jnp.ndarray) -> jnp.ndarray:
+    """score[j] = Σ_{q ∈ query items} Σ_k 1[idx[q,k] = j] · llr[q,k] —
+    a gather of the query rows + one scatter-add, all on device.  Shared
+    indicator-table serving (similar-product, complementary-purchase)."""
+    qv = q_ids >= 0
+    safe = jnp.where(qv, q_ids, 0)
+    rows = idx[safe]                              # [Wq, C]
+    vals = llr[safe] * qv[:, None]
+    valid = rows >= 0
+    return jnp.zeros((idx.shape[0],), jnp.float32).at[
+        jnp.where(valid, rows, 0)].add(jnp.where(valid, vals, 0.0))
+
+
+@jax.jit
+def indicator_scatter_scores_batch(idx: jnp.ndarray, llr: jnp.ndarray,
+                                   q_ids: jnp.ndarray) -> jnp.ndarray:
+    """Batched indicator_scatter_scores: [B, Wq] query rows →
+    [B, n_items] scores in one gather + scatter-add (all-(-1) rows
+    score 0 everywhere)."""
+    b = q_ids.shape[0]
+    qv = q_ids >= 0
+    safe = jnp.where(qv, q_ids, 0)
+    rows = idx[safe]                              # [B, Wq, C]
+    vals = llr[safe] * qv[:, :, None]
+    valid = rows >= 0
+    out_rows = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.int32)[:, None, None], rows.shape)
+    return jnp.zeros((b, idx.shape[0]), jnp.float32).at[
+        out_rows, jnp.where(valid, rows, 0)
+    ].add(jnp.where(valid, vals, 0.0))
+
+
+def _rules_topk_batch(scores, cat_masks, cat_ids, white_idx, excl_idx,
+                      top_k: int):
+    """Batched _rules_topk: per-row rule id lists over [B, n_items]
+    scores → stacked [B, 2, top_k].  One device program serves a whole
+    serving micro-batch (see create_server._MicroBatcher)."""
+    b, n_items = scores.shape
+    check_f32_id_range(n_items)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    cat_valid = cat_ids >= 0                              # [B, Wc]
+    sel = (cat_masks[jnp.where(cat_valid, cat_ids, 0)]    # [B, Wc, I]
+           & cat_valid[:, :, None])
+    allow_cat = jnp.where(cat_valid.any(axis=1, keepdims=True),
+                          sel.any(axis=1), True)          # [B, I]
+    white_valid = white_idx >= 0                          # [B, Ww]
+    white_mask = jnp.zeros((b, n_items), bool).at[
+        rows, jnp.where(white_valid, white_idx, 0)].max(white_valid)
+    allow_white = jnp.where(white_valid.any(axis=1, keepdims=True),
+                            white_mask, True)
+    scores = jnp.where(allow_cat & allow_white, scores, -jnp.inf)
+    excl_valid = excl_idx >= 0
+    scores = scores.at[rows, jnp.where(excl_valid, excl_idx, 0)].min(
+        jnp.where(excl_valid, -jnp.inf, jnp.inf))
+    st, si = jax.lax.top_k(scores, top_k)
+    return jnp.stack([st, si.astype(jnp.float32)], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def recommend_batch_rules(
+    user_vecs: jnp.ndarray,       # [B, K]
+    item_factors: jnp.ndarray,    # [n_items, K] — device-resident
+    cat_masks: jnp.ndarray,       # [C, n_items] bool — device-resident
+    cat_ids: jnp.ndarray,         # [B, Wc] -1-padded
+    white_idx: jnp.ndarray,       # [B, Ww] -1-padded
+    excl_idx: jnp.ndarray,        # [B, We] -1-padded
+    top_k: int,
+) -> jnp.ndarray:                 # [B, 2, top_k]
+    """Batched recommend_scores_rules: B queries' rules + top-ks in one
+    program, one readback."""
+    return _rules_topk_batch(user_vecs @ item_factors.T, cat_masks,
+                             cat_ids, white_idx, excl_idx, top_k)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def scores_rules_topk_batch(
+    scores: jnp.ndarray,          # [B, n_items] precomputed device scores
+    cat_masks: jnp.ndarray,       # [C, n_items] bool — device-resident
+    cat_ids: jnp.ndarray,         # [B, Wc] -1-padded
+    white_idx: jnp.ndarray,       # [B, Ww] -1-padded
+    excl_idx: jnp.ndarray,        # [B, We] -1-padded
+    top_k: int,
+) -> jnp.ndarray:                 # [B, 2, top_k]
+    """Batched scores_rules_topk (indicator-table similarity serving)."""
+    return _rules_topk_batch(scores, cat_masks, cat_ids, white_idx,
+                             excl_idx, top_k)
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def recommend_batch_excl(
     user_vecs: jnp.ndarray,       # [B, K]

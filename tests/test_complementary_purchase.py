@@ -225,3 +225,27 @@ def test_basket_rules_past_old_cap():
                 np.testing.assert_allclose(cv, want_conf[j], rtol=1e-4)
                 checked += 1
     assert checked > 100
+
+
+def test_cp_serve_batch_matches_serial(cp_app):
+    """serve_batch_predict ≡ predict across carts, multi-item carts, and
+    unresolvable carts in one batch."""
+    engine = ComplementaryPurchaseEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    model = models[0]
+    name, params = ep.algorithm_params_list[0]
+    algo = engine.algorithm_classes[name](params)
+    queries = [
+        CPQuery(items=["coffee"], num=3),
+        CPQuery(items=["tea"], num=2),
+        CPQuery(items=["coffee", "tea"], num=4),
+        CPQuery(items=["nothing-known"], num=3),
+        CPQuery(items=[], num=3),
+    ]
+    serial = [algo.predict(model, q) for q in queries]
+    batched = algo.serve_batch_predict(model, queries)
+    for q, s, b in zip(queries, serial, batched):
+        s_i = [(r.item, round(r.score, 4)) for r in s.item_scores]
+        b_i = [(r.item, round(r.score, 4)) for r in b.item_scores]
+        assert s_i == b_i, (q, s_i, b_i)

@@ -185,3 +185,32 @@ def test_first_revision_pickle_format_migrates(ecomm_app):
     a = engine.predictor(ep, models)(q).to_json()
     b = engine.predictor(ep, [restored])(q).to_json()
     assert a == b
+
+
+def test_ecomm_serve_batch_matches_serial(ecomm_app):
+    """serve_batch_predict ≡ predict across tier-1 (known user), tier-2
+    (recent-similar), tier-3 (popularity), rules, and infeasible queries
+    in one batch."""
+    from predictionio_tpu.models.ecommerce import ECommerceEngine
+
+    engine = ECommerceEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    model = models[0]
+    name, params = ep.algorithm_params_list[0]
+    algo = engine.algorithm_classes[name](params)
+    queries = [
+        ECommQuery(user="u0", num=4),
+        ECommQuery(user="u1", num=4),
+        ECommQuery(user="totally-new", num=4),           # popularity tier
+        ECommQuery(user="u0", num=6, categories=["zeta"]),
+        ECommQuery(user="u0", num=6, white_list=["a1", "a2"]),
+        ECommQuery(user="u0", num=6, black_list=["a0", "a1"]),
+        ECommQuery(user="u0", num=6, categories=["nope"]),  # infeasible
+    ]
+    serial = [algo.predict(model, q) for q in queries]
+    batched = algo.serve_batch_predict(model, queries)
+    for q, s, b in zip(queries, serial, batched):
+        s_i = [(r.item, round(r.score, 4)) for r in s.item_scores]
+        b_i = [(r.item, round(r.score, 4)) for r in b.item_scores]
+        assert s_i == b_i, (q, s_i, b_i)

@@ -94,3 +94,26 @@ def test_model_roundtrip(pr_app):
     q = PRQuery(user="u0", items=["a0", "z0", "a1"])
     assert (engine.predictor(ep, models)(q).to_json()
             == engine.predictor(ep, restored)(q).to_json())
+
+
+def test_pr_serve_batch_matches_serial(pr_app):
+    """serve_batch_predict ≡ predict across rankable, unknown-user, and
+    unknown-item queries in one batch."""
+    engine, ep, predict, models = trained()
+    model = models[0]
+    algo = engine.algorithm_classes["als"](
+        dict(ep.algorithm_params_list)["als"])
+    queries = [
+        PRQuery(user="u0", items=["z0", "a1", "z1", "a0"]),
+        PRQuery(user="u1", items=["a0", "z0"]),
+        PRQuery(user="nobody", items=["z0", "a1"]),
+        PRQuery(user="u0", items=["mystery", "a1", "a0"]),
+        PRQuery(user="u2", items=["ghost", "phantom"]),    # no known items
+    ]
+    serial = [algo.predict(model, q) for q in queries]
+    batched = algo.serve_batch_predict(model, queries)
+    for q, s, b in zip(queries, serial, batched):
+        assert s.is_original == b.is_original, q
+        s_i = [(r.item, round(r.score, 4)) for r in s.item_scores]
+        b_i = [(r.item, round(r.score, 4)) for r in b.item_scores]
+        assert s_i == b_i, (q, s_i, b_i)

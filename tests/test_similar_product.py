@@ -90,3 +90,33 @@ def test_query_json():
         {"items": ["i1"], "num": 2, "whiteList": ["i2"], "blackList": ["i3"],
          "categories": ["c"]})
     assert q.items == ["i1"] and q.white_list == ["i2"] and q.categories == ["c"]
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("als", SPALSParams(rank=8, num_iterations=5, mesh_dp=1)),
+    ("cooccurrence", SPCooccurrenceParams(mesh_dp=1)),
+])
+def test_sp_serve_batch_matches_serial(sp_app, algo, params):
+    """serve_batch_predict ≡ predict on both algorithm kinds across
+    plain / multi-item / rules / blacklist / unresolvable queries."""
+    engine = SimilarProductEngine.apply()
+    ep = make_ep(algo, params)
+    models = engine.train(ep)
+    model = models[0]
+    a = engine.algorithm_classes[algo](params)
+    queries = [
+        SimilarProductQuery(items=["a1"], num=4),
+        SimilarProductQuery(items=["a0", "a2"], num=3),
+        SimilarProductQuery(items=["z1"], num=4, categories=["zeta"]),
+        SimilarProductQuery(items=["a1"], num=4, white_list=["a2", "a3"]),
+        SimilarProductQuery(items=["a1"], num=4, black_list=["a2"]),
+        SimilarProductQuery(items=["nope"], num=4),          # unresolvable
+        SimilarProductQuery(items=["a1"], num=4, categories=["ghost"]),
+    ]
+    serial = [a.predict(model, q) for q in queries]
+    batched = a.serve_batch_predict(model, queries)
+    assert len(batched) == len(queries)
+    for q, s, b in zip(queries, serial, batched):
+        s_i = [(r.item, round(r.score, 4)) for r in s.item_scores]
+        b_i = [(r.item, round(r.score, 4)) for r in b.item_scores]
+        assert s_i == b_i, (q, s_i, b_i)
