@@ -181,17 +181,29 @@ def make_handler(state: EventServerState):
             if not isinstance(body, dict):
                 self.send_error_json(400, "event must be a JSON object")
                 return
-            try:
-                event = Event.from_json(body)
-            except (ValueError, KeyError, TypeError) as e:
-                self.send_error_json(400, str(e))
-                return
-            err = self._check_allowed(ak, event.event)
+            name = body.get("event")
+            err = (self._check_allowed(ak, name)
+                   if isinstance(name, str) and name else None)
             if err:
-                self.send_error_json(403, err)
+                # validate-then-authorize: malformed stays 400 even when
+                # the event name is also disallowed (same as the batch
+                # endpoint and the old Event-object path)
+                try:
+                    Event.from_json(body)
+                    self.send_error_json(403, err)
+                except (ValueError, KeyError, TypeError) as e:
+                    self.send_error_json(400, str(e))
                 return
-            event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
-            state.record(ak.app_id, event.event)
+            # same canonical fast path as /batch/events.json: wire dict →
+            # storage line without Event-object round trips (~45 µs less
+            # per event; byte-identical lines by the parity contract)
+            r = state.storage.l_events.insert_json_batch(
+                [body], ak.app_id, channel_id)[0]
+            if r["status"] != 201:
+                self.send_error_json(400, r["message"])
+                return
+            event_id = r["eventId"]
+            state.record(ak.app_id, name)
             if type(event_id) is str and event_id.isalnum():
                 # hand-built body: alnum ids (every server-generated id is
                 # hex) need no JSON escaping, and this is the single-event

@@ -227,7 +227,7 @@ class CPAlgorithm(Algorithm):
                 live.append(qi)
                 carts.append(cart)
         if not live:
-            return [r for r in results]
+            return results
         bp = als_ops.bucket_width(len(live), min_width=1)
         qm = als_ops.pad_id_rows(carts + [[]] * (bp - len(live)))
         idx_dev, lift_dev = model.tables_device()
@@ -247,7 +247,7 @@ class CPAlgorithm(Algorithm):
                 [ItemScore(model.item_dict.str(int(j)), float(s))
                  for s, j in zip(st[:n], si[:n])
                  if np.isfinite(s) and s > 0])
-        return [r for r in results]
+        return results
 
 
 class ComplementaryPurchaseEngine(EngineFactory):

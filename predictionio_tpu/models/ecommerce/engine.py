@@ -323,7 +323,7 @@ class ECommAlgorithm(Algorithm):
             rules.append((cat_ids, white, excl))
             nums.append(min(query.num, n_items))
         if not live:
-            return [r for r in results]
+            return results
         bp = als_ops.bucket_width(len(live), min_width=1)
         pad_tail = [[]] * (bp - len(live))
         v = np.zeros((bp, vecs[0].shape[0]), np.float32)
@@ -342,7 +342,7 @@ class ECommAlgorithm(Algorithm):
             results[qi] = PredictedResult(
                 [ItemScore(model.item_dict.str(int(i)), float(s))
                  for s, i in zip(scores[:n], idx[:n]) if np.isfinite(s)])
-        return [r for r in results]
+        return results
 
     def _scored(self, model: ECommModel, query: ECommQuery,
                 vec: np.ndarray, exclude: Sequence[int] = ()) -> PredictedResult:

@@ -208,8 +208,6 @@ class PRAlgorithm(Algorithm):
         ONE device program and one [B, W] readback; unrankable queries
         (unknown user / no known items) answer host-side in original
         order exactly as predict does."""
-        from predictionio_tpu.ops.als import bucket_width
-
         results: List[Optional[PRResult]] = [None] * len(queries)
         live, knowns, uids = [], [], []
         for qi, query in enumerate(queries):
@@ -225,9 +223,9 @@ class PRAlgorithm(Algorithm):
                 knowns.append(known)
                 uids.append(uid)
         if not live:
-            return [r for r in results]
-        bp = bucket_width(len(live), min_width=1)
-        w = bucket_width(max(len(k) for k in knowns))
+            return results
+        bp = als_ops.bucket_width(len(live), min_width=1)
+        w = als_ops.bucket_width(max(len(k) for k in knowns))
         ids = np.full((bp, w), -1, np.int32)
         for r, known in enumerate(knowns):
             ids[r, : len(known)] = [iid if iid is not None else -1
@@ -247,7 +245,7 @@ class PRAlgorithm(Algorithm):
             results[qi] = PRResult(
                 [ItemScore(n, s if s is not None else 0.0)
                  for n, s in ranked], is_original=False)
-        return [r for r in results]
+        return results
 
 
 class ProductRankingEngine(EngineFactory):

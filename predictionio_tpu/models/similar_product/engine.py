@@ -356,7 +356,7 @@ def _sp_predict_batch(model: SPModel,
             live.append(i)
             prepped.append(p)
     if not live:
-        return [r for r in results]
+        return results
     bp = als_ops.bucket_width(len(live), min_width=1)
     pad = bp - len(live)
     qm = als_ops.pad_id_rows([p[0] for p in prepped] + [[]] * pad)
@@ -392,7 +392,7 @@ def _sp_predict_batch(model: SPModel,
         results[i] = PredictedResult(
             [ItemScore(model.item_dict.str(int(j)), float(s))
              for s, j in zip(st[:n], si[:n]) if np.isfinite(s) and s > 0])
-    return [r for r in results]
+    return results
 
 
 class SimilarProductEngine(EngineFactory):

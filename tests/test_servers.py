@@ -560,3 +560,29 @@ def test_prefork_workers_share_port_and_die_with_server(tmp_path, monkeypatch):
         assert httpd.pio_workers[0].poll() is not None
     finally:
         set_storage(None)
+
+
+def test_http_pipelined_requests(event_server):
+    """Two requests written in ONE TCP segment (HTTP/1.1 pipelining) are
+    served in order — the lean request loop must consume exact body
+    boundaries from the buffered stream."""
+    import socket
+    from urllib.parse import urlsplit
+
+    u = urlsplit(event_server["base"])
+    key = event_server["key"]
+    body = json.dumps({"event": "buy", "entityType": "user",
+                       "entityId": "u1", "targetEntityType": "item",
+                       "targetEntityId": "i1"}).encode()
+    one = (b"POST /events.json?accessKey=" + key.encode() +
+           b" HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+           b"Content-Length: %d\r\n\r\n" % len(body) + body)
+    s = socket.create_connection((u.hostname, u.port))
+    s.sendall(one + one)          # pipelined: both before any read
+    data = b""
+    while data.count(b"HTTP/1.1 201") < 2:
+        chunk = s.recv(65536)
+        assert chunk, data
+        data += chunk
+    assert data.count(b'"eventId"') == 2
+    s.close()
