@@ -586,3 +586,41 @@ def test_http_pipelined_requests(event_server):
         data += chunk
     assert data.count(b'"eventId"') == 2
     s.close()
+
+
+def test_micro_batcher_isolates_poisoned_query():
+    """One failing query must not 500 its batchmates: the leader re-runs
+    the batch serially so only the offender errors.  Also covers
+    leadership handoff under sustained concurrent load."""
+    import threading as _threading
+
+    from predictionio_tpu.workflow.create_server import _MicroBatcher
+
+    def run_one(q):
+        if q == "poison":
+            raise ValueError("bad query")
+        return f"ok:{q}"
+
+    def run_batch(queries):
+        return [run_one(q) for q in queries]
+
+    batcher = _MicroBatcher(run_batch, run_one, max_batch=4)
+    results = {}
+    errors = {}
+    gate = _threading.Barrier(8)
+
+    def worker(q):
+        gate.wait()
+        try:
+            results[q] = batcher.predict(q)
+        except ValueError as e:
+            errors[q] = str(e)
+
+    qs = [f"q{i}" for i in range(7)] + ["poison"]
+    ts = [_threading.Thread(target=worker, args=(q,)) for q in qs]
+    [t.start() for t in ts]
+    [t.join(timeout=30) for t in ts]
+    assert errors == {"poison": "bad query"}
+    assert results == {f"q{i}": f"ok:q{i}" for i in range(7)}
+    # batcher fully drained and leadership released
+    assert batcher._queue == [] and not batcher._leader_active
