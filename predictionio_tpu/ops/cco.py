@@ -819,8 +819,14 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR
             within = np.arange(chunk, dtype=np.int64) - np.repeat(
                 csum - rep, rep)
             flat = p_rep.astype(np.int64) * I_t + a.item[offs + within]
-            cells, counts = np.unique(flat, return_counts=True)
-            C[cells] += counts.astype(np.int32)
+            if I_p * I_t <= (16 << 20):
+                # small matrix: one O(n) bincount pass beats the
+                # sort-based unique (the transient int64 histogram is
+                # ≤128 MB here)
+                C += np.bincount(flat, minlength=I_p * I_t).astype(np.int32)
+            else:
+                cells, counts = np.unique(flat, return_counts=True)
+                C[cells] += counts.astype(np.int32)
         lo = hi
     return C.reshape(I_p, I_t)
 
