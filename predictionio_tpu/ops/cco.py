@@ -819,10 +819,13 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR
             within = np.arange(chunk, dtype=np.int64) - np.repeat(
                 csum - rep, rep)
             flat = p_rep.astype(np.int64) * I_t + a.item[offs + within]
-            if I_p * I_t <= (16 << 20):
-                # small matrix: one O(n) bincount pass beats the
-                # sort-based unique (the transient int64 histogram is
-                # ≤128 MB here)
+            if I_p * I_t <= (16 << 20) and chunk * 8 >= I_p * I_t:
+                # dense-ish chunk over a small matrix: an O(n + cells)
+                # bincount pass beats the sort-based unique.  Gated on
+                # BOTH sizes — with few pairs the per-chunk full-width
+                # histogram (+ astype + add over every cell) would be a
+                # constant-factor and 128 MB-peak regression exactly in
+                # the low-density regime this path serves.
                 C += np.bincount(flat, minlength=I_p * I_t).astype(np.int32)
             else:
                 cells, counts = np.unique(flat, return_counts=True)
