@@ -168,6 +168,9 @@ class LSModel(PersistentModel):
 
 class LSAlgorithm(Algorithm):
     params_class = LSAlgorithmParams
+    # not serving_batchable: predict is a handful of host scalar lookups
+    # (no device dispatch/readback to amortize), so micro-batching would
+    # only add coordination overhead — same reasoning as TextNBAlgorithm
 
     def train(self, td: LSTrainingData) -> LSModel:
         n_sessions = td.attr_idx.shape[1]
