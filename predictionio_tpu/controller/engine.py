@@ -220,6 +220,12 @@ class Engine(BaseEngine):
             return [serving.serve(q, [col[i] for col in per_algo])
                     for i, q in enumerate(queries)]
 
+        # the tightest per-algorithm batch cap rides along for the
+        # micro-batcher (e.g. UR bounds its [B, I_p, K] scoring gather's
+        # transient memory on large catalogs)
+        predict_batch.max_batch = min(
+            getattr(a, "serve_batch_max", 64) for a in algorithms)
+
         return predict, predict_batch
 
     # -- params binding (engine.json) ----------------------------------------

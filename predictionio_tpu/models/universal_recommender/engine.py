@@ -771,6 +771,10 @@ class URAlgorithmParams(Params):
 
 class URAlgorithm(Algorithm):
     params_class = URAlgorithmParams
+    # cap the serving micro-batch: the batched indicator scorer's
+    # [B, I_p, K] gather is the transient; 16 × 100k items × 50 × 4 B
+    # ≈ 320 MB worst-case, comfortable next to the resident model
+    serve_batch_max = 16
 
     def train(self, td: URTrainingData) -> URModel:
         primary = td.event_names[0]

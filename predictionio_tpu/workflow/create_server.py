@@ -242,8 +242,10 @@ class QueryServerState:
                     enable = False
             self.predictor, bp = self.engine.serving_bundle(
                 self.engine_params, models)
-            self.batcher = (_MicroBatcher(bp, self.predictor)
-                            if enable and bp is not None else None)
+            self.batcher = (
+                _MicroBatcher(bp, self.predictor,
+                              max_batch=getattr(bp, "max_batch", 64))
+                if enable and bp is not None else None)
             self.instance = instance
             return instance.id
 
