@@ -27,6 +27,10 @@ from predictionio_tpu.core.base import (
     doer_name,
 )
 
+#: fleet default for serving micro-batch size; engines tighten it via a
+#: ``serve_batch_max`` class attribute
+DEFAULT_SERVE_BATCH = 64
+
 
 @dataclasses.dataclass
 class EngineParams:
@@ -207,7 +211,11 @@ class Engine(BaseEngine):
         if any(f is None for f in fns):
             return predict, None
 
-        def predict_batch(queries: Sequence[Any]) -> List[Any]:
+        max_batch = min(
+            (getattr(a, "serve_batch_max", DEFAULT_SERVE_BATCH)
+             for a in algorithms), default=DEFAULT_SERVE_BATCH)
+
+        def _run_slice(queries: Sequence[Any]) -> List[Any]:
             per_algo = []
             for fn, algo, model in zip(fns, algorithms, models):
                 col = fn(model, queries)
@@ -220,12 +228,17 @@ class Engine(BaseEngine):
             return [serving.serve(q, [col[i] for col in per_algo])
                     for i, q in enumerate(queries)]
 
-        # the tightest per-algorithm batch cap rides along for the
-        # micro-batcher (e.g. UR bounds its [B, I_p, K] scoring gather's
-        # transient memory on large catalogs)
-        predict_batch.max_batch = min(
-            getattr(a, "serve_batch_max", 64) for a in algorithms)
+        def predict_batch(queries: Sequence[Any]) -> List[Any]:
+            # the cap is ENFORCED here, not just advised: any consumer
+            # (micro-batcher or a direct batch_predictor() caller) stays
+            # inside the per-slice memory bound engines declared (e.g.
+            # UR's [B, I_p, K] scoring gather transient)
+            out: List[Any] = []
+            for s in range(0, len(queries), max_batch):
+                out.extend(_run_slice(queries[s: s + max_batch]))
+            return out
 
+        predict_batch.max_batch = max_batch
         return predict, predict_batch
 
     # -- params binding (engine.json) ----------------------------------------

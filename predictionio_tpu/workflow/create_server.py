@@ -65,7 +65,11 @@ class _MicroBatcher:
     """
 
     def __init__(self, run_batch: Callable, run_one: Callable,
-                 max_batch: int = 64):
+                 max_batch: Optional[int] = None):
+        from predictionio_tpu.controller.engine import DEFAULT_SERVE_BATCH
+
+        if max_batch is None:
+            max_batch = DEFAULT_SERVE_BATCH
         self._run = run_batch
         self._run_one = run_one
         self._max = max_batch
@@ -244,7 +248,7 @@ class QueryServerState:
                 self.engine_params, models)
             self.batcher = (
                 _MicroBatcher(bp, self.predictor,
-                              max_batch=getattr(bp, "max_batch", 64))
+                              max_batch=getattr(bp, "max_batch", None))
                 if enable and bp is not None else None)
             self.instance = instance
             return instance.id
