@@ -85,6 +85,10 @@ class JsonHandler(socketserver.StreamRequestHandler):
             self.command, self.path, version = (
                 line.decode("latin-1").rstrip("\r\n").split(" ", 2))
         except ValueError:
+            # close first so the 400 doesn't advertise keep-alive on a
+            # connection we're about to drop (matches the other early-error
+            # paths)
+            self.close_connection = True
             self._send_raw(400, b'{"message": "malformed request line"}')
             return False
         headers = _Headers()
@@ -103,6 +107,18 @@ class JsonHandler(socketserver.StreamRequestHandler):
         self.close_connection = (
             conn_tok == "close"
             or (version == "HTTP/1.0" and conn_tok != "keep-alive"))
+        if headers.get("transfer-encoding") is not None:
+            # we don't decode chunked bodies; silently ignoring the header
+            # would leave the chunk bytes in the stream to be parsed as the
+            # next pipelined request — a desync / request-smuggling vector
+            # behind a chunked-forwarding proxy.  RFC 9112 §6.1: respond
+            # 501 and close.  Checked BEFORE Expect handling so we never
+            # send 100 Continue inviting a body we are about to refuse.
+            self.close_connection = True
+            self._body_unread = 0
+            self._send_raw(
+                501, b'{"message": "Transfer-Encoding not supported"}')
+            return False
         if (headers.get("expect") or "").lower() == "100-continue":
             self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
         cl = headers.get("content-length")

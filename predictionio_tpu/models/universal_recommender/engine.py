@@ -598,7 +598,6 @@ class URModel(PersistentModel):
 
 
 @partial(jax.jit, static_argnames=("n_items_t",))
-@partial(jax.jit, static_argnames=("n_items_t",))
 def _indicator_score_ids_batch(
     idx: jnp.ndarray,       # [I_p, K] device-resident indicator table
     llr: jnp.ndarray,       # [I_p, K] LLR strengths

@@ -47,6 +47,13 @@ def _to_jsonable(obj: Any) -> Any:
     return str(obj)
 
 
+# How long a queued query waits for its result before giving up.  A fresh
+# shape bucket on TPU can compile for minutes, so this is generous; only a
+# genuinely dead leader should trip it.  Module-level so tests can shrink
+# it to exercise the timeout/handoff races directly.
+_WAIT_TIMEOUT_S = 600.0
+
+
 class _MicroBatcher:
     """Group-commit micro-batching for concurrent queries.
 
@@ -87,28 +94,60 @@ class _MicroBatcher:
         while True:
             if lead:
                 self._lead_until_served(item)
-            # generous bound: a fresh shape bucket on TPU can compile for
-            # minutes; only a genuinely dead leader should trip this
-            if not item["ev"].wait(timeout=600.0):
-                raise TimeoutError(
-                    "micro-batch not served within 600 s (leader died?)")
-            if item.pop("lead", False) and "r" not in item and "e" not in item:
-                # a finishing leader promoted us: drain until our own
-                # result lands, then hand off again
-                item["ev"].clear()
-                lead = True
+                lead = False  # leading guarantees our item was served
+            if "r" in item or "e" in item:
+                break
+            # re-arm, then re-check BOTH wake sources.  Result writers
+            # assign r/e before set(), so a set() racing our clear() is
+            # caught by the r/e re-check.  Leadership nudges set() WITHOUT
+            # writing a result — a clear() could swallow one — so we also
+            # probe the vacancy itself under the lock: if no leader is
+            # active we claim the lead ourselves, making a swallowed nudge
+            # harmless (the lock orders us against the releasing leader:
+            # either we see the vacancy, or their nudge lands after our
+            # clear and wakes the wait).
+            item["ev"].clear()
+            if "r" in item or "e" in item:
+                break
+            with self._lock:
+                lead = not self._leader_active
+                if lead:
+                    self._leader_active = True
+            if lead:
                 continue
-            break
+            if not item["ev"].wait(timeout=_WAIT_TIMEOUT_S):
+                with self._lock:
+                    if item in self._queue:
+                        self._queue.remove(item)
+                    served = "r" in item or "e" in item
+                    # if we were about to inherit leadership, pass the
+                    # wake on so the remaining waiters aren't stranded
+                    nxt = (self._queue[0]
+                           if not served and not self._leader_active
+                           and self._queue else None)
+                if nxt is not None:
+                    nxt["ev"].set()
+                if not served:
+                    raise TimeoutError(
+                        "micro-batch not served within %.0f s (leader died?)"
+                        % _WAIT_TIMEOUT_S)
+                continue
+            # woken: loop re-checks the result and the leadership vacancy
         if "e" in item:
             raise item["e"]
         return item["r"]
 
     def _lead_until_served(self, own: dict) -> None:
-        """Run batches until ``own`` is served, then hand leadership to a
-        queued waiter (or release it).  Draining until the queue empties
-        would starve the leader's own client under sustained load —
-        leadership rotates instead, so every request is served after at
-        most a few batches."""
+        """Run batches until ``own`` is served, then RELEASE leadership and
+        nudge the head waiter to re-claim it under the lock.  Draining
+        until the queue empties would starve the leader's own client under
+        sustained load — leadership rotates instead, so every request is
+        served after at most a few batches.  Leadership is never
+        *transferred* to a specific thread: the nudged waiter may already
+        have timed out and departed, and a transfer would then leave
+        ``_leader_active`` stuck True forever (every later query waits
+        600 s and fails).  Releasing means any thread — the nudged waiter
+        or a fresh arrival — can claim the vacancy."""
         while True:
             with self._lock:
                 batch = self._queue[: self._max]
@@ -118,7 +157,10 @@ class _MicroBatcher:
                     return
             try:
                 results = self._run([i["q"] for i in batch])
-                for i, r in zip(batch, results):
+                # strict: a predictor returning the wrong count must fall
+                # into the serial fallback, not leave an unserved item
+                # (whose thread would spin claiming/releasing leadership)
+                for i, r in zip(batch, results, strict=True):
                     i["r"] = r
             except Exception:
                 # one poisoned query must not 500 its batchmates:
@@ -131,12 +173,10 @@ class _MicroBatcher:
             served_self = own in batch
             if served_self:
                 with self._lock:
+                    self._leader_active = False
                     nxt = self._queue[0] if self._queue else None
-                    if nxt is None:
-                        self._leader_active = False
                 if nxt is not None:
-                    nxt["lead"] = True       # leadership transfers with it
-                    nxt["ev"].set()
+                    nxt["ev"].set()  # wake to re-claim the released lead
             for i in batch:
                 i["ev"].set()
             if served_self:
@@ -450,7 +490,11 @@ def deploy(
                 "deploy --workers resolves storage from PIO_STORAGE_* env "
                 "in each worker; a programmatic storage object cannot "
                 "cross the process boundary")
-    if reuse_port and workers == 1:
+    # Orphan-watch only in children WE spawned (marked via env by the
+    # prefork Popen below) — a programmatic caller passing reuse_port=True
+    # behind their own balancer must not get a server that self-terminates
+    # when its launcher exits.
+    if os.environ.get("PIO_PREFORK_CHILD") == "1" and workers == 1:
         _watch_parent_process()   # prefork child: die when orphaned
     doc = load_engine_variant(engine_json, variant)
     factory, engine, engine_params = engine_from_variant(doc)
@@ -488,6 +532,7 @@ def deploy(
                 + (["--engine-id", engine_id] if engine_id else [])
                 + (["--feedback"] if feedback else [])
                 + (["--auto-reload", str(auto_reload)] if auto_reload else []),
+                env={**os.environ, "PIO_PREFORK_CHILD": "1"},
             ))
         # surface child exits (a worker that dies at startup — bad env,
         # bind failure — would otherwise silently leave the port at 1/N

@@ -624,3 +624,150 @@ def test_micro_batcher_isolates_poisoned_query():
     assert results == {f"q{i}": f"ok:q{i}" for i in range(7)}
     # batcher fully drained and leadership released
     assert batcher._queue == [] and not batcher._leader_active
+
+
+def test_micro_batcher_soak():
+    """Stress the leadership-rotation machinery: many threads, many
+    queries each, random poisoned queries and randomly slow batches.
+    Every query must get exactly its own result (no lost, duplicated, or
+    mis-routed responses) and the batcher must fully drain."""
+    import random
+    import threading as _threading
+    import time as _time
+
+    from predictionio_tpu.workflow.create_server import _MicroBatcher
+
+    rng = random.Random(42)  # only the (single) leader calls run_batch
+
+    def run_one(q):
+        if q.endswith(":poison"):
+            raise ValueError(q)
+        return "ok:" + q
+
+    def run_batch(queries):
+        if rng.random() < 0.2:          # a slow batch: mid-flight queries
+            _time.sleep(0.002)          # must coalesce into the next one
+        return [run_one(q) for q in queries]
+
+    batcher = _MicroBatcher(run_batch, run_one, max_batch=6)
+    n_threads, n_queries = 12, 30
+    results: dict = {}
+    errors: dict = {}
+    gate = _threading.Barrier(n_threads)
+
+    def worker(tid):
+        trng = random.Random(tid)
+        gate.wait()
+        for seq in range(n_queries):
+            q = f"{tid}:{seq}"
+            if trng.random() < 0.1:
+                q += ":poison"
+            try:
+                results[q] = batcher.predict(q)
+            except ValueError as e:
+                errors[q] = str(e)
+
+    ts = [_threading.Thread(target=worker, args=(i,))
+          for i in range(n_threads)]
+    start = _time.monotonic()
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    elapsed = _time.monotonic() - start
+    assert not any(t.is_alive() for t in ts), "soak deadlocked"
+    assert elapsed < 30, f"soak took {elapsed:.1f}s — unbounded waits?"
+    assert len(results) + len(errors) == n_threads * n_queries
+    for q, r in results.items():
+        assert r == "ok:" + q, f"mis-routed response: {q} -> {r}"
+    for q, e in errors.items():
+        assert q.endswith(":poison") and e == q
+    assert batcher._queue == [] and not batcher._leader_active
+
+
+def test_micro_batcher_recovers_when_nudged_waiter_departed(monkeypatch):
+    """Regression for the leadership-handoff wedge: a slow batch makes a
+    queued waiter hit its wait timeout and depart; the finishing leader
+    must RELEASE leadership (not transfer it to the departed thread), so
+    the next query can claim it and be served.  Under the old
+    transfer-to-queue[0] scheme this left ``_leader_active`` stuck True
+    and every later query timed out until restart."""
+    import threading as _threading
+    import time as _time
+
+    from predictionio_tpu.workflow import create_server as cs
+
+    monkeypatch.setattr(cs, "_WAIT_TIMEOUT_S", 0.2)
+    slow_gate = _threading.Event()
+
+    def run_batch(queries):
+        if "slow" in queries:
+            slow_gate.wait(timeout=10)
+        return ["ok:" + q for q in queries]
+
+    batcher = cs._MicroBatcher(run_batch, lambda q: "ok:" + q, max_batch=1)
+    res: dict = {}
+    errs: list = []
+
+    def leader():
+        res["slow"] = batcher.predict("slow")
+
+    def waiter():
+        try:
+            res["w"] = batcher.predict("w")
+        except TimeoutError as e:
+            errs.append(e)
+
+    t1 = _threading.Thread(target=leader)
+    t1.start()
+    _time.sleep(0.05)        # leader claims the lead, blocks in run_batch
+    t2 = _threading.Thread(target=waiter)
+    t2.start()
+    t2.join(timeout=5)       # waiter times out at 0.2 s and departs
+    assert not t2.is_alive() and errs, "waiter should have timed out"
+    slow_gate.set()
+    t1.join(timeout=5)
+    assert res["slow"] == "ok:slow"
+    # the actual regression check: the batcher must not be wedged
+    assert batcher.predict("after") == "ok:after"
+    assert batcher._queue == [] and not batcher._leader_active
+
+
+def test_http_rejects_transfer_encoding(event_server):
+    """We never decode chunked bodies — ignoring the header would leave
+    chunk bytes in the stream to be parsed as the next pipelined request
+    (request smuggling behind a chunked-forwarding proxy).  RFC 9112
+    §6.1: 501 + connection close."""
+    import socket
+    from urllib.parse import urlsplit
+
+    u = urlsplit(event_server["base"])
+    key = event_server["key"]
+    req = (b"POST /events.json?accessKey=" + key.encode() +
+           b" HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+           b"Transfer-Encoding: chunked\r\n\r\n"
+           b"5\r\nhello\r\n0\r\n\r\n")
+    s = socket.create_connection((u.hostname, u.port))
+    s.sendall(req)
+    data = b""
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    s.close()
+    assert data.startswith(b"HTTP/1.1 501"), data[:80]
+    assert b"connection: close" in data.lower()
+    # the connection was closed (recv returned b"") — no smuggled parse
+
+
+def test_micro_batcher_short_batch_result_falls_back_serial():
+    """A batch predictor returning the wrong result count must not strand
+    any item: the strict zip raises and the serial fallback serves every
+    query individually."""
+    from predictionio_tpu.workflow.create_server import _MicroBatcher
+
+    def run_batch(queries):
+        return ["ok:" + q for q in queries][:-1]   # one short
+
+    batcher = _MicroBatcher(run_batch, lambda q: "one:" + q, max_batch=4)
+    assert batcher.predict("a") == "one:a"
+    assert batcher._queue == [] and not batcher._leader_active
