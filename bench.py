@@ -465,6 +465,15 @@ def bench_ingest(smoke: bool) -> dict:
             for k in range(n_single):
                 client.record_user_action_on_item(
                     "buy", f"u{k % 1000}", f"i{k % 5000}")
+            sdk_serial_rate = n_single / (time.perf_counter() - t0)
+
+            # the SDK's pipelined mode — the shipped client's best
+            # single-event path (HTTP/1.1 pipelining on one socket)
+            t0 = time.perf_counter()
+            with client.pipeline(depth=128) as pipe:
+                for k in range(n_single):
+                    pipe.record_user_action_on_item(
+                        "buy", f"u{k % 1000}", f"i{k % 5000}")
             sdk_rate = n_single / (time.perf_counter() - t0)
         finally:
             httpd.shutdown()
@@ -473,6 +482,7 @@ def bench_ingest(smoke: bool) -> dict:
             "ingest_batch_events_per_sec": batch_rate,
             "ingest_single_events_per_sec": single_rate,
             "ingest_single_sdk_events_per_sec": sdk_rate,
+            "ingest_single_sdk_serial_events_per_sec": sdk_serial_rate,
             "fsync_policy": "rotate",
         }
     finally:
@@ -804,6 +814,7 @@ def main() -> int:
         "ingest_batch_events_per_sec": 0.0,
         "ingest_single_events_per_sec": 0.0,
         "ingest_single_sdk_events_per_sec": 0.0,
+        "ingest_single_sdk_serial_events_per_sec": 0.0,
         "fsync_policy": "section_failed",
     })
     p50 = http["ur_http_p50_ms"]   # the served path IS the north-star metric
@@ -862,6 +873,8 @@ def main() -> int:
             "ingest_single_events_per_sec": round(ingest["ingest_single_events_per_sec"], 1),
             "ingest_single_sdk_events_per_sec": round(
                 ingest["ingest_single_sdk_events_per_sec"], 1),
+            "ingest_single_sdk_serial_events_per_sec": round(
+                ingest.get("ingest_single_sdk_serial_events_per_sec", 0.0), 1),
             "ingest_fsync_policy": ingest["fsync_policy"],
             **({"section_failures": _SECTION_FAILURES}
                if _SECTION_FAILURES else {}),

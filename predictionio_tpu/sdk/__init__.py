@@ -1,1 +1,7 @@
-from predictionio_tpu.sdk.client import EngineClient, EventClient  # noqa: F401
+from predictionio_tpu.sdk.client import (  # noqa: F401
+    AsyncResult,
+    EngineClient,
+    EventClient,
+    EventPipeline,
+    PIOError,
+)

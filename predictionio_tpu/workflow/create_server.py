@@ -156,20 +156,39 @@ class _MicroBatcher:
                     self._leader_active = False
                     return
             try:
-                results = self._run([i["q"] for i in batch])
-                # strict: a predictor returning the wrong count must fall
-                # into the serial fallback, not leave an unserved item
-                # (whose thread would spin claiming/releasing leadership)
-                for i, r in zip(batch, results, strict=True):
-                    i["r"] = r
-            except Exception:
-                # one poisoned query must not 500 its batchmates:
-                # re-run the batch serially so only the offender errors
+                try:
+                    results = self._run([i["q"] for i in batch])
+                    # strict: a predictor returning the wrong count must
+                    # fall into the serial fallback, not leave an unserved
+                    # item (whose thread would spin claiming/releasing
+                    # leadership)
+                    for i, r in zip(batch, results, strict=True):
+                        i["r"] = r
+                except Exception:
+                    # one poisoned query must not 500 its batchmates:
+                    # re-run the batch serially so only the offender errors
+                    for i in batch:
+                        try:
+                            i["r"] = self._run_one(i["q"])
+                        except Exception as e:
+                            i["e"] = e
+            except BaseException as exc:
+                # SystemExit/KeyboardInterrupt escape the Exception
+                # clauses above; leadership and the batch's waiters must
+                # not leak with them (a stuck _leader_active wedges every
+                # future query)
+                err = RuntimeError(f"batch leader aborted: {exc!r}")
                 for i in batch:
-                    try:
-                        i["r"] = self._run_one(i["q"])
-                    except Exception as e:
-                        i["e"] = e
+                    if "r" not in i and "e" not in i:
+                        i["e"] = err
+                with self._lock:
+                    self._leader_active = False
+                    nxt = self._queue[0] if self._queue else None
+                if nxt is not None:
+                    nxt["ev"].set()
+                for i in batch:
+                    i["ev"].set()
+                raise
             served_self = own in batch
             if served_self:
                 with self._lock:

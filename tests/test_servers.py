@@ -771,3 +771,49 @@ def test_micro_batcher_short_batch_result_falls_back_serial():
     batcher = _MicroBatcher(run_batch, lambda q: "one:" + q, max_batch=4)
     assert batcher.predict("a") == "one:a"
     assert batcher._queue == [] and not batcher._leader_active
+
+
+def test_sdk_event_pipeline(event_server):
+    """Pipelined single-event ingestion: many requests in flight on one
+    keep-alive socket, responses drained in order; errors are isolated to
+    their own handle."""
+    from predictionio_tpu.sdk import EventClient
+
+    c = EventClient(event_server["key"], event_server["base"])
+    with c.pipeline(depth=16) as p:
+        handles = [p.record_user_action_on_item("buy", f"pu{i}", f"pi{i}")
+                   for i in range(50)]
+        bad = p.create_event("", "", "")          # server rejects: 400
+        more = [p.record_user_action_on_item("view", f"pu{i}", f"pi{i}")
+                for i in range(10)]
+    ids = [h.result()["eventId"] for h in handles]
+    assert len(set(ids)) == 50
+    import pytest as _pytest
+
+    from predictionio_tpu.sdk import PIOError
+    with _pytest.raises(PIOError):
+        bad.result()
+    assert all(m.result()["eventId"] for m in more)
+    # the events actually landed
+    got = c.find_events(entityType="user", entityId="pu3")
+    assert {e["event"] for e in got} == {"buy", "view"}
+
+
+def test_sdk_event_pipeline_abort_fails_pending(event_server):
+    """Leaving the pipeline context via an exception must fail the
+    outstanding handles cleanly (PIOError), not let a later result()
+    drain into the closed socket."""
+    import pytest as _pytest
+
+    from predictionio_tpu.sdk import EventClient, PIOError
+
+    c = EventClient(event_server["key"], event_server["base"])
+    with _pytest.raises(RuntimeError, match="boom"):
+        with c.pipeline(depth=64) as p:
+            handles = [p.record_user_action_on_item("buy", "au", f"ai{i}")
+                       for i in range(5)]
+            raise RuntimeError("boom")
+    for h in handles:
+        assert h.done
+        with _pytest.raises(PIOError, match="aborted"):
+            h.result()
