@@ -230,8 +230,7 @@ class EventPipeline:
         h = AsyncResult(self)
         self._pending.append(h)
         if len(self._buf) >= self._SEND_BUF:
-            self._sock.sendall(self._buf)
-            del self._buf[:]
+            self._flush_buf()
         if len(self._pending) >= self._depth:
             # drain half: keeps the wire busy while bounding in-flight
             self._drain(len(self._pending) - self._depth // 2)
@@ -271,10 +270,21 @@ class EventPipeline:
         except OSError:
             pass
 
-    def _drain(self, n: int) -> None:
-        if self._buf:
+    def _flush_buf(self) -> None:
+        """Send the userspace buffer; a send-side failure gets the same
+        clean-abort treatment as a read-side one (fail every pending
+        handle, release the socket) instead of leaving the pipeline
+        half-open."""
+        try:
             self._sock.sendall(self._buf)
             del self._buf[:]
+        except Exception as e:
+            self._abort(e)
+            raise
+
+    def _drain(self, n: int) -> None:
+        if self._buf:
+            self._flush_buf()
         for _ in range(min(n, len(self._pending))):
             h = self._pending.pop(0)
             h.done = True

@@ -97,19 +97,22 @@ class _MicroBatcher:
                 lead = False  # leading guarantees our item was served
             if "r" in item or "e" in item:
                 break
-            # re-arm, then re-check BOTH wake sources.  Result writers
-            # assign r/e before set(), so a set() racing our clear() is
-            # caught by the r/e re-check.  Leadership nudges set() WITHOUT
-            # writing a result — a clear() could swallow one — so we also
-            # probe the vacancy itself under the lock: if no leader is
-            # active we claim the lead ourselves, making a swallowed nudge
-            # harmless (the lock orders us against the releasing leader:
-            # either we see the vacancy, or their nudge lands after our
-            # clear and wakes the wait).
+            # re-arm, then re-check BOTH wake sources under ONE lock hold.
+            # Result writers assign r/e before set(), so a set() racing
+            # our clear() is caught by the r/e re-check.  Leadership
+            # nudges set() WITHOUT writing a result — a clear() could
+            # swallow one — so we also probe the vacancy itself: if no
+            # leader is active we claim the lead, making a swallowed
+            # nudge harmless.  The r/e check MUST share the claim's lock
+            # hold: results are written before leadership is released
+            # (itself under the lock), so either we see our result here,
+            # or the leader hasn't released yet and we won't win the
+            # vacancy — never both, so a served waiter can't become a
+            # leader that withholds its own finished result.
             item["ev"].clear()
-            if "r" in item or "e" in item:
-                break
             with self._lock:
+                if "r" in item or "e" in item:
+                    break
                 lead = not self._leader_active
                 if lead:
                     self._leader_active = True

@@ -372,3 +372,19 @@ def test_two_process_als_training_matches_single(tmp_path):
         got = np.load(out_dir / f"p{pid}.npz")
         np.testing.assert_allclose(got["X"], np.asarray(X), rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(got["Y"], np.asarray(Y), rtol=2e-3, atol=2e-3)
+
+
+def test_graft_dryrun_multichip_8():
+    """The driver's multichip validation entry point must stay green:
+    sharded ALS + CCO (both strategies) + the engine-level UR pipeline
+    (run_train → persist → predict) on an 8-device mesh, all asserted
+    equal to single-device."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        from __graft_entry__ import dryrun_multichip
+    finally:
+        sys.path.pop(0)
+    dryrun_multichip(8)
