@@ -747,6 +747,12 @@ def _dense_path_ok(n_items_p: int, n_items_t: int) -> bool:
 _SPARSE_PAIR_BUDGET = 200_000_000
 _SPARSE_C_BYTES = 512 << 20
 _SPARSE_CHUNK_PAIRS = 8_000_000   # cross-join temporaries cap (~64 MB/chunk)
+# Matrices at or under this cell count use the bincount accumulation branch
+# (which loses per-cell identities); above it every chunk goes through
+# np.unique, which is what lets want_coo collect touched cells.  ONE
+# constant for both gates — they must stay in lockstep or the COO path
+# would silently drop cells accumulated by a bincount chunk.
+_SPARSE_BINCOUNT_CELLS = 16 << 20
 
 
 def _sparse_path_ok() -> bool:
@@ -781,24 +787,44 @@ class _SparseHostCSR:
             self.item, minlength=n_items).astype(np.int32)
 
 
-def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR
-                   ) -> Optional[np.ndarray]:
+def _cross_join_pairs(p: _SparseHostCSR, a: _SparseHostCSR) -> int:
+    """Σ_u deg_P(u)·deg_A(u) — the exact cross-join expansion size, an
+    upper bound on the count matrix's nnz."""
+    n = min(len(p.deg), len(a.deg))
+    return int((p.deg[:n] * a.deg[:n]).sum())
+
+
+def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR,
+                   want_coo: bool = False,
+                   total_pairs: Optional[int] = None):
     """Exact cooccurrence counts C[i, j] = |users with both| via a
     vectorized per-user cross-join + bincount — O(E + Σ_u deg_P·deg_A)
     host work, no densified matrices anywhere.  Returns None when the
     expansion or the count matrix would blow the host budgets (caller
     falls back to the device path).  Bit-identical to the device counts:
-    both count distinct (user, item) pairs."""
+    both count distinct (user, item) pairs.
+
+    ``want_coo=True`` returns ``(C, flat)`` where ``flat`` is the sorted
+    unique flat indices of C's nonzero cells — collected from the
+    unique-branch chunks for large matrices, so the sparse LLR tail never
+    has to re-scan a 100M+-cell dense matrix to find them (for small
+    matrices a direct flatnonzero scan is cheap and exact)."""
     I_p, I_t = p.n_items, a.n_items
     if I_p * I_t * 4 > _SPARSE_C_BYTES:       # true peak: C is int32 below
         return None
-    n = min(len(p.deg), len(a.deg))
-    total = int((p.deg[:n] * a.deg[:n]).sum())
+    total = _cross_join_pairs(p, a) if total_pairs is None else total_pairs
     if total > _SPARSE_PAIR_BUDGET:
         return None
+    # touched-cell tracking: only worthwhile when the matrix is big
+    # enough that the bincount branch (which loses cell identities) can
+    # never fire — exactly the case where a flatnonzero scan would hurt
+    touched: Optional[list] = (
+        [] if want_coo and I_p * I_t > _SPARSE_BINCOUNT_CELLS else None)
     C = np.zeros(I_p * I_t, np.int32)         # counts ≤ n_users < 2³¹
     if total == 0:
-        return C.reshape(I_p, I_t)
+        empty = np.empty(0, np.int64)
+        return (C.reshape(I_p, I_t), empty) if want_coo \
+            else C.reshape(I_p, I_t)
     rep_all = a.deg[p.user]                   # partners per primary entry
     csum_all = np.cumsum(rep_all)
     # chunk the expansion over primary entries so the ~5 pair-length
@@ -819,7 +845,7 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR
             within = np.arange(chunk, dtype=np.int64) - np.repeat(
                 csum - rep, rep)
             flat = p_rep.astype(np.int64) * I_t + a.item[offs + within]
-            if I_p * I_t <= (16 << 20) and chunk * 8 >= I_p * I_t:
+            if I_p * I_t <= _SPARSE_BINCOUNT_CELLS and chunk * 8 >= I_p * I_t:
                 # dense-ish chunk over a small matrix: an O(n + cells)
                 # bincount pass beats the sort-based unique.  Gated on
                 # BOTH sizes — with few pairs the per-chunk full-width
@@ -830,15 +856,115 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR
             else:
                 cells, counts = np.unique(flat, return_counts=True)
                 C[cells] += counts.astype(np.int32)
+                if touched is not None:
+                    touched.append(cells)
         lo = hi
-    return C.reshape(I_p, I_t)
+    if not want_coo:
+        return C.reshape(I_p, I_t)
+    if touched is None:
+        flat_nz = np.flatnonzero(C)
+    elif touched:
+        flat_nz = np.unique(np.concatenate(touched))
+    else:
+        flat_nz = np.empty(0, np.int64)
+    return C.reshape(I_p, I_t), flat_nz
+
+
+@jax.jit
+def _llr_cells(k11, rc_g, cc_g, n_total, llr_threshold):
+    """Elementwise LLR + masking on GATHERED nonzero cells — the same op
+    sequence as _llr_mask_scores applied to 1-D gathers, so each cell's
+    float32 score is bit-identical to the dense [I_p, I_t] tail's value
+    at that cell (XLA elementwise math is element-value-deterministic,
+    independent of tensor shape)."""
+    k12 = rc_g - k11
+    k21 = cc_g - k11
+    k22 = n_total - k11 - k12 - k21
+    s = llr_score(k11, k12, k21, k22)
+    s = jnp.where(k11 > 0, s, -jnp.inf)
+    return jnp.where(s >= llr_threshold, s, -jnp.inf)
+
+
+def _llr_topk_sparse_host(C, rc, cc, n_total, llr_threshold,
+                          top_k: int, exclude_self: bool,
+                          flat: Optional[np.ndarray] = None):
+    """Sparse-aware LLR + top-k for the host path: score ONLY the nonzero
+    cells of C (the dense tail masks c==0 to -inf anyway, so the zeros
+    carry no information), then per-row top-k on host via one lexsort.
+
+    At the low occupancies this path serves (events ≪ users·items, e.g.
+    ~0.6% at the bench shape) the dense [I_p, I_t] LLR + lax.top_k tail
+    does ~99% wasted work on CPU; this is O(nnz) scoring + O(nnz·log nnz)
+    selection.  Output is bit-identical to _llr_topk_dense: scores come
+    from the same jitted elementwise chain, and ties at equal scores pick
+    the smaller column index — exactly lax.top_k's stable order.
+
+    ``flat`` (from ``_sparse_counts(..., want_coo=True)``): sorted unique
+    flat indices of the nonzero cells, so no O(I_p·I_t) scan happens
+    here."""
+    I_p, I_t = C.shape
+    if flat is not None:
+        rows, cols = flat // I_t, flat % I_t
+    else:
+        rows, cols = np.nonzero(C)
+    if exclude_self:
+        off_diag = rows != cols
+        rows, cols = rows[off_diag], cols[off_diag]
+    width = min(top_k, I_t)
+    out_s = np.full((I_p, width), -np.inf, np.float32)
+    out_i = np.full((I_p, width), -1, np.int32)
+    if len(rows):
+        # bucket the gather length to the next power of two (zero-padded
+        # k11 scores to -inf and is filtered below) so _llr_cells compiles
+        # once per bucket, not once per distinct nnz
+        nnz = len(rows)
+        pad = 1 << (nnz - 1).bit_length()
+        k11 = np.zeros(pad, np.float32)
+        rc_g = np.ones(pad, np.float32)
+        cc_g = np.ones(pad, np.float32)
+        k11[:nnz] = C[rows, cols]
+        rc_g[:nnz] = rc[rows]
+        cc_g[:nnz] = cc[cols]
+        scores = np.asarray(_llr_cells(
+            k11, rc_g, cc_g,
+            jnp.float32(n_total), jnp.float32(llr_threshold)))[:nnz]
+        keep = scores > -np.inf
+        rows, cols, scores = rows[keep], cols[keep], scores[keep]
+    if len(rows):
+        # row-major, score desc within row, column asc on ties
+        order = np.lexsort((cols, -scores, rows))
+        rows, cols, scores = rows[order], cols[order], scores[order]
+        starts = np.concatenate([[0], np.flatnonzero(np.diff(rows)) + 1])
+        counts = np.diff(np.concatenate([starts, [len(rows)]]))
+        rank = np.arange(len(rows)) - np.repeat(starts, counts)
+        sel = rank < width
+        out_s[rows[sel], rank[sel]] = scores[sel]
+        out_i[rows[sel], rank[sel]] = cols[sel]
+    return out_s, out_i
+
+
+def _sparse_tail() -> str:
+    """'auto' (default) | 'host' | 'device' via PIO_CCO_SPARSE_TAIL.
+
+    auto picks per event type by pair density (see dispatch): the host
+    tail's cost scales with the nonzero cells, the device tail's with ALL
+    cells, and the measured crossover on this class of host is at
+    pairs/cells ≈ 0.25 (sweep in PERF.md round 5)."""
+    conf = _os.environ.get("PIO_CCO_SPARSE_TAIL", "auto").lower()
+    if conf in ("device", "dense"):
+        return "device"
+    if conf == "host":
+        return "host"
+    return "auto"
 
 
 class _SparseHostRunner:
     """Host-count twin of _DenseRunner: same dispatch/collect contract,
-    same device LLR + top-k tail (_llr_topk_dense), so results are
-    bit-identical to the dense strategy — only the count production
-    differs.  dispatch returns None when budgets say 'use the device'."""
+    and a bit-identical tail — sparse host LLR/top-k by default (same
+    elementwise scores, same tie order as the device tail), or the device
+    _llr_topk_dense via PIO_CCO_SPARSE_TAIL=device.  Only the count
+    production ever differs from the dense strategy: it never does.
+    dispatch returns None when budgets say 'use the device'."""
 
     def __init__(self, p_user, p_item, n_users: int, n_items_p: int,
                  n_total_users: Optional[int] = None):
@@ -854,16 +980,35 @@ class _SparseHostRunner:
 
         a = self.p if self_pair else _SparseHostCSR(
             a_user, a_item, n_items_t, self.n_users)
-        C = _sparse_counts(self.p, a)
-        if C is None:
+        pairs = _cross_join_pairs(self.p, a)
+        tail = _sparse_tail()
+        if tail == "auto":
+            # nnz ≤ total cross-join pairs, so pairs/cells bounds the
+            # occupancy the host tail would have to sort; past ~0.25 the
+            # dense device tail is the better deal (measured crossover)
+            tail = "host" if pairs * 4 < self.n_items_p * n_items_t \
+                else "device"
+        host_tail = tail == "host"
+        got = _sparse_counts(self.p, a, want_coo=host_tail,
+                             total_pairs=pairs)
+        if got is None:
             return None
-        s, i = _llr_topk_dense(
-            jnp.asarray(C), jnp.asarray(self.p.col_counts),
-            jnp.asarray(a.col_counts),
-            float(self.n_total_users), float(llr_threshold),
-            top_k=min(top_k, C.shape[1]), exclude_self=bool(exclude_self),
-            pallas=pallas_mode(), topk=topk_impl(),
-        )
+        if host_tail:
+            C, flat = got
+            s, i = _llr_topk_sparse_host(
+                C, self.p.col_counts, a.col_counts,
+                float(self.n_total_users), float(llr_threshold),
+                top_k=top_k, exclude_self=bool(exclude_self), flat=flat)
+        else:
+            C = got
+            s, i = _llr_topk_dense(
+                jnp.asarray(C), jnp.asarray(self.p.col_counts),
+                jnp.asarray(a.col_counts),
+                float(self.n_total_users), float(llr_threshold),
+                top_k=min(top_k, C.shape[1]),
+                exclude_self=bool(exclude_self),
+                pallas=pallas_mode(), topk=topk_impl(),
+            )
         return s, i, n_items_t, top_k
 
     @staticmethod

@@ -355,7 +355,8 @@ class EventClient:
     def pipeline(self, depth: int = 128) -> EventPipeline:
         """Open a pipelined single-event ingestion session (see
         EventPipeline).  Use when pushing many events whose ids you don't
-        need synchronously — ~2-3x the serial keep-alive rate."""
+        need synchronously — ~4x the serial keep-alive rate measured
+        against a local event server."""
         return EventPipeline(self, depth=depth, timeout=self.timeout)
 
     def _qs(self) -> str:

@@ -417,3 +417,64 @@ def test_sparse_host_self_pair_and_train_indicators(monkeypatch):
     for r in range(n_items):
         idx = r_sparse["buy"][1][r]
         assert r not in set(idx[idx >= 0])
+
+
+def test_sparse_host_tail_matches_device_tail(monkeypatch):
+    """The sparse host LLR/top-k tail (scores only nonzero cells, lexsort
+    top-k) must be bit-identical to the dense device tail at both forced
+    settings, including the COO fast path and exclude_self."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_items = 300, 64
+    u, i = random_interactions(n_users, n_items, 900, 71)
+    monkeypatch.setenv("PIO_CCO_SPARSE", "1")
+
+    def run():
+        r = cco_ops._SparseHostRunner(u, i, n_users, n_items)
+        d = r.dispatch(u, i, n_items, 5, 1.0, True, self_pair=True)
+        return r.collect(d)
+
+    monkeypatch.setenv("PIO_CCO_SPARSE_TAIL", "device")
+    ds, di = run()
+    monkeypatch.setenv("PIO_CCO_SPARSE_TAIL", "host")
+    hs, hi = run()
+    np.testing.assert_array_equal(hs, ds)
+    np.testing.assert_array_equal(hi, di)
+    # auto at this tiny shape picks SOME tail; result must match either way
+    monkeypatch.setenv("PIO_CCO_SPARSE_TAIL", "auto")
+    as_, ai_ = run()
+    np.testing.assert_array_equal(as_, ds)
+    np.testing.assert_array_equal(ai_, di)
+    # rows with fewer than top_k surviving cells pad with -inf / -1
+    assert ((hi == -1) == (hs == -np.inf)).all()
+
+
+def test_sparse_counts_coo_touched_path():
+    """want_coo on a matrix ABOVE the bincount-branch gate must collect
+    the touched cells from the unique-branch chunks — and they must equal
+    a direct flatnonzero scan of the dense result."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    # 4200 x 4100 = 17.2M cells > _SPARSE_BINCOUNT_CELLS (16.8M)
+    n_users, n_ip, n_it = 500, 4200, 4100
+    assert n_ip * n_it > cco_ops._SPARSE_BINCOUNT_CELLS
+    pu, pi = random_interactions(n_users, n_ip, 3000, 81)
+    au, ai = random_interactions(n_users, n_it, 4000, 82)
+    p = cco_ops._SparseHostCSR(pu, pi, n_ip, n_users)
+    a = cco_ops._SparseHostCSR(au, ai, n_it, n_users)
+    C, flat = cco_ops._sparse_counts(p, a, want_coo=True)
+    np.testing.assert_array_equal(flat, np.flatnonzero(C))
+    assert len(flat) > 0
+    # and the host tail built from that COO matches the device tail
+    s_host, i_host = cco_ops._llr_topk_sparse_host(
+        C, p.col_counts, a.col_counts, float(n_users), 0.0, 6, False,
+        flat=flat)
+    import jax.numpy as jnp
+    from predictionio_tpu.ops.pallas_kernels import pallas_mode
+    s_dev, i_dev = cco_ops._llr_topk_dense(
+        jnp.asarray(C), jnp.asarray(p.col_counts), jnp.asarray(a.col_counts),
+        float(n_users), 0.0, top_k=6, exclude_self=False,
+        pallas=pallas_mode(), topk="lax")
+    s_dev, i_dev = cco_ops._finalize_topk(s_dev, i_dev, n_it)
+    np.testing.assert_array_equal(s_host, s_dev)
+    np.testing.assert_array_equal(i_host, i_dev)
