@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os as _os
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -477,11 +478,46 @@ class URModel(PersistentModel):
             self.__dict__["_dev_indicators"] = dev
         return dev
 
+    def host_inverted(self, name: str) -> Tuple[np.ndarray, np.ndarray,
+                                                np.ndarray]:
+        """CSR inversion of one event type's indicator table, keyed by
+        TARGET item id: ``(indptr [n_t+1], rows [nnz], weights [nnz])``
+        where rows are the primary items listing target t as a correlator.
+        Lazily built and cached (never serialized — derived data).
+
+        Why: the device scorer gathers the history multi-hot at every
+        [I_p, K] table cell — ideal for the VPU, but ~5M random gathers
+        per event type on CPU (~6 ms/query at 100k items).  The inversion
+        turns a query into |hist| posting-list slices and ~|hist|·K/I_t·I_p
+        scatter-adds — microseconds of host work."""
+        cache = self.__dict__.setdefault("_host_inv", {})
+        if name not in cache:
+            idx, llr = self.indicator_idx[name], self.indicator_llr[name]
+            i_p, k = idx.shape if idx.ndim == 2 else (0, 0)
+            valid = idx >= 0
+            rows = np.repeat(np.arange(i_p, dtype=np.int32), k)[valid.ravel()]
+            tgt = idx[valid]
+            w = llr[valid].astype(np.float32)
+            order = np.argsort(tgt, kind="stable")
+            tgt, rows, w = tgt[order], rows[order], w[order]
+            n_t = max(len(self.event_item_dicts[name]), 1)
+            indptr = np.concatenate(
+                [[0], np.cumsum(np.bincount(tgt, minlength=n_t))]
+            ).astype(np.int64)
+            cache[name] = (indptr, rows, w)
+        return cache[name]
+
     def warm(self) -> None:
         self.device_indicators()
         self.device_popularity()
         self.device_ones()
         self.pop_norm()
+        if _serve_scorer() == "host":
+            # the CSR inversion is an argsort over ~I_p·K entries per
+            # event type — build it at warm time, not inside the first
+            # query (where it would stall the micro-batch leader)
+            for name in self.indicator_idx:
+                self.host_inverted(name)
 
     def pop_norm(self) -> float:
         norm = self.__dict__.get("_pop_norm")
@@ -652,6 +688,19 @@ def _serve_topk_batch(signal, mask, bf, black_ids, k: int):
     bt, bi = jax.lax.top_k(bfm, k)
     return jnp.stack(
         [st, si.astype(jnp.float32), bt, bi.astype(jnp.float32)], axis=1)
+
+
+def _serve_scorer() -> str:
+    """'device' | 'host' — which history scorer serves queries.
+
+    auto (default): host on the CPU backend (the inverted-index path is
+    ~10× the gather program there — see _score_history), device
+    everywhere else (the gather program keeps the [I_p] signal on the
+    accelerator and ships only id lists).  PIO_UR_SERVE_SCORER forces."""
+    conf = _os.environ.get("PIO_UR_SERVE_SCORER", "auto").lower()
+    if conf in ("host", "device"):
+        return conf
+    return "host" if jax.default_backend() == "cpu" else "device"
 
 
 @partial(jax.jit, static_argnames=("n_items_t",))
@@ -990,9 +1039,18 @@ class URAlgorithm(Algorithm):
     def _score_history(
         self, model: URModel, hist: Dict[str, np.ndarray]
     ) -> Optional[jnp.ndarray]:
-        """Run the device-resident scorer over every event type's history;
-        accumulates ON DEVICE and stays there — the serving tail
-        (_serve_topk) consumes it without any [I_p] host transfer."""
+        """Run the scorer over every event type's history.
+
+        device (TPU default): the resident-table gather program — a query
+        ships a few hundred bytes and the [I_p] signal never leaves the
+        device for the serving tail.  host (CPU default): posting-list
+        scatter-adds over the inverted indicator index (see
+        URModel.host_inverted) — the gather program's ~5M random accesses
+        per event type are the measured CPU serving bottleneck at 100k
+        items (13 ms of a 15.6 ms p50).  PIO_UR_SERVE_SCORER overrides."""
+        if _serve_scorer() == "host":
+            s = self._score_history_host(model, hist)
+            return None if s is None else jnp.asarray(s)
         use_llr = jnp.asarray(self.params.use_llr_weights)
         total = None
         for name, (idx_dev, llr_dev) in model.device_indicators().items():
@@ -1006,6 +1064,38 @@ class URAlgorithm(Algorithm):
             weight = float(self.params.indicator_weights.get(name, 1.0))
             s = s * weight if weight != 1.0 else s
             total = s if total is None else total + s
+        return total
+
+    def _score_history_host(
+        self, model: URModel, hist: Dict[str, np.ndarray]
+    ) -> Optional[np.ndarray]:
+        """Inverted-index twin of the device scorer: same signal (float32
+        sums may differ in the last ulp — addition order differs), built
+        from |hist| posting-list slices per event type."""
+        i_p = len(model.item_dict)
+        total: Optional[np.ndarray] = None
+        for name in model.indicator_idx:
+            h_ids = hist.get(name)
+            if h_ids is None or len(h_ids) == 0:
+                continue
+            indptr, rows, w = model.host_inverted(name)
+            n_t = len(indptr) - 1
+            segs = [(indptr[h], indptr[h + 1])
+                    for h in np.asarray(h_ids) if 0 <= h < n_t]
+            segs = [(a, b) for a, b in segs if b > a]
+            score = np.zeros(i_p, np.float32)
+            if segs:
+                cat_rows = np.concatenate([rows[a:b] for a, b in segs])
+                if self.params.use_llr_weights:
+                    cat_w = np.concatenate([w[a:b] for a, b in segs])
+                    np.add.at(score, cat_rows, cat_w)
+                else:
+                    score += np.bincount(
+                        cat_rows, minlength=i_p).astype(np.float32)
+            weight = float(self.params.indicator_weights.get(name, 1.0))
+            if weight != 1.0:
+                score *= weight
+            total = score if total is None else total + score
         return total
 
     def batch_predict(self, model: URModel, queries) -> List[URResult]:
@@ -1124,23 +1214,32 @@ class URAlgorithm(Algorithm):
         hists = [self._query_hist(model, q) for q in queries]
         have_signal = [h is not None and any(len(v) for v in h.values())
                        for h in hists]
-        use_llr = jnp.asarray(self.params.use_llr_weights)
         total = None
-        for name, (idx_dev, llr_dev) in model.device_indicators().items():
-            lens = [len(h[name]) if h and name in h else 0 for h in hists]
-            if not any(lens):
-                continue
-            w = bucket_width(max(lens))
-            hm = np.full((bp, w), -1, np.int32)
-            for r, h in enumerate(hists):
-                if h and name in h and len(h[name]):
-                    hm[r, : len(h[name])] = h[name]
-            n_t = max(len(model.event_item_dicts[name]), 1)
-            s = _indicator_score_ids_batch(
-                idx_dev, llr_dev, jnp.asarray(hm), use_llr, n_t)
-            weight = float(self.params.indicator_weights.get(name, 1.0))
-            s = s * weight if weight != 1.0 else s
-            total = s if total is None else total + s
+        if _serve_scorer() == "host":
+            rows_np = [self._score_history_host(model, h) if h else None
+                       for h in hists]
+            if any(r is not None for r in rows_np):
+                total = jnp.asarray(np.stack(
+                    [r if r is not None else np.zeros(n_items, np.float32)
+                     for r in rows_np]
+                    + [np.zeros(n_items, np.float32)] * (bp - b)))
+        else:
+            use_llr = jnp.asarray(self.params.use_llr_weights)
+            for name, (idx_dev, llr_dev) in model.device_indicators().items():
+                lens = [len(h[name]) if h and name in h else 0 for h in hists]
+                if not any(lens):
+                    continue
+                w = bucket_width(max(lens))
+                hm = np.full((bp, w), -1, np.int32)
+                for r, h in enumerate(hists):
+                    if h and name in h and len(h[name]):
+                        hm[r, : len(h[name])] = h[name]
+                n_t = max(len(model.event_item_dicts[name]), 1)
+                s = _indicator_score_ids_batch(
+                    idx_dev, llr_dev, jnp.asarray(hm), use_llr, n_t)
+                weight = float(self.params.indicator_weights.get(name, 1.0))
+                s = s * weight if weight != 1.0 else s
+                total = s if total is None else total + s
         if total is None:
             total = jnp.zeros((bp, n_items), jnp.float32)
         masks = jnp.stack(

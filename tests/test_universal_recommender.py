@@ -764,3 +764,41 @@ def test_ur_serve_batch_matches_serial(ur_app):
         s_items = [(r.item, round(r.score, 4)) for r in s.item_scores]
         b_items = [(r.item, round(r.score, 4)) for r in b.item_scores]
         assert s_items == b_items, (q, s_items, b_items)
+
+
+def test_host_scorer_matches_device_scorer(trained, monkeypatch):
+    """The inverted-index host scorer must produce the same signal (and
+    the same recommendations) as the device gather program for identical
+    queries — only float32 addition order may differ."""
+    import numpy as np
+
+    engine, ep, models = trained
+    queries = [URQuery(user=u, num=6) for u in ("u2", "u9", "u20", "u27")]
+
+    def run():
+        predict = engine.predictor(ep, models)
+        return [predict(q) for q in queries]
+
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "device")
+    dev = run()
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    host = run()
+    for d, h in zip(dev, host):
+        # f32 addition order differs between scorers, so near-equal
+        # scores may legitimately swap rank: compare the item SETS and
+        # the sorted score vectors, not the exact ordering
+        assert {s.item for s in d.item_scores} == \
+            {s.item for s in h.item_scores}
+        np.testing.assert_allclose(
+            sorted(s.score for s in d.item_scores),
+            sorted(s.score for s in h.item_scores), rtol=1e-5)
+
+    # the raw signal too, on the algorithm directly
+    from predictionio_tpu.models.universal_recommender.engine import URAlgorithm
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    hist = algo._user_history(model, "u2")
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "device")
+    s_dev = np.asarray(algo._score_history(model, hist))
+    s_host = algo._score_history_host(model, hist)
+    np.testing.assert_allclose(s_dev, s_host, rtol=1e-5, atol=1e-6)
