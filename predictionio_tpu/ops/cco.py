@@ -976,8 +976,6 @@ class _SparseHostRunner:
     def dispatch(self, a_user, a_item, n_items_t: int, top_k: int,
                  llr_threshold: float, exclude_self: bool,
                  self_pair: bool = False):
-        from predictionio_tpu.ops.pallas_kernels import pallas_mode
-
         a = self.p if self_pair else _SparseHostCSR(
             a_user, a_item, n_items_t, self.n_users)
         pairs = _cross_join_pairs(self.p, a)
@@ -1000,6 +998,10 @@ class _SparseHostRunner:
                 float(self.n_total_users), float(llr_threshold),
                 top_k=top_k, exclude_self=bool(exclude_self), flat=flat)
         else:
+            # imported here, not at dispatch entry: the pallas machinery
+            # is a ~0.35 s one-time import the host tail never needs
+            from predictionio_tpu.ops.pallas_kernels import pallas_mode
+
             C = got
             s, i = _llr_topk_dense(
                 jnp.asarray(C), jnp.asarray(self.p.col_counts),

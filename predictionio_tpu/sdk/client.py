@@ -189,7 +189,10 @@ class EventPipeline:
         self._host = (u.hostname or "localhost").encode("ascii")
         self._prefix = u.path.rstrip("/")
         self._qs = client._qs()
-        self._depth = max(1, depth)
+        # the deadlock-avoidance invariant (see docstring) only holds if
+        # queued responses stay well under a default socket buffer
+        # (~128 KiB): clamp depth so ~100 B/response can't fill it
+        self._depth = max(1, min(depth, 512))
         self._buf = bytearray()
         self._pending: List[AsyncResult] = []
         self._closed = False
