@@ -677,20 +677,12 @@ def bench_scale(smoke: bool) -> dict:
     del idd, idt
 
     # ---- tiled-path throughput on the big catalog, streamed staging ----
-    def gen_batches(seed):
-        g = np.random.default_rng(seed)
-        done = 0
-        while done < n_events:
-            n = min(batch, n_events - done)
-            yield (g.integers(0, n_users, n).astype(np.int32),
-                   (g.zipf(1.25, n) % n_items).astype(np.int32))
-            done += n
-
     os.environ["PIO_CCO_DENSE"] = "0"
     try:
         t0 = time.perf_counter()
         blocked = cco_ops.block_interactions_stream(
-            gen_batches(7), n_users, n_items, user_block=user_block)
+            _gen_scale_batches(7, n_users, n_items, n_events, batch),
+            n_users, n_items, user_block=user_block)
         stage_s = time.perf_counter() - t0
         t1 = time.perf_counter()
         scores, idx = cco_ops.cco_indicators(
@@ -749,6 +741,19 @@ def bench_scale(smoke: bool) -> dict:
     return out
 
 
+def _gen_scale_batches(seed, n_users, n_items, n_events, batch):
+    """Streamed synthetic event batches for the scale legs — ONE
+    generator for the reduced run and the full-shape host proof, so the
+    two can't drift apart in distribution."""
+    g = np.random.default_rng(seed)
+    done = 0
+    while done < n_events:
+        n = min(batch, n_events - done)
+        yield (g.integers(0, n_users, n).astype(np.int32),
+               (g.zipf(1.25, n) % n_items).astype(np.int32))
+        done += n
+
+
 def _scale_fullshape_host_proof(fullshape) -> dict:
     import math
 
@@ -759,18 +764,10 @@ def _scale_fullshape_host_proof(fullshape) -> dict:
 
     n_users, n_items, n_events, batch, user_block, tile = fullshape
 
-    def gen(seed):
-        g = np.random.default_rng(seed)
-        done = 0
-        while done < n_events:
-            n = min(batch, n_events - done)
-            yield (g.integers(0, n_users, n).astype(np.int32),
-                   (g.zipf(1.25, n) % n_items).astype(np.int32))
-            done += n
-
     t0 = time.perf_counter()
     blocked = cco_ops.block_interactions_stream(
-        gen(7), n_users, n_items, user_block=user_block)
+        _gen_scale_batches(7, n_users, n_items, n_events, batch),
+        n_users, n_items, user_block=user_block)
     stage_s = time.perf_counter() - t0
     n_tiles = math.ceil(n_items / tile)   # matches cco_indicators exactly
     sds = [jax.ShapeDtypeStruct(a.shape, np.asarray(a).dtype)

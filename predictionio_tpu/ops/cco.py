@@ -904,7 +904,7 @@ def _llr_topk_sparse_host(C, rc, cc, n_total, llr_threshold,
     here."""
     I_p, I_t = C.shape
     if flat is not None:
-        rows, cols = flat // I_t, flat % I_t
+        rows, cols = np.divmod(flat, I_t)
     else:
         rows, cols = np.nonzero(C)
     if exclude_self:

@@ -13,6 +13,7 @@ import json
 import threading
 import time
 import urllib.parse
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 
@@ -194,7 +195,7 @@ class EventPipeline:
         # (~128 KiB): clamp depth so ~100 B/response can't fill it
         self._depth = max(1, min(depth, 512))
         self._buf = bytearray()
-        self._pending: List[AsyncResult] = []
+        self._pending: "deque[AsyncResult]" = deque()
         self._closed = False
 
     # -- request side -------------------------------------------------------
@@ -258,20 +259,23 @@ class EventPipeline:
         payload = self._rfile.read(length) if length else b""
         return status, payload
 
-    def _abort(self, err: Exception) -> None:
-        """Fail every outstanding handle and release the socket — after
-        this, pending ``result()`` calls raise ``err`` instead of
-        touching the dead/closed stream."""
+    def _release_socket(self) -> None:
         self._closed = True
-        for h in self._pending:
-            h.done, h._error = True, err
-        del self._pending[:]
-        del self._buf[:]
         try:
             self._rfile.close()
             self._sock.close()
         except OSError:
             pass
+
+    def _abort(self, err: Exception) -> None:
+        """Fail every outstanding handle and release the socket — after
+        this, pending ``result()`` calls raise ``err`` instead of
+        touching the dead/closed stream."""
+        for h in self._pending:
+            h.done, h._error = True, err
+        self._pending.clear()
+        del self._buf[:]
+        self._release_socket()
 
     def _flush_buf(self) -> None:
         """Send the userspace buffer; a send-side failure gets the same
@@ -289,7 +293,7 @@ class EventPipeline:
         if self._buf:
             self._flush_buf()
         for _ in range(min(n, len(self._pending))):
-            h = self._pending.pop(0)
+            h = self._pending.popleft()
             h.done = True
             try:
                 status, payload = self._read_response()
@@ -323,12 +327,7 @@ class EventPipeline:
         try:
             self.flush()
         finally:
-            self._closed = True
-            try:
-                self._rfile.close()
-                self._sock.close()
-            except OSError:
-                pass
+            self._release_socket()
 
     def __enter__(self) -> "EventPipeline":
         return self
