@@ -508,16 +508,21 @@ class URModel(PersistentModel):
         return cache[name]
 
     def warm(self) -> None:
-        self.device_indicators()
+        # stage only what the resolved scorer will read: the device
+        # tables are the model's largest arrays (~80 MB at 100k items ×
+        # 2 event types) and the host scorer never touches them — and
+        # vice versa, the CSR inversion is an argsort over ~I_p·K
+        # entries per event type that must not stall the first query's
+        # micro-batch leader.  Both stay lazy, so a runtime scorer
+        # switch still works — it just pays its build on first use.
+        if _serve_scorer() == "host":
+            for name in self.indicator_idx:
+                self.host_inverted(name)
+        else:
+            self.device_indicators()
         self.device_popularity()
         self.device_ones()
         self.pop_norm()
-        if _serve_scorer() == "host":
-            # the CSR inversion is an argsort over ~I_p·K entries per
-            # event type — build it at warm time, not inside the first
-            # query (where it would stall the micro-batch leader)
-            for name in self.indicator_idx:
-                self.host_inverted(name)
 
     def pop_norm(self) -> float:
         norm = self.__dict__.get("_pop_norm")

@@ -753,6 +753,12 @@ _SPARSE_CHUNK_PAIRS = 8_000_000   # cross-join temporaries cap (~64 MB/chunk)
 # constant for both gates — they must stay in lockstep or the COO path
 # would silently drop cells accumulated by a bincount chunk.
 _SPARSE_BINCOUNT_CELLS = 16 << 20
+# Touched-cell collection holds up to one int64 per cross-join pair across
+# the per-chunk unique arrays (+ ~the same again transiently in the final
+# concatenate+unique) — unbudgeted, that can dwarf _SPARSE_C_BYTES.  Past
+# this pair count the tail falls back to one flatnonzero scan of C
+# (O(cells), bounded by the 512 MB C budget) instead of collecting.
+_SPARSE_COO_PAIRS = 32_000_000   # ~0.25 GB int64 + transient ≈ C budget
 
 
 def _sparse_path_ok() -> bool:
@@ -817,9 +823,13 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR,
         return None
     # touched-cell tracking: only worthwhile when the matrix is big
     # enough that the bincount branch (which loses cell identities) can
-    # never fire — exactly the case where a flatnonzero scan would hurt
+    # never fire — exactly the case where a flatnonzero scan would hurt —
+    # AND the pair count keeps the collected arrays inside their own
+    # memory budget (past it, the flatnonzero fallback below is cheaper
+    # than the collection's transients)
     touched: Optional[list] = (
-        [] if want_coo and I_p * I_t > _SPARSE_BINCOUNT_CELLS else None)
+        [] if want_coo and I_p * I_t > _SPARSE_BINCOUNT_CELLS
+        and total <= _SPARSE_COO_PAIRS else None)
     C = np.zeros(I_p * I_t, np.int32)         # counts ≤ n_users < 2³¹
     if total == 0:
         empty = np.empty(0, np.int64)

@@ -817,3 +817,25 @@ def test_sdk_event_pipeline_abort_fails_pending(event_server):
         assert h.done
         with _pytest.raises(PIOError, match="aborted"):
             h.result()
+
+
+def test_sdk_event_pipeline_partial_drain_and_close(event_server):
+    """result() on an early handle drains only up to it; close() finishes
+    the rest; a closed pipeline refuses new sends."""
+    import pytest as _pytest
+
+    from predictionio_tpu.sdk import EventClient, PIOError
+
+    c = EventClient(event_server["key"], event_server["base"])
+    p = c.pipeline(depth=64)
+    handles = [p.record_user_action_on_item("buy", f"du{i}", f"di{i}")
+               for i in range(9)]
+    # draining handle 2 completes 0..2 but leaves 3.. pending
+    assert handles[2].result()["eventId"]
+    assert all(h.done for h in handles[:3])
+    assert not any(h.done for h in handles[3:])
+    p.close()
+    assert all(h.done for h in handles)
+    assert all(h.result()["eventId"] for h in handles)
+    with _pytest.raises(PIOError, match="closed"):
+        p.create_event("buy", "user", "x")

@@ -802,3 +802,32 @@ def test_host_scorer_matches_device_scorer(trained, monkeypatch):
     s_dev = np.asarray(algo._score_history(model, hist))
     s_host = algo._score_history_host(model, hist)
     np.testing.assert_allclose(s_dev, s_host, rtol=1e-5, atol=1e-6)
+
+
+def test_host_scorer_edge_cases(trained, monkeypatch):
+    """Host scorer handles: an all-padding indicator table (no
+    correlators -> zero signal), out-of-range history ids (skipped), and
+    an empty history (None)."""
+    import numpy as np
+
+    from predictionio_tpu.models.universal_recommender.engine import URAlgorithm
+
+    engine, ep, models = trained
+    model = models[0]
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+
+    assert algo._score_history_host(model, {}) is None
+    some = next(iter(model.indicator_idx))
+    # out-of-range ids are skipped, not crashed on
+    s = algo._score_history_host(
+        model, {some: np.asarray([10**6, -5], np.int32)})
+    assert s is None or not s.any()
+
+    # an event type whose table is all -1 contributes nothing
+    blank = {k: np.full_like(v, -1) for k, v in model.indicator_idx.items()}
+    monkeypatch.setattr(model, "indicator_idx", blank)
+    model.__dict__.pop("_host_inv", None)   # rebuild inversion
+    hist = {some: np.asarray([0, 1], np.int32)}
+    s = algo._score_history_host(model, hist)
+    assert s is not None and not s.any()
