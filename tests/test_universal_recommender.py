@@ -297,23 +297,34 @@ def test_expire_date_boundary_instant_valid(ur_app, mem_storage):
     assert "b2" not in [s.item for s in past_boundary.item_scores]
 
 
-def test_serving_is_device_resident(trained):
-    """predictor() pre-stages indicator tables to device (warm); the cache
-    is held on the model instance and reused across queries — predict never
-    re-uploads the tables."""
+def test_serving_warm_stages_resolved_scorer(trained, monkeypatch):
+    """predictor() pre-stages what the RESOLVED scorer reads (warm):
+    device mode stages the indicator tables; host mode builds the CSR
+    inversions instead (the other side stays lazy).  Caches are held on
+    the model and reused across queries — predict never rebuilds them."""
+    import pickle
+
     engine, ep, models = trained
     model = models[0]
     assert "_dev_indicators" not in model.__dict__
+    assert "_host_inv" not in model.__dict__
+
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "device")
     predict = engine.predictor(ep, models)
-    assert "_dev_indicators" in model.__dict__, "predictor() must warm the model"
+    assert "_dev_indicators" in model.__dict__, "warm must stage tables"
+    assert "_host_inv" not in model.__dict__, "host side must stay lazy"
     dev1 = model.device_indicators()
     predict(URQuery(user="u2", num=4))
     assert model.device_indicators() is dev1, "device cache must be stable"
-    # the cache never rides the pickle: a reloaded model re-stages lazily
-    import pickle
 
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
     m2 = pickle.loads(pickle.dumps(model))
+    # the caches never ride the pickle: a reloaded model re-stages lazily
     assert "_dev_indicators" not in m2.__dict__
+    engine.predictor(ep, [m2])
+    assert "_host_inv" in m2.__dict__, "host warm must build inversions"
+    assert "_dev_indicators" not in m2.__dict__, \
+        "device tables must stay lazy under the host scorer"
 
 
 def test_item_similarity_uses_all_indicators(trained):
