@@ -109,6 +109,19 @@ def _http_post(url, body):
         return resp.status, json.loads(resp.read())
 
 
+def _keepalive_query_conn(port):
+    import http.client
+
+    return http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+
+
+def _conn_post(conn, body, path="/queries.json"):
+    conn.request("POST", path, json.dumps(body).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read())
+
+
 def bench_http(smoke: bool) -> dict:
     """p50 of the FULL served path: HTTP POST /queries.json against a
     deployed engine — JSON parse, LEventStore history lookup, device
@@ -164,37 +177,49 @@ def bench_http(smoke: bool) -> dict:
                 storage.l_events.insert_batch(evs[s:s + 20_000], app_id)
 
         def measure(httpd, make_body, n):
-            base = f"http://127.0.0.1:{httpd.server_address[1]}"
-            for w in range(min(10, n)):   # warm: compile + cache fill
-                _http_post(base + "/queries.json", make_body(w))
-            times = []
-            for q in range(n):
-                t0 = time.perf_counter()
-                status, body = _http_post(base + "/queries.json", make_body(q))
-                times.append((time.perf_counter() - t0) * 1e3)
-                assert status == 200, body
+            # ONE keep-alive connection, like the shipped EngineClient —
+            # a fresh TCP connect per query measures the client's
+            # connection churn, not the server (the ingest bench learned
+            # this at 1.2k-vs-10k ev/s; same lesson here)
+            import contextlib
+
+            port = httpd.server_address[1]
+            with contextlib.closing(_keepalive_query_conn(port)) as conn:
+                for w in range(min(10, n)):   # warm: compile + cache fill
+                    _conn_post(conn, make_body(w))
+                times = []
+                for q in range(n):
+                    t0 = time.perf_counter()
+                    status, body = _conn_post(conn, make_body(q))
+                    times.append((time.perf_counter() - t0) * 1e3)
+                    assert status == 200, body
             return float(np.percentile(times, 50)), float(np.percentile(times, 95))
 
         def measure_qps(httpd, make_body, seconds=3.0, workers=8):
             """Concurrent sustained throughput (queries/s) — closer to a
-            loaded deployment than the serial p50 loop."""
+            loaded deployment than the serial p50 loop.  Each worker
+            holds ONE keep-alive connection (what the shipped
+            EngineClient does per thread)."""
             import threading
 
-            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            port = httpd.server_address[1]
             stop = time.perf_counter() + seconds
             done = [0] * workers
             errors = []
 
             def worker(w):
+                import contextlib
+
                 try:
-                    q = w
-                    while time.perf_counter() < stop:
-                        status, body = _http_post(
-                            base + "/queries.json", make_body(q))
-                        if status != 200:
-                            raise AssertionError(f"HTTP {status}: {body}")
-                        done[w] += 1
-                        q += workers
+                    with contextlib.closing(
+                            _keepalive_query_conn(port)) as conn:
+                        q = w
+                        while time.perf_counter() < stop:
+                            status, body = _conn_post(conn, make_body(q))
+                            if status != 200:
+                                raise AssertionError(f"HTTP {status}: {body}")
+                            done[w] += 1
+                            q += workers
                 except Exception as e:   # surfaced after join, not swallowed
                     errors.append(e)
 
@@ -593,15 +618,18 @@ def bench_serve100k(smoke: bool) -> dict:
         httpd = deploy(engine_json=ur_json, host="127.0.0.1", port=0,
                        storage=storage, background=True)
         try:
-            base = f"http://127.0.0.1:{httpd.server_address[1]}"
-            times = []
-            for q in range(n_q + 10):
-                body = {"user": f"u{(q * 13) % n_users}", "num": 10}
-                t0 = time.perf_counter()
-                status, resp = _http_post(base + "/queries.json", body)
-                if q >= 10:              # 10 warm queries: shape buckets
-                    times.append((time.perf_counter() - t0) * 1e3)
-                assert status == 200, resp
+            import contextlib
+
+            with contextlib.closing(
+                    _keepalive_query_conn(httpd.server_address[1])) as conn:
+                times = []
+                for q in range(n_q + 10):
+                    body = {"user": f"u{(q * 13) % n_users}", "num": 10}
+                    t0 = time.perf_counter()
+                    status, resp = _conn_post(conn, body)
+                    if q >= 10:          # 10 warm queries: shape buckets
+                        times.append((time.perf_counter() - t0) * 1e3)
+                    assert status == 200, resp
         finally:
             httpd.shutdown()
             httpd.server_close()
