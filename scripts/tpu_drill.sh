@@ -1,0 +1,45 @@
+#!/bin/bash
+# The first-TPU-session drill (VERDICT r4 #1): land the hardware record
+# BEFORE any experiment that can compile for minutes.  Run each step to
+# completion — NEVER timeout-kill a TPU-attached process (a SIGTERM
+# mid-compile wedges the tunnel for the whole session; see PERF.md).
+#
+# Usage: bash scripts/tpu_drill.sh   (from the repo root, box otherwise idle)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 1. relay sanity (do NOT wait on jax init to learn this) =="
+ss -tln || true
+echo "   (a listener alone is not proof — round 5 had one and the claim"
+echo "    leg still failed UNAVAILABLE; the probe below is the real test)"
+
+echo "== 2. probe: devices + one real readback (~1 min healthy; if it"
+echo "   blocks >10 min the session has no TPU — fall back to CPU work) =="
+python - << 'PY'
+import time, numpy as np, jax
+t0 = time.time()
+print("devices:", jax.devices(), f"init {time.time()-t0:.0f}s")
+import jax.numpy as jnp
+t0 = time.time()
+s = float(np.asarray(jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16)).sum())
+print(f"readback ok sum={s} rtt={time.time()-t0:.2f}s")
+PY
+[ $? -ne 0 ] && { echo "NO TPU — stop here, do CPU work"; exit 1; }
+
+echo "== 3. THE RECORD: full bench, solo, before anything else =="
+python bench.py | tee /tmp/bench_tpu_record.json
+
+echo "== 4. profile: section 7 prints the Pallas-merge FLIP/KEEP verdict,"
+echo "   section 8 the MFU/roofline.  If FLIP: change topk_impl() auto in"
+echo "   ops/cco.py to pallas-on-TPU and re-run the ablation. =="
+python profile_tpu.py
+
+echo "== 5. serving A/B on TPU (micro-batch validation, VERDICT r4 #4) =="
+for mode in off auto; do
+  echo "-- PIO_SERVE_BATCH=$mode --"
+  PIO_SERVE_BATCH=$mode python bench.py --only http | tail -1
+done
+echo "-- p50@100k with the device gather scorer --"
+python bench.py --only serve100k | tail -1
+
+echo "== drill complete: record BENCH + FLIP/KEEP + serving table in PERF.md =="
