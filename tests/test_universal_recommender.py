@@ -744,15 +744,19 @@ def test_rank_metrics_family():
     assert abs(p2.calculate(data) - (0.5) / 3) < 1e-9
 
 
-def test_ur_serve_batch_matches_serial(ur_app):
+@pytest.mark.parametrize("scorer", ["host", "device"])
+def test_ur_serve_batch_matches_serial(ur_app, monkeypatch, scorer):
     """serve_batch_predict ≡ predict across every query shape in one
     batch: user, cold user, item-similarity, itemSet, business rules,
-    blacklist — live-store semantics, one batched readback."""
+    blacklist — live-store semantics, one batched readback.  Runs under
+    BOTH scorers (auto would pick host on the CPU test backend, leaving
+    the TPU device batch branch uncovered)."""
     from predictionio_tpu.models.universal_recommender.engine import (
         FieldRule,
         URAlgorithm,
     )
 
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", scorer)
     engine = UniversalRecommenderEngine.apply()
     ep = make_ep(min_llr=0.0)
     models = engine.train(ep)
