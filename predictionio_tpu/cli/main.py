@@ -333,18 +333,50 @@ def _cmd_undeploy(args) -> int:
     SO_REUSEPORT and the kernel routes each /stop to ONE of them; the
     parent tears its children down when it stops, but /stop may land on
     a CHILD first — so keep stopping until nothing answers."""
+    import http.client as _http_client
     import time as _time
     import urllib.error
     import urllib.request
 
+    def _port_answers() -> bool:
+        # a raw TCP connect, not an HTTP exchange: ANY listener — even
+        # one that resets every connection after accept — completes the
+        # handshake, while a genuinely stopped server refuses.  That
+        # distinction is exactly what separates "the /stop reset WAS the
+        # shutdown" from "something unkillable owns the port", and it
+        # doesn't depend on how much response preamble survived the RST.
+        import socket as _socket
+
+        try:
+            with _socket.create_connection(
+                    (args.ip, args.port), timeout=args.timeout):
+                return True
+        except OSError:
+            return False
+
     url = f"http://{args.ip}:{args.port}/stop"
     stopped = 0
+    mid_response = ""
     for _ in range(34):   # bound: far above any sane --workers count
         try:
             with urllib.request.urlopen(url, timeout=args.timeout) as resp:
                 resp.read()
             stopped += 1
             _time.sleep(0.3)   # let the listener actually close
+        except (ConnectionError, TimeoutError,
+                _http_client.HTTPException) as e:
+            # a query server can die mid-response to its own /stop (a
+            # reset or truncated body while reading; urlopen wraps
+            # connect-time failures in URLError but read()-time ones
+            # escape raw).  Don't guess what it meant: probe the port.
+            # Dead → that failure WAS the stop.  Still answering →
+            # another listener remains (prefork) or this isn't a query
+            # server at all — retry /stop, bounded by the loop.
+            mid_response = type(e).__name__
+            _time.sleep(0.3)
+            if _port_answers():
+                continue
+            stopped += 1
         except urllib.error.HTTPError as e:
             # something IS listening but refused /stop (e.g. the event
             # server): distinguish from "nothing deployed"
@@ -358,9 +390,14 @@ def _cmd_undeploy(args) -> int:
                 return 0
             print(f"No deployment reachable at {args.ip}:{args.port}: {e.reason}")
             return 1
-    print(f"Undeployed {args.ip}:{args.port} ({stopped} listeners stopped; "
-          "more may remain)")
-    return 0
+    if stopped:
+        print(f"Undeployed {args.ip}:{args.port} ({stopped} listeners "
+              "stopped; more may remain)")
+        return 0
+    print(f"Could not undeploy {args.ip}:{args.port}: /stop kept failing "
+          f"mid-response ({mid_response or 'unknown'}) and the port still "
+          "answers — is this a query server?")
+    return 1
 
 
 def _cmd_eval(args) -> int:

@@ -839,3 +839,80 @@ def test_sdk_event_pipeline_partial_drain_and_close(event_server):
     assert all(h.result()["eventId"] for h in handles)
     with _pytest.raises(PIOError, match="closed"):
         p.create_event("buy", "user", "x")
+
+
+def _rst_close(c):
+    import socket as _socket
+    import struct
+
+    c.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                 struct.pack("ii", 1, 0))   # linger 0 => RST on close
+    c.close()
+
+
+def test_undeploy_mid_response_death_counts_as_stop():
+    """A query server that dies while answering its own /stop (partial
+    response then reset, port then dead) must still be reported as
+    undeployed — the reset WAS the stop."""
+    import socket
+    import threading
+
+    from predictionio_tpu.cli.main import main as pio_main
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def one_shot():
+        c, _ = srv.accept()
+        c.recv(65536)
+        c.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 50\r\n\r\n{")
+        _rst_close(c)          # truncated body + RST
+        srv.close()            # port goes dead: the server is gone
+
+    threading.Thread(target=one_shot, daemon=True).start()
+    rc = pio_main(["undeploy", "--ip", "127.0.0.1", "--port", str(port),
+                   "--timeout", "2"])
+    assert rc == 0
+
+
+def test_undeploy_persistent_resetter_reports_failure():
+    """A listener that keeps dropping /stop mid-response while STAYING
+    on the port (not a query server) must not be reported as undeployed."""
+    import socket
+    import threading
+
+    from predictionio_tpu.cli.main import main as pio_main
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+    alive = True
+
+    def reset_loop():
+        preamble = True
+        while alive:
+            try:
+                c, _ = srv.accept()
+                c.recv(65536)
+                if preamble:   # alternate: with and without any response
+                    c.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: 50\r\n\r\n{")
+                preamble = not preamble
+                _rst_close(c)
+            except OSError:
+                return
+
+    t = threading.Thread(target=reset_loop, daemon=True)
+    t.start()
+    try:
+        rc = pio_main(["undeploy", "--ip", "127.0.0.1", "--port", str(port),
+                       "--timeout", "2"])
+        assert rc == 1
+    finally:
+        alive = False
+        srv.close()
