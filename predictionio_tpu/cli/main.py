@@ -338,30 +338,36 @@ def _cmd_undeploy(args) -> int:
     import urllib.error
     import urllib.request
 
-    def _port_answers() -> bool:
+    def _probe_port() -> str:
         # a raw TCP connect, not an HTTP exchange: ANY listener — even
         # one that resets every connection after accept — completes the
         # handshake, while a genuinely stopped server refuses.  That
         # distinction is exactly what separates "the /stop reset WAS the
         # shutdown" from "something unkillable owns the port", and it
         # doesn't depend on how much response preamble survived the RST.
+        # 'unknown' (e.g. a firewall DROPping packets) is kept distinct:
+        # an unverifiable port must not be reported as undeployed.
         import socket as _socket
 
         try:
             with _socket.create_connection(
                     (args.ip, args.port), timeout=args.timeout):
-                return True
+                return "live"
+        except ConnectionRefusedError:
+            return "dead"
         except OSError:
-            return False
+            return "unknown"
 
     url = f"http://{args.ip}:{args.port}/stop"
     stopped = 0
+    fails = 0
     mid_response = ""
     for _ in range(34):   # bound: far above any sane --workers count
         try:
             with urllib.request.urlopen(url, timeout=args.timeout) as resp:
                 resp.read()
             stopped += 1
+            fails = 0
             _time.sleep(0.3)   # let the listener actually close
         except (ConnectionError, TimeoutError,
                 _http_client.HTTPException) as e:
@@ -369,14 +375,26 @@ def _cmd_undeploy(args) -> int:
             # reset or truncated body while reading; urlopen wraps
             # connect-time failures in URLError but read()-time ones
             # escape raw).  Don't guess what it meant: probe the port.
-            # Dead → that failure WAS the stop.  Still answering →
-            # another listener remains (prefork) or this isn't a query
-            # server at all — retry /stop, bounded by the loop.
             mid_response = type(e).__name__
             _time.sleep(0.3)
-            if _port_answers():
+            state = _probe_port()
+            if state == "dead":
+                stopped += 1      # that failure WAS the shutdown
+                fails = 0
                 continue
-            stopped += 1
+            if state == "unknown":
+                print(f"Cannot verify {args.ip}:{args.port}: /stop failed "
+                      f"mid-response ({mid_response}) and the port is "
+                      "unreachable (filtered?) — not reporting success")
+                return 1
+            # still listening: another listener remains (prefork) or
+            # this isn't a query server at all — retry a few times,
+            # but don't burn the whole worker-count bound on a
+            # no-progress loop (a wedged/non-HTTP listener would hold
+            # us here for minutes of timeouts otherwise)
+            fails += 1
+            if fails >= 3:
+                break
         except urllib.error.HTTPError as e:
             # something IS listening but refused /stop (e.g. the event
             # server): distinguish from "nothing deployed"

@@ -747,11 +747,10 @@ def _dense_path_ok(n_items_p: int, n_items_t: int) -> bool:
 _SPARSE_PAIR_BUDGET = 200_000_000
 _SPARSE_C_BYTES = 512 << 20
 _SPARSE_CHUNK_PAIRS = 8_000_000   # cross-join temporaries cap (~64 MB/chunk)
-# Matrices at or under this cell count use the bincount accumulation branch
-# (which loses per-cell identities); above it every chunk goes through
-# np.unique, which is what lets want_coo collect touched cells.  ONE
-# constant for both gates — they must stay in lockstep or the COO path
-# would silently drop cells accumulated by a bincount chunk.
+# Matrices at or under this cell count may use the bincount accumulation
+# branch (which loses per-cell identities — a chunk that takes it
+# downgrades want_coo to a final flatnonzero scan, bounded by this same
+# size, instead of returning collected cells).
 _SPARSE_BINCOUNT_CELLS = 16 << 20
 # Touched-cell collection holds up to one int64 per cross-join pair across
 # the per-chunk unique arrays (+ ~the same again transiently in the final
@@ -811,25 +810,27 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR,
     both count distinct (user, item) pairs.
 
     ``want_coo=True`` returns ``(C, flat)`` where ``flat`` is the sorted
-    unique flat indices of C's nonzero cells — collected from the
-    unique-branch chunks for large matrices, so the sparse LLR tail never
-    has to re-scan a 100M+-cell dense matrix to find them (for small
-    matrices a direct flatnonzero scan is cheap and exact)."""
+    unique flat indices of C's nonzero cells.  They are collected from
+    the unique-branch chunks whenever the cross-join pair count fits
+    the collection's own memory budget (_SPARSE_COO_PAIRS), so the
+    sparse LLR tail normally never re-scans the dense matrix; past the
+    budget, or when a bincount-branch chunk ran (losing cell
+    identities — only possible at ≤ _SPARSE_BINCOUNT_CELLS), one final
+    flatnonzero scan recovers them instead."""
     I_p, I_t = p.n_items, a.n_items
     if I_p * I_t * 4 > _SPARSE_C_BYTES:       # true peak: C is int32 below
         return None
     total = _cross_join_pairs(p, a) if total_pairs is None else total_pairs
     if total > _SPARSE_PAIR_BUDGET:
         return None
-    # touched-cell tracking: only worthwhile when the matrix is big
-    # enough that the bincount branch (which loses cell identities) can
-    # never fire — exactly the case where a flatnonzero scan would hurt —
-    # AND the pair count keeps the collected arrays inside their own
-    # memory budget (past it, the flatnonzero fallback below is cheaper
-    # than the collection's transients)
+    # touched-cell tracking: collect from every unique-branch chunk so
+    # the tail never has to rescan the dense matrix.  Gated on the pair
+    # count only (past the budget, the collection's int64 arrays and
+    # their concatenate+unique transients would dwarf the C budget and
+    # the flatnonzero fallback is cheaper); a bincount-branch chunk
+    # loses cell identities and downgrades to that fallback too.
     touched: Optional[list] = (
-        [] if want_coo and I_p * I_t > _SPARSE_BINCOUNT_CELLS
-        and total <= _SPARSE_COO_PAIRS else None)
+        [] if want_coo and total <= _SPARSE_COO_PAIRS else None)
     C = np.zeros(I_p * I_t, np.int32)         # counts ≤ n_users < 2³¹
     if total == 0:
         empty = np.empty(0, np.int64)
@@ -863,6 +864,7 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR,
                 # constant-factor and 128 MB-peak regression exactly in
                 # the low-density regime this path serves.
                 C += np.bincount(flat, minlength=I_p * I_t).astype(np.int32)
+                touched = None   # identities lost; tail rescans (≤ gate)
             else:
                 cells, counts = np.unique(flat, return_counts=True)
                 C[cells] += counts.astype(np.int32)

@@ -478,3 +478,20 @@ def test_sparse_counts_coo_touched_path():
     s_dev, i_dev = cco_ops._finalize_topk(s_dev, i_dev, n_it)
     np.testing.assert_array_equal(s_host, s_dev)
     np.testing.assert_array_equal(i_host, i_dev)
+
+
+def test_sparse_counts_coo_bincount_downgrade():
+    """A bincount-branch chunk loses cell identities, so want_coo must
+    fall back to the flatnonzero scan — exercised with a small matrix
+    and a dense chunk (chunk * 8 >= cells), where the bincount branch
+    actually fires."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_items = 40, 50         # 2500 cells << bincount gate
+    pu, pi = random_interactions(n_users, n_items, 700, 91)
+    p = cco_ops._SparseHostCSR(pu, pi, n_items, n_users)
+    total = cco_ops._cross_join_pairs(p, p)
+    assert total * 8 >= n_items * n_items, "need a dense chunk for the test"
+    C, flat = cco_ops._sparse_counts(p, p, want_coo=True)
+    np.testing.assert_array_equal(flat, np.flatnonzero(C))
+    assert len(flat) > 0
