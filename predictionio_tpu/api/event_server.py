@@ -22,6 +22,8 @@ import datetime as _dt
 import json
 import logging
 import os
+import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -35,10 +37,33 @@ log = logging.getLogger("pio.eventserver")
 MAX_BATCH = 50  # reference: EventServer batch limit
 
 
+def _max_batch() -> int:
+    """Batch-size cap: PIO_MAX_BATCH (default 50 for reference parity).
+
+    Raising it lets high-volume importers amortize per-request HTTP cost
+    over bigger group-committed appends; the request body is bounded by
+    the cap × event size, so keep it within what one thread should buffer
+    (a 10k-event batch is ~2 MB)."""
+    raw = os.environ.get("PIO_MAX_BATCH")
+    if raw is None:
+        return MAX_BATCH
+    try:
+        n = int(raw)
+        if n > 0:
+            return n
+    except ValueError:
+        pass
+    # a typo'd cap silently falling back would surface only as runtime
+    # 400s on big batches — say what was discarded, loudly, at startup
+    log.warning("ignoring invalid PIO_MAX_BATCH=%r; using %d", raw, MAX_BATCH)
+    return MAX_BATCH
+
+
 class EventServerState:
     def __init__(self, storage: Optional[Storage] = None, stats: bool = True):
         self.storage = storage or get_storage()
         self.stats_enabled = stats
+        self.max_batch = _max_batch()
         self.counts: Dict[int, Dict[str, int]] = {}
         # (accessKey, channel) → (result, stamp): the metadata store read
         # behind auth costs ~0.08 ms/request on localfs, which dominates a
@@ -91,7 +116,37 @@ def make_handler(state: EventServerState):
         def do_GET(self):
             path, query = self.route
             if path == "/":
-                self.send_json({"status": "alive"})
+                # pid identifies WHICH prefork worker answered — the
+                # readiness/diagnostic signal for multi-worker deployments
+                # (a client probing fresh connections sees each live
+                # worker's pid as the kernel load-balances the accepts)
+                self.send_json({"status": "alive", "pid": os.getpid()})
+                return
+            if path == "/stop":
+                # graceful shutdown (same contract as the query server's
+                # /stop): with --workers the kernel routes this to ONE
+                # listener; `pio undeploy` keeps stopping until the port
+                # stops answering, and the parent tears its children down
+                # via the wired server_close.  Loopback-only by default:
+                # every data endpoint authenticates, so an open /stop on a
+                # 0.0.0.0 bind would be an unauthenticated kill switch
+                # (PIO_ALLOW_REMOTE_STOP=1 opts out behind a trusted LB).
+                if (self.client_address[0] not in ("127.0.0.1", "::1")
+                        and os.environ.get("PIO_ALLOW_REMOTE_STOP") != "1"):
+                    self.send_error_json(
+                        403, "remote /stop denied (loopback only; set "
+                             "PIO_ALLOW_REMOTE_STOP=1 to allow)")
+                    return
+                self.send_json({"stopping": True})
+
+                def _stop(server):
+                    server.shutdown()
+                    # close the listening socket too: shutdown() alone
+                    # keeps accepting connections that nothing serves
+                    server.server_close()
+
+                threading.Thread(target=_stop, args=(self.server,),
+                                 daemon=True).start()
                 return
             ak, channel_id, err = state.auth(query)
             if err:
@@ -216,8 +271,10 @@ def make_handler(state: EventServerState):
             if not isinstance(body, list):
                 self.send_error_json(400, "batch body must be a JSON array")
                 return
-            if len(body) > MAX_BATCH:
-                self.send_error_json(400, f"batch size {len(body)} exceeds limit {MAX_BATCH}")
+            if len(body) > state.max_batch:
+                self.send_error_json(
+                    400, f"batch size {len(body)} exceeds limit "
+                         f"{state.max_batch}")
                 return
             # access-key event filter first (needs only the name), then ONE
             # storage batch for everything allowed — the per-item Event
@@ -286,10 +343,80 @@ def run_event_server(
     port: int = 7070,
     storage: Optional[Storage] = None,
     background: bool = False,
+    workers: int = 1,
+    reuse_port: bool = False,
 ):
+    """Run the event server; returns the HTTPServer (background=True) or
+    blocks.
+
+    ``workers > 1`` preforks N−1 extra OS processes all ingesting on the
+    SAME port via SO_REUSEPORT (the kernel load-balances accepts) — the
+    same scaling treatment as ``pio deploy --workers``.  Each worker gets
+    a distinct PIO_WRITER_TAG, so the localfs event log gives every
+    process its own ``seg-<tag>-NNNNN.jsonl`` segment series: appends
+    never share a file descriptor, and readers scan the union.  Workers
+    resolve storage from the PIO_STORAGE_* environment (a programmatic
+    ``storage`` object cannot cross the process boundary).
+
+    Caveats of the multi-process split: /stats.json counts and the auth
+    cache are per-worker (the kernel routes each request to one worker),
+    and a GET /stop reaches one listener — ``pio undeploy --port`` loops
+    until the whole group is down.
+    """
+    from predictionio_tpu.api import prefork
+
+    if workers > 1 and storage is not None:
+        raise ValueError(
+            "eventserver --workers resolves storage from PIO_STORAGE_* env "
+            "in each worker; a programmatic storage object cannot cross "
+            "the process boundary")
+    if workers == 1:
+        prefork.maybe_watch_parent(log)   # prefork child: die when orphaned
+    prev_tag = os.environ.get("PIO_WRITER_TAG")
+    if workers > 1:
+        # the parent is writer w0, children w1..wN-1 — suffixed with the
+        # PARENT's pid so tags stay unique across server instances: a
+        # rolling restart (or accidental double start) against the same
+        # store must never resume/heal the OLD group's still-active
+        # segment files.  Overrides (not setdefault) an inherited tag —
+        # a shell-exported PIO_WRITER_TAG shared by two groups would
+        # defeat exactly that uniqueness.  Set BEFORE the state resolves
+        # storage so FSEvents picks the tag up.
+        os.environ["PIO_WRITER_TAG"] = f"w0-{os.getpid()}"
+        # a process-default Storage built BEFORE this point (e.g. a
+        # programmatic caller that seeded apps/keys via get_storage())
+        # would carry an untagged FSEvents; refresh so the parent's
+        # writer is guaranteed to see the tag
+        storage = get_storage(refresh=True)
     state = EventServerState(storage)
-    httpd = start_server(make_handler(state), host, port, background=background)
-    log.info("Event server listening on %s:%d", host, httpd.server_address[1])
+    if workers > 1:
+        # bind the tagged event writer NOW (Storage clients are lazy),
+        # then restore the environment: a later programmatic FSEvents in
+        # this process must not silently inherit this server's tag
+        state.storage.l_events
+        if prev_tag is None:
+            os.environ.pop("PIO_WRITER_TAG", None)
+        else:
+            os.environ["PIO_WRITER_TAG"] = prev_tag
+    httpd = start_server(make_handler(state), host, port,
+                         background=background,
+                         reuse_port=workers > 1 or reuse_port)
+    bound_port = httpd.server_address[1]
+    children: list = []
+    if workers > 1:
+        children = prefork.spawn_workers(
+            workers - 1,
+            lambda w: [sys.executable, "-m", "predictionio_tpu.cli.main",
+                       "eventserver", "--ip", host,
+                       "--port", str(bound_port), "--reuse-port"],
+            build_env=lambda w: {
+                "PIO_WRITER_TAG": f"w{w + 1}-{os.getpid()}"},
+            log=log,
+        )
+    prefork.wire_shutdown(httpd, children)
+    httpd.pio_state = state   # handle for tests/tools
+    httpd.pio_workers = children
+    log.info("Event server listening on %s:%d", host, bound_port)
     if background:
         return httpd
     try:

@@ -396,13 +396,26 @@ def _cmd_undeploy(args) -> int:
             if fails >= 3:
                 break
         except urllib.error.HTTPError as e:
-            # something IS listening but refused /stop (e.g. the event
-            # server): distinguish from "nothing deployed"
+            # something IS listening but refused /stop: distinguish from
+            # "nothing deployed"; a 403 is likely the event server's
+            # loopback-only /stop gate, not a foreign server
+            hint = (" (the event server only honors /stop from loopback; "
+                    "run undeploy on the server's host or set "
+                    "PIO_ALLOW_REMOTE_STOP=1)" if e.code == 403 else
+                    " — is this a query server?")
             print(f"Server at {args.ip}:{args.port} rejected /stop "
-                  f"(HTTP {e.code}) — is this a query server?")
+                  f"(HTTP {e.code}){hint}")
             return 1
         except urllib.error.URLError as e:
             if stopped:
+                # SO_REUSEPORT race: a SYN that landed in a CLOSING
+                # listener's backlog is refused even though other workers
+                # still listen — re-probe before declaring the port down,
+                # or a surviving worker would be left behind with undeploy
+                # reporting success
+                _time.sleep(0.3)
+                if _probe_port() == "live":
+                    continue
                 extra = f" ({stopped} listener(s) stopped)" if stopped > 1 else ""
                 print(f"Undeployed {args.ip}:{args.port}.{extra}")
                 return 0
@@ -414,7 +427,9 @@ def _cmd_undeploy(args) -> int:
         return 0
     print(f"Could not undeploy {args.ip}:{args.port}: /stop kept failing "
           f"mid-response ({mid_response or 'unknown'}) and the port still "
-          "answers — is this a query server?")
+          "answers — is this a query server? (a slow-but-legit shutdown "
+          f"can also exceed --timeout {args.timeout:g}s; try a larger "
+          "--timeout)")
     return 1
 
 
@@ -427,7 +442,14 @@ def _cmd_eval(args) -> int:
 def _cmd_eventserver(args) -> int:
     from predictionio_tpu.api.event_server import run_event_server
 
-    return run_event_server(host=args.ip, port=args.port)
+    try:
+        return run_event_server(
+            host=args.ip, port=args.port,
+            workers=getattr(args, "workers", 1) or 1,
+            reuse_port=getattr(args, "reuse_port", False))
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
 
 
 def _cmd_adminserver(args) -> int:
@@ -567,6 +589,13 @@ def build_parser() -> argparse.ArgumentParser:
     es = sub.add_parser("eventserver")
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--workers", type=int, default=1,
+                    help="prefork N processes all ingesting on this port "
+                         "via SO_REUSEPORT (scales ingest past the "
+                         "per-process GIL; each worker appends to its own "
+                         "per-writer segment files)")
+    es.add_argument("--reuse-port", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: prefork child
     es.set_defaults(func=_cmd_eventserver)
 
     adm = sub.add_parser("adminserver")

@@ -493,7 +493,18 @@ class URModel(PersistentModel):
         cache = self.__dict__.setdefault("_host_inv", {})
         if name not in cache:
             idx, llr = self.indicator_idx[name], self.indicator_llr[name]
-            i_p, k = idx.shape if idx.ndim == 2 else (0, 0)
+            if idx.ndim != 2:
+                # degenerate table (no [I_p, K] shape to invert): an empty
+                # CSR — every posting list empty — not the old (0, 0)
+                # fallback, whose arange(0) rows were then boolean-indexed
+                # with the FULL idx length (IndexError for any non-empty
+                # non-2D input)
+                n_t = max(len(self.event_item_dicts[name]), 1)
+                cache[name] = (np.zeros(n_t + 1, dtype=np.int64),
+                               np.zeros(0, dtype=np.int32),
+                               np.zeros(0, dtype=np.float32))
+                return cache[name]
+            i_p, k = idx.shape
             valid = idx >= 0
             rows = np.repeat(np.arange(i_p, dtype=np.int32), k)[valid.ravel()]
             tgt = idx[valid]

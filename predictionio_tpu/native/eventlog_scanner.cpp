@@ -488,12 +488,16 @@ int64_t scan_run(void* h, int n_threads) {
       auto& out = per_file[i];
       while (p < end) {
         const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
-        const char* line_end = nl ? nl : end;
-        if (line_end > p) {
+        if (!nl) break;  // unterminated torn tail (writer killed
+                         // mid-append): never acknowledged; the Python
+                         // scan skips it and the owning writer truncates
+                         // it on reopen — surfacing it here would make
+                         // native and Python scans disagree
+        if (nl > p) {
           RawEvent ev;
-          if (parse_line(p, line_end, &ev)) out.push_back(std::move(ev));
+          if (parse_line(p, nl, &ev)) out.push_back(std::move(ev));
         }
-        p = nl ? nl + 1 : end;
+        p = nl + 1;
       }
     }
   };

@@ -165,6 +165,18 @@ class EventPipeline:
         with client.pipeline() as p:
             handles = [p.create_event(...) for _ in events]
         ids = [h.result()["eventId"] for h in handles]   # all done here
+
+    Failure semantics — at-least-once ambiguity: if the server signals
+    ``Connection: close`` (or the socket dies) while requests are still
+    in flight, every outstanding handle fails with PIOError — but the
+    server may already have COMMITTED some of those events before
+    closing; the close only guarantees their acknowledgements will never
+    arrive.  A caller that retries failed handles can therefore
+    duplicate events unless it supplies its own ``eventId`` per event
+    (the server stores a client-supplied id verbatim, making the retry
+    idempotent at read time).  After a server-signaled close the
+    pipeline refuses new sends immediately instead of writing requests
+    the server will never read.
     """
 
     _SEND_BUF = 32 * 1024
@@ -243,21 +255,29 @@ class EventPipeline:
     # -- response side ------------------------------------------------------
 
     def _read_response(self) -> tuple:
+        """Returns (status, payload, server_closing).  ``server_closing``
+        is True when the response carries ``Connection: close`` — this is
+        the LAST response the server will send on this socket, so any
+        requests already pipelined after it will never be answered."""
         line = self._rfile.readline(65537)
         if not line:
             raise PIOError(0, "server closed the pipelined connection")
         parts = line.decode("latin-1").split(" ", 2)
         status = int(parts[1])
         length = 0
+        closing = False
         while True:
             h = self._rfile.readline(65537)
             if h in (b"\r\n", b"\n", b""):
                 break
             name, _, value = h.decode("latin-1").partition(":")
-            if name.strip().lower() == "content-length":
+            name = name.strip().lower()
+            if name == "content-length":
                 length = int(value.strip())
+            elif name == "connection":
+                closing = value.strip().lower() == "close"
         payload = self._rfile.read(length) if length else b""
-        return status, payload
+        return status, payload, closing
 
     def _release_socket(self) -> None:
         self._closed = True
@@ -296,7 +316,7 @@ class EventPipeline:
             h = self._pending.popleft()
             h.done = True
             try:
-                status, payload = self._read_response()
+                status, payload, closing = self._read_response()
             except Exception as e:
                 h._error = e
                 self._abort(e)   # the stream is dead: fail the rest too
@@ -309,6 +329,20 @@ class EventPipeline:
                 h._error = PIOError(status, message)
             else:
                 h._value = json.loads(payload) if payload else None
+            if closing:
+                # the server signaled Connection: close — THIS response is
+                # the last one it will send.  Fail every handle already
+                # pipelined after it (their requests may or may not have
+                # been committed before the close; see the class docstring)
+                # and refuse new sends, instead of surfacing the same
+                # opaque 'server closed' error for everything later.
+                self._abort(PIOError(
+                    0, "server signaled Connection: close mid-pipeline; "
+                       "this request was sent but will never be "
+                       "acknowledged (it may or may not have been "
+                       "committed — supply client eventIds to retry "
+                       "idempotently)"))
+                return
 
     def drain_until(self, handle: AsyncResult) -> None:
         try:

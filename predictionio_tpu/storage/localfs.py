@@ -61,26 +61,41 @@ class _SegmentWriter:
     The previous write path re-opened the active segment per insert (open +
     append + close per HTTP request); this holds the handle open, appends
     with one write(), and applies the PIO_FSYNC durability policy.  Callers
-    serialize via FSEvents._lock; writes use O_APPEND semantics so external
-    writers to the same directory stay safe."""
+    serialize via FSEvents' per-channel commit group; writes use O_APPEND
+    semantics so external writers to the same directory stay safe.
 
-    def __init__(self, d: Path):
+    With ``tag`` set (prefork event-server workers, sharedfs multi-host
+    ingest) segments are named ``seg-<tag>-NNNNN.jsonl`` and this writer
+    only ever appends to its OWN segments — N concurrent writer processes
+    never share an active file, so their appends can never interleave
+    bytes.  Readers glob ``seg-*.jsonl`` and see the union."""
+
+    def __init__(self, d: Path, tag: Optional[str] = None):
         self._dir = d
+        self._tag = tag
         self._f = None
+        self._path: Optional[Path] = None
         self._last_sync = 0.0
 
     def append(self, text: str) -> None:
         import time as _time
 
         if self._f is not None:
-            # a data-delete/re-import from ANY process may have unlinked the
-            # segment under us; writing on would ack events into an orphaned
-            # inode (nlink 0) that no reader can ever see
+            # a data-delete/re-import from ANY process may have unlinked or
+            # replaced the segment under us; writing on would ack events
+            # into an orphaned inode that no reader can ever see.  Compare
+            # the directory entry's inode with the open handle's — unlike
+            # fstat's st_nlink, this also detects the unlink on filesystems
+            # (9p, some overlayfs) that keep st_nlink at 1 for open files.
             try:
-                if os.fstat(self._f.fileno()).st_nlink == 0:
+                if os.stat(self._path).st_ino != os.fstat(self._f.fileno()).st_ino:
                     self._f.close()
                     self._f = None
             except OSError:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
                 self._f = None
         if self._f is None or self._f.tell() >= SEGMENT_MAX_BYTES:
             self._open_next()
@@ -99,18 +114,64 @@ class _SegmentWriter:
                 os.fsync(self._f.fileno())
                 self._last_sync = now
 
+    @staticmethod
+    def _heal_torn_tail(path: Path) -> None:
+        """Truncate an unterminated final line before resuming appends.
+
+        A crash (kill -9, power loss) mid-append can leave a partial last
+        line; appending after it would fuse two events into one corrupt
+        line mid-file.  The torn event was never acknowledged (the fsync
+        policy runs after the full write), so dropping it is safe — and
+        only THIS writer owns the file (per-writer/single-writer
+        contract), so truncating cannot race another appender."""
+        with open(path, "rb+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size == 0:
+                return
+            f.seek(size - 1)
+            if f.read(1) == b"\n":
+                return
+            # scan backwards in chunks for the last newline
+            pos = size
+            keep = 0
+            while pos > 0:
+                step = min(64 * 1024, pos)
+                f.seek(pos - step)
+                chunk = f.read(step)
+                nl = chunk.rfind(b"\n")
+                if nl >= 0:
+                    keep = pos - step + nl + 1
+                    break
+                pos -= step
+            f.truncate(keep)
+
     def _open_next(self) -> None:
         self.close()
         self._dir.mkdir(parents=True, exist_ok=True)
-        # only THIS writer's numeric naming — never append into a sharedfs
-        # per-writer segment that may coexist in the same directory
-        segs = sorted(p for p in self._dir.glob("seg-*.jsonl")
-                      if p.stem.split("-", 1)[1].isdigit())
+        if self._tag is None:
+            # only THIS writer's numeric naming — never append into a
+            # per-writer segment that may coexist in the same directory
+            segs = sorted(p for p in self._dir.glob("seg-*.jsonl")
+                          if p.stem.split("-", 1)[1].isdigit())
+        else:
+            # exact-tag match, not just the glob: the glob alone would let
+            # tag 'bulk' claim (and truncate-heal!) live segments of a
+            # dash-extended tag like 'bulk-2'
+            def _own(p: Path) -> bool:
+                n = p.stem.rsplit("-", 1)[1]
+                return n.isdigit() and p.stem == f"seg-{self._tag}-{n}"
+
+            segs = sorted(p for p in self._dir.glob(f"seg-{self._tag}-*.jsonl")
+                          if _own(p))
         if segs and segs[-1].stat().st_size < SEGMENT_MAX_BYTES:
             path = segs[-1]
+            self._heal_torn_tail(path)
         else:
-            n = int(segs[-1].stem.split("-")[1]) + 1 if segs else 0
-            path = self._dir / f"seg-{n:05d}.jsonl"
+            n = int(segs[-1].stem.rsplit("-", 1)[1]) + 1 if segs else 0
+            path = (self._dir / f"seg-{n:05d}.jsonl" if self._tag is None
+                    else self._dir / f"seg-{self._tag}-{n:05d}.jsonl")
+        self._path = path
         self._f = open(path, "a")
 
     def close(self) -> None:
@@ -576,16 +637,50 @@ class _EntityIndex:
         return out
 
 
-class FSEvents(base.LEvents, base.PEvents):
-    """Append-only segmented JSONL event log."""
+def _env_writer_tag() -> Optional[str]:
+    """Per-process writer tag from PIO_WRITER_TAG (set by the event
+    server's prefork spawn), sanitized to filesystem-safe characters.
+    '-' is kept: tags like ``w1-<parent pid>`` must stay distinct —
+    stripping the separator could collide two different tags."""
+    tag = os.environ.get("PIO_WRITER_TAG", "")
+    tag = "".join(c for c in tag if c.isalnum() or c in "_-")
+    return tag.strip("-") or None
 
-    def __init__(self, root: Path):
+
+class _CommitGroup:
+    """Pending group-commit appends for one (app, channel) log."""
+
+    __slots__ = ("cond", "pending", "active")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.pending: List[dict] = []
+        self.active = False
+
+
+class FSEvents(base.LEvents, base.PEvents):
+    """Append-only segmented JSONL event log.
+
+    Concurrency model: within one process, appends to one (app, channel)
+    are GROUP-COMMITTED — concurrent threads enqueue their encoded lines
+    and the first thread in becomes the commit leader, writing every
+    queued buffer with ONE write() (and at most one fsync per the
+    PIO_FSYNC policy) while later arrivals queue for the next commit.
+    Across processes, each writer appends only to its own
+    ``seg-<tag>-NNNNN.jsonl`` segments (``writer_tag`` / PIO_WRITER_TAG),
+    so prefork event-server workers never share a file descriptor; all
+    read paths glob ``seg-*.jsonl`` and see the union."""
+
+    def __init__(self, root: Path, writer_tag: Optional[str] = None):
         self._root = Path(root) / "events"
         # RLock: lock-holding paths (delete, compact) re-enter via
         # segment_paths' crashed-compaction recovery branch
         self._lock = threading.RLock()
         self._indexes: Dict[tuple, _EntityIndex] = {}
         self._writers: Dict[tuple, _SegmentWriter] = {}
+        self._groups: Dict[tuple, _CommitGroup] = {}
+        self._writer_tag = (writer_tag if writer_tag is not None
+                            else _env_writer_tag())
 
     def _entity_index(self, app_id: int, channel_id: Optional[int]) -> _EntityIndex:
         key = (app_id, channel_id)
@@ -647,11 +742,15 @@ class FSEvents(base.LEvents, base.PEvents):
         return self.insert_batch([event], app_id, channel_id)[0]
 
     def _new_writer(self, d: Path) -> _SegmentWriter:
-        """Writer factory hook (sharedfs overrides with per-writer naming)."""
-        return _SegmentWriter(d)
+        """Writer factory hook: per-writer segment naming when a writer
+        tag is set (prefork workers, sharedfs multi-host ingest)."""
+        return _SegmentWriter(d, self._writer_tag)
 
     def _tombstone_path(self, d: Path) -> Path:
-        """Tombstone file hook (sharedfs overrides with per-writer naming)."""
+        """Tombstone file hook: per-writer when a tag is set (readers
+        union all ``tombstones*.txt``)."""
+        if self._writer_tag:
+            return d / f"tombstones-{self._writer_tag}.txt"
         return d / "tombstones.txt"
 
     def insert_batch(
@@ -666,12 +765,15 @@ class FSEvents(base.LEvents, base.PEvents):
     ) -> List[dict]:
         """Ingest fast path: wire dicts are canonicalized WITHOUT building
         Event objects (events.canonical_event_json — byte-identical lines,
-        ~5× cheaper) and all valid items land in one locked append."""
+        ~5× cheaper) and all valid items land in one group-committed
+        append.  One clock read serves the whole batch: events with no
+        explicit eventTime/creationTime share the batch's commit instant."""
         results: List[dict] = []
         lines: List[str] = []
+        now_iso = _dt.datetime.now(_dt.timezone.utc).isoformat()
         for item in items:
             try:
-                d = canonical_event_json(item)
+                d = canonical_event_json(item, now_iso)
                 lines.append(json.dumps(d, separators=(",", ":"),
                                         sort_keys=True))
                 results.append({"status": 201, "eventId": d["eventId"]})
@@ -684,18 +786,60 @@ class FSEvents(base.LEvents, base.PEvents):
 
     def _append_lines(self, lines: str, app_id: int,
                       channel_id: Optional[int]) -> None:
+        """Group-commit append: enqueue this call's buffer; the first
+        thread into an idle group becomes the commit leader and writes
+        EVERY queued buffer with one write() (one fsync per policy),
+        amortizing the syscall + durability cost across concurrent
+        request threads — a storage group commit, same pattern as the
+        serving micro-batcher.  Buffers arriving while a commit is in
+        flight queue for the next leader — any waiter claims the vacancy
+        when woken (leadership is released, never transferred)."""
         key = (app_id, channel_id)
         with self._lock:
-            w = self._writers.get(key)
-            if w is None:
-                d = self._chan_dir(app_id, channel_id)
-                if (d / self._COMPACT_INTENT).exists():
-                    # finish a crashed compaction BEFORE picking a segment:
-                    # appending to a superseded segment would ack events the
-                    # roll-forward recovery then unlinks
-                    self._recover_compact(d)
-                w = self._writers[key] = self._new_writer(d)
-            w.append(lines)
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _CommitGroup()
+        item: dict = {"lines": lines}
+        with g.cond:
+            g.pending.append(item)
+            while "done" not in item and g.active:
+                g.cond.wait()
+            if "done" not in item:
+                # leadership vacancy: commit everything queued (incl. ours)
+                g.active = True
+                batch = g.pending[:]
+                del g.pending[:]
+            else:
+                batch = None
+        if batch is not None:
+            err: Optional[BaseException] = None
+            try:
+                with self._lock:
+                    w = self._writers.get(key)
+                    if w is None:
+                        d = self._chan_dir(*key)
+                        if (d / self._COMPACT_INTENT).exists():
+                            # finish a crashed compaction BEFORE picking a
+                            # segment: appending to a superseded segment
+                            # would ack events the roll-forward recovery
+                            # then unlinks
+                            self._recover_compact(d)
+                        w = self._writers[key] = self._new_writer(d)
+                    w.append("".join(i["lines"] for i in batch))
+            except BaseException as e:
+                # a failed write (ENOSPC/EIO) must NACK every event in
+                # the group — none of them is durable
+                err = e
+            with g.cond:
+                for i in batch:
+                    if err is not None:
+                        i["err"] = err
+                    i["done"] = True
+                g.active = False
+                g.cond.notify_all()
+        err2 = item.get("err")
+        if err2 is not None:
+            raise err2
 
     _COMPACT_INTENT = "compact-intent.json"
     _COMPACT_LOCK = "compact.lock"
@@ -843,14 +987,26 @@ class FSEvents(base.LEvents, base.PEvents):
     @staticmethod
     def _iter_segments(segs: Sequence[Path], dead: set) -> Iterator[Event]:
         for seg in segs:
-            with open(seg) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    e = Event.from_json(json.loads(line))
-                    if e.event_id not in dead:
-                        yield e
+            with open(seg, "rb") as f:
+                prev = None
+                for raw in f:
+                    if prev is not None:
+                        line = prev.strip()
+                        if line:
+                            e = Event.from_json(json.loads(line))
+                            if e.event_id not in dead:
+                                yield e
+                    prev = raw
+                # an unterminated final line is a torn tail from a writer
+                # killed mid-append (never acknowledged — the fsync policy
+                # runs after the full write): skip it instead of crashing
+                # the scan; the writer truncates it on its next open
+                if prev is not None and prev.endswith(b"\n"):
+                    line = prev.strip()
+                    if line:
+                        e = Event.from_json(json.loads(line))
+                        if e.event_id not in dead:
+                            yield e
 
     def _iter_raw(self, app_id: int, channel_id: Optional[int]) -> Iterator[Event]:
         d = self._chan_dir(app_id, channel_id)

@@ -351,45 +351,19 @@ class SharedModels(localfs.FSModels):
     names already); the localfs tmp+rename write is the object PUT."""
 
 
-class _SharedSegmentWriter(localfs._SegmentWriter):
-    """Per-writer segment naming: ``seg-<writer>-NNNNN.jsonl`` — this
-    process only ever appends to its own segments, so concurrent writers
-    on other hosts can never interleave bytes."""
-
-    def __init__(self, d: Path, tag: str):
-        super().__init__(d)
-        self._tag = tag
-
-    def _open_next(self) -> None:
-        self.close()
-        self._dir.mkdir(parents=True, exist_ok=True)
-        own = sorted(self._dir.glob(f"seg-{self._tag}-*.jsonl"))
-        if own and own[-1].stat().st_size < localfs.SEGMENT_MAX_BYTES:
-            path = own[-1]
-        else:
-            n = int(own[-1].stem.rsplit("-", 1)[1]) + 1 if own else 0
-            path = self._dir / f"seg-{self._tag}-{n:05d}.jsonl"
-        self._f = open(path, "a")
-
-
 class SharedFSEvents(localfs.FSEvents):
     """Per-writer segments over the shared prefix.
 
     Readers (find/scan/native batch/host-sharded scans) are inherited
     unchanged — they glob ``seg-*.jsonl``, and per-writer names sort into a
-    stable global order.  Only the two write hooks change: segments are
-    ``seg-<writer>-NNNNN.jsonl`` and tombstones ``tombstones-<writer>.txt``
-    (unioned at read time by the inherited ``_tombstones``)."""
+    stable global order.  The write hooks are the tagged localfs ones:
+    segments are ``seg-<writer>-NNNNN.jsonl`` and tombstones
+    ``tombstones-<writer>.txt`` (unioned at read time by the inherited
+    ``_tombstones``); the tag defaults to ``<host>-<pid>`` instead of
+    localfs's untagged single-writer naming."""
 
     def __init__(self, root: Path, writer_tag: Optional[str] = None):
-        super().__init__(root)
-        self._tag = writer_tag or writer_id()
-
-    def _new_writer(self, d: Path) -> localfs._SegmentWriter:
-        return _SharedSegmentWriter(d, self._tag)
-
-    def _tombstone_path(self, d: Path) -> Path:
-        return d / f"tombstones-{self._tag}.txt"
+        super().__init__(root, writer_tag=writer_tag or writer_id())
 
 
 class SharedFSSource:

@@ -25,6 +25,7 @@ import threading
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+from predictionio_tpu.api import prefork
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.storage.locator import Storage, get_storage
 from predictionio_tpu.workflow import core_workflow
@@ -446,24 +447,6 @@ def make_handler(state: QueryServerState):
     return QueryHandler
 
 
-def _watch_parent_process() -> None:
-    """Prefork child: exit when the spawning parent is gone (reparented),
-    so a killed/crashed parent never strands orphan workers on the port."""
-    parent = os.getppid()
-
-    def watch():
-        import time as _time
-
-        while True:
-            _time.sleep(2.0)
-            if os.getppid() != parent:
-                log.info("prefork worker: parent gone; exiting")
-                os._exit(0)
-
-    threading.Thread(target=watch, daemon=True,
-                     name="pio-parent-watch").start()
-
-
 def deploy(
     engine_json: str = "engine.json",
     variant: str = "default",
@@ -513,11 +496,11 @@ def deploy(
                 "in each worker; a programmatic storage object cannot "
                 "cross the process boundary")
     # Orphan-watch only in children WE spawned (marked via env by the
-    # prefork Popen below) — a programmatic caller passing reuse_port=True
+    # prefork spawn below) — a programmatic caller passing reuse_port=True
     # behind their own balancer must not get a server that self-terminates
     # when its launcher exits.
-    if os.environ.get("PIO_PREFORK_CHILD") == "1" and workers == 1:
-        _watch_parent_process()   # prefork child: die when orphaned
+    if workers == 1:
+        prefork.maybe_watch_parent(log)   # prefork child: die when orphaned
     doc = load_engine_variant(engine_json, variant)
     factory, engine, engine_params = engine_from_variant(doc)
     eid = resolve_engine_id(engine_id, doc, factory)
@@ -537,15 +520,9 @@ def deploy(
                          reuse_port=workers > 1 or reuse_port)
     bound_port = httpd.server_address[1]
     if workers > 1:
-        import subprocess
-
-        cores = os.cpu_count() or 1
-        if workers > cores:
-            log.warning(
-                "deploy --workers %d exceeds %d CPU core(s): extra "
-                "workers contend instead of scaling", workers, cores)
-        for w in range(workers - 1):
-            child_procs.append(subprocess.Popen(
+        child_procs = prefork.spawn_workers(
+            workers - 1,
+            lambda w: (
                 [sys.executable, "-m", "predictionio_tpu.cli.main",
                  "deploy", "--engine-json", str(engine_json),
                  "--variant", variant,
@@ -553,42 +530,17 @@ def deploy(
                  "--ip", host, "--port", str(bound_port), "--reuse-port"]
                 + (["--engine-id", engine_id] if engine_id else [])
                 + (["--feedback"] if feedback else [])
-                + (["--auto-reload", str(auto_reload)] if auto_reload else []),
-                env={**os.environ, "PIO_PREFORK_CHILD": "1"},
-            ))
-        # surface child exits (a worker that dies at startup — bad env,
-        # bind failure — would otherwise silently leave the port at 1/N
-        # capacity); the reaper also wait()s so no zombies accumulate
-        def _reap(p, idx):
-            rc = p.wait()
-            if rc not in (0, -15):   # -15: our own terminate()
-                log.warning("prefork worker %d exited with code %s", idx, rc)
-
-        for idx, p in enumerate(child_procs):
-            threading.Thread(target=_reap, args=(p, idx), daemon=True).start()
-        log.info("prefork: %d extra worker process(es) on port %d",
-                 workers - 1, bound_port)
+                + (["--auto-reload", str(auto_reload)] if auto_reload else [])
+            ),
+            log=log,
+        )
     log.info("Query server for %s listening on %s:%d", eid, host, bound_port)
     httpd.pio_state = state  # handle for tests/tools
     httpd.pio_workers = child_procs
     # the auto-reload poller (and any prefork workers) must die with the
     # server, however it is shut down (shutdown()/server_close(), /stop,
     # or pio undeploy)
-    _orig_close = httpd.server_close
-
-    def _close_and_stop_poller():
-        state.stop_auto_reload()
-        for p in child_procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in child_procs:
-            try:
-                p.wait(timeout=10)
-            except Exception:
-                p.kill()
-        _orig_close()
-
-    httpd.server_close = _close_and_stop_poller
+    prefork.wire_shutdown(httpd, child_procs, before=state.stop_auto_reload)
     if background:
         return httpd
     try:

@@ -40,8 +40,11 @@ def event_server(mem_storage):
 
 
 def test_event_server_alive(event_server):
+    import os
+
     status, body = http("GET", event_server["base"] + "/")
-    assert status == 200 and body == {"status": "alive"}
+    assert status == 200 and body["status"] == "alive"
+    assert body["pid"] == os.getpid()   # identifies the serving worker
 
 
 def test_post_and_get_event(event_server):
@@ -839,6 +842,64 @@ def test_sdk_event_pipeline_partial_drain_and_close(event_server):
     assert all(h.result()["eventId"] for h in handles)
     with _pytest.raises(PIOError, match="closed"):
         p.create_event("buy", "user", "x")
+
+
+def test_sdk_event_pipeline_honors_connection_close():
+    """ADVICE r5: a server 'Connection: close' mid-pipeline must fail the
+    outstanding handles with the committed-but-unacknowledged message and
+    refuse NEW sends — not surface an opaque 'server closed' for
+    everything later."""
+    import socket as _socket
+    import threading as _threading
+
+    import pytest as _pytest
+
+    from predictionio_tpu.sdk import EventClient, PIOError
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        c, _ = srv.accept()
+        buf = b""
+        # read until the FIRST request's body is in, then answer it with
+        # Connection: close and drop the socket (http_util does exactly
+        # this after e.g. an oversized unread body)
+        while b"\r\n\r\n" not in buf:
+            buf += c.recv(65536)
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        clen = 0
+        for h in head.split(b"\r\n"):
+            if h.lower().startswith(b"content-length:"):
+                clen = int(h.split(b":")[1])
+        while len(rest) < clen:
+            rest += c.recv(65536)
+        body = b'{"eventId": "first"}'
+        c.sendall(b"HTTP/1.1 201 Created\r\nContent-Type: application/json"
+                  b"\r\nContent-Length: %d\r\nConnection: close\r\n\r\n"
+                  % len(body) + body)
+        c.close()
+        srv.close()
+
+    t = _threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = EventClient("k", f"http://127.0.0.1:{port}")
+    p = c.pipeline(depth=64)
+    first = p.record_user_action_on_item("buy", "u1", "i1")
+    rest = [p.record_user_action_on_item("buy", "u1", f"i{i}")
+            for i in range(2, 5)]
+    # draining the first handle reads its response AND sees the close
+    assert first.result()["eventId"] == "first"
+    for h in rest:
+        assert h.done
+        with _pytest.raises(PIOError, match="Connection: close"):
+            h.result()
+    # fail fast on new sends after the server signaled close
+    with _pytest.raises(PIOError, match="closed"):
+        p.record_user_action_on_item("buy", "u1", "i9")
+    t.join(timeout=10)
 
 
 def _rst_close(c):

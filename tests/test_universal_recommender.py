@@ -846,3 +846,26 @@ def test_host_scorer_edge_cases(trained, monkeypatch):
     hist = {some: np.asarray([0, 1], np.int32)}
     s = algo._score_history_host(model, hist)
     assert s is not None and not s.any()
+
+
+def test_host_inverted_degenerate_table_returns_empty_csr(trained):
+    """ADVICE r5: a non-2D indicator table (degenerate/empty training
+    shard) must yield an EMPTY CSR inversion, not the broken
+    arange(0)/boolean-index fallback that IndexError'd on any non-empty
+    non-2D input."""
+    _, _, models = trained
+    model = models[0]
+    name = next(iter(model.indicator_idx))
+    model.__dict__.pop("_host_inv", None)
+    orig = model.indicator_idx
+    try:
+        model.indicator_idx = dict(orig)
+        # 1-D non-empty table: the exact shape the old guard crashed on
+        model.indicator_idx[name] = np.asarray([1, 2, 3], np.int32)
+        indptr, rows, w = model.host_inverted(name)
+        n_t = max(len(model.event_item_dicts[name]), 1)
+        assert indptr.shape == (n_t + 1,) and (indptr == 0).all()
+        assert rows.size == 0 and w.size == 0
+    finally:
+        model.indicator_idx = orig
+        model.__dict__.pop("_host_inv", None)
