@@ -6,16 +6,22 @@ engine params and metric scores).
   GET /dashboard.json           same data as JSON
   GET /engine_instances.json    all engine instances
   GET /evaluations.json         completed evaluation instances
+  GET /spans/<instance>.json    span journal of one train/eval run
+  GET /metrics                  Prometheus text
+  GET /stats.json               per-(route, status) request windows
 """
 
 from __future__ import annotations
 
+import datetime as _dt
 import html
 import logging
 from typing import Optional
 
 from predictionio_tpu import __version__
 from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.obs import spans as obs_spans
+from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.storage.locator import Storage, get_storage
 
 log = logging.getLogger("pio.dashboard")
@@ -48,9 +54,35 @@ def _evi_json(i) -> dict:
 
 def _start_key(i):
     # instances may have a None start_time (inserted before train started)
-    import datetime as _dt
-
     return i.start_time or _dt.datetime.min.replace(tzinfo=_dt.timezone.utc)
+
+
+def _duration(i) -> str:
+    """Rendered end−start, '' while running or when either end is unset."""
+    if not i.start_time or not i.end_time:
+        return ""
+    secs = (i.end_time - i.start_time).total_seconds()
+    if secs >= 120:
+        return f"{secs / 60:.1f} min"
+    return f"{secs:.2f} s"
+
+
+# journals are read per rendered row; only the newest rows get one so a
+# long instance history doesn't turn GET / into thousands of file reads
+_MAX_SPAN_ROWS = 25
+
+
+def _span_summary(storage: Storage, instance_id: str, limit: int = 8) -> str:
+    """Escaped one-line-per-span digest of a run's journal for the HTML
+    table ('' when no journal was recorded)."""
+    spans = obs_spans.read_journal(obs_spans.journal_path(storage, instance_id))
+    if not spans:
+        return ""
+    spans = sorted(spans, key=lambda s: s.get("duration_s", 0.0),
+                   reverse=True)[:limit]
+    return "<br>".join(
+        html.escape(f"{s.get('name', '?')}: {s.get('duration_s', 0.0):.3f}s")
+        for s in sorted(spans, key=lambda s: s.get("id", 0)))
 
 
 def _render_html(storage: Storage) -> str:
@@ -58,26 +90,34 @@ def _render_html(storage: Storage) -> str:
     engines = sorted(storage.engine_instances.get_all(),
                      key=_start_key, reverse=True)
     rows_eval = "".join(
-        "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td>{res}</td></tr>".format(
+        "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td>{dur}</td>"
+        "<td>{spans}</td><td>{res}</td></tr>".format(
             id=html.escape(i.id[:12]),
             cls=html.escape(i.evaluation_class),
             start=html.escape(i.start_time.isoformat(timespec="seconds") if i.start_time else ""),
+            dur=html.escape(_duration(i)),
+            spans=(_span_summary(storage, i.id)
+                   if k < _MAX_SPAN_ROWS else ""),
             # evaluator_results_html is framework-generated markup
             # (core_workflow._eval_results_html), not user input
             res=i.evaluator_results_html
             or "<pre>" + html.escape((i.evaluator_results or "")[:2000]) + "</pre>",
         )
-        for i in sorted(evals, key=_start_key, reverse=True)
-    ) or "<tr><td colspan=4><i>no completed evaluations</i></td></tr>"
+        for k, i in enumerate(sorted(evals, key=_start_key, reverse=True))
+    ) or "<tr><td colspan=6><i>no completed evaluations</i></td></tr>"
     rows_engine = "".join(
-        "<tr><td>{id}</td><td>{eng}</td><td>{status}</td><td>{start}</td></tr>".format(
+        "<tr><td>{id}</td><td>{eng}</td><td>{status}</td><td>{start}</td>"
+        "<td>{dur}</td><td>{spans}</td></tr>".format(
             id=html.escape(i.id[:12]),
             eng=html.escape(f"{i.engine_id} v{i.engine_version} ({i.engine_variant})"),
             status=html.escape(i.status),
             start=html.escape(i.start_time.isoformat(timespec="seconds") if i.start_time else ""),
+            dur=html.escape(_duration(i)),
+            spans=(_span_summary(storage, i.id)
+                   if k < _MAX_SPAN_ROWS else ""),
         )
-        for i in engines
-    ) or "<tr><td colspan=4><i>no engine instances</i></td></tr>"
+        for k, i in enumerate(engines)
+    ) or "<tr><td colspan=6><i>no engine instances</i></td></tr>"
     return f"""<!DOCTYPE html>
 <html><head><title>PredictionIO-TPU Dashboard</title>
 <style>
@@ -91,16 +131,22 @@ def _render_html(storage: Storage) -> str:
 <body>
 <h1>PredictionIO-TPU Dashboard <small>v{html.escape(__version__)}</small></h1>
 <h2>Completed evaluations</h2>
-<table><tr><th>id</th><th>evaluation</th><th>started</th><th>results</th></tr>
+<table><tr><th>id</th><th>evaluation</th><th>started</th><th>duration</th>
+<th>spans</th><th>results</th></tr>
 {rows_eval}</table>
 <h2>Engine instances</h2>
-<table><tr><th>id</th><th>engine</th><th>status</th><th>started</th></tr>
+<table><tr><th>id</th><th>engine</th><th>status</th><th>started</th>
+<th>duration</th><th>train spans</th></tr>
 {rows_engine}</table>
+<p><a href="/metrics">/metrics</a> &middot;
+<a href="/stats.json">/stats.json</a></p>
 </body></html>"""
 
 
 def make_handler(storage: Storage):
     class DashboardHandler(JsonHandler):
+        stats_collector = StatsCollector()
+
         def do_GET(self):
             path, _ = self.route
             if path == "/":
@@ -120,6 +166,22 @@ def make_handler(storage: Storage):
                 self.send_json({"evaluations": [
                     _evi_json(i) for i in storage.evaluation_instances.get_completed()
                 ]})
+            elif path.startswith("/spans/") and path.endswith(".json"):
+                instance_id = path[len("/spans/"):-len(".json")]
+                spans = obs_spans.read_journal(
+                    obs_spans.journal_path(storage, instance_id))
+                if not spans:
+                    self.send_error_json(
+                        404, f"no span journal for {instance_id!r}")
+                else:
+                    self.send_json({"instanceId": instance_id,
+                                    "spans": spans})
+            elif path == "/metrics":
+                self._send_raw(200, metrics_payload(),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+            elif path == "/stats.json":
+                self.send_json(self.stats_collector.to_json())
             else:
                 self.send_error_json(404, "not found")
 

@@ -8,8 +8,9 @@ Reference: data/src/main/scala/io/prediction/data/api/EventServer.scala
   GET    /events.json?accessKey=K&...filters           query events
   GET    /events/<id>.json?accessKey=K                 fetch one
   DELETE /events/<id>.json?accessKey=K                 tombstone one
-  GET    /                                             {"status": "alive"}
-  GET    /stats.json?accessKey=K                       per-app event counts
+  GET    /                                             {"status": "alive", pid, version, workerTag}
+  GET    /stats.json?accessKey=K                       per-app event counts + window stats
+  GET    /metrics                                      Prometheus text (cross-worker aggregate)
 
 Auth matches the reference: the access key names the app; a key with a
 non-empty ``events`` list may only write those event types; channels resolve
@@ -27,12 +28,19 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from predictionio_tpu import __version__
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.events.event import Event, parse_time
+from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.storage.base import AccessKey
 from predictionio_tpu.storage.locator import Storage, get_storage
 
 log = logging.getLogger("pio.eventserver")
+
+_M_INGESTED = obs_metrics.get_registry().counter(
+    "pio_events_ingested_total",
+    "Events accepted (HTTP 201 / per-item 201) by app and event name")
 
 MAX_BATCH = 50  # reference: EventServer batch limit
 
@@ -65,6 +73,14 @@ class EventServerState:
         self.stats_enabled = stats
         self.max_batch = _max_batch()
         self.counts: Dict[int, Dict[str, int]] = {}
+        # reference-parity EventServerStats windows (obs.exposition);
+        # serves the statsSinceStart/statsCurrent views of /stats.json
+        self.stats = StatsCollector()
+        # event names are client-supplied: bound the distinct label set
+        # (metric series + stats keys + counts) the way route_label
+        # bounds routes, or a hostile/buggy producer posting unique
+        # names grows the registry and every snapshot flush forever
+        self._event_labels: set = set()
         # (accessKey, channel) → (result, stamp): the metadata store read
         # behind auth costs ~0.08 ms/request on localfs, which dominates a
         # hot ingest loop.  TTL-bounded so key revocation/channel changes
@@ -73,10 +89,29 @@ class EventServerState:
         self._auth_cache: Dict[Tuple[str, str], Tuple[tuple, float]] = {}
         self._auth_ttl = float(os.environ.get("PIO_AUTH_CACHE_S", "2"))
 
-    def record(self, app_id: int, event_name: str) -> None:
-        if self.stats_enabled:
+    MAX_EVENT_LABELS = 1000
+
+    def _bounded_label(self, name):
+        if not isinstance(name, str) or not name:
+            return name
+        if (name not in self._event_labels
+                and len(self._event_labels) >= self.MAX_EVENT_LABELS):
+            return "(other)"
+        self._event_labels.add(name)
+        return name
+
+    def record(self, app_id: int, event_name: str, status: int = 201,
+               entity_type: Optional[str] = None) -> None:
+        if not self.stats_enabled:
+            return
+        event_name = self._bounded_label(event_name)
+        entity_type = self._bounded_label(entity_type)
+        if status == 201:
             per_app = self.counts.setdefault(app_id, {})
             per_app[event_name] = per_app.get(event_name, 0) + 1
+            _M_INGESTED.inc(1, app=str(app_id), event=event_name or "")
+        self.stats.record(app_id, status, event=event_name,
+                          entity_type=entity_type)
 
     def auth(self, query: Dict[str, str]) -> Tuple[Optional[AccessKey], Optional[int], Optional[str]]:
         """Returns (access_key, channel_id, error)."""
@@ -119,8 +154,20 @@ def make_handler(state: EventServerState):
                 # pid identifies WHICH prefork worker answered — the
                 # readiness/diagnostic signal for multi-worker deployments
                 # (a client probing fresh connections sees each live
-                # worker's pid as the kernel load-balances the accepts)
-                self.send_json({"status": "alive", "pid": os.getpid()})
+                # worker's pid as the kernel load-balances the accepts).
+                # version + workerTag let a rolling restart verify a
+                # mixed-version worker group from outside.
+                self.send_json({"status": "alive", "pid": os.getpid(),
+                                "version": __version__,
+                                "workerTag": obs_metrics.worker_tag()})
+                return
+            if path == "/metrics":
+                # Prometheus text; unauthenticated like every standard
+                # exporter (no event data leaves through it).  One scrape
+                # of ANY worker merges every sibling's snapshot.
+                self._send_raw(200, metrics_payload(),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
                 return
             if path == "/stop":
                 # graceful shutdown (same contract as the query server's
@@ -155,7 +202,13 @@ def make_handler(state: EventServerState):
             if path == "/events.json":
                 self._find(ak, channel_id, query)
             elif path == "/stats.json":
-                self.send_json({"appId": ak.app_id, "counts": state.counts.get(ak.app_id, {})})
+                # back-compat keys (appId/counts) + the reference-parity
+                # window views (per-(appId, status, event/entityType)
+                # since start, current window, last completed window)
+                doc = state.stats.to_json(app_id=ak.app_id)
+                doc["appId"] = ak.app_id
+                doc["counts"] = state.counts.get(ak.app_id, {})
+                self.send_json(doc)
             elif path.startswith("/events/") and path.endswith(".json"):
                 event_id = path[len("/events/"):-len(".json")]
                 e = state.storage.l_events.get(event_id, ak.app_id, channel_id)
@@ -224,7 +277,8 @@ def make_handler(state: EventServerState):
                 self.send_error_json(403, err)
                 return
             event_id = state.storage.l_events.insert(event, ak.app_id, channel_id)
-            state.record(ak.app_id, event.event)
+            state.record(ak.app_id, event.event,
+                         entity_type=event.entity_type)
             self.send_json({"eventId": event_id}, status=201)
 
         def _check_allowed(self, ak: AccessKey, event_name: str) -> Optional[str]:
@@ -245,8 +299,10 @@ def make_handler(state: EventServerState):
                 # endpoint and the old Event-object path)
                 try:
                     Event.from_json(body)
+                    state.record(ak.app_id, name, 403)
                     self.send_error_json(403, err)
                 except (ValueError, KeyError, TypeError) as e:
+                    state.record(ak.app_id, name, 400)
                     self.send_error_json(400, str(e))
                 return
             # same canonical fast path as /batch/events.json: wire dict →
@@ -255,10 +311,13 @@ def make_handler(state: EventServerState):
             r = state.storage.l_events.insert_json_batch(
                 [body], ak.app_id, channel_id)[0]
             if r["status"] != 201:
+                state.record(ak.app_id, name if isinstance(name, str)
+                             else None, 400)
                 self.send_error_json(400, r["message"])
                 return
             event_id = r["eventId"]
-            state.record(ak.app_id, name)
+            state.record(ak.app_id, name,
+                         entity_type=body.get("entityType"))
             if type(event_id) is str and event_id.isalnum():
                 # hand-built body: alnum ids (every server-generated id is
                 # hex) need no JSON escaping, and this is the single-event
@@ -307,8 +366,11 @@ def make_handler(state: EventServerState):
                 if r is None:
                     results[k] = next(it)
             for item, r in zip(body, results):
-                if r.get("status") == 201 and isinstance(item, dict):
-                    state.record(ak.app_id, item.get("event", ""))
+                name = item.get("event") if isinstance(item, dict) else None
+                etype = (item.get("entityType")
+                         if isinstance(item, dict) else None)
+                state.record(ak.app_id, name, r.get("status", 0),
+                             entity_type=etype)
             self.send_json(results)
 
         def _find(self, ak, channel_id, query):
@@ -372,7 +434,13 @@ def run_event_server(
             "the process boundary")
     if workers == 1:
         prefork.maybe_watch_parent(log)   # prefork child: die when orphaned
+        # prefork child spawned with a PIO_METRICS_DIR: publish this
+        # worker's registry snapshots so any sibling's scrape sees us
+        # (no-op — pure in-memory metrics — for a true single worker)
+        obs_metrics.start_worker_flusher()
+        obs_metrics.mark_worker_up()
     prev_tag = os.environ.get("PIO_WRITER_TAG")
+    metrics_dir: Optional[str] = None
     if workers > 1:
         # the parent is writer w0, children w1..wN-1 — suffixed with the
         # PARENT's pid so tags stay unique across server instances: a
@@ -404,16 +472,30 @@ def run_event_server(
     bound_port = httpd.server_address[1]
     children: list = []
     if workers > 1:
+        # cross-worker metrics: every worker snapshots its registry into
+        # this directory; a scrape of ANY worker merges the whole group.
+        # The dir travels to children by env (never set in the parent's
+        # own environ — a later programmatic server in this process must
+        # not silently join this group).
+        import tempfile
+
+        metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
+        obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
         children = prefork.spawn_workers(
             workers - 1,
             lambda w: [sys.executable, "-m", "predictionio_tpu.cli.main",
                        "eventserver", "--ip", host,
                        "--port", str(bound_port), "--reuse-port"],
             build_env=lambda w: {
-                "PIO_WRITER_TAG": f"w{w + 1}-{os.getpid()}"},
+                "PIO_WRITER_TAG": f"w{w + 1}-{os.getpid()}",
+                "PIO_METRICS_DIR": metrics_dir},
             log=log,
         )
     prefork.wire_shutdown(httpd, children)
+    if metrics_dir is not None:
+        # AFTER wire_shutdown so this runs once the children are stopped
+        # (their flushers write into the dir until they die)
+        prefork.wire_metrics_cleanup(httpd, metrics_dir)
     httpd.pio_state = state   # handle for tests/tools
     httpd.pio_workers = children
     log.info("Event server listening on %s:%d", host, bound_port)

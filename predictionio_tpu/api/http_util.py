@@ -13,14 +13,62 @@ BaseHTTPRequestHandler-ish surface they already used: ``self.path``,
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
+import os
 import socketserver
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.obs.metrics import get_registry
+
 _access_log = logging.getLogger("pio.http")
+
+# -- request middleware instruments (obs tentpole) ---------------------------
+_REG = get_registry()
+_M_REQS = _REG.counter(
+    "pio_http_requests_total", "HTTP requests served, by route and status")
+_M_LAT = _REG.histogram(
+    "pio_http_request_duration_seconds",
+    "Request handling latency by route (parse to response written)")
+_M_INFLIGHT = _REG.gauge(
+    "pio_http_requests_in_flight", "Requests currently being handled")
+
+# request-id generation: cheap monotonic id, unique per process
+_RID = itertools.count(1)
+_RID_PREFIX = f"{os.getpid():x}"
+
+# static routes exposed verbatim; everything else is normalized (or
+# bucketed) so per-id paths can't explode label cardinality
+_KNOWN_ROUTES = frozenset({
+    "/", "/stop", "/reload", "/metrics", "/stats.json",
+    "/events.json", "/batch/events.json", "/queries.json",
+    "/dashboard.json", "/engine_instances.json", "/evaluations.json",
+    "/cmd/app",
+})
+
+
+def route_label(path: str) -> str:
+    """Bounded-cardinality route label for a request path."""
+    route = path.partition("?")[0]
+    if route in _KNOWN_ROUTES:
+        return route
+    if route.startswith("/events/") and route.endswith(".json"):
+        return "/events/{id}.json"
+    if route.startswith("/webhooks/") and route.endswith(".json"):
+        return "/webhooks/{name}.json"
+    if route.startswith("/spans/") and route.endswith(".json"):
+        return "/spans/{id}.json"
+    if route.startswith("/cmd/app/"):
+        if route.endswith("/accesskeys"):
+            return "/cmd/app/{name}/accesskeys"
+        if route.endswith("/data"):
+            return "/cmd/app/{name}/data"
+        return "/cmd/app/{name}"
+    return "(other)"
 
 
 class ThreadingHTTPServer(socketserver.ThreadingTCPServer):
@@ -54,6 +102,9 @@ class JsonHandler(socketserver.StreamRequestHandler):
 
     server_version = "pio-tpu"
     protocol_version = "HTTP/1.1"
+    # per-server-class stats.json window collector (obs.exposition
+    # StatsCollector); the middleware records (status, route) into it
+    stats_collector = None
     # Nagle + delayed-ACK interact catastrophically with keep-alive
     # request/response traffic: the response's last segment sits in the
     # kernel ~40 ms waiting for an ACK the client won't send until its
@@ -78,6 +129,8 @@ class JsonHandler(socketserver.StreamRequestHandler):
             pass
 
     def _handle_one(self) -> bool:
+        self.request_id = ""   # early-error responses must not reuse a
+        self._status_sent = 0  # previous keep-alive request's id/status
         line = self.rfile.readline(65537)
         if not line or line in (b"\r\n", b"\n"):
             return False
@@ -137,14 +190,32 @@ class JsonHandler(socketserver.StreamRequestHandler):
             self._send_raw(400, b'{"message": "bad Content-Length"}')
             return False
         method = getattr(self, "do_" + self.command, None)
+        # request-id propagation: honor an incoming X-Request-ID (bounded)
+        # or mint one, so one id links client logs, access logs, and the
+        # echoed response header across the prefork worker group
+        rid = headers.get("x-request-id")
+        self.request_id = (rid if rid and len(rid) <= 64
+                           else f"{_RID_PREFIX}-{next(_RID):x}")
+        self._status_sent = 0
+        _M_INFLIGHT.inc()
+        t0 = time.perf_counter()
         try:
-            if method is None:
-                self.send_error_json(
-                    501, f"Unsupported method ({self.command!r})")
-            else:
-                method()
-        except (BrokenPipeError, ConnectionResetError):
-            return False
+            try:
+                if method is None:
+                    self.send_error_json(
+                        501, f"Unsupported method ({self.command!r})")
+                else:
+                    method()
+            except (BrokenPipeError, ConnectionResetError):
+                return False
+        finally:
+            _M_INFLIGHT.dec()
+            route = route_label(self.path)
+            _M_LAT.observe(time.perf_counter() - t0, route=route)
+            _M_REQS.inc(1, route=route, status=str(self._status_sent or 0))
+            sc = self.stats_collector
+            if sc is not None:
+                sc.record(None, self._status_sent or 0, event=route)
         # a handler that errored before read_json (auth failure, 404 route)
         # leaves the request body in the stream; drain it or the next
         # keep-alive request would be parsed out of body bytes (>1 MB:
@@ -155,7 +226,8 @@ class JsonHandler(socketserver.StreamRequestHandler):
             else:
                 self.rfile.read(self._body_unread)
         if _access_log.isEnabledFor(logging.DEBUG):
-            self.log_message('"%s %s" -', self.command, self.path)
+            self.log_message('"%s %s" %s rid=%s', self.command, self.path,
+                             self._status_sent or "-", self.request_id)
         return True
 
     # -- helpers -------------------------------------------------------------
@@ -193,9 +265,13 @@ class JsonHandler(socketserver.StreamRequestHandler):
         # clients see spurious mid-pipeline disconnects)
         if getattr(self, "_body_unread", 0) > (1 << 20):
             self.close_connection = True
+        self._status_sent = status
+        rid = getattr(self, "request_id", "")
+        rid_line = "X-Request-ID: %s\r\n" % rid if rid else ""
         head = (
             f"HTTP/1.1 {status} {_REASON.get(status, '')}\r\n"
             f"Server: {self.server_version}\r\n"
+            f"{rid_line}"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"{'Connection: close' if self.close_connection else 'Connection: keep-alive'}\r\n"

@@ -125,6 +125,25 @@ def stop_workers(procs: List[subprocess.Popen]) -> None:
             p.kill()
 
 
+def wire_metrics_cleanup(httpd, metrics_dir: str) -> None:
+    """Parent side of cross-worker metrics teardown: once the server
+    closes (children already stopped by the wire_shutdown wrapper
+    installed BEFORE this one), stop the snapshot flusher and remove the
+    per-worker snapshot directory."""
+    import shutil
+
+    from predictionio_tpu.obs import metrics as obs_metrics
+
+    orig_close = httpd.server_close
+
+    def _close_then_cleanup():
+        orig_close()
+        obs_metrics.stop_worker_flusher()
+        shutil.rmtree(metrics_dir, ignore_errors=True)
+
+    httpd.server_close = _close_then_cleanup
+
+
 def wire_shutdown(httpd, procs: List[subprocess.Popen],
                   before: Optional[Callable[[], None]] = None) -> None:
     """Make ``httpd.server_close()`` also run ``before()`` and stop the

@@ -11,6 +11,7 @@ bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
   train / deploy/undeploy / eval                   DASE workflow (workflow module)
   import / export                         event batch files
   eventserver / adminserver / dashboard   REST ingestion / admin API / eval dashboard
+  metrics                                 scrape + pretty-print a server's /metrics
   status                                  storage + env sanity report
   version
 
@@ -313,6 +314,35 @@ def _cmd_dashboard(args) -> int:
     return run_dashboard(host=args.ip, port=args.port)
 
 
+def _cmd_metrics(args) -> int:
+    """`pio metrics <url>` — scrape a server's /metrics and pretty-print
+    it: counters/gauges per series, histograms as count/sum/avg with
+    bucket-interpolated p50/p95/p99.  Any pio server works (event server,
+    deployed engine, dashboard); scraping one prefork worker reports the
+    whole group."""
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.obs.exposition import summarize_prometheus
+
+    url = args.url
+    if "://" not in url:
+        url = f"http://{url}"
+    if not url.endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, OSError) as e:
+        print(f"Error: cannot scrape {url}: {e}", file=sys.stderr)
+        return 1
+    if args.raw:
+        sys.stdout.write(text)
+    else:
+        sys.stdout.write(summarize_prometheus(text))
+    return 0
+
+
 def _cmd_train(args) -> int:
     from predictionio_tpu.workflow.create_workflow import run_train_from_args
 
@@ -538,6 +568,17 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
     db.set_defaults(func=_cmd_dashboard)
+
+    mt = sub.add_parser(
+        "metrics",
+        help="scrape a server's /metrics and pretty-print it")
+    mt.add_argument("url",
+                    help="server base URL or host:port (e.g. "
+                         "http://127.0.0.1:7070 or 127.0.0.1:7070)")
+    mt.add_argument("--timeout", type=float, default=10.0)
+    mt.add_argument("--raw", action="store_true",
+                    help="dump the raw Prometheus text instead")
+    mt.set_defaults(func=_cmd_metrics)
 
     tr = sub.add_parser("train")
     tr.add_argument("--engine-json", default="engine.json")

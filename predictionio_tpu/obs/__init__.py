@@ -1,0 +1,22 @@
+"""Observability subsystem: metrics registry, Prometheus/stats.json
+exposition, and structured span journals.
+
+The reference EventServer shipped a ``--stats`` flag with a
+``stats.json`` endpoint and leaned on the Spark UI for everything else
+(SURVEY.md §5); this package is the TPU-native replacement the prefork
+multi-worker servers need — a process-local registry
+(:mod:`predictionio_tpu.obs.metrics`) whose snapshots cross the
+SO_REUSEPORT process boundary via per-worker files, text exposition at
+``GET /metrics`` + reference-parity ``GET /stats.json``
+(:mod:`predictionio_tpu.obs.exposition`), and a per-run span journal for
+training/evaluation (:mod:`predictionio_tpu.obs.spans`).
+
+Everything here is stdlib-only and import-safe from the storage layer
+(no jax, no predictionio_tpu.api imports).
+"""
+
+from predictionio_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    set_enabled,
+)

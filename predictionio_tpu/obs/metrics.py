@@ -1,0 +1,413 @@
+"""Process-local metrics registry with cross-process aggregation.
+
+Counter / Gauge / Histogram over a thread-safe registry, designed for the
+prefork SO_REUSEPORT model (api/prefork.py): each worker process owns a
+plain in-memory registry (near-zero hot-path cost — one lock hop and a
+dict update per record), and a :class:`SnapshotFlusher` persists its
+snapshot to ``<PIO_METRICS_DIR>/<tag>.json`` (tag = the worker's
+``PIO_METRICS_TAG``/``PIO_WRITER_TAG``).  A scrape of ANY worker merges
+every sibling's snapshot file with its own live registry
+(:func:`aggregate_snapshot`), so one ``GET /metrics`` sees the whole
+server group.  Counters and gauges sum across workers; histograms sum
+bucket-wise.
+
+Naming contract (enforced at registration, linted by
+``scripts/check_metrics_names.py``): every metric name matches
+``pio_[a-z0-9_]+`` and carries a non-empty help string.
+
+``PIO_METRICS=off`` disables recording globally (the bench's
+instrumentation-overhead guard compares against exactly this mode);
+exposition then serves whatever was recorded before the switch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time as _time
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence, Tuple
+
+NAME_RE = re.compile(r"^pio_[a-z0-9_]+$")
+
+# log-scaled latency buckets (seconds): 500 µs … 60 s, the envelope of a
+# single-event append on one end and a cold-compile train span on the other
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+# power-of-two size buckets for batch/occupancy histograms
+SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096)
+
+
+def _label_key(labels: Dict[str, str]) -> str:
+    """Canonical series key: the Prometheus label body, sorted by name.
+    Doubles as the on-disk snapshot key so merge needs no re-parsing."""
+    if not labels:
+        return ""
+    return ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
+        for k, v in sorted(labels.items()))
+
+
+class _Metric:
+    """Common series bookkeeping; subclasses define the value shape."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str):
+        self._reg = registry
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[str, object] = {}
+
+    def _snapshot_series(self):
+        with self._lock:
+            return dict(self._series)
+
+    def clear_series(self) -> None:
+        """Drop every series (identity gauges on server restart within
+        one process; test isolation)."""
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help,
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        super().__init__(registry, name, help)
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                # one cumulative-count slot per bucket + the +Inf slot
+                s = self._series[key] = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+            s["counts"][i] += 1
+            s["sum"] += value
+            s["count"] += 1
+
+    def _snapshot_series(self):
+        with self._lock:
+            return {k: {"counts": list(v["counts"]), "sum": v["sum"],
+                        "count": v["count"]}
+                    for k, v in self._series.items()}
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric registry.  Registration is idempotent:
+    asking for an existing name returns the existing metric (and raises
+    on a kind mismatch), so modules can declare their instruments at
+    import time without coordinating order."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("PIO_METRICS", "").lower() not in (
+                "off", "0", "false")
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help: str, **kw) -> _Metric:
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must match {NAME_RE.pattern}")
+        if not help or not help.strip():
+            raise ValueError(f"metric {name!r} needs a non-empty help string")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}")
+                return m
+            m = self._metrics[name] = cls(self, name, help, **kw)
+            return m
+
+    def counter(self, name: str, help: str) -> Counter:
+        return self._register(Counter, name, help)
+
+    def gauge(self, name: str, help: str) -> Gauge:
+        return self._register(Gauge, name, help)
+
+    def histogram(self, name: str, help: str,
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> dict:
+        """JSON-able full-state dump, the unit of cross-process exchange."""
+        out = {}
+        for m in self.metrics():
+            entry = {"type": m.kind, "help": m.help,
+                     "series": m._snapshot_series()}
+            if isinstance(m, Histogram):
+                entry["buckets"] = list(m.buckets)
+            out[m.name] = entry
+        return out
+
+
+def merge_snapshots(snapshots: Sequence[dict]) -> dict:
+    """Sum snapshots across workers: counters/gauges add per series,
+    histograms add bucket-wise (boundaries must agree — they come from
+    the same code in every worker)."""
+    merged: dict = {}
+    for snap in snapshots:
+        for name, entry in snap.items():
+            tgt = merged.get(name)
+            if tgt is None:
+                tgt = merged[name] = {
+                    "type": entry["type"], "help": entry["help"],
+                    "series": {}}
+                if "buckets" in entry:
+                    tgt["buckets"] = list(entry["buckets"])
+            for key, val in entry["series"].items():
+                cur = tgt["series"].get(key)
+                if entry["type"] == "histogram":
+                    if cur is None:
+                        tgt["series"][key] = {
+                            "counts": list(val["counts"]),
+                            "sum": val["sum"], "count": val["count"]}
+                    else:
+                        cur["counts"] = [a + b for a, b in
+                                         zip(cur["counts"], val["counts"])]
+                        cur["sum"] += val["sum"]
+                        cur["count"] += val["count"]
+                else:
+                    tgt["series"][key] = (cur or 0.0) + val
+    return merged
+
+
+# -- process-default registry -------------------------------------------------
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def set_enabled(enabled: bool) -> None:
+    """Runtime switch for the default registry (the bench's
+    instrumentation-overhead guard toggles this)."""
+    _REGISTRY.enabled = enabled
+
+
+def worker_tag() -> str:
+    """This process's metrics identity: the active snapshot flusher's tag
+    (authoritative — the prefork parent assigns itself ``w0-<pid>``
+    explicitly and restores its environment afterwards), else
+    PIO_METRICS_TAG (deploy workers) or PIO_WRITER_TAG (event-server
+    workers), else pid-based."""
+    with _flusher_lock:
+        if _flusher is not None:
+            return _flusher.tag
+    return (os.environ.get("PIO_METRICS_TAG")
+            or os.environ.get("PIO_WRITER_TAG")
+            or f"pid-{os.getpid()}")
+
+
+# the prefork health view: one series per live worker, merged at scrape
+WORKER_UP = _REGISTRY.gauge(
+    "pio_worker_up", "1 per worker process contributing to this scrape")
+
+
+def mark_worker_up(tag: Optional[str] = None) -> None:
+    """Declare THIS process's worker identity.  Clears previous local
+    pio_worker_up series first: a process only ever IS one worker, and a
+    programmatic server restarted in-process (tests) must not keep
+    advertising its old tag."""
+    WORKER_UP.clear_series()
+    WORKER_UP.set(1, worker=tag or worker_tag())
+
+
+class SnapshotFlusher:
+    """Background persister of the registry snapshot for cross-worker
+    scrapes.  Writes ``<dir>/<tag>.json`` atomically (tmp+rename) every
+    ``interval`` seconds and on demand (:meth:`flush`)."""
+
+    def __init__(self, directory: str, tag: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 interval: Optional[float] = None):
+        self.dir = directory
+        self.tag = tag
+        self.registry = registry or _REGISTRY
+        if interval is None:
+            try:
+                interval = float(os.environ.get("PIO_METRICS_FLUSH_S", "1.0"))
+            except ValueError:
+                interval = 1.0
+        self.interval = max(interval, 0.05)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, f"{self.tag}.json")
+
+    def flush(self) -> None:
+        tmp = self.path + f".tmp{os.getpid()}"
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(self.registry.snapshot(), f)
+            os.replace(tmp, self.path)
+        except OSError:
+            # the dir may be torn down mid-shutdown; a missed flush only
+            # staleness-lags siblings' view, never corrupts it
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.flush()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.flush()
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="pio-metrics-flush")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self.flush()
+
+
+_flusher: Optional[SnapshotFlusher] = None
+_flusher_lock = threading.Lock()
+
+
+def start_worker_flusher(directory: Optional[str] = None,
+                         tag: Optional[str] = None) -> Optional[SnapshotFlusher]:
+    """Arm cross-worker aggregation for this process.  No-op without a
+    metrics dir (single-worker servers stay purely in-memory).  A second
+    call replaces the previous flusher (programmatic servers in one
+    process, e.g. tests) — the registry itself is process-global either
+    way."""
+    global _flusher
+    directory = directory or os.environ.get("PIO_METRICS_DIR")
+    if not directory:
+        return None
+    if tag is None:
+        # resolve from env here, NOT via worker_tag() — that helper reads
+        # the flusher under _flusher_lock, which this block holds
+        tag = (os.environ.get("PIO_METRICS_TAG")
+               or os.environ.get("PIO_WRITER_TAG")
+               or f"pid-{os.getpid()}")
+    with _flusher_lock:
+        if _flusher is not None:
+            _flusher.stop()
+        _flusher = SnapshotFlusher(directory, tag)
+        mark_worker_up(tag)
+        _flusher.start()
+        return _flusher
+
+
+def stop_worker_flusher() -> None:
+    global _flusher
+    with _flusher_lock:
+        if _flusher is not None:
+            _flusher.stop()
+            _flusher = None
+
+
+def aggregate_snapshot(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The scrape view: this process's LIVE registry merged with every
+    sibling worker's persisted snapshot.  Flushes our own file first so
+    alternating scrapes across workers converge within one flush
+    interval instead of two."""
+    registry = registry or _REGISTRY
+    snaps = [registry.snapshot()]
+    with _flusher_lock:
+        fl = _flusher
+    if fl is not None:
+        fl.flush()
+        # a sibling whose file stopped updating is dead (SIGKILLed/OOMed):
+        # its counters still count — the events it acked are on disk — but
+        # its GAUGES describe the current state of a process that no
+        # longer exists (in-flight requests, worker_up) and must read 0,
+        # or an idle server reports the dead worker's last values forever
+        stale_after = max(10.0 * fl.interval, 15.0)
+        try:
+            names = sorted(os.listdir(fl.dir))
+        except OSError:
+            names = []
+        now = _time.time()
+        for name in names:
+            if not name.endswith(".json") or name == f"{fl.tag}.json":
+                continue
+            path = os.path.join(fl.dir, name)
+            try:
+                mtime = os.stat(path).st_mtime
+                with open(path) as f:
+                    snap = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue  # sibling mid-write/teardown; next scrape heals
+            if now - mtime > stale_after:
+                for entry in snap.values():
+                    if entry.get("type") == "gauge":
+                        entry["series"] = {k: 0.0 for k in entry["series"]}
+            snaps.append(snap)
+    return merge_snapshots(snaps)

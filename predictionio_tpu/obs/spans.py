@@ -1,0 +1,145 @@
+"""Structured span journal for training/evaluation runs.
+
+``utils.tracing.timed`` logged wall-clock spans and accumulated them in a
+dict; this extends that into a persisted artifact: one JSONL file per
+workflow run (train or eval), each line a span with parent/child links,
+written next to the engine instances so ``pio dashboard`` can render the
+breakdown of every completed run.
+
+Parent/child structure comes from a per-thread stack: a span opened
+while another is active on the same thread becomes its child.  The
+ACTIVE journal travels via a contextvar, so any ``timed()`` call inside
+``engine.train`` — engine code never imports this module — lands in the
+run's journal automatically.
+
+Journal location (:func:`spans_dir`): ``PIO_SPANS_DIR`` if set, else
+``<storage localfs/sharedfs METADATA path>/spans/`` (next to the engine
+instances), else ``~/.cache/predictionio_tpu/spans``.  File name is the
+engine/evaluation instance id: ``<instance_id>.jsonl``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+_CURRENT: contextvars.ContextVar[Optional["SpanJournal"]] = (
+    contextvars.ContextVar("pio_span_journal", default=None))
+
+
+def current_journal() -> Optional["SpanJournal"]:
+    return _CURRENT.get()
+
+
+class SpanJournal:
+    """Collects spans for one run and writes them as JSONL on close."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._spans: List[dict] = []
+        self._next_id = 1
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[dict]:
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        rec = {"id": span_id, "parent": parent, "name": name,
+               "start": time.time()}
+        if attrs:
+            rec["attrs"] = {k: v for k, v in attrs.items()}
+        stack.append(span_id)
+        t0 = time.perf_counter()
+        try:
+            yield rec
+        except BaseException:
+            rec["error"] = True
+            raise
+        finally:
+            rec["duration_s"] = time.perf_counter() - t0
+            rec["end"] = rec["start"] + rec["duration_s"]
+            stack.pop()
+            with self._lock:
+                self._spans.append(rec)
+
+    def write(self) -> None:
+        """Persist atomically (tmp+rename): a crashed run leaves either
+        the previous journal or the full new one, never a torn file."""
+        with self._lock:
+            spans = sorted(self._spans, key=lambda s: s["id"])
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.path.with_suffix(self.path.suffix + f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            for rec in spans:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        tmp.replace(self.path)
+
+    @contextlib.contextmanager
+    def activate(self) -> Iterator["SpanJournal"]:
+        """Make this the process-current journal (timed() feeds it) for
+        the duration; the journal is written on exit, success or not."""
+        token = _CURRENT.set(self)
+        try:
+            yield self
+        finally:
+            _CURRENT.reset(token)
+            try:
+                self.write()
+            except OSError:
+                import logging
+
+                logging.getLogger("pio.trace").exception(
+                    "span journal write failed: %s", self.path)
+
+
+def read_journal(path) -> List[dict]:
+    """Load a journal; missing file → []."""
+    p = Path(path)
+    if not p.exists():
+        return []
+    out = []
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if line:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def spans_dir(storage=None) -> Path:
+    """Where this deployment's span journals live (see module docstring
+    for the precedence)."""
+    env = os.environ.get("PIO_SPANS_DIR")
+    if env:
+        return Path(env)
+    if storage is not None:
+        try:
+            src = storage.config.sources[storage.config.repositories["METADATA"]]
+            if src.get("type") in ("localfs", "sharedfs") and src.get("path"):
+                return Path(src["path"]) / "spans"
+        except (KeyError, AttributeError):
+            pass
+    return Path.home() / ".cache" / "predictionio_tpu" / "spans"
+
+
+def journal_path(storage, instance_id: str) -> Path:
+    safe = "".join(c for c in instance_id if c.isalnum() or c in "_-")
+    return spans_dir(storage) / f"{safe}.jsonl"

@@ -26,6 +26,7 @@ from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.events.event import Event, canonical_event_json
+from predictionio_tpu.obs.metrics import LATENCY_BUCKETS, SIZE_BUCKETS, get_registry
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import (
     AccessKey,
@@ -38,6 +39,36 @@ from predictionio_tpu.storage.base import (
 
 SEGMENT_MAX_BYTES = 64 << 20  # rotate segments at 64 MiB
 DEFAULT_CHANNEL = "_default"
+
+# -- write-path instruments (obs tentpole).  All recorded at group-commit
+# granularity (one observation per physical write/fsync, not per event),
+# so the hot ingest loop pays a few dict updates per THOUSANDS of events.
+_REG = get_registry()
+_M_APPEND = _REG.histogram(
+    "pio_storage_append_duration_seconds",
+    "Segment append latency (write+flush, excluding fsync); count = "
+    "physical appends", buckets=LATENCY_BUCKETS)
+_M_APPEND_BYTES = _REG.counter(
+    "pio_storage_append_bytes_total", "Bytes appended to event segments")
+_M_EVENTS = _REG.counter(
+    "pio_storage_events_appended_total",
+    "Event lines appended to the log (exactly the on-disk line count)")
+_M_FSYNC = _REG.histogram(
+    "pio_storage_fsync_duration_seconds",
+    "fsync latency on event segments; count = fsyncs issued",
+    buckets=LATENCY_BUCKETS)
+_M_GROUP = _REG.histogram(
+    "pio_storage_group_commit_batch_size",
+    "Request buffers coalesced per group commit (occupancy = sum/count)",
+    buckets=SIZE_BUCKETS)
+_M_HEALS = _REG.counter(
+    "pio_storage_torn_tail_heals_total",
+    "Torn segment tails truncated on writer reopen")
+_M_ROTATE = _REG.counter(
+    "pio_storage_segment_rotations_total", "New segment files opened")
+_M_SEGS = _REG.gauge(
+    "pio_storage_live_segments",
+    "Segments in the writer's channel directory at last open, by channel")
 
 
 def _fsync_policy() -> str:
@@ -99,11 +130,14 @@ class _SegmentWriter:
                 self._f = None
         if self._f is None or self._f.tell() >= SEGMENT_MAX_BYTES:
             self._open_next()
+        t0 = _time.perf_counter()
         self._f.write(text)
         self._f.flush()
+        _M_APPEND.observe(_time.perf_counter() - t0)
+        _M_APPEND_BYTES.inc(len(text))
         policy = _fsync_policy()
         if policy == "always":
-            os.fsync(self._f.fileno())
+            self._timed_fsync()
         elif policy.startswith("interval:"):
             try:
                 every = float(policy.split(":", 1)[1]) / 1e3
@@ -111,8 +145,15 @@ class _SegmentWriter:
                 every = 0.1
             now = _time.monotonic()
             if now - self._last_sync >= every:
-                os.fsync(self._f.fileno())
+                self._timed_fsync()
                 self._last_sync = now
+
+    def _timed_fsync(self) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        os.fsync(self._f.fileno())
+        _M_FSYNC.observe(_time.perf_counter() - t0)
 
     @staticmethod
     def _heal_torn_tail(path: Path) -> None:
@@ -145,6 +186,7 @@ class _SegmentWriter:
                     break
                 pos -= step
             f.truncate(keep)
+            _M_HEALS.inc()
 
     def _open_next(self) -> None:
         self.close()
@@ -171,8 +213,12 @@ class _SegmentWriter:
             n = int(segs[-1].stem.rsplit("-", 1)[1]) + 1 if segs else 0
             path = (self._dir / f"seg-{n:05d}.jsonl" if self._tag is None
                     else self._dir / f"seg-{self._tag}-{n:05d}.jsonl")
+            _M_ROTATE.inc()
         self._path = path
         self._f = open(path, "a")
+        # this writer's view of its own series; readers union all writers
+        _M_SEGS.set(len(segs) + (1 if path not in segs else 0),
+                    channel=f"{self._dir.parent.name}/{self._dir.name}")
 
     def close(self) -> None:
         if self._f is not None:
@@ -186,7 +232,7 @@ class _SegmentWriter:
                     unlinked = True
                 self._f.flush()
                 if _fsync_policy() != "never" and not unlinked:
-                    os.fsync(self._f.fileno())
+                    self._timed_fsync()
             finally:
                 f, self._f = self._f, None
                 f.close()
@@ -825,7 +871,10 @@ class FSEvents(base.LEvents, base.PEvents):
                             # then unlinks
                             self._recover_compact(d)
                         w = self._writers[key] = self._new_writer(d)
-                    w.append("".join(i["lines"] for i in batch))
+                    payload = "".join(i["lines"] for i in batch)
+                    w.append(payload)
+                    _M_GROUP.observe(len(batch))
+                    _M_EVENTS.inc(payload.count("\n"))
             except BaseException as e:
                 # a failed write (ENOSPC/EIO) must NACK every event in
                 # the group — none of them is durable

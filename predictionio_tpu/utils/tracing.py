@@ -3,7 +3,10 @@
 The reference has no custom tracer (SURVEY.md §5) — it leans on the Spark UI.
 The TPU-native equivalents: ``jax.named_scope`` for XLA-visible annotation,
 ``jax.profiler`` traces viewable in xprof/tensorboard, and a lightweight
-wall-clock timer that feeds the workflow logs.
+wall-clock timer that feeds the workflow logs — and, when a span journal
+is active (``obs.spans``: ``pio train``/``pio eval`` activate one per
+run), every ``timed()`` block also lands in the journal as a structured
+span with parent/child links.
 """
 
 from __future__ import annotations
@@ -27,10 +30,30 @@ def named_scope(name: str) -> Iterator[None]:
 
 @contextlib.contextmanager
 def profile_to(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
-    """Capture a jax.profiler trace into log_dir (view with xprof/tensorboard)."""
+    """Capture a jax.profiler trace into log_dir (view with xprof/tensorboard).
+
+    ``host_tracer_level`` (0 = host tracing off, 1 = critical events,
+    2 = info, 3 = verbose) is honored via ``jax.profiler.ProfileOptions``
+    where the installed jax exposes it (≥ 0.5); older jax (e.g. the 0.4.x
+    line) offers no per-trace option hook on ``start_trace`` at all — its
+    signature is ``(log_dir, create_perfetto_link, create_perfetto_trace)``
+    — so there the level is logged-and-skipped rather than silently
+    dropped."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    options = None
+    if host_tracer_level != 2 and hasattr(jax.profiler, "ProfileOptions"):
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+    if options is not None:
+        jax.profiler.start_trace(log_dir, profiler_options=options)
+    else:
+        if host_tracer_level != 2:
+            log.warning(
+                "host_tracer_level=%d requested but this jax (%s) has no "
+                "ProfileOptions; tracing at the default level",
+                host_tracer_level, jax.__version__)
+        jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
@@ -39,12 +62,25 @@ def profile_to(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
 
 @contextlib.contextmanager
 def timed(name: str, sink: Optional[dict] = None) -> Iterator[None]:
-    """Wall-clock span logged at INFO; optionally recorded into sink[name]."""
+    """Wall-clock span logged at INFO; optionally recorded into sink.
+
+    ``sink[name]`` accumulates seconds across calls and
+    ``sink[name + ".count"]`` the number of calls, so a sink consumer can
+    tell one 10 s span from a thousand 10 ms ones.  When a span journal
+    is active (obs.spans), the block is also recorded there as a
+    structured span (with parent/child nesting)."""
+    from predictionio_tpu.obs import spans as _spans
+
+    journal = _spans.current_journal()
+    ctx = journal.span(name) if journal is not None else contextlib.nullcontext()
     t0 = time.perf_counter()
     try:
-        yield
+        with ctx:
+            yield
     finally:
         dt = time.perf_counter() - t0
         log.info("%s took %.3fs", name, dt)
         if sink is not None:
             sink[name] = sink.get(name, 0.0) + dt
+            count_key = name + ".count"
+            sink[count_key] = sink.get(count_key, 0) + 1

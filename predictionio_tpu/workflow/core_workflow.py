@@ -18,11 +18,21 @@ from typing import Any, List, Optional
 from predictionio_tpu.controller.engine import Engine, EngineParams, serialize_engine_params
 from predictionio_tpu.controller.evaluation import Evaluation, MetricEvaluatorResult
 from predictionio_tpu.core.base import doer_name
+from predictionio_tpu.obs import spans as _spans
+from predictionio_tpu.obs.metrics import get_registry
 from predictionio_tpu.storage.base import EngineInstance, EvaluationInstance
 from predictionio_tpu.storage.locator import Storage, get_storage
 from predictionio_tpu.workflow import persistence
 
 log = logging.getLogger("pio.workflow")
+
+_REG = get_registry()
+_M_TRAINS = _REG.counter(
+    "pio_train_runs_total", "Training runs by final status")
+_M_TRAIN_S = _REG.histogram(
+    "pio_train_duration_seconds", "Wall-clock duration of training runs")
+_M_EVALS = _REG.counter(
+    "pio_eval_runs_total", "Evaluation runs by final status")
 
 
 def _now() -> _dt.datetime:
@@ -72,28 +82,45 @@ def run_train(
     instance.status = "TRAINING"
     storage.engine_instances.update(instance)
     attempt = 0
-    while True:
-        try:
-            log.info("training engine %s (instance %s, attempt %d)",
-                     engine_id, instance_id, attempt + 1)
-            models = engine.train(engine_params)
-            persistence.save_models(storage, instance_id, models)
-            instance.status = "COMPLETED"
-            instance.end_time = _now()
-            storage.engine_instances.update(instance)
-            log.info("training done: instance %s COMPLETED", instance_id)
-            return instance
-        except Exception:
-            attempt += 1
-            if attempt <= retries:
-                log.warning("training attempt %d failed, retrying (%d left):\n%s",
-                            attempt, retries - attempt + 1, traceback.format_exc())
-                continue
-            instance.status = "FAILED"
-            instance.end_time = _now()
-            storage.engine_instances.update(instance)
-            log.error("training FAILED: %s", traceback.format_exc())
-            raise
+    # span journal persisted next to the engine instance: every timed()
+    # inside engine.train nests under this run's root span, and
+    # `pio dashboard` renders the breakdown per completed train
+    journal = _spans.SpanJournal(_spans.journal_path(storage, instance_id))
+    t_run = _dt.datetime.now(_dt.timezone.utc).timestamp()
+    with journal.activate():
+        with journal.span("train", engine_id=engine_id,
+                          instance_id=instance_id):
+            while True:
+                try:
+                    log.info("training engine %s (instance %s, attempt %d)",
+                             engine_id, instance_id, attempt + 1)
+                    with journal.span("engine_train", attempt=attempt + 1):
+                        models = engine.train(engine_params)
+                    with journal.span("save_models"):
+                        persistence.save_models(storage, instance_id, models)
+                    instance.status = "COMPLETED"
+                    instance.end_time = _now()
+                    storage.engine_instances.update(instance)
+                    log.info("training done: instance %s COMPLETED",
+                             instance_id)
+                    _M_TRAINS.inc(1, status="COMPLETED")
+                    _M_TRAIN_S.observe(
+                        _dt.datetime.now(_dt.timezone.utc).timestamp() - t_run)
+                    return instance
+                except Exception:
+                    attempt += 1
+                    if attempt <= retries:
+                        log.warning(
+                            "training attempt %d failed, retrying (%d left):\n%s",
+                            attempt, retries - attempt + 1,
+                            traceback.format_exc())
+                        continue
+                    instance.status = "FAILED"
+                    instance.end_time = _now()
+                    storage.engine_instances.update(instance)
+                    log.error("training FAILED: %s", traceback.format_exc())
+                    _M_TRAINS.inc(1, status="FAILED")
+                    raise
 
 
 def load_latest_models(
@@ -156,8 +183,12 @@ def run_eval(
         evaluation_class=evaluation_class or doer_name(evaluation),
     )
     instance_id = storage.evaluation_instances.insert(instance)
+    journal = _spans.SpanJournal(_spans.journal_path(storage, instance_id))
     try:
-        result = evaluation.run()
+        with journal.activate(), journal.span(
+                "eval", instance_id=instance_id,
+                evaluation_class=instance.evaluation_class):
+            result = evaluation.run()
         instance.status = "EVALCOMPLETED"
         instance.end_time = _now()
         instance.evaluator_results = (
@@ -167,8 +198,13 @@ def run_eval(
         instance.evaluator_results_json = json.dumps(result.to_json())
         instance.evaluator_results_html = _eval_results_html(result)
         storage.evaluation_instances.update(instance)
+        # counted only after the instance is durably COMPLETED: a
+        # serialization/persistence failure above lands in the except
+        # block, and one run must never count under both statuses
+        _M_EVALS.inc(1, status="EVALCOMPLETED")
         return result
     except Exception:
+        _M_EVALS.inc(1, status="EVALFAILED")
         instance.status = "EVALFAILED"
         instance.end_time = _now()
         storage.evaluation_instances.update(instance)

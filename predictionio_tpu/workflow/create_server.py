@@ -8,6 +8,8 @@ latest COMPLETED EngineInstance, loads models, and spawns the spray
   GET  /               engine-instance info
   GET  /reload         hot-swap to the newest COMPLETED instance
   GET  /stop           shut down (reference web UI's stop)
+  GET  /metrics        Prometheus text (cross-worker aggregate)
+  GET  /stats.json     per-(route, status) request windows
 
 The feedback loop (reference: ServerActor writing prediction events back to
 the event store with ``prId`` when feedback is enabled) is implemented via
@@ -27,6 +29,9 @@ from typing import Any, Callable, Dict, Optional
 
 from predictionio_tpu.api import prefork
 from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
+from predictionio_tpu.obs.metrics import SIZE_BUCKETS
 from predictionio_tpu.storage.locator import Storage, get_storage
 from predictionio_tpu.workflow import core_workflow
 from predictionio_tpu.workflow.create_workflow import (
@@ -36,6 +41,11 @@ from predictionio_tpu.workflow.create_workflow import (
 )
 
 log = logging.getLogger("pio.queryserver")
+
+_M_SERVE_BATCH = obs_metrics.get_registry().histogram(
+    "pio_serve_batch_size",
+    "Queries coalesced per micro-batch device dispatch",
+    buckets=SIZE_BUCKETS)
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -159,6 +169,7 @@ class _MicroBatcher:
                 if not batch:
                     self._leader_active = False
                     return
+            _M_SERVE_BATCH.observe(len(batch))
             try:
                 try:
                     results = self._run([i["q"] for i in batch])
@@ -384,12 +395,17 @@ th,td{{border:1px solid #ccc;padding:4px 10px;text-align:left}}</style></head>
 <body><h1>Engine server: {_html.escape(state.engine_id)}</h1>
 <table>{rows}</table>
 <p>plugins: {_html.escape(plugins)}</p>
-<p>POST /queries.json &middot; GET /reload &middot; GET /stop</p>
+<p>POST /queries.json &middot; GET /reload &middot; GET /stop &middot;
+GET /metrics &middot; GET /stats.json</p>
 </body></html>"""
 
 
 def make_handler(state: QueryServerState):
     class QueryHandler(JsonHandler):
+        # per-(route, status) windows for /stats.json, fed by the
+        # http_util middleware
+        stats_collector = StatsCollector()
+
         def do_GET(self):
             path, _query = self.route
             if path == "/":
@@ -398,6 +414,16 @@ def make_handler(state: QueryServerState):
                     self.send_html(_render_info_html(state))
                 else:
                     self.send_json(state.info())
+            elif path == "/metrics":
+                self._send_raw(200, metrics_payload(),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+            elif path == "/stats.json":
+                doc = self.stats_collector.to_json()
+                doc["engineId"] = state.engine_id
+                doc["queryCount"] = state.query_count
+                doc["startedAt"] = state.started.isoformat()
+                self.send_json(doc)
             elif path == "/reload":
                 try:
                     iid = state.reload()
@@ -501,6 +527,11 @@ def deploy(
     # when its launcher exits.
     if workers == 1:
         prefork.maybe_watch_parent(log)   # prefork child: die when orphaned
+        # prefork child spawned with a PIO_METRICS_DIR/PIO_METRICS_TAG:
+        # publish snapshots so any sibling's /metrics scrape sees us
+        # (no-op — pure in-memory metrics — for a true single worker)
+        obs_metrics.start_worker_flusher()
+        obs_metrics.mark_worker_up()
     doc = load_engine_variant(engine_json, variant)
     factory, engine, engine_params = engine_from_variant(doc)
     eid = resolve_engine_id(engine_id, doc, factory)
@@ -519,7 +550,12 @@ def deploy(
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
     bound_port = httpd.server_address[1]
+    metrics_dir: Optional[str] = None
     if workers > 1:
+        import tempfile
+
+        metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
+        obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
         child_procs = prefork.spawn_workers(
             workers - 1,
             lambda w: (
@@ -532,6 +568,9 @@ def deploy(
                 + (["--feedback"] if feedback else [])
                 + (["--auto-reload", str(auto_reload)] if auto_reload else [])
             ),
+            build_env=lambda w: {
+                "PIO_METRICS_TAG": f"w{w + 1}-{os.getpid()}",
+                "PIO_METRICS_DIR": metrics_dir},
             log=log,
         )
     log.info("Query server for %s listening on %s:%d", eid, host, bound_port)
@@ -541,6 +580,8 @@ def deploy(
     # server, however it is shut down (shutdown()/server_close(), /stop,
     # or pio undeploy)
     prefork.wire_shutdown(httpd, child_procs, before=state.stop_auto_reload)
+    if metrics_dir is not None:
+        prefork.wire_metrics_cleanup(httpd, metrics_dir)
     if background:
         return httpd
     try:

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Lint the metric-name contract.
+
+Imports every module that declares instruments (they register at import
+time) and verifies each registered metric:
+
+- name matches ``pio_[a-z0-9_]+`` (the registry enforces this at
+  registration too — the lint catches a registry regression and any
+  metric that dodges the registry);
+- carries a non-empty help string;
+- histograms have strictly increasing bucket boundaries.
+
+Run standalone (``python scripts/check_metrics_names.py``) or via the
+tier-1 suite (tests/test_obs_metrics.py wraps it), exit 0 = clean.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+# runnable from any cwd without an installed package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# every module that declares instruments at import time; a new
+# instrumented module must be added here (the test fails otherwise only
+# if its names are bad AND it happens to be imported transitively)
+INSTRUMENTED_MODULES = [
+    "predictionio_tpu.obs.metrics",
+    "predictionio_tpu.api.http_util",
+    "predictionio_tpu.api.event_server",
+    "predictionio_tpu.api.dashboard",
+    "predictionio_tpu.storage.localfs",
+    "predictionio_tpu.workflow.core_workflow",
+    "predictionio_tpu.workflow.create_server",
+]
+
+
+def main() -> int:
+    for mod in INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+    from predictionio_tpu.obs.metrics import NAME_RE, Histogram, get_registry
+
+    problems = []
+    metrics = get_registry().metrics()
+    for m in metrics:
+        if not NAME_RE.match(m.name):
+            problems.append(f"{m.name}: name violates {NAME_RE.pattern}")
+        if not m.help or not m.help.strip():
+            problems.append(f"{m.name}: missing help string")
+        if isinstance(m, Histogram):
+            if list(m.buckets) != sorted(set(m.buckets)):
+                problems.append(f"{m.name}: buckets not strictly increasing")
+    if not metrics:
+        problems.append("no metrics registered — imports broken?")
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    if not problems:
+        print(f"ok: {len(metrics)} metrics, names and help strings clean")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
