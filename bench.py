@@ -410,10 +410,217 @@ def bench_scan(smoke: bool) -> float:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def bench_ingest(smoke: bool) -> dict:
-    """Event-server ingest throughput over real HTTP against the localfs
-    backend: batch endpoint (50-event batches, the reference's batch limit)
-    and single-event POSTs, under the default fsync policy (PIO_FSYNC=rotate)."""
+def bench_snapshot(smoke: bool) -> dict:
+    """Columnar event-store snapshots: cold-train scan speed from the
+    mmap'd snapshot vs the native JSONL scan on the same host/data
+    (integrity-verified: event count + eventId set + trained-model
+    parity), delta-aware retrain staging (exact staged-event counter),
+    and micro-guards on the vectorized IdDict/concat hot paths."""
+    import os
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import predictionio_tpu.storage.localfs as lfs
+    from predictionio_tpu.native import native_available, scan_segments
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
+    from predictionio_tpu.store.columnar import EventBatch, IdDict
+    from predictionio_tpu.store.event_store import (
+        PEventStore, invalidate_staging_cache, staging_counts,
+    )
+
+    n = 20_000 if smoke else 500_000
+    n_delta = max(n // 100, 50)
+    n_parity = 10_000 if smoke else 20_000
+    old_max = lfs.SEGMENT_MAX_BYTES
+    lfs.SEGMENT_MAX_BYTES = 4 << 20   # multi-segment layout, bench-sized
+    tmp = tempfile.mkdtemp(prefix="pio_bench_snapshot")
+    out: dict = {
+        "train_cold_snapshot_events_per_sec": 0.0,
+        "retrain_delta_events_per_sec": 0.0,
+        "retrain_delta_staged_events": 0,
+        "snapshot_vs_native_scan_speedup": 0.0,
+        "snapshot_native_scan_events_per_sec": 0.0,
+        "snapshot_build_events_per_sec": 0.0,
+        "snapshot_integrity": "not_run",
+        "snapshot_model_parity": "not_run",
+        "iddict_encode_strings_per_sec": 0.0,
+        "concat_shared_dict_rows_per_sec": 0.0,
+    }
+    try:
+        storage = Storage(StorageConfig(
+            sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
+            repositories={r: "FS" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+        ))
+        set_storage(storage)
+        app_id = storage.apps.insert(App(0, "snapbench"))
+
+        def wire(k):
+            return {"event": "buy" if k % 4 else "view",
+                    "entityType": "user", "entityId": f"u{k % 5000}",
+                    "targetEntityType": "item", "targetEntityId": f"i{k % 2000}",
+                    "properties": {"rating": float(k % 5)},
+                    "eventTime": "2026-01-01T00:00:00+00:00"}
+
+        for lo in range(0, n, 10_000):
+            storage.l_events.insert_json_batch(
+                [wire(k) for k in range(lo, min(lo + 10_000, n))], app_id)
+        paths = storage.l_events.segment_paths(app_id)
+
+        # baseline: the JSONL path a cold train pays today (native C++
+        # parse; 0.0 when the toolchain can't build the scanner)
+        native_rate = 0.0
+        if native_available():
+            t0 = time.perf_counter()
+            nb = scan_segments(paths)
+            t_native = time.perf_counter() - t0
+            assert len(nb) == n
+            native_rate = n / t_native
+        out["snapshot_native_scan_events_per_sec"] = native_rate
+
+        bs = storage.l_events.build_snapshot(app_id)
+        assert bs["events"] == n, f"build covered {bs['events']} != {n}"
+        out["snapshot_build_events_per_sec"] = n / bs["build_s"]
+
+        # cold columnar read: fresh backend instance + empty staging cache
+        # (what a brand-new `pio train` process sees)
+        invalidate_staging_cache()
+        fs_cold = lfs.FSEvents(Path(f"{tmp}/store"))
+        t0 = time.perf_counter()
+        res = fs_cold.snapshot_scan(app_id)
+        t_cold = time.perf_counter() - t0
+        assert res is not None and len(res["batch"]) == n
+        cold_rate = n / t_cold
+        out["train_cold_snapshot_events_per_sec"] = cold_rate
+        if native_rate:
+            out["snapshot_vs_native_scan_speedup"] = cold_rate / native_rate
+
+        # integrity: identical event count + eventId set vs the JSONL
+        # ground truth (the same diff scripts/check_snapshot_integrity.py
+        # runs in CI)
+        ids_snap = set(res["ids"].tolist())
+        ids_jsonl = set()
+        for p in paths:
+            with open(p, "rb") as f:
+                for line in f:
+                    if line.strip():
+                        ids_jsonl.add(json.loads(line)["eventId"])
+        if len(ids_snap) == n and ids_snap == ids_jsonl:
+            out["snapshot_integrity"] = "ok"
+        else:
+            out["snapshot_integrity"] = (
+                f"MISMATCH: {len(ids_snap)} snapshot ids vs "
+                f"{len(ids_jsonl)} jsonl ids")
+
+        # delta-aware retrain: first batch() stages through the snapshot
+        # and retains the batch; the retrain must re-stage ONLY the
+        # n_delta new events (exact counter), at e2e speed recorded here
+        c0 = staging_counts()
+        b1 = PEventStore.batch("snapbench", storage=storage)
+        assert len(b1) == n
+        storage.l_events.insert_json_batch(
+            [wire(k) for k in range(n, n + n_delta)], app_id)
+        c1 = staging_counts()
+        t0 = time.perf_counter()
+        b2 = PEventStore.batch("snapbench", storage=storage)
+        t_delta = time.perf_counter() - t0
+        c2 = staging_counts()
+        staged = int(c2["delta"] - c1["delta"])
+        assert len(b2) == n + n_delta
+        assert staged == n_delta, (
+            f"delta retrain staged {staged} events, expected {n_delta}")
+        out["retrain_delta_staged_events"] = staged
+        out["retrain_delta_events_per_sec"] = len(b2) / t_delta
+
+        # trained-model parity: the same UR train with the snapshot layer
+        # off (full JSONL path) vs on must produce identical
+        # recommendations (separate small app so parity stays cheap on
+        # every platform)
+        out["snapshot_model_parity"] = _snapshot_model_parity(
+            storage, n_parity)
+
+        # micro-guards for the vectorized dictionary hot paths (satellite:
+        # IdDict.encode / lookup_many / shared-dict concat)
+        strs = [f"u{k % 5000}" for k in range(200_000)]
+        d = IdDict()
+        t0 = time.perf_counter()
+        d.encode(strs)
+        enc_rate = len(strs) / (time.perf_counter() - t0)
+        assert enc_rate > 100_000, f"IdDict.encode regressed: {enc_rate:.0f}/s"
+        out["iddict_encode_strings_per_sec"] = enc_rate
+        big = res["batch"]
+        tail = big.subset(np.arange(len(big)) < 1000)  # shares dict objects
+        t0 = time.perf_counter()
+        cc = EventBatch.concat([big, tail])
+        concat_rate = len(cc) / (time.perf_counter() - t0)
+        assert cc.event_dict is big.event_dict, \
+            "concat shared-dict fast path not taken"
+        assert concat_rate > 1_000_000, \
+            f"shared-dict concat regressed: {concat_rate:.0f} rows/s"
+        out["concat_shared_dict_rows_per_sec"] = concat_rate
+        return out
+    finally:
+        lfs.SEGMENT_MAX_BYTES = old_max
+        invalidate_staging_cache()
+        set_storage(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _snapshot_model_parity(storage, n_events: int) -> str:
+    """Train the UR template twice on a dedicated app — snapshot layer
+    OFF (cold JSONL path) vs ON (mmap snapshot) — and compare the
+    recommendations for a probe set of users.  'ok' on identical output."""
+    import os
+
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine, URQuery,
+    )
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+
+    app_id = storage.apps.insert(App(0, "snapparity"))
+    rng = np.random.default_rng(7)
+    items = [f"i{j}" for j in range(200)]
+    wire = []
+    for k in range(n_events):
+        u = int(rng.integers(0, 500))
+        it = items[int(rng.integers(0, 40)) + (u % 5) * 40]
+        wire.append({"event": "buy" if k % 3 else "view",
+                     "entityType": "user", "entityId": f"u{u}",
+                     "targetEntityType": "item", "targetEntityId": it,
+                     "eventTime": "2026-01-01T00:00:00+00:00"})
+    for lo in range(0, len(wire), 10_000):
+        storage.l_events.insert_json_batch(wire[lo:lo + 10_000], app_id)
+    engine = UniversalRecommenderEngine.apply()
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="snapparity", event_names=["buy", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(
+            app_name="snapparity", mesh_dp=1, max_correlators_per_item=8,
+            min_llr=0.0))],
+    )
+    probes = [URQuery(user=f"u{u}", num=10) for u in range(0, 100, 7)]
+
+    def run():
+        invalidate_staging_cache()
+        models = engine.train(ep)
+        predict = engine.predictor(ep, models)
+        return [[(r.item, round(r.score, 5)) for r in predict(q).item_scores]
+                for q in probes]
+
+    os.environ["PIO_SNAPSHOT"] = "off"
+    try:
+        baseline = run()
+    finally:
+        os.environ.pop("PIO_SNAPSHOT", None)
+    storage.l_events.build_snapshot(app_id)
+    with_snap = run()
+    return "ok" if baseline == with_snap else "MISMATCH"
     import os
     import shutil
     import tempfile
@@ -1338,7 +1545,7 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
     ap.add_argument("--only",
                     choices=["ur", "p50", "als", "scan", "http", "scale", "ingest",
-                             "ingest_scale", "serve100k"],
+                             "ingest_scale", "serve100k", "snapshot"],
                     default=None)
     ap.add_argument("--scale", action="store_true",
                     help="run only the 1B-scale tiled-path slice")
@@ -1371,6 +1578,7 @@ def main() -> int:
             "ingest": lambda: bench_ingest(args.smoke),
             "ingest_scale": lambda: bench_ingest_scaling(args.smoke),
             "serve100k": lambda: bench_serve100k(args.smoke),
+            "snapshot": lambda: bench_snapshot(args.smoke),
         }[args.only]()
         print(json.dumps(out))
         return 0
@@ -1427,6 +1635,18 @@ def main() -> int:
         "predict_p50_100k_ms": 0.0, "predict_p95_100k_ms": 0.0,
         "serve100k_catalog_items": 0,
         "predict_p50_100k_basis": "section_failed",
+    })
+    snapshot = _run_section("snapshot", args.smoke, {
+        "train_cold_snapshot_events_per_sec": 0.0,
+        "retrain_delta_events_per_sec": 0.0,
+        "retrain_delta_staged_events": 0,
+        "snapshot_vs_native_scan_speedup": 0.0,
+        "snapshot_native_scan_events_per_sec": 0.0,
+        "snapshot_build_events_per_sec": 0.0,
+        "snapshot_integrity": "section_failed",
+        "snapshot_model_parity": "section_failed",
+        "iddict_encode_strings_per_sec": 0.0,
+        "concat_shared_dict_rows_per_sec": 0.0,
     })
     p50 = http["ur_http_p50_ms"]   # the served path IS the north-star metric
 
@@ -1500,6 +1720,10 @@ def main() -> int:
             "predict_p95_100k_ms": round(serve100k["predict_p95_100k_ms"], 3),
             "serve100k_catalog_items": serve100k["serve100k_catalog_items"],
             "predict_p50_100k_basis": serve100k["predict_p50_100k_basis"],
+            # columnar snapshot layer: cold-train mmap scan vs JSONL,
+            # delta-aware retrain, dictionary micro-guards
+            **{k: (round(v, 1) if isinstance(v, float) else v)
+               for k, v in snapshot.items()},
             **({"section_failures": _SECTION_FAILURES}
                if _SECTION_FAILURES else {}),
         },

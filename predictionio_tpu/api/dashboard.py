@@ -7,7 +7,8 @@ engine params and metric scores).
   GET /engine_instances.json    all engine instances
   GET /evaluations.json         completed evaluation instances
   GET /spans/<instance>.json    span journal of one train/eval run
-  GET /metrics                  Prometheus text
+  GET /snapshots.json           per-(app, channel) event-store snapshot coverage
+  GET /metrics                  Prometheus text (incl. pio_snapshot_* gauges)
   GET /stats.json               per-(route, status) request windows
 """
 
@@ -85,6 +86,31 @@ def _span_summary(storage: Storage, instance_id: str, limit: int = 8) -> str:
         for s in sorted(spans, key=lambda s: s.get("id", 0)))
 
 
+def _snapshot_rows(storage: Storage) -> list:
+    """Per-(app, channel) columnar-snapshot coverage, with the matching
+    pio_snapshot_* gauges refreshed so /metrics mirrors what's rendered.
+    Empty on backends without a snapshot layer."""
+    backend = storage.l_events
+    if not hasattr(backend, "snapshot_status"):
+        return []
+    from predictionio_tpu.storage import snapshot as obs_snap
+
+    rows = []
+    for app in sorted(storage.apps.get_all(), key=lambda a: a.id):
+        chans = [("", None)] + [
+            (c.name, c.id) for c in storage.channels.get_by_app_id(app.id)]
+        for chan_name, chan_id in chans:
+            status = backend.snapshot_status(app.id, chan_id)
+            if status is None:
+                continue
+            label = f"app_{app.id}/" + (
+                f"channel_{chan_id}" if chan_id is not None else "_default")
+            obs_snap.publish_status_gauges(status, label)
+            rows.append({"app": app.name, "channel": chan_name or "(default)",
+                         **status})
+    return rows
+
+
 def _render_html(storage: Storage) -> str:
     evals = storage.evaluation_instances.get_completed()
     engines = sorted(storage.engine_instances.get_all(),
@@ -118,6 +144,17 @@ def _render_html(storage: Storage) -> str:
         )
         for k, i in enumerate(engines)
     ) or "<tr><td colspan=6><i>no engine instances</i></td></tr>"
+    rows_snap = "".join(
+        "<tr><td>{app}</td><td>{chan}</td><td>{ev}</td><td>{tail}</td>"
+        "<td>{cov:.1%}</td><td>{built}</td><td>{dur}</td></tr>".format(
+            app=html.escape(r["app"]), chan=html.escape(r["channel"]),
+            ev=r["events"], tail=r["tailEvents"], cov=r["coverage"],
+            built=html.escape((r.get("builtAt") or "")[:19]),
+            dur=(f"{r['buildSeconds']:.3f} s"
+                 if r.get("buildSeconds") is not None else ""),
+        )
+        for r in _snapshot_rows(storage)
+    ) or "<tr><td colspan=7><i>no columnar snapshots</i></td></tr>"
     return f"""<!DOCTYPE html>
 <html><head><title>PredictionIO-TPU Dashboard</title>
 <style>
@@ -138,8 +175,14 @@ def _render_html(storage: Storage) -> str:
 <table><tr><th>id</th><th>engine</th><th>status</th><th>started</th>
 <th>duration</th><th>train spans</th></tr>
 {rows_engine}</table>
+<h2>Event-store snapshots</h2>
+<table><tr><th>app</th><th>channel</th><th>events in snapshot</th>
+<th>events in tail</th><th>coverage</th><th>built</th>
+<th>build time</th></tr>
+{rows_snap}</table>
 <p><a href="/metrics">/metrics</a> &middot;
-<a href="/stats.json">/stats.json</a></p>
+<a href="/stats.json">/stats.json</a> &middot;
+<a href="/snapshots.json">/snapshots.json</a></p>
 </body></html>"""
 
 
@@ -166,6 +209,11 @@ def make_handler(storage: Storage):
                 self.send_json({"evaluations": [
                     _evi_json(i) for i in storage.evaluation_instances.get_completed()
                 ]})
+            elif path == "/snapshots.json":
+                # also refreshes the pio_snapshot_* gauges this process
+                # exports, so scraping /metrics right after sees the
+                # same coverage the JSON reports
+                self.send_json({"snapshots": _snapshot_rows(storage)})
             elif path.startswith("/spans/") and path.endswith(".json"):
                 instance_id = path[len("/spans/"):-len(".json")]
                 spans = obs_spans.read_journal(

@@ -9,7 +9,7 @@ Reference: data/src/main/scala/io/prediction/data/api/EventServer.scala
   GET    /events/<id>.json?accessKey=K                 fetch one
   DELETE /events/<id>.json?accessKey=K                 tombstone one
   GET    /                                             {"status": "alive", pid, version, workerTag}
-  GET    /stats.json?accessKey=K                       per-app event counts + window stats
+  GET    /stats.json?accessKey=K                       per-app event counts + window stats + snapshot coverage
   GET    /metrics                                      Prometheus text (cross-worker aggregate)
 
 Auth matches the reference: the access key names the app; a key with a
@@ -208,6 +208,12 @@ def make_handler(state: EventServerState):
                 doc = state.stats.to_json(app_id=ak.app_id)
                 doc["appId"] = ak.app_id
                 doc["counts"] = state.counts.get(ak.app_id, {})
+                # columnar-snapshot coverage of this app's channels (only
+                # on backends with a snapshot layer; channels with no
+                # snapshot are omitted)
+                snap = self._snapshot_coverage(ak.app_id)
+                if snap:
+                    doc["snapshot"] = snap
                 self.send_json(doc)
             elif path.startswith("/events/") and path.endswith(".json"):
                 event_id = path[len("/events/"):-len(".json")]
@@ -256,6 +262,22 @@ def make_handler(state: EventServerState):
                 self.send_error_json(404, "not found")
 
         # -- impl ------------------------------------------------------------
+
+        def _snapshot_coverage(self, app_id: int) -> Dict[str, Any]:
+            """Per-channel snapshot status for /stats.json ('' = default
+            channel); {} when the backend has no snapshot layer."""
+            backend = state.storage.l_events
+            if not hasattr(backend, "snapshot_status"):
+                return {}
+            out: Dict[str, Any] = {}
+            st = backend.snapshot_status(app_id)
+            if st is not None:
+                out[""] = st
+            for chan in state.storage.channels.get_by_app_id(app_id):
+                st = backend.snapshot_status(app_id, chan.id)
+                if st is not None:
+                    out[chan.name] = st
+            return out
 
         def _webhook(self, ak, channel_id, name, body):
             from predictionio_tpu.api.webhooks import get_connector

@@ -4,6 +4,7 @@ Reference: tools/src/main/scala/io/prediction/tools/console/Console.scala and
 bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
 
   app new|list|show|delete|data-delete|compact   application management + log compaction
+  snapshot                                columnar event-store snapshots (fast training scans)
   accesskey new|list|delete               access keys
   channel new|delete                      channels
   build                                   validate engine.json + register manifest
@@ -211,6 +212,51 @@ def _resolve_channel(st, app, channel_name: Optional[str]):
         print(f"Error: channel {channel_name!r} does not exist.", file=sys.stderr)
         return None, False
     return chan.id, True
+
+
+def _cmd_snapshot(args) -> int:
+    """`pio snapshot <app>` — fold the event log into a columnar snapshot
+    so cold `pio train` reads mmap'd columns instead of re-parsing JSONL;
+    `--status` reports coverage without building.  Safe alongside live
+    ingest (only complete lines at build time are covered; the tail is
+    scanned at train time)."""
+    st = get_storage()
+    app = _resolve_app(st, args.name)
+    if app is None:
+        return 1
+    channel_id, ok = _resolve_channel(st, app, args.channel)
+    if not ok:
+        return 1
+    backend = st.l_events
+    if not hasattr(backend, "build_snapshot"):
+        print("Error: this event backend does not support columnar "
+              "snapshots (localfs/sharedfs only).", file=sys.stderr)
+        return 1
+    where = f"app {args.name!r}" + (
+        f" channel {args.channel!r}" if args.channel else "")
+    if args.status:
+        status = backend.snapshot_status(app.id, channel_id)
+        if status is None:
+            print(f"No snapshot for {where}.")
+            return 0
+        print(f"Snapshot status for {where}:")
+        print(f"  file: {status['snapshot']}  (built {status['builtAt']}, "
+              f"{status['buildSeconds']:.3f}s, writer {status['writer']})")
+        print(f"  events: {status['events']} in snapshot, "
+              f"{status['tailEvents']} in JSONL tail "
+              f"({status['tailBytes']} bytes)")
+        print(f"  coverage: {status['coverage']:.4f} over "
+              f"{status['segmentsCovered']} segment(s)")
+        return 0
+    try:
+        stats = backend.build_snapshot(app.id, channel_id)
+    except RuntimeError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Built snapshot for {where}: {stats['events']} events from "
+          f"{stats['segments']} segment(s) in {stats['build_s']:.3f}s "
+          f"({stats['snapshot']}).")
+    return 0
 
 
 def _cmd_import(args) -> int:
@@ -534,6 +580,16 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("app_name")
         sp.add_argument("name")
     ch.set_defaults(func=_cmd_channel)
+
+    sn = sub.add_parser(
+        "snapshot",
+        help="build a columnar event-store snapshot (mmap-speed training "
+             "scans); --status reports coverage")
+    sn.add_argument("name")
+    sn.add_argument("--channel", default=None)
+    sn.add_argument("--status", action="store_true",
+                    help="report snapshot coverage instead of building")
+    sn.set_defaults(func=_cmd_snapshot)
 
     imp = sub.add_parser("import")
     imp.add_argument("--appid", type=int, default=0)

@@ -386,12 +386,32 @@ class PEvents(abc.ABC):
         training path never needs time ordering."""
         return self.find(app_id, **filters)
 
+    # -- columnar snapshot plumbing (optional per backend) -------------------
+    # Segment-file backends (localfs/sharedfs) persist columnar snapshots
+    # of the event log and serve find_batches from them at mmap speed,
+    # parsing only the uncovered JSONL tail.  The default hooks say "no
+    # snapshot": find_batches then streams through scan() as always.
+
+    def snapshot_scan(self, app_id: int,
+                      channel_id: Optional[int] = None) -> Optional[Dict]:
+        """{"batch", "ids", "watermark", ...} from a persisted columnar
+        snapshot + tail, or None when the backend has none (the default)."""
+        return None
+
+    def snapshot_status(self, app_id: int,
+                        channel_id: Optional[int] = None) -> Optional[Dict]:
+        """Coverage summary for dashboards, or None without snapshots."""
+        return None
+
     def find_batches(
         self,
         app_id: int,
         batch_size: int = 1 << 20,
         **filters: Any,
     ) -> Iterator["EventBatch"]:
+        """Columnar batches for training reads.  Backends with snapshot
+        support override this to serve one snapshot+tail batch instead of
+        re-encoding every event through this scan loop."""
         from predictionio_tpu.store.columnar import EventBatch
 
         buf: List[Event] = []

@@ -19,11 +19,12 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import logging
 import os
 import threading
 import uuid
 from pathlib import Path
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.events.event import Event, canonical_event_json
 from predictionio_tpu.obs.metrics import LATENCY_BUCKETS, SIZE_BUCKETS, get_registry
@@ -37,8 +38,12 @@ from predictionio_tpu.storage.base import (
     EvaluationInstance,
 )
 
-SEGMENT_MAX_BYTES = 64 << 20  # rotate segments at 64 MiB
+# rotate segments at 64 MiB; PIO_SEGMENT_MAX_BYTES overrides (benches and
+# snapshot tests rotate early to exercise multi-segment layouts cheaply)
+SEGMENT_MAX_BYTES = int(os.environ.get("PIO_SEGMENT_MAX_BYTES", 64 << 20))
 DEFAULT_CHANNEL = "_default"
+
+log = logging.getLogger("pio.storage")
 
 # -- write-path instruments (obs tentpole).  All recorded at group-commit
 # granularity (one observation per physical write/fsync, not per event),
@@ -107,6 +112,7 @@ class _SegmentWriter:
         self._f = None
         self._path: Optional[Path] = None
         self._last_sync = 0.0
+        self.rotations = 0   # new segment files opened (snapshot auto-trigger)
 
     def append(self, text: str) -> None:
         import time as _time
@@ -214,6 +220,7 @@ class _SegmentWriter:
             path = (self._dir / f"seg-{n:05d}.jsonl" if self._tag is None
                     else self._dir / f"seg-{self._tag}-{n:05d}.jsonl")
             _M_ROTATE.inc()
+            self.rotations += 1
         self._path = path
         self._f = open(path, "a")
         # this writer's view of its own series; readers union all writers
@@ -727,6 +734,8 @@ class FSEvents(base.LEvents, base.PEvents):
         self._groups: Dict[tuple, _CommitGroup] = {}
         self._writer_tag = (writer_tag if writer_tag is not None
                             else _env_writer_tag())
+        self._rot_seen: Dict[tuple, int] = {}    # snapshot auto-trigger state
+        self._snap_inflight: set = set()
 
     def _entity_index(self, app_id: int, channel_id: Optional[int]) -> _EntityIndex:
         key = (app_id, channel_id)
@@ -875,6 +884,13 @@ class FSEvents(base.LEvents, base.PEvents):
                     w.append(payload)
                     _M_GROUP.observe(len(batch))
                     _M_EVENTS.inc(payload.count("\n"))
+                    # snapshot auto-trigger: only worth checking when this
+                    # commit opened a new segment (rotations are rare; the
+                    # default-0 get keeps a resumed writer's first commit
+                    # from paying the manifest/glob check for nothing)
+                    if w.rotations != self._rot_seen.get(key, 0):
+                        self._rot_seen[key] = w.rotations
+                        self._maybe_auto_snapshot(key)
             except BaseException as e:
                 # a failed write (ENOSPC/EIO) must NACK every event in
                 # the group — none of them is durable
@@ -1033,15 +1049,129 @@ class FSEvents(base.LEvents, base.PEvents):
         self._indexes.pop(key, None)
         return {"kept": kept, "expired": expired, "segments": n_new}
 
+    # -- columnar snapshots --------------------------------------------------
+
+    def build_snapshot(self, app_id: int,
+                       channel_id: Optional[int] = None) -> Dict:
+        """Fold the (app, channel) log into a columnar snapshot (see
+        storage.snapshot).  Safe alongside live ingest: segments are
+        append-only and only complete lines at build time are covered."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        self.segment_paths(app_id, channel_id)   # recover crashed compaction
+        d = self._chan_dir(app_id, channel_id)
+        d.mkdir(parents=True, exist_ok=True)
+        return _snap.build_snapshot(
+            d, self._tombstones(d), self._writer_tag or "local")
+
+    def snapshot_scan(self, app_id: int,
+                      channel_id: Optional[int] = None) -> Optional[Dict]:
+        """Snapshot-or-tail columnar read: {"batch", "ids", "watermark",
+        ...} from the mmap'd snapshot plus a parse of only the uncovered
+        JSONL tail, or None (miss — caller scans the log)."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        if not _snap.enabled():
+            return None
+        self.segment_paths(app_id, channel_id)   # recover crashed compaction
+        d = self._chan_dir(app_id, channel_id)
+        res = _snap.scan_snapshot(d, self._tombstones(d))
+        if res is None:
+            _snap.record_miss()
+        else:
+            _snap.record_hit()
+        return res
+
+    def scan_tail_from(self, app_id: int, channel_id: Optional[int],
+                       watermark: Dict[str, int], base=None,
+                       heads: Optional[Dict] = None) -> Optional[Dict]:
+        """Delta staging: parse only events past ``watermark`` (a
+        per-segment byte map from a previous snapshot_scan; ``heads``
+        are its segment fingerprints).  None when the watermark no
+        longer matches the log (full restage needed)."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        d = self._chan_dir(app_id, channel_id)
+        return _snap.scan_tail(d, watermark, self._tombstones(d), base=base,
+                               heads=heads)
+
+    def snapshot_status(self, app_id: int,
+                        channel_id: Optional[int] = None) -> Optional[Dict]:
+        from predictionio_tpu.storage import snapshot as _snap
+
+        return _snap.snapshot_status(self._chan_dir(app_id, channel_id))
+
+    def tombstone_state(self, app_id: int,
+                        channel_id: Optional[int] = None) -> frozenset:
+        """Current tombstone-id set (staging caches key their validity on
+        it: any change forces a full restage)."""
+        return frozenset(self._tombstones(self._chan_dir(app_id, channel_id)))
+
+    def _maybe_auto_snapshot(self, key: tuple) -> None:
+        """Background build once PIO_SNAPSHOT_SEGMENTS uncovered segments
+        exist.  Called with self._lock held, on segment rotation only."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        thr = _snap.auto_threshold()
+        if thr <= 0 or not _snap.enabled() or key in self._snap_inflight:
+            return
+        d = self._chan_dir(*key)
+        if _snap.uncovered_segments(d) < thr:
+            return
+        self._snap_inflight.add(key)
+
+        def run():
+            try:
+                self.build_snapshot(*key)
+            except RuntimeError:
+                pass     # another process's build already in flight
+            except Exception:
+                log.warning("auto snapshot build failed for %s", key,
+                            exc_info=True)
+            finally:
+                with self._lock:
+                    self._snap_inflight.discard(key)
+
+        threading.Thread(target=run, daemon=True,
+                         name="pio-snapshot-build").start()
+
+    def find_batches(
+        self,
+        app_id: int,
+        batch_size: int = 1 << 20,
+        **filters: Any,
+    ) -> Iterator["EventBatch"]:  # noqa: F821 - forward ref via base
+        """Columnar batches served snapshot-first: a valid snapshot plus
+        its JSONL tail becomes ONE batch (filters applied columnar), at
+        mmap speed; misses stream through the base scan-and-encode path."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        plain = {"channel_id", "start_time", "until_time", "entity_type",
+                 "event_names"}
+        if set(filters) <= plain:
+            res = self.snapshot_scan(app_id, filters.get("channel_id"))
+            if res is not None:
+                yield _snap.apply_filters(
+                    res["batch"],
+                    event_names=filters.get("event_names"),
+                    entity_type=filters.get("entity_type"),
+                    start_time=filters.get("start_time"),
+                    until_time=filters.get("until_time"))
+                return
+        yield from super().find_batches(app_id, batch_size=batch_size,
+                                        **filters)
+
     @staticmethod
-    def _iter_segments(segs: Sequence[Path], dead: set) -> Iterator[Event]:
+    def _iter_segments(segs: Sequence[Path], dead: set,
+                       needles: Optional[List[bytes]] = None) -> Iterator[Event]:
         for seg in segs:
             with open(seg, "rb") as f:
                 prev = None
                 for raw in f:
                     if prev is not None:
                         line = prev.strip()
-                        if line:
+                        if line and (needles is None
+                                     or any(nd in line for nd in needles)):
                             e = Event.from_json(json.loads(line))
                             if e.event_id not in dead:
                                 yield e
@@ -1052,15 +1182,37 @@ class FSEvents(base.LEvents, base.PEvents):
                 # the scan; the writer truncates it on its next open
                 if prev is not None and prev.endswith(b"\n"):
                     line = prev.strip()
-                    if line:
+                    if line and (needles is None
+                                 or any(nd in line for nd in needles)):
                         e = Event.from_json(json.loads(line))
                         if e.event_id not in dead:
                             yield e
 
-    def _iter_raw(self, app_id: int, channel_id: Optional[int]) -> Iterator[Event]:
+    @staticmethod
+    def _event_needles(event_names: Optional[Sequence[str]]
+                       ) -> Optional[List[bytes]]:
+        """Raw-line prefilter for name-filtered scans: a stored line whose
+        bytes contain none of these can't have one of the wanted event
+        verbs, so the (dominant) json.loads cost is skipped.  Needles use
+        json.dumps for the exact escaping both writer paths emit; the
+        spaced variant tolerates pretty-printed external lines.  A false
+        positive (the needle inside a property VALUE) merely parses — the
+        post-parse filter still decides."""
+        if event_names is None:
+            return None
+        needles: List[bytes] = []
+        for n in event_names:
+            j = json.dumps(n)
+            needles.append(f'"event":{j}'.encode())
+            needles.append(f'"event": {j}'.encode())
+        return needles
+
+    def _iter_raw(self, app_id: int, channel_id: Optional[int],
+                  needles: Optional[List[bytes]] = None) -> Iterator[Event]:
         d = self._chan_dir(app_id, channel_id)
         yield from self._iter_segments(
-            self.segment_paths(app_id, channel_id), self._tombstones(d))
+            self.segment_paths(app_id, channel_id), self._tombstones(d),
+            needles=needles)
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         return next((e for e in self._iter_raw(app_id, channel_id) if e.event_id == event_id), None)
@@ -1121,8 +1273,11 @@ class FSEvents(base.LEvents, base.PEvents):
         target_entity_type: Optional[str] = None,
     ) -> Iterator[Event]:
         """Streaming unordered scan over segments — O(segment) memory, unlike
-        ``find`` which must sort. This is the bulk-training read path."""
-        for e in self._iter_raw(app_id, channel_id):
+        ``find`` which must sort. This is the bulk-training read path.
+        Name-filtered scans prefilter raw lines by substring before
+        parsing (see _event_needles)."""
+        for e in self._iter_raw(app_id, channel_id,
+                                needles=self._event_needles(event_names)):
             if base.match_filters(
                 e, start_time, until_time, entity_type, None,
                 event_names, target_entity_type, None,

@@ -360,7 +360,18 @@ class SharedFSEvents(localfs.FSEvents):
     segments are ``seg-<writer>-NNNNN.jsonl`` and tombstones
     ``tombstones-<writer>.txt`` (unioned at read time by the inherited
     ``_tombstones``); the tag defaults to ``<host>-<pid>`` instead of
-    localfs's untagged single-writer naming."""
+    localfs's untagged single-writer naming.
+
+    Columnar snapshots are shared the same way: ANY host may run
+    ``pio snapshot`` (or hit the auto-trigger) and the build lands as
+    ``snapshot/snap-<its writer tag>-<id>.pioc`` plus an atomically
+    replaced ``manifest.json`` on the shared prefix — every other host's
+    ``snapshot_scan`` validates that manifest against the live segment
+    set and mmap-loads the same file, so one build serves the whole
+    fleet.  Concurrent builders are serialized by the flock where the
+    filesystem honors it; where it doesn't, last-writer-wins manifest
+    replaces stay self-consistent (the loser's file is garbage-collected
+    by the next build)."""
 
     def __init__(self, root: Path, writer_tag: Optional[str] = None):
         super().__init__(root, writer_tag=writer_tag or writer_id())

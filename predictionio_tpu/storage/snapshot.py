@@ -1,0 +1,659 @@
+"""Columnar event-store snapshots — mmap-speed training scans.
+
+The JSONL segment log is the system of record; every cold ``pio train``
+used to re-parse it (native C++ scan ~0.5–0.6 M ev/s, JSON-parse-bound).
+A snapshot folds the segments into ONE binary struct-of-arrays file
+(``store.columnar`` container: int32 code columns + string dictionaries +
+int64 timestamps + an event-id column) so training reads memory-mapped
+columns at page-cache speed and only the *uncovered JSONL tail* — events
+appended since the last build — still pays a parse.
+
+Layout, per (app, channel) directory::
+
+    events/app_<id>/<chan>/snapshot/
+        manifest.json          what the snapshot covers (atomic replace)
+        snap-<writer>-<id>.pioc  the columnar file (tmp + fsync + rename)
+        .lock                  flock held for a build's whole duration
+
+The manifest records the covered byte range of every segment (up to the
+last complete line at build time — segments are append-only, so the tail
+scan resumes exactly there), the applied tombstone set, and an
+event-count watermark.  Builds are crash-safe two-phase: a kill at any
+instant leaves either the old manifest + old snapshot (tmp ignored) or
+the new pair; readers never see a half state.  A torn/corrupt snapshot
+file is quarantined on first read and rebuilt by the next trigger.
+
+Multi-writer stores (prefork event servers, sharedfs multi-host) share
+one snapshot: any writer tag may build, every reader validates the
+manifest against the live segment set, and last-writer-wins manifest
+replaces are self-consistent.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.obs.metrics import LATENCY_BUCKETS, get_registry
+from predictionio_tpu.store.columnar import (
+    EventBatch,
+    EventIdColumn,
+    IdDict,
+    PropColumn,
+    read_batch,
+    write_batch,
+)
+
+log = logging.getLogger("pio.snapshot")
+
+SNAP_DIR = "snapshot"
+MANIFEST = "manifest.json"
+LOCK = ".lock"
+
+_REG = get_registry()
+_M_BUILD_S = _REG.histogram(
+    "pio_snapshot_build_duration_seconds",
+    "Wall-clock duration of snapshot builds", buckets=LATENCY_BUCKETS)
+_M_BUILDS = _REG.counter(
+    "pio_snapshot_builds_total", "Snapshot builds by final status")
+_M_EVENTS = _REG.gauge(
+    "pio_snapshot_events",
+    "Events in the last-built snapshot, by channel")
+_M_HITS = _REG.counter(
+    "pio_snapshot_scan_hits_total",
+    "Training scans served from a snapshot (+ tail)")
+_M_MISSES = _REG.counter(
+    "pio_snapshot_scan_misses_total",
+    "Training scans that fell back to a full JSONL parse")
+_M_QUAR = _REG.counter(
+    "pio_snapshot_quarantined_total",
+    "Torn/corrupt snapshot files set aside for rebuild")
+_M_STAGED = _REG.counter(
+    "pio_stage_events_total",
+    "Events staged into columnar batches by source: snapshot = served "
+    "from the mmap'd file, tail = parsed from the uncovered JSONL tail, "
+    "delta = parsed past a retained batch's watermark on retrain")
+
+
+def enabled() -> bool:
+    """PIO_SNAPSHOT=off disables the snapshot READ path and auto-trigger
+    (builds via CLI still work, for pre-warming before re-enabling)."""
+    return os.environ.get("PIO_SNAPSHOT", "").lower() not in (
+        "off", "0", "false")
+
+
+def auto_threshold() -> int:
+    """PIO_SNAPSHOT_SEGMENTS=N: the event-log writer auto-triggers a
+    background build once N segments exist that the current snapshot
+    doesn't cover (0 = disabled, the default — builds are `pio snapshot`
+    or programmatic otherwise)."""
+    try:
+        return max(0, int(os.environ.get("PIO_SNAPSHOT_SEGMENTS", "0")))
+    except ValueError:
+        return 0
+
+
+def _chan_label(d: Path) -> str:
+    return f"{d.parent.name}/{d.name}"
+
+
+def _segment_head(seg: Path, consumed: int) -> Optional[Dict[str, int]]:
+    """Identity fingerprint of a consumed segment prefix: CRC of its first
+    min(64, consumed) bytes.  Segment NAMES can recur with fresh content
+    (data-delete + re-import restarts writer numbering at seg-00000), and
+    a size check alone passes once the new file outgrows the recorded
+    offset — byte offsets into such a file are meaningless and parsing
+    from them would crash or, worse, silently splice two generations of
+    data.  The first line embeds a unique eventId, so 64 bytes suffice."""
+    import zlib
+
+    n = min(64, consumed)
+    if n <= 0:
+        return None
+    try:
+        with open(seg, "rb") as f:
+            return {"n": n, "crc": zlib.crc32(f.read(n))}
+    except OSError:
+        return None
+
+
+def _head_matches(seg: Path, head: Optional[Dict[str, int]]) -> bool:
+    if not head:
+        return True      # nothing was consumed: nothing to mismatch
+    cur = _segment_head(seg, int(head["n"]))
+    return cur is not None and cur["crc"] == head["crc"]
+
+
+def _last_newline_boundary(path: Path, size: int) -> int:
+    """Byte offset just past the last complete line within ``size`` bytes
+    (0 if none) — the snapshot never covers a torn tail, and a writer's
+    truncate-heal only ever removes bytes PAST this boundary."""
+    if size <= 0:
+        return 0
+    with open(path, "rb") as f:
+        pos = size
+        while pos > 0:
+            step = min(64 * 1024, pos)
+            f.seek(pos - step)
+            chunk = f.read(step)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                return pos - step + nl + 1
+            pos -= step
+    return 0
+
+
+class ColumnarBuilder:
+    """Streaming wire-dict → struct-of-arrays builder.
+
+    The Python analogue of the native scanner's output (same columns,
+    same property-column kinds) plus an event-id column.  With ``base``
+    set, codes are assigned IN the base batch's dictionaries (mutating
+    them in place) so the result concatenates with the base via the
+    shared-dict fast path — no re-coding, no dictionary rescans.
+    """
+
+    def __init__(self, base: Optional[EventBatch] = None):
+        if base is not None:
+            self.event_dict = base.event_dict
+            self.entity_type_dict = base.entity_type_dict
+            self.entity_dict = base.entity_dict
+            self.target_dict = base.target_dict
+        else:
+            self.event_dict = IdDict()
+            self.entity_type_dict = IdDict()
+            self.entity_dict = IdDict()
+            self.target_dict = IdDict()
+        self._base_props = (base.prop_columns or {}) if base is not None else {}
+        self._ev: List[int] = []
+        self._et: List[int] = []
+        self._ei: List[int] = []
+        self._ti: List[int] = []
+        self._ts: List[int] = []
+        self._rt: List[float] = []
+        self._ids: List[str] = []
+        self._props: Dict[str, dict] = {}
+
+    def __len__(self) -> int:
+        return len(self._ev)
+
+    def add(self, d: dict) -> None:
+        """Append one stored wire-format event dict (a parsed log line)."""
+        from predictionio_tpu.events.event import parse_time  # no-cycle: lazy
+
+        row = len(self._ev)
+        self._ev.append(self.event_dict.add(d["event"]))
+        self._et.append(self.entity_type_dict.add(d["entityType"]))
+        self._ei.append(self.entity_dict.add(str(d["entityId"])))
+        tei = d.get("targetEntityId")
+        self._ti.append(self.target_dict.add(str(tei))
+                        if tei is not None else -1)
+        self._ts.append(int(parse_time(d.get("eventTime")).timestamp() * 1e6))
+        props = d.get("properties") or {}
+        r = props.get("rating")
+        # bool counts as numeric here, mirroring EventBatch.from_events
+        self._rt.append(float(r) if isinstance(r, (int, float)) else np.nan)
+        self._ids.append(d.get("eventId") or "")
+        for key, val in props.items():
+            self._add_prop(key, row, val)
+
+    def _add_prop(self, key: str, row: int, val) -> None:
+        p = self._props.get(key)
+        if p is None:
+            base_col = self._base_props.get(key)
+            p = self._props[key] = {
+                "rows": [], "kind": [], "num": [], "strs": [],
+                "dict": base_col.dict if base_col is not None else IdDict(),
+            }
+        # kinds mirror PropColumn.value_at: 0 num, 1 bool, 2 str,
+        # 3 str-list, 4 null, 5 nested (raw JSON span)
+        if isinstance(val, bool):
+            kind, num, strs = 1, float(val), ()
+        elif isinstance(val, (int, float)):
+            kind, num, strs = 0, float(val), ()
+        elif isinstance(val, str):
+            kind, num, strs = 2, 0.0, (val,)
+        elif val is None:
+            kind, num, strs = 4, 0.0, ()
+        elif isinstance(val, list) and all(isinstance(x, str) for x in val):
+            kind, num, strs = 3, 0.0, tuple(val)
+        else:
+            kind, num, strs = 5, 0.0, (json.dumps(val),)
+        p["rows"].append(row)
+        p["kind"].append(kind)
+        p["num"].append(num)
+        p["strs"].append(strs)
+
+    def finish(self) -> tuple:
+        """→ (EventBatch with prop_columns, EventIdColumn)."""
+        n = len(self._ev)
+        props: Dict[str, PropColumn] = {}
+        for key, p in self._props.items():
+            offs = np.zeros(len(p["rows"]) + 1, np.int64)
+            np.cumsum([len(s) for s in p["strs"]], out=offs[1:])
+            flat = [s for strs in p["strs"] for s in strs]
+            props[key] = PropColumn(
+                rows=np.asarray(p["rows"], np.int64),
+                kind=np.asarray(p["kind"], np.int8),
+                num=np.asarray(p["num"], np.float64),
+                str_offs=offs,
+                codes=p["dict"].encode(flat) if flat else np.empty(0, np.int32),
+                dict=p["dict"],
+            )
+        batch = EventBatch(
+            np.asarray(self._ev, np.int32), np.asarray(self._et, np.int32),
+            np.asarray(self._ei, np.int32), np.asarray(self._ti, np.int32),
+            np.asarray(self._ts, np.int64),
+            np.asarray(self._rt, np.float32) if n else np.empty(0, np.float32),
+            self.event_dict, self.entity_type_dict, self.entity_dict,
+            self.target_dict, prop_columns=props,
+        )
+        return batch, EventIdColumn.from_ids(self._ids)
+
+
+def _parse_range(seg: Path, start: int, end: int, dead: set,
+                 builder: ColumnarBuilder, delay: float = 0.0) -> int:
+    """Parse complete lines in ``seg[start:end)`` into ``builder``,
+    skipping tombstoned ids.  Returns the number of events added."""
+    added = 0
+    with open(seg, "rb") as f:
+        f.seek(start)
+        data = f.read(end - start)
+    for line in data.split(b"\n"):
+        if not line.strip():
+            continue
+        if delay:
+            time.sleep(delay)   # test hook: widen the kill-mid-build window
+        d = json.loads(line)
+        if d.get("eventId") in dead:
+            continue
+        builder.add(d)
+        added += 1
+    return added
+
+
+def load_manifest(d: Path) -> Optional[dict]:
+    p = d / SNAP_DIR / MANIFEST
+    try:
+        m = json.loads(p.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(m, dict) or "snapshot" not in m or "covered" not in m:
+        return None
+    return m
+
+
+def _fsync_write(path: Path, text: str) -> None:
+    """tmp + fsync + atomic rename — the manifest's durability contract."""
+    tmp = path.with_suffix(path.suffix + f".tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    tmp.replace(path)
+
+
+def build_snapshot(d: Path, tombstones: set, writer: str) -> dict:
+    """Fold every complete line of every segment into a fresh snapshot.
+
+    Two-phase: columns stream into ``snap-*.pioc.tmp<pid>`` (invisible to
+    readers), fsync, atomic rename, THEN the manifest is atomically
+    replaced — a SIGKILL at any instant leaves a fully readable store.
+    Exactly-once across processes/hosts via a non-blocking flock; losing
+    the race raises RuntimeError("snapshot build already in progress").
+
+    Returns {"events", "segments", "build_s", "snapshot"}.
+    """
+    import fcntl
+
+    snap_dir = d / SNAP_DIR
+    snap_dir.mkdir(parents=True, exist_ok=True)
+    lockf = open(snap_dir / LOCK, "a")
+    try:
+        try:
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            raise RuntimeError(
+                "snapshot build already in progress for this channel")
+        t0 = time.perf_counter()
+        try:
+            delay = float(os.environ.get("PIO_SNAPSHOT_TEST_DELAY_S") or 0.0)
+        except ValueError:
+            delay = 0.0
+        for stale in snap_dir.glob("*.tmp*"):
+            stale.unlink(missing_ok=True)
+        covered: Dict[str, int] = {}
+        heads: Dict[str, Dict[str, int]] = {}
+        builder = ColumnarBuilder()
+        n = 0
+        try:
+            for seg in sorted(d.glob("seg-*.jsonl")):
+                try:
+                    size = seg.stat().st_size
+                except FileNotFoundError:
+                    continue     # racing a data-delete
+                end = _last_newline_boundary(seg, size)
+                covered[seg.name] = end
+                head = _segment_head(seg, end)
+                if head is not None:
+                    heads[seg.name] = head
+                if end > 0:
+                    n += _parse_range(seg, 0, end, tombstones, builder, delay)
+            batch, ids = builder.finish()
+            name = f"snap-{writer}-{uuid.uuid4().hex[:8]}.pioc"
+            tmp = snap_dir / (name + f".tmp{os.getpid()}")
+            write_batch(tmp, batch, ids, meta={
+                "writer": writer, "events": n})
+            tmp.rename(snap_dir / name)
+            manifest = {
+                "version": 1,
+                "snapshot": name,
+                "covered": covered,
+                "heads": heads,
+                "events": n,                     # event-count watermark
+                "tombstones_applied": sorted(tombstones),
+                "built_at": _dt.datetime.now(
+                    _dt.timezone.utc).isoformat(),
+                "build_s": round(time.perf_counter() - t0, 6),
+                "writer": writer,
+            }
+            _fsync_write(snap_dir / MANIFEST, json.dumps(
+                manifest, indent=1, sort_keys=True))
+        except Exception:
+            _M_BUILDS.inc(1, status="failed")
+            raise
+        # superseded snapshot files: unlink AFTER the manifest flip so a
+        # reader holding the old manifest raced at worst into a miss
+        for p in snap_dir.glob("snap-*.pioc"):
+            if p.name != name:
+                p.unlink(missing_ok=True)
+        build_s = time.perf_counter() - t0
+        _M_BUILD_S.observe(build_s)
+        _M_BUILDS.inc(1, status="ok")
+        _M_EVENTS.set(n, channel=_chan_label(d))
+        log.info("snapshot built: %s %d events / %d segments in %.3fs",
+                 _chan_label(d), n, len(covered), build_s)
+        return {"events": n, "segments": len(covered),
+                "build_s": build_s, "snapshot": name}
+    finally:
+        lockf.close()   # closing releases the flock
+
+
+def _quarantine(snap_dir: Path, name: str) -> None:
+    """Set a torn/corrupt snapshot aside (kept for forensics) and drop the
+    manifest so the next trigger rebuilds instead of re-tripping."""
+    try:
+        (snap_dir / name).rename(snap_dir / (name + ".quarantine"))
+    except OSError:
+        pass
+    (snap_dir / MANIFEST).unlink(missing_ok=True)
+    _M_QUAR.inc()
+    log.warning("quarantined torn snapshot %s", snap_dir / name)
+
+
+def scan_tail(d: Path, watermark: Dict[str, int], tombstones: set,
+              base: Optional[EventBatch],
+              heads: Optional[Dict[str, dict]] = None) -> Optional[dict]:
+    """Parse only the log bytes past ``watermark`` (per-segment covered
+    byte offsets; unlisted segments are wholly new).
+
+    Returns {"batch", "ids", "events", "watermark", "heads"} — the tail
+    batch shares ``base``'s dictionaries when given — or None when the
+    watermark no longer describes the live log: a segment vanished or
+    shrank (compaction/data-delete), its head fingerprint changed (a
+    recreated file reusing the name), or the bytes at the offset don't
+    parse (any stale-offset case the cheaper checks miss).  Callers
+    treat None as a full restage."""
+    segs = sorted(d.glob("seg-*.jsonl")) if d.exists() else []
+    names = {s.name for s in segs}
+    for name in watermark:
+        if name not in names:
+            return None
+    builder = ColumnarBuilder(base=base)
+    new_mark = dict(watermark)
+    new_heads: Dict[str, Dict[str, int]] = {}
+    n = 0
+    for seg in segs:
+        start = watermark.get(seg.name, 0)
+        try:
+            size = seg.stat().st_size
+        except FileNotFoundError:
+            return None
+        if size < start:
+            return None          # shrank under the watermark: invalid
+        if heads is not None and not _head_matches(seg, heads.get(seg.name)):
+            return None          # same name, different content generation
+        end = _last_newline_boundary(seg, size)
+        new_mark[seg.name] = max(end, start)
+        head = _segment_head(seg, new_mark[seg.name])
+        if head is not None:
+            new_heads[seg.name] = head
+        if end > start:
+            try:
+                n += _parse_range(seg, start, end, tombstones, builder)
+            except (json.JSONDecodeError, UnicodeDecodeError, KeyError,
+                    TypeError, ValueError):
+                return None      # stale offset mid-line / foreign bytes
+    batch, ids = builder.finish()
+    return {"batch": batch, "ids": ids, "events": n,
+            "watermark": new_mark, "heads": new_heads}
+
+
+def scan_snapshot(d: Path, tombstones: set) -> Optional[dict]:
+    """The snapshot-or-tail read: mmap the covered columns, parse only the
+    uncovered tail, splice via the shared-dict concat fast path.
+
+    Returns None (a miss — caller falls back to a full JSONL scan) when
+    there is no valid snapshot for the CURRENT log state: no manifest, a
+    covered segment vanished/shrank (compaction, data-delete), tombstones
+    receded, or the file is torn (then also quarantined).  Events
+    tombstoned AFTER the build are dropped via the snapshot's id column,
+    so a pre-delete snapshot can never resurface them.
+
+    Hit result: {"batch", "ids", "snap_events", "tail_events",
+    "watermark", "manifest"}.
+    """
+    m = load_manifest(d)
+    if m is None:
+        return None
+    snap_dir = d / SNAP_DIR
+    covered: Dict[str, int] = m["covered"]
+    heads: Dict[str, dict] = m.get("heads", {})
+    for name, end in covered.items():
+        p = d / name
+        try:
+            if p.stat().st_size < end:
+                return None      # covered bytes no longer exist
+        except OSError:
+            return None          # segment gone (compaction/data-delete)
+        if not _head_matches(p, heads.get(name)):
+            return None          # recreated file reusing a covered name
+    applied = set(m.get("tombstones_applied", ()))
+    if applied - tombstones:
+        return None              # tombstones receded: log was rewritten
+    try:
+        batch, ids, _meta = read_batch(snap_dir / m["snapshot"])
+    except FileNotFoundError:
+        return None              # raced a concurrent rebuild's cleanup
+    except (ValueError, OSError):
+        _quarantine(snap_dir, m["snapshot"])
+        return None
+    if ids is None:
+        return None
+    new_dead = tombstones - applied
+    if new_dead:
+        mask = np.ones(len(batch), bool)
+        for eid in new_dead:
+            r = ids.index_of(eid)
+            if r >= 0:
+                mask[r] = False
+        if not mask.all():
+            batch = batch.subset(mask)
+            ids = ids.subset(mask)
+    snap_events = len(batch)
+    tail = scan_tail(d, covered, tombstones, base=batch, heads=heads)
+    if tail is None:
+        return None
+    if tail["events"]:
+        batch = EventBatch.concat([batch, tail["batch"]])
+        ids = EventIdColumn.concat([ids, tail["ids"]])
+    _M_STAGED.inc(snap_events, mode="snapshot")
+    if tail["events"]:
+        _M_STAGED.inc(tail["events"], mode="tail")
+    return {"batch": batch, "ids": ids, "snap_events": snap_events,
+            "tail_events": tail["events"], "watermark": tail["watermark"],
+            "heads": tail["heads"], "manifest": m}
+
+
+def uncovered_segments(d: Path) -> int:
+    """Segments the current snapshot doesn't list — the auto-trigger's
+    staleness measure."""
+    m = load_manifest(d)
+    covered = set(m["covered"]) if m else set()
+    if not d.exists():
+        return 0
+    return sum(1 for s in d.glob("seg-*.jsonl") if s.name not in covered)
+
+
+# status is wired into scrape-frequency endpoints (/stats.json, the
+# dashboard page) while the tail-event count needs a read of every
+# uncovered byte — memoize per channel on the (segment name, size,
+# covered offset) signature so a growing-but-unpolled log is read once
+# per change, not once per scrape
+_status_lock = threading.Lock()
+_status_cache: Dict[str, dict] = {}
+
+
+def snapshot_status(d: Path) -> Optional[dict]:
+    """Coverage summary for dashboards//stats.json, or None when the
+    channel has no snapshot.  ``tailEvents`` counts complete lines past
+    the covered offsets (tombstones not subtracted — this is a coverage
+    view, not a scan)."""
+    m = load_manifest(d)
+    if m is None:
+        return None
+    covered: Dict[str, int] = m["covered"]
+    segs = sorted(d.glob("seg-*.jsonl")) if d.exists() else []
+    sizes = []
+    for seg in segs:
+        try:
+            sizes.append((seg, seg.stat().st_size))
+        except OSError:
+            continue
+    sig = (m.get("snapshot"),) + tuple(
+        (seg.name, size, covered.get(seg.name, 0)) for seg, size in sizes)
+    with _status_lock:
+        hit = _status_cache.get(str(d))
+        if hit is not None and hit["sig"] == sig:
+            tail_events, tail_bytes = hit["tail_events"], hit["tail_bytes"]
+            sizes = []           # nothing to recount
+        else:
+            tail_events = tail_bytes = 0
+    for seg, size in sizes:
+        start = covered.get(seg.name, 0)
+        end = _last_newline_boundary(seg, size)
+        if end > start:
+            tail_bytes += end - start
+            with open(seg, "rb") as f:
+                f.seek(start)
+                tail_events += f.read(end - start).count(b"\n")
+    if sizes or hit is None:
+        with _status_lock:
+            if len(_status_cache) > 256:
+                _status_cache.clear()
+            _status_cache[str(d)] = {"sig": sig, "tail_events": tail_events,
+                                     "tail_bytes": tail_bytes}
+    snap_events = int(m.get("events", 0))
+    total = snap_events + tail_events
+    return {
+        "events": snap_events,
+        "tailEvents": tail_events,
+        "tailBytes": tail_bytes,
+        "coverage": (snap_events / total) if total else 1.0,
+        "builtAt": m.get("built_at"),
+        "buildSeconds": m.get("build_s"),
+        "snapshot": m.get("snapshot"),
+        "writer": m.get("writer"),
+        "segmentsCovered": len(covered),
+    }
+
+
+def apply_filters(batch: EventBatch,
+                  event_names: Optional[Sequence[str]] = None,
+                  entity_type: Optional[str] = None,
+                  start_time: Optional[_dt.datetime] = None,
+                  until_time: Optional[_dt.datetime] = None) -> EventBatch:
+    """Columnar equivalent of the scan filters (same semantics as
+    storage.base.match_filters for these four), shared by every
+    snapshot-backed read path."""
+    mask = np.ones(len(batch), bool)
+    if event_names is not None:
+        codes = [batch.event_dict.id(n) for n in event_names]
+        codes = [c for c in codes if c is not None]
+        mask &= np.isin(batch.event_codes, np.asarray(codes, np.int32))
+    if entity_type is not None:
+        c = batch.entity_type_dict.id(entity_type)
+        mask &= np.asarray(batch.entity_type_codes) == (
+            c if c is not None else -2)
+    if start_time is not None:
+        mask &= np.asarray(batch.times_us) >= int(
+            start_time.timestamp() * 1e6)
+    if until_time is not None:
+        mask &= np.asarray(batch.times_us) < int(
+            until_time.timestamp() * 1e6)
+    return batch.subset(mask) if not mask.all() else batch
+
+
+def record_hit() -> None:
+    _M_HITS.inc()
+
+
+def record_miss() -> None:
+    _M_MISSES.inc()
+
+
+def record_delta(n: int) -> None:
+    _M_STAGED.inc(n, mode="delta")
+
+
+def staged_counts() -> Dict[str, float]:
+    """Current staged-event counter values by mode (snapshot/tail/delta) —
+    the exactness hook for delta-retrain assertions and train spans."""
+    return {mode: _M_STAGED.value(mode=mode)
+            for mode in ("snapshot", "tail", "delta")}
+
+
+def publish_status_gauges(status: dict, channel: str) -> None:
+    """Mirror a status dict onto pio_snapshot_* gauges (dashboard scrapes)."""
+    _M_EVENTS.set(status["events"], channel=channel)
+    _REG.gauge(
+        "pio_snapshot_tail_events",
+        "Events in the uncovered JSONL tail, by channel",
+    ).set(status["tailEvents"], channel=channel)
+    _REG.gauge(
+        "pio_snapshot_coverage_ratio",
+        "Events in snapshot / total events, by channel",
+    ).set(status["coverage"], channel=channel)
+    if status.get("builtAt"):
+        try:
+            ts = _dt.datetime.fromisoformat(status["builtAt"]).timestamp()
+        except ValueError:
+            ts = 0.0
+        _REG.gauge(
+            "pio_snapshot_last_build_timestamp_seconds",
+            "Unix time of the last snapshot build, by channel",
+        ).set(ts, channel=channel)
+    if status.get("buildSeconds") is not None:
+        _REG.gauge(
+            "pio_snapshot_last_build_seconds",
+            "Duration of the last snapshot build, by channel",
+        ).set(float(status["buildSeconds"]), channel=channel)

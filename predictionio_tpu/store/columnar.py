@@ -11,7 +11,7 @@ consumed by jitted programs without further host processing.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,13 +58,18 @@ class IdDict:
         return list(self._to_str)
 
     def encode(self, values: Sequence[str]) -> np.ndarray:
-        return np.fromiter((self.add(v) for v in values), dtype=np.int32, count=len(values))
+        # hot loop: one list-comp over a local-aliased dict .get — hits
+        # never touch a method frame, only misses pay the add() call
+        get = self._to_id.get
+        add = self.add
+        codes = [c if (c := get(v)) is not None else add(v) for v in values]
+        return np.fromiter(codes, dtype=np.int32, count=len(codes))
 
     def lookup_many(self, values: Sequence[str]) -> np.ndarray:
-        """ids for known strings, -1 for unknown — one tight fromiter pass
-        (no per-item method dispatch), for bulk dictionary translation."""
+        """ids for known strings, -1 for unknown — one list-comp over a
+        local-aliased ``.get`` + one fromiter, for bulk translation."""
         get = self._to_id.get
-        return np.fromiter((get(v, -1) for v in values), dtype=np.int32,
+        return np.fromiter([get(v, -1) for v in values], dtype=np.int32,
                            count=len(values))
 
     def to_state(self) -> List[str]:
@@ -256,9 +261,39 @@ class EventBatch:
 
     @classmethod
     def concat(cls, batches: Sequence["EventBatch"]) -> "EventBatch":
-        """Concatenate batches, re-coding each batch's codes into shared dicts."""
+        """Concatenate batches, re-coding each batch's codes into shared dicts.
+
+        Fast path: batches whose dictionaries ARE the same objects (the
+        snapshot+tail scan stages the tail directly into the snapshot's
+        dicts) need no re-coding at all — the merge is pure
+        ``np.concatenate``, with no per-string Python rescan of the (large,
+        already-shared) snapshot dictionaries.  Mixed inputs fall back to
+        per-batch re-coding into fresh dicts, exactly as before.
+
+        ``prop_columns`` merge when every batch carries them AND any
+        per-key dictionaries shared between batches are shared *objects*
+        (same snapshot+tail contract); otherwise the result drops them."""
         if len(batches) == 1:
             return batches[0]
+        shared = all(
+            b.event_dict is batches[0].event_dict
+            and b.entity_type_dict is batches[0].entity_type_dict
+            and b.entity_dict is batches[0].entity_dict
+            and b.target_dict is batches[0].target_dict
+            for b in batches[1:])
+        if shared:
+            b0 = batches[0]
+            return cls(
+                np.concatenate([b.event_codes for b in batches]),
+                np.concatenate([b.entity_type_codes for b in batches]),
+                np.concatenate([b.entity_ids for b in batches]),
+                np.concatenate([b.target_ids for b in batches]),
+                np.concatenate([b.times_us for b in batches]),
+                np.concatenate([b.ratings for b in batches]),
+                b0.event_dict, b0.entity_type_dict, b0.entity_dict,
+                b0.target_dict,
+                prop_columns=cls._concat_props(batches),
+            )
         event_dict, entity_type_dict = IdDict(), IdDict()
         entity_dict, target_dict = IdDict(), IdDict()
         cols: Dict[str, List[np.ndarray]] = {k: [] for k in ("ev", "et", "ei", "ti", "ts", "rt")}
@@ -291,6 +326,46 @@ class EventBatch:
             event_dict, entity_type_dict, entity_dict, target_dict,
         )
 
+    @staticmethod
+    def _concat_props(batches: Sequence["EventBatch"]
+                      ) -> Optional[Dict[str, "PropColumn"]]:
+        """Row-shifted merge of per-key property columns across batches.
+
+        Requires every batch to carry prop_columns, and any key present in
+        more than one batch to share its string dictionary OBJECT across
+        those batches (codes are then directly comparable).  Returns None
+        when the contract doesn't hold — callers treat that exactly like
+        the legacy "concat drops properties" behavior."""
+        if any(b.prop_columns is None for b in batches):
+            return None
+        offsets = np.cumsum([0] + [len(b) for b in batches])
+        keys: List[str] = []
+        for b in batches:
+            for k in b.prop_columns:
+                if k not in keys:
+                    keys.append(k)
+        out: Dict[str, PropColumn] = {}
+        for key in keys:
+            entries = [(offsets[i], b.prop_columns[key])
+                       for i, b in enumerate(batches)
+                       if key in b.prop_columns]
+            d = entries[0][1].dict
+            if any(c.dict is not d for _, c in entries[1:]):
+                return None
+            rows = np.concatenate([c.rows + off for off, c in entries])
+            kind = np.concatenate([c.kind for _, c in entries])
+            num = np.concatenate([c.num for _, c in entries])
+            code_base = np.cumsum(
+                [0] + [len(c.codes) for _, c in entries])
+            str_offs = np.concatenate(
+                [np.asarray([0], np.int64)]
+                + [c.str_offs[1:] + code_base[i]
+                   for i, (_, c) in enumerate(entries)])
+            codes = (np.concatenate([c.codes for _, c in entries])
+                     if code_base[-1] else np.empty(0, np.int32))
+            out[key] = PropColumn(rows, kind, num, str_offs, codes, d)
+        return out
+
     def subset(self, mask: np.ndarray) -> "EventBatch":
         """Row-filter by boolean mask; dictionaries are shared."""
         props = None
@@ -311,6 +386,243 @@ class EventBatch:
         codes = [c for c in codes if c is not None]
         mask = np.isin(self.event_codes, np.asarray(codes, np.int32))
         return self.subset(mask)
+
+
+class EventIdColumn:
+    """Per-row event ids as a flat byte blob + int64 offsets — the
+    mmap-able companion of an :class:`EventBatch` (the batch itself has no
+    id column; snapshots need one for tombstone deltas and integrity
+    checks).  ``blob`` holds the ids back to back; row j is
+    ``blob[offs[j]:offs[j+1]]``."""
+
+    __slots__ = ("blob", "offs", "_bytes")
+
+    def __init__(self, blob: np.ndarray, offs: np.ndarray):
+        self.blob = np.asarray(blob, np.uint8)
+        self.offs = np.asarray(offs, np.int64)
+        self._bytes: Optional[bytes] = None
+
+    @classmethod
+    def from_ids(cls, ids: Sequence[str]) -> "EventIdColumn":
+        encoded = [s.encode("utf-8", "surrogatepass") for s in ids]
+        offs = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(b) for b in encoded], out=offs[1:])
+        blob = np.frombuffer(b"".join(encoded), np.uint8).copy()
+        return cls(blob, offs)
+
+    def __len__(self) -> int:
+        return len(self.offs) - 1
+
+    def _materialize(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = self.blob.tobytes()
+        return self._bytes
+
+    def tolist(self) -> List[str]:
+        b = self._materialize()
+        offs = self.offs
+        return [b[offs[j]:offs[j + 1]].decode("utf-8", "surrogatepass")
+                for j in range(len(self))]
+
+    def index_of(self, event_id: str) -> int:
+        """Row of ``event_id`` or -1 — a C-speed substring scan validated
+        against the offset table (a raw hit inside a longer id is skipped)."""
+        needle = event_id.encode("utf-8", "surrogatepass")
+        if not needle:
+            return -1
+        blob = self._materialize()
+        start = 0
+        while True:
+            p = blob.find(needle, start)
+            if p < 0:
+                return -1
+            row = int(np.searchsorted(self.offs, p, side="left"))
+            if (row < len(self) and self.offs[row] == p
+                    and self.offs[row + 1] - p == len(needle)):
+                return row
+            start = p + 1
+
+    @classmethod
+    def concat(cls, columns: Sequence["EventIdColumn"]) -> "EventIdColumn":
+        if len(columns) == 1:
+            return columns[0]
+        blob = np.concatenate([np.asarray(c.blob, np.uint8) for c in columns])
+        offs = [np.asarray([0], np.int64)]
+        base = 0
+        for c in columns:
+            offs.append(np.asarray(c.offs[1:], np.int64) + base)
+            base += int(c.offs[-1])
+        return cls(blob, np.concatenate(offs))
+
+    def subset(self, mask: np.ndarray) -> "EventIdColumn":
+        idx = np.flatnonzero(mask)
+        lens = np.diff(self.offs)[idx]
+        offs = np.zeros(len(idx) + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        total = int(offs[-1])
+        if total == 0:
+            return EventIdColumn(np.empty(0, np.uint8), offs)
+        gather = np.arange(total, dtype=np.int64) + np.repeat(
+            self.offs[idx] - offs[:-1], lens)
+        return EventIdColumn(np.asarray(self.blob)[gather], offs)
+
+
+# -- persisted columnar container (snapshot files) ---------------------------
+#
+# Layout (all little-endian):
+#   bytes 0..7    magic  b"PIOCOL01"
+#   bytes 8..15   uint64 header length H
+#   bytes 16..16+H JSON header (column dtypes/offsets, string dictionaries,
+#                  per-key property columns, opaque meta)
+#   data blobs, each 64-byte aligned, at header-recorded offsets
+#
+# Loads are np.memmap views into the file — no parse, no copy; the OS pages
+# columns in at device-fill speed.  String dictionaries live in the JSON
+# header (they must become Python strings anyway to rebuild IdDicts).
+
+_COLUMNAR_MAGIC = b"PIOCOL01"
+_ALIGN = 64
+
+
+def _spec(arrays: List[np.ndarray], pos: int, arr: np.ndarray,
+          dtype: str) -> Tuple[Dict, int]:
+    arr = np.ascontiguousarray(arr)
+    pos = (pos + _ALIGN - 1) // _ALIGN * _ALIGN
+    arrays.append(arr)
+    return {"dtype": dtype, "n": int(arr.shape[0]), "off": pos}, pos + arr.nbytes
+
+
+def write_batch(path, batch: EventBatch,
+                event_ids: Optional[EventIdColumn] = None,
+                meta: Optional[Dict] = None) -> None:
+    """Serialize ``batch`` (+ optional id column) into one columnar file.
+
+    The write is flush+fsync'd but NOT atomic — callers own the tmp +
+    rename two-phase (see storage.snapshot)."""
+    arrays: List[np.ndarray] = []
+    pos = 0
+    cols = {}
+    for name, arr, dt in (
+        ("event_codes", batch.event_codes, "<i4"),
+        ("entity_type_codes", batch.entity_type_codes, "<i4"),
+        ("entity_ids", batch.entity_ids, "<i4"),
+        ("target_ids", batch.target_ids, "<i4"),
+        ("times_us", batch.times_us, "<i8"),
+        ("ratings", batch.ratings, "<f4"),
+    ):
+        cols[name], pos = _spec(arrays, pos, np.asarray(arr).astype(dt), dt)
+    ids_entry = None
+    if event_ids is not None:
+        blob_spec, pos = _spec(arrays, pos,
+                               np.asarray(event_ids.blob, np.uint8), "|u1")
+        offs_spec, pos = _spec(arrays, pos,
+                               np.asarray(event_ids.offs).astype("<i8"), "<i8")
+        ids_entry = {"blob": blob_spec, "offs": offs_spec}
+    props_entry = []
+    for key, col in (batch.prop_columns or {}).items():
+        entry: Dict = {"dict": col.dict.to_state()}
+        for name, arr, dt in (
+            ("rows", col.rows, "<i8"), ("kind", col.kind, "|i1"),
+            ("num", col.num, "<f8"), ("str_offs", col.str_offs, "<i8"),
+            ("codes", col.codes, "<i4"),
+        ):
+            entry[name], pos = _spec(arrays, pos,
+                                     np.asarray(arr).astype(dt), dt)
+        props_entry.append([key, entry])
+    header = {
+        "rows": len(batch),
+        "cols": cols,
+        "ids": ids_entry,
+        "dicts": {
+            "event": batch.event_dict.to_state(),
+            "entity_type": batch.entity_type_dict.to_state(),
+            "entity": batch.entity_dict.to_state(),
+            "target": batch.target_dict.to_state(),
+        },
+        "props": props_entry,
+        "meta": meta or {},
+    }
+    import json as _json
+    import os as _os
+
+    hdr = _json.dumps(header, separators=(",", ":")).encode()
+    data_base = 16 + len(hdr)
+    with open(path, "wb") as f:
+        f.write(_COLUMNAR_MAGIC)
+        f.write(len(hdr).to_bytes(8, "little"))
+        f.write(hdr)
+        at = data_base
+        for arr in arrays:
+            # specs recorded offsets relative to the data region start;
+            # pad from the current absolute position to the next one
+            spec_off = (at - data_base + _ALIGN - 1) // _ALIGN * _ALIGN
+            f.write(b"\0" * (data_base + spec_off - at))
+            f.write(arr.tobytes())
+            at = data_base + spec_off + arr.nbytes
+        f.flush()
+        _os.fsync(f.fileno())
+
+
+def read_batch(path, mmap: bool = True
+               ) -> Tuple[EventBatch, Optional[EventIdColumn], Dict]:
+    """Load a columnar file → (batch, ids-or-None, meta).
+
+    ``mmap=True`` returns lazy views (GB/s cold loads); columns are
+    read-only.  Raises ValueError on a torn/corrupt file — callers
+    quarantine and rebuild."""
+    import json as _json
+
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    if mm.shape[0] < 16 or bytes(mm[:8]) != _COLUMNAR_MAGIC:
+        raise ValueError(f"{path}: not a columnar snapshot (bad magic)")
+    hlen = int.from_bytes(bytes(mm[8:16]), "little")
+    if 16 + hlen > mm.shape[0]:
+        raise ValueError(f"{path}: truncated header")
+    try:
+        header = _json.loads(bytes(mm[16:16 + hlen]))
+    except (UnicodeDecodeError, _json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: corrupt header: {e}") from None
+    data_base = 16 + hlen
+
+    def view(spec) -> np.ndarray:
+        dt = np.dtype(spec["dtype"])
+        a, b = data_base + spec["off"], data_base + spec["off"] + spec["n"] * dt.itemsize
+        if b > mm.shape[0]:
+            raise ValueError(f"{path}: truncated column data")
+        arr = mm[a:b].view(dt)
+        return arr if mmap else np.array(arr)
+
+    c = header["cols"]
+    d = header["dicts"]
+    props: Dict[str, PropColumn] = {}
+    for key, entry in header.get("props", []):
+        props[key] = PropColumn(
+            rows=view(entry["rows"]), kind=view(entry["kind"]),
+            num=view(entry["num"]), str_offs=view(entry["str_offs"]),
+            codes=view(entry["codes"]),
+            dict=IdDict.from_state(entry["dict"]))
+    batch = EventBatch(
+        event_codes=view(c["event_codes"]),
+        entity_type_codes=view(c["entity_type_codes"]),
+        entity_ids=view(c["entity_ids"]),
+        target_ids=view(c["target_ids"]),
+        times_us=view(c["times_us"]),
+        ratings=view(c["ratings"]),
+        event_dict=IdDict.from_state(d["event"]),
+        entity_type_dict=IdDict.from_state(d["entity_type"]),
+        entity_dict=IdDict.from_state(d["entity"]),
+        target_dict=IdDict.from_state(d["target"]),
+        prop_columns=props,
+    )
+    if len(batch) != header["rows"]:
+        raise ValueError(f"{path}: row-count mismatch")
+    ids = None
+    if header.get("ids"):
+        ids = EventIdColumn(view(header["ids"]["blob"]),
+                            view(header["ids"]["offs"]))
+        if len(ids) != len(batch):
+            raise ValueError(f"{path}: id column length mismatch")
+    return batch, ids, header.get("meta", {})
 
 
 def fold_properties(batch: EventBatch, entity_type: Optional[str] = None):

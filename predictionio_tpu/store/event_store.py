@@ -13,6 +13,9 @@ reference's ``Common.appNameToId``.
 from __future__ import annotations
 
 import datetime as _dt
+import os
+import threading
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.events.event import Event, PropertyMap
@@ -35,6 +38,99 @@ def _app_channel_ids(
             raise ValueError(f"channel {channel_name!r} does not exist for app {app_name!r}")
         channel_id = chan.id
     return app.id, channel_id
+
+
+def _delta_staging_enabled() -> bool:
+    """PIO_DELTA_STAGING=off disables the retained-batch retrain cache."""
+    return os.environ.get("PIO_DELTA_STAGING", "").lower() not in (
+        "off", "0", "false")
+
+
+class _StagedCache:
+    """Process-level retained staging batches for delta-aware retrain.
+
+    Keyed by the channel's directory identity; each entry retains the
+    UNFILTERED columnar batch of the whole log plus the per-segment byte
+    watermark and tombstone set it reflects.  A retrain in the same
+    process (bench loops, deploy --auto-reload trainers, programmatic
+    pipelines) re-stages ONLY events past the watermark and splices them
+    in via the shared-dict concat fast path; any tombstone or log-shape
+    change invalidates the entry (full restage).  Entries only exist for
+    stores with a snapshot layer — the snapshot supplies the watermark.
+    """
+
+    MAX_ENTRIES = 4
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+
+    def staged_batch(self, backend, app_id: int,
+                     channel_id: Optional[int]) -> Optional[EventBatch]:
+        """Serve the full columnar batch for (app, channel) from the
+        retained entry + delta, else from the backend's snapshot_scan
+        (populating the entry), else None."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        key = str(backend._chan_dir(app_id, channel_id)) if hasattr(
+            backend, "_chan_dir") else f"{id(backend)}/{app_id}/{channel_id}"
+        use_cache = _delta_staging_enabled()
+        with self._lock:
+            ent = self._entries.get(key) if use_cache else None
+            if ent is not None:
+                tomb = backend.tombstone_state(app_id, channel_id)
+                if tomb == ent["tombstones"]:
+                    tail = backend.scan_tail_from(
+                        app_id, channel_id, ent["watermark"],
+                        base=ent["batch"], heads=ent["heads"])
+                    if tail is not None:
+                        if tail["events"]:
+                            ent["batch"] = EventBatch.concat(
+                                [ent["batch"], tail["batch"]])
+                            _snap.record_delta(tail["events"])
+                        ent["watermark"] = tail["watermark"]
+                        ent["heads"] = tail["heads"]
+                        self._entries.move_to_end(key)
+                        _snap.record_hit()
+                        return ent["batch"]
+                self._entries.pop(key, None)   # stale: full restage below
+            tomb = (backend.tombstone_state(app_id, channel_id)
+                    if hasattr(backend, "tombstone_state") else frozenset())
+            res = backend.snapshot_scan(app_id, channel_id)
+            if res is None:
+                return None
+            if use_cache:
+                self._entries[key] = {
+                    "batch": res["batch"],
+                    "watermark": res["watermark"],
+                    "heads": res.get("heads", {}),
+                    "tombstones": tomb,
+                }
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.MAX_ENTRIES:
+                    self._entries.popitem(last=False)
+            return res["batch"]
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_STAGED = _StagedCache()
+
+
+def invalidate_staging_cache() -> None:
+    """Drop every retained staging batch (tests; manual memory release)."""
+    _STAGED.invalidate()
+
+
+def staging_counts() -> Dict[str, float]:
+    """Cumulative staged-event counters by mode (snapshot/tail/delta) —
+    run_train diffs these around Engine.train to report exactly how many
+    events a (re)train actually staged from where."""
+    from predictionio_tpu.storage import snapshot as _snap
+
+    return _snap.staged_counts()
 
 
 class PEventStore:
@@ -133,16 +229,28 @@ class PEventStore:
         app_name, channel_name, event_names, entity_type,
         start_time, until_time, storage, local_shard=False,
     ) -> Optional[EventBatch]:
-        import numpy as np
-
         backend = storage.p_events
         if not hasattr(backend, "segment_paths"):
             return None
+        from predictionio_tpu.storage import snapshot as _snap
+
+        app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
+        if not local_shard:
+            # snapshot-first: a retained staged batch (delta retrain) or a
+            # persisted columnar snapshot + JSONL tail serves the whole
+            # batch at mmap speed, tombstones already honored.  Sharded
+            # multi-host reads partition raw segments instead (every
+            # process passes the same local_shard, so the strategy choice
+            # stays globally consistent).
+            staged = _STAGED.staged_batch(backend, app_id, channel_id)
+            if staged is not None:
+                return _snap.apply_filters(
+                    staged, event_names=event_names, entity_type=entity_type,
+                    start_time=start_time, until_time=until_time)
         from predictionio_tpu.native import native_available, scan_segments
 
         if not native_available():
             return None
-        app_id, channel_id = _app_channel_ids(app_name, channel_name, storage)
         paths = backend.segment_paths(app_id, channel_id)
         if not paths:
             return EventBatch.from_events([])
@@ -161,20 +269,10 @@ class PEventStore:
             paths = dist.shard_segments(paths)
             if not paths:
                 return EventBatch.from_events([])
-        batch = scan_segments(paths)
-        mask = np.ones(len(batch), bool)
-        if event_names is not None:
-            codes = [batch.event_dict.id(n) for n in event_names]
-            codes = [c for c in codes if c is not None]
-            mask &= np.isin(batch.event_codes, np.asarray(codes, np.int32))
-        if entity_type is not None:
-            c = batch.entity_type_dict.id(entity_type)
-            mask &= batch.entity_type_codes == (c if c is not None else -2)
-        if start_time is not None:
-            mask &= batch.times_us >= int(start_time.timestamp() * 1e6)
-        if until_time is not None:
-            mask &= batch.times_us < int(until_time.timestamp() * 1e6)
-        return batch.subset(mask) if not mask.all() else batch
+        return _snap.apply_filters(
+            scan_segments(paths), event_names=event_names,
+            entity_type=entity_type, start_time=start_time,
+            until_time=until_time)
 
     @staticmethod
     def aggregate_properties(

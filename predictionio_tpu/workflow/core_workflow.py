@@ -33,6 +33,20 @@ _M_TRAIN_S = _REG.histogram(
     "pio_train_duration_seconds", "Wall-clock duration of training runs")
 _M_EVALS = _REG.counter(
     "pio_eval_runs_total", "Evaluation runs by final status")
+_M_TRAIN_STAGED = _REG.counter(
+    "pio_train_staged_events_total",
+    "Events staged during training runs, by source: snapshot = mmap'd "
+    "columns, tail = JSONL past snapshot coverage, delta = JSONL past a "
+    "retained batch's watermark (delta-aware retrain)")
+
+
+def _staging_delta(before):
+    """Per-mode staged-event counts accrued since ``before`` (a
+    store.event_store.staging_counts snapshot)."""
+    from predictionio_tpu.store.event_store import staging_counts
+
+    after = staging_counts()
+    return {mode: after[mode] - before.get(mode, 0.0) for mode in after}
 
 
 def _now() -> _dt.datetime:
@@ -94,8 +108,24 @@ def run_train(
                 try:
                     log.info("training engine %s (instance %s, attempt %d)",
                              engine_id, instance_id, attempt + 1)
+                    from predictionio_tpu.store.event_store import staging_counts
+
+                    stage_before = staging_counts()
                     with journal.span("engine_train", attempt=attempt + 1):
                         models = engine.train(engine_params)
+                    # delta-aware retrain accounting: how many events this
+                    # run staged from where (mmap'd snapshot vs parsed
+                    # tail vs past-watermark delta) — recorded as a span
+                    # attribute per run and a cross-run counter.  An
+                    # all-zero read means the engine staged through a
+                    # non-snapshot path (memory/sql/native full scan).
+                    staged = _staging_delta(stage_before)
+                    with journal.span("staging_summary", **{
+                            f"staged_{k}": int(v) for k, v in staged.items()}):
+                        pass
+                    for mode, v in staged.items():
+                        if v:
+                            _M_TRAIN_STAGED.inc(v, mode=mode)
                     with journal.span("save_models"):
                         persistence.save_models(storage, instance_id, models)
                     instance.status = "COMPLETED"

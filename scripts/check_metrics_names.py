@@ -32,6 +32,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.api.event_server",
     "predictionio_tpu.api.dashboard",
     "predictionio_tpu.storage.localfs",
+    "predictionio_tpu.storage.snapshot",
     "predictionio_tpu.workflow.core_workflow",
     "predictionio_tpu.workflow.create_server",
 ]

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Verify columnar event-store snapshots against their JSONL ground truth.
+
+For every ``snapshot/manifest.json`` under a localfs/sharedfs store root
+(``<root>/events/app_*/<channel>/snapshot/``):
+
+- re-parse each covered byte range of each covered segment, drop the
+  tombstone ids the manifest says were applied, and diff the resulting
+  event COUNT against both the manifest's event-count watermark and the
+  snapshot file's row count;
+- diff the re-derived eventId SET against the snapshot's id column;
+- row-verify a sample prefix: event verb, entityType, entityId, target
+  and timestamp columns must decode back to exactly what the JSONL says.
+
+Exit 0 = every snapshot matches; 1 = any diff (printed).  Run standalone
+(``python scripts/check_snapshot_integrity.py <store_root>...``) or via
+the tier-1 suite (tests/test_snapshot.py wraps it), like
+check_metrics_names.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+# runnable from any cwd without an installed package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SAMPLE_ROWS = 500
+
+
+def check_channel(d: Path) -> list:
+    """Problems for one channel dir with a snapshot (empty = clean)."""
+    from predictionio_tpu.store.columnar import read_batch
+    from predictionio_tpu.storage.snapshot import load_manifest
+
+    problems = []
+    m = load_manifest(d)
+    if m is None:
+        return [f"{d}: unreadable/invalid manifest"]
+    try:
+        batch, ids, _meta = read_batch(d / "snapshot" / m["snapshot"])
+    except (OSError, ValueError) as e:
+        return [f"{d}: snapshot unreadable: {e}"]
+    if ids is None:
+        return [f"{d}: snapshot has no id column"]
+    applied = set(m.get("tombstones_applied", ()))
+    truth = []   # wire dicts in builder order (sorted covered segments)
+    for name in sorted(m["covered"]):
+        end = m["covered"][name]
+        seg = d / name
+        if not seg.exists():
+            problems.append(f"{d}: covered segment {name} missing "
+                            "(stale manifest — snapshot would be bypassed)")
+            continue
+        with open(seg, "rb") as f:
+            data = f.read(end)
+        for line in data.split(b"\n"):
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            if ev.get("eventId") in applied:
+                continue
+            truth.append(ev)
+    if len(truth) != m.get("events"):
+        problems.append(
+            f"{d}: JSONL recount {len(truth)} != manifest watermark "
+            f"{m.get('events')}")
+    if len(batch) != len(truth):
+        problems.append(
+            f"{d}: snapshot rows {len(batch)} != JSONL recount {len(truth)}")
+    id_truth = {e.get("eventId") for e in truth}
+    id_snap = set(ids.tolist())
+    if id_truth != id_snap:
+        missing = list(id_truth - id_snap)[:3]
+        extra = list(id_snap - id_truth)[:3]
+        problems.append(
+            f"{d}: eventId set diff (missing {missing}, extra {extra})")
+    from predictionio_tpu.events.event import parse_time
+
+    for j, ev in enumerate(truth[:SAMPLE_ROWS]):
+        if j >= len(batch):
+            break
+        got = (
+            batch.event_dict.str(int(batch.event_codes[j])),
+            batch.entity_type_dict.str(int(batch.entity_type_codes[j])),
+            batch.entity_dict.str(int(batch.entity_ids[j])),
+            (batch.target_dict.str(int(batch.target_ids[j]))
+             if batch.target_ids[j] >= 0 else None),
+            int(batch.times_us[j]),
+        )
+        want = (
+            ev["event"], ev["entityType"], str(ev["entityId"]),
+            (str(ev["targetEntityId"])
+             if ev.get("targetEntityId") is not None else None),
+            int(parse_time(ev["eventTime"]).timestamp() * 1e6),
+        )
+        if got != want:
+            problems.append(f"{d}: row {j} mismatch: {got} != {want}")
+            break
+    return problems
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_snapshot_integrity.py <store_root>...",
+              file=sys.stderr)
+        return 2
+    problems = []
+    checked = 0
+    for root in argv:
+        events = Path(root) / "events"
+        for manifest in sorted(events.glob("app_*/*/snapshot/manifest.json")):
+            checked += 1
+            problems.extend(check_channel(manifest.parent.parent))
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    if not problems:
+        print(f"ok: {checked} snapshot(s) match their JSONL ground truth")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
