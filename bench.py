@@ -621,6 +621,15 @@ def _snapshot_model_parity(storage, n_events: int) -> str:
     storage.l_events.build_snapshot(app_id)
     with_snap = run()
     return "ok" if baseline == with_snap else "MISMATCH"
+
+
+def bench_ingest(smoke: bool) -> dict:
+    """Single-worker HTTP ingest: concurrent-free batch posts, raw
+    keep-alive single events, and the SDK's serial + pipelined clients
+    against one live event server.  (The ``def`` line was lost in the
+    PR-3 refactor, orphaning this body as dead code under
+    _snapshot_model_parity — every bench since recorded the section as
+    failed with a NameError.)"""
     import os
     import shutil
     import tempfile
@@ -1104,6 +1113,90 @@ def bench_ingest_scaling(smoke: bool) -> dict:
     return out
 
 
+def _fabricate_ur_serving_store(tmp: str, n_items: int, n_users: int,
+                                k: int, engine_id: str, app_name: str):
+    """Shared serving-bench fixture: a localfs store seeded with user
+    histories, a fabricated 100k-scale URModel (production dtypes/padding
+    + a modest category property map so business-rule queries exercise
+    the mask cache), persisted through the normal run_train machinery
+    (train bypassed), and an engine.json pointing at it.  Returns
+    (storage, engine_json_path).  Serving cost depends only on the model
+    tables, so fabrication keeps the section accelerator-independent."""
+    import numpy as np
+
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+    from predictionio_tpu.models.universal_recommender.engine import URModel
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.store.columnar import CSRLookup, IdDict
+    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
+    from predictionio_tpu.workflow import core_workflow
+
+    storage = Storage(StorageConfig(
+        sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
+        repositories={r: "FS" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
+    ))
+    set_storage(storage)
+    rng = np.random.default_rng(9)
+    app_id = storage.apps.insert(App(0, app_name))
+    evs = []
+    for u in range(n_users):
+        for name, n_ev in (("buy", 3), ("view", 4)):
+            for it in rng.integers(0, n_items, n_ev):
+                evs.append(Event(
+                    event=name, entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{it}"))
+    for s in range(0, len(evs), 20_000):
+        storage.l_events.insert_batch(evs[s:s + 20_000], app_id)
+
+    item_dict = IdDict([f"i{j}" for j in range(n_items)])
+    user_dict = IdDict([f"u{j}" for j in range(n_users)])
+
+    def tables():
+        idx = rng.integers(0, n_items, (n_items, k)).astype(np.int32)
+        llr = np.sort(rng.random((n_items, k)).astype(np.float32) * 10,
+                      axis=1)[:, ::-1].copy()
+        idx[:, -2:] = -1          # production models carry -1 padding
+        return idx, llr
+
+    bi, bl = tables()
+    vi, vl = tables()
+    pu = rng.integers(0, n_users, 4 * n_users)
+    pi = rng.integers(0, n_items, 4 * n_users)
+    # category properties on a 1k-item sample: enough for field-rule
+    # queries (the serve_scale parity corpus) without a 100k-entry dict
+    props = {f"i{j}": {"category": f"c{j % 7}"}
+             for j in range(0, n_items, max(1, n_items // 1000))}
+    model = URModel(
+        primary_event="buy", item_dict=item_dict, user_dict=user_dict,
+        indicator_idx={"buy": bi, "view": vi},
+        indicator_llr={"buy": bl, "view": vl},
+        event_item_dicts={"buy": item_dict, "view": item_dict},
+        popularity=rng.random(n_items).astype(np.float32),
+        item_properties=props,
+        user_seen=CSRLookup.from_pairs(pu, pi, n_users),
+    )
+    variant = {
+        "id": engine_id,
+        "engineFactory":
+            "predictionio_tpu.models.universal_recommender.UniversalRecommenderEngine",
+        "datasource": {"params": {"appName": app_name,
+                                  "eventNames": ["buy", "view"]}},
+        "algorithms": [{"name": "ur", "params": {
+            "appName": app_name, "eventNames": [], "meshDp": 1}}],
+    }
+    ur_json = f"{tmp}/{engine_id}-engine.json"
+    with open(ur_json, "w") as f:
+        json.dump(variant, f)
+    engine = UniversalRecommenderEngine.apply()
+    ep = engine.engine_params_from_variant(variant)
+    engine.train = lambda _ep: [model]     # serving bench: skip training
+    core_workflow.run_train(engine, ep, engine_id=engine_id, storage=storage)
+    return storage, ur_json
+
+
 def bench_serve100k(smoke: bool) -> dict:
     """HTTP serving p50/p95 at the FULL 100k-item catalog (VERDICT r4
     weak #4: never recorded off-tunnel).  Training a 100k-item CCO model
@@ -1123,15 +1216,7 @@ def bench_serve100k(smoke: bool) -> dict:
 
     import numpy as np
 
-    from predictionio_tpu.events.event import Event
-    from predictionio_tpu.models.universal_recommender import (
-        UniversalRecommenderEngine,
-    )
-    from predictionio_tpu.models.universal_recommender.engine import URModel
-    from predictionio_tpu.storage import App
-    from predictionio_tpu.store.columnar import CSRLookup, IdDict
-    from predictionio_tpu.storage.locator import Storage, StorageConfig, set_storage
-    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.storage.locator import set_storage
     from predictionio_tpu.workflow.create_server import deploy
 
     if smoke:
@@ -1140,63 +1225,8 @@ def bench_serve100k(smoke: bool) -> dict:
         n_items, n_users, k, n_q = 100_000, 5_000, 50, 100
     tmp = tempfile.mkdtemp(prefix="pio_bench_100k")
     try:
-        storage = Storage(StorageConfig(
-            sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
-            repositories={r: "FS" for r in ("METADATA", "EVENTDATA", "MODELDATA")},
-        ))
-        set_storage(storage)
-        rng = np.random.default_rng(9)
-        app_id = storage.apps.insert(App(0, "bench100k"))
-        evs = []
-        for u in range(n_users):
-            for name, n_ev in (("buy", 3), ("view", 4)):
-                for it in rng.integers(0, n_items, n_ev):
-                    evs.append(Event(
-                        event=name, entity_type="user", entity_id=f"u{u}",
-                        target_entity_type="item", target_entity_id=f"i{it}"))
-        for s in range(0, len(evs), 20_000):
-            storage.l_events.insert_batch(evs[s:s + 20_000], app_id)
-
-        item_dict = IdDict([f"i{j}" for j in range(n_items)])
-        user_dict = IdDict([f"u{j}" for j in range(n_users)])
-
-        def tables():
-            idx = rng.integers(0, n_items, (n_items, k)).astype(np.int32)
-            llr = np.sort(rng.random((n_items, k)).astype(np.float32) * 10,
-                          axis=1)[:, ::-1].copy()
-            idx[:, -2:] = -1          # production models carry -1 padding
-            return idx, llr
-
-        bi, bl = tables()
-        vi, vl = tables()
-        pu = rng.integers(0, n_users, 4 * n_users)
-        pi = rng.integers(0, n_items, 4 * n_users)
-        model = URModel(
-            primary_event="buy", item_dict=item_dict, user_dict=user_dict,
-            indicator_idx={"buy": bi, "view": vi},
-            indicator_llr={"buy": bl, "view": vl},
-            event_item_dicts={"buy": item_dict, "view": item_dict},
-            popularity=rng.random(n_items).astype(np.float32),
-            item_properties={},
-            user_seen=CSRLookup.from_pairs(pu, pi, n_users),
-        )
-        variant = {
-            "id": "bench-ur-100k",
-            "engineFactory":
-                "predictionio_tpu.models.universal_recommender.UniversalRecommenderEngine",
-            "datasource": {"params": {"appName": "bench100k",
-                                      "eventNames": ["buy", "view"]}},
-            "algorithms": [{"name": "ur", "params": {
-                "appName": "bench100k", "eventNames": [], "meshDp": 1}}],
-        }
-        ur_json = f"{tmp}/ur100k-engine.json"
-        with open(ur_json, "w") as f:
-            json.dump(variant, f)
-        engine = UniversalRecommenderEngine.apply()
-        ep = engine.engine_params_from_variant(variant)
-        engine.train = lambda _ep: [model]     # serving bench: skip training
-        core_workflow.run_train(engine, ep, engine_id="bench-ur-100k",
-                                storage=storage)
+        storage, ur_json = _fabricate_ur_serving_store(
+            tmp, n_items, n_users, k, "bench-ur-100k", "bench100k")
         httpd = deploy(engine_json=ur_json, host="127.0.0.1", port=0,
                        storage=storage, background=True)
         try:
@@ -1217,6 +1247,7 @@ def bench_serve100k(smoke: bool) -> dict:
             httpd.server_close()
         from predictionio_tpu.models.universal_recommender.engine import (
             _serve_scorer,
+            _serve_tail,
         )
 
         return {
@@ -1225,8 +1256,238 @@ def bench_serve100k(smoke: bool) -> dict:
             "serve100k_catalog_items": n_items,
             "predict_p50_100k_basis":
                 f"http_queries_json_ur_synthetic_model_"
-                f"{_serve_scorer()}_scorer",
+                f"{_serve_scorer()}_scorer_{_serve_tail()}_tail",
         }
+    finally:
+        set_storage(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _measure_qps_latency(port: int, bodies, seconds: float, workers: int):
+    """Sustained concurrent load with per-request latencies: each worker
+    holds ONE keep-alive connection (what the shipped EngineClient does
+    per thread).  Returns (qps, p50_ms, p95_ms, n_requests)."""
+    import contextlib
+    import threading
+
+    stop = time.perf_counter() + seconds
+    lat_ms = [[] for _ in range(workers)]
+    errors: list = []
+
+    def worker(w):
+        try:
+            with contextlib.closing(_keepalive_query_conn(port)) as conn:
+                q = w
+                while time.perf_counter() < stop:
+                    t0 = time.perf_counter()
+                    status, body = _conn_post(conn, bodies[q % len(bodies)])
+                    lat_ms[w].append((time.perf_counter() - t0) * 1e3)
+                    if status != 200:
+                        raise AssertionError(f"HTTP {status}: {body}")
+                    q += workers
+        except Exception as e:   # surfaced after join, not swallowed
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    lat = np.concatenate([np.asarray(x) for x in lat_ms if x]) \
+        if any(lat_ms) else np.zeros(1)
+    return (sum(len(x) for x in lat_ms) / wall,
+            float(np.percentile(lat, 50)), float(np.percentile(lat, 95)),
+            sum(len(x) for x in lat_ms))
+
+
+def bench_serve_scale(smoke: bool) -> dict:
+    """Multi-worker query serving (the serving twin of ingest_scale): a
+    REAL ``pio deploy --workers N`` CLI subprocess per cell — prefork
+    SO_REUSEPORT listeners over the fabricated 100k-item UR model —
+    swept over workers × concurrent keep-alive clients ×
+    PIO_SERVE_BATCH ∈ {off, auto}, recording p50/p95/qps per cell.
+
+    Every cell FIRST replays a fixed query corpus (users, cold users,
+    field filters/boosts, blacklists) over one connection and diffs the
+    responses exactly against the first cell — the throughput numbers
+    double as a cross-worker/cross-batch-mode response-parity proof.
+    One /metrics scrape per worker count records the serve-tail stage
+    breakdown (pio_ur_serve_stage_duration_seconds, aggregated across
+    the prefork group)."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from predictionio_tpu.obs.exposition import (
+        family_total,
+        parse_prometheus_text,
+    )
+    from predictionio_tpu.storage.locator import set_storage
+
+    if smoke:
+        worker_counts, client_counts = (1, 2), (2, 4)
+        n_items, n_users, k, secs = 800, 200, 8, 0.8
+    elif _cpu_reduced():
+        worker_counts, client_counts = (1, 2, 4), (8, 32)
+        n_items, n_users, k, secs = 20_000, 2_000, 50, 2.0
+    else:
+        worker_counts, client_counts = (1, 2, 4), (8, 32)
+        n_items, n_users, k, secs = 100_000, 5_000, 50, 3.0
+    # deploy --workers requires the CPU backend, where auto resolves to
+    # off — the auto cells document that resolution; the "on" cells force
+    # the micro-batcher so batching-vs-not is actually measured
+    batch_modes = ("off", "auto", "on")
+    tmp = tempfile.mkdtemp(prefix="pio_bench_servescale")
+    out: dict = {
+        "serve_scale_catalog_items": n_items,
+        "serve_scale_parity": "not_run",
+    }
+    try:
+        _storage, ur_json = _fabricate_ur_serving_store(
+            tmp, n_items, n_users, k, "bench-serve-scale", "servescale")
+        env_base = {
+            **os.environ,
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": f"{tmp}/store",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            "PIO_JAX_PLATFORM": os.environ.get("PIO_JAX_PLATFORM", "cpu"),
+            "PIO_METRICS_FLUSH_S": "0.25",
+        }
+        # the parity corpus: every rule shape the mask cache serves, with
+        # enough repetition that steady-state cells run on cache hits
+        corpus = [{"user": f"u{(j * 13) % n_users}", "num": 10}
+                  for j in range(24)]
+        corpus += [{"user": f"cold{j}", "num": 10} for j in range(4)]
+        corpus += [{"user": f"u{j}", "num": 10,
+                    "fields": [{"name": "category",
+                                "values": [f"c{j % 7}"], "bias": -1}]}
+                   for j in range(8)]
+        corpus += [{"user": f"u{j}", "num": 10,
+                    "fields": [{"name": "category",
+                                "values": ["c1", "c3"], "bias": 2.0}]}
+                   for j in range(4)]
+        corpus += [{"user": f"u{j}", "num": 10,
+                    "blacklistItems": [f"i{j}", f"i{j + 1}"]}
+                   for j in range(4)]
+        reference = None
+        for workers in worker_counts:
+            for mode in batch_modes:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                env = {**env_base, "PIO_SERVE_BATCH": mode}
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "predictionio_tpu.cli.main",
+                     "deploy", "--engine-json", ur_json,
+                     "--ip", "127.0.0.1", "--port", str(port),
+                     "--workers", str(workers)],
+                    env=env)
+                base = f"http://127.0.0.1:{port}"
+                try:
+                    # readiness: poll fresh connections until every
+                    # prefork worker's pid has answered GET /
+                    deadline = time.time() + 180
+                    pids: set = set()
+                    while len(pids) < workers:
+                        try:
+                            with urllib.request.urlopen(
+                                    base + "/", timeout=2) as r:
+                                pids.add(json.loads(r.read()).get("pid"))
+                        except Exception:
+                            pass
+                        if proc.poll() is not None:
+                            raise RuntimeError(
+                                f"deploy --workers {workers} died at "
+                                f"startup (rc {proc.returncode})")
+                        if time.time() > deadline:
+                            raise RuntimeError(
+                                f"only {len(pids)}/{workers} query workers "
+                                "came up within 180s")
+                        if len(pids) < workers:
+                            time.sleep(0.1)
+                    # response parity: the fixed corpus must answer
+                    # identically in EVERY cell (workers × batch mode)
+                    import contextlib
+
+                    with contextlib.closing(
+                            _keepalive_query_conn(port)) as conn:
+                        got = []
+                        for body in corpus:
+                            status, resp = _conn_post(conn, body)
+                            assert status == 200, resp
+                            # raw floats: JSON round-trips them exactly,
+                            # so cross-cell parity is EXACT, not rounded
+                            got.append([(r["item"], r["score"])
+                                        for r in resp["itemScores"]])
+                    cell = f"w{workers}_{mode}"
+                    if reference is None:
+                        reference = got
+                        out["serve_scale_parity"] = "ok"
+                    elif got != reference:
+                        bad = next(i for i, (g, w) in
+                                   enumerate(zip(got, reference)) if g != w)
+                        out["serve_scale_parity"] = (
+                            f"MISMATCH at {cell} corpus #{bad}")
+                    for c in client_counts:
+                        qps, p50, p95, n = _measure_qps_latency(
+                            port, corpus, secs, c)
+                        out[f"serve_scale_{cell}_c{c}_qps"] = qps
+                        out[f"serve_scale_{cell}_c{c}_p50_ms"] = p50
+                        out[f"serve_scale_{cell}_c{c}_p95_ms"] = p95
+                    # serve-tail stage breakdown, aggregated across the
+                    # worker group by the /metrics cross-worker merge
+                    if mode == "off":
+                        with urllib.request.urlopen(
+                                base + "/metrics", timeout=10) as r:
+                            fams, _ = parse_prometheus_text(r.read().decode())
+                        stages = {}
+                        for stage in ("history", "score", "mask", "topk",
+                                      "assemble"):
+                            cnt = family_total(
+                                fams,
+                                "pio_ur_serve_stage_duration_seconds_count",
+                                stage=stage)
+                            tot = family_total(
+                                fams,
+                                "pio_ur_serve_stage_duration_seconds_sum",
+                                stage=stage)
+                            if cnt:
+                                stages[stage] = round(tot / cnt * 1e3, 4)
+                        out[f"serve_scale_w{workers}_tail_stage_avg_ms"] = (
+                            stages)
+                finally:
+                    # graceful /stop fan-in (undeploy-style), then escalate
+                    for _ in range(16):
+                        try:
+                            with urllib.request.urlopen(
+                                    base + "/stop", timeout=5) as r:
+                                r.read()
+                            time.sleep(0.3)
+                        except Exception:
+                            break
+                    try:
+                        proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+        w1 = out.get(f"serve_scale_w1_off_c{client_counts[-1]}_qps", 0.0)
+        wmax = out.get(
+            f"serve_scale_w{worker_counts[-1]}_off_"
+            f"c{client_counts[-1]}_qps", 0.0)
+        out["serve_scale_speedup_wmax_vs_w1"] = wmax / w1 if w1 else 0.0
+        return out
     finally:
         set_storage(None)
         shutil.rmtree(tmp, ignore_errors=True)
@@ -1545,7 +1806,8 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
     ap.add_argument("--only",
                     choices=["ur", "p50", "als", "scan", "http", "scale", "ingest",
-                             "ingest_scale", "serve100k", "snapshot"],
+                             "ingest_scale", "serve100k", "serve_scale",
+                             "snapshot"],
                     default=None)
     ap.add_argument("--scale", action="store_true",
                     help="run only the 1B-scale tiled-path slice")
@@ -1578,6 +1840,7 @@ def main() -> int:
             "ingest": lambda: bench_ingest(args.smoke),
             "ingest_scale": lambda: bench_ingest_scaling(args.smoke),
             "serve100k": lambda: bench_serve100k(args.smoke),
+            "serve_scale": lambda: bench_serve_scale(args.smoke),
             "snapshot": lambda: bench_snapshot(args.smoke),
         }[args.only]()
         print(json.dumps(out))
@@ -1635,6 +1898,11 @@ def main() -> int:
         "predict_p50_100k_ms": 0.0, "predict_p95_100k_ms": 0.0,
         "serve100k_catalog_items": 0,
         "predict_p50_100k_basis": "section_failed",
+    })
+    serve_scale = _run_section("serve_scale", args.smoke, {
+        "serve_scale_catalog_items": 0,
+        "serve_scale_parity": "section_failed",
+        "serve_scale_speedup_wmax_vs_w1": 0.0,
     })
     snapshot = _run_section("snapshot", args.smoke, {
         "train_cold_snapshot_events_per_sec": 0.0,
@@ -1720,6 +1988,10 @@ def main() -> int:
             "predict_p95_100k_ms": round(serve100k["predict_p95_100k_ms"], 3),
             "serve100k_catalog_items": serve100k["serve100k_catalog_items"],
             "predict_p50_100k_basis": serve100k["predict_p50_100k_basis"],
+            # multi-worker query serving (prefork deploy × clients ×
+            # micro-batch mode; response-parity verified across cells)
+            **{k: (round(v, 2) if isinstance(v, float) else v)
+               for k, v in serve_scale.items()},
             # columnar snapshot layer: cold-train mmap scan vs JSONL,
             # delta-aware retrain, dictionary micro-guards
             **{k: (round(v, 1) if isinstance(v, float) else v)

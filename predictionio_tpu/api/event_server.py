@@ -68,8 +68,14 @@ def _max_batch() -> int:
 
 
 class EventServerState:
-    def __init__(self, storage: Optional[Storage] = None, stats: bool = True):
+    def __init__(self, storage: Optional[Storage] = None,
+                 stats: Optional[bool] = None):
         self.storage = storage or get_storage()
+        # stats ride the same kill switch as the metrics registry:
+        # PIO_METRICS=off disables both, and /stats.json then answers 503
+        # (service disabled) instead of serving frozen counters
+        if stats is None:
+            stats = obs_metrics.get_registry().enabled
         self.stats_enabled = stats
         self.max_batch = _max_batch()
         self.counts: Dict[int, Dict[str, int]] = {}
@@ -202,6 +208,12 @@ def make_handler(state: EventServerState):
             if path == "/events.json":
                 self._find(ak, channel_id, query)
             elif path == "/stats.json":
+                if not state.stats_enabled:
+                    # disabled registry (PIO_METRICS=off): say "service
+                    # off" rather than serving frozen/empty windows
+                    self.send_error_json(
+                        503, "stats disabled (PIO_METRICS=off)")
+                    return
                 # back-compat keys (appId/counts) + the reference-parity
                 # window views (per-(appId, status, event/entityType)
                 # since start, current window, last completed window)

@@ -93,7 +93,7 @@ _REASON = {
     200: "OK", 201: "Created", 400: "Bad Request", 401: "Unauthorized",
     403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
     411: "Length Required", 500: "Internal Server Error",
-    501: "Not Implemented",
+    501: "Not Implemented", 503: "Service Unavailable",
 }
 
 

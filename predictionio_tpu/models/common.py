@@ -8,7 +8,9 @@ cache or to the empty-vs-absent semantics lands everywhere at once.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import collections
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -18,6 +20,138 @@ def opt_str_list(d: Dict, key: str) -> Optional[List[str]]:
     stays ``[]`` (an explicitly empty whiteList means "nothing qualifies")
     while an absent or null key is ``None`` ("unconstrained")."""
     return [str(v) for v in d[key]] if key in d and d[key] is not None else None
+
+
+class LRUCache:
+    """Thread-safe bounded LRU with touch-on-hit ordering.
+
+    The serving caches (value-mask bitsets, date offsets, composed
+    rule masks) used to be plain dicts with FIFO eviction and unguarded
+    concurrent mutation — under concurrent query threads a popular entry
+    aged out in insertion order no matter how hot it was, and dict
+    iteration could race a writer.  One lock per cache; every ``get``
+    hit re-ranks the entry.
+
+    ``on_event`` (called with "hit" | "miss" | "evict", OUTSIDE the
+    lock) feeds cache metrics without coupling this class to the
+    registry; hit/miss/eviction totals are also kept on the instance for
+    direct inspection.
+    """
+
+    def __init__(self, max_entries: int,
+                 on_event: Optional[Callable[[str], None]] = None):
+        self._max = max(int(max_entries), 1)
+        self._on = on_event
+        self._lock = threading.Lock()
+        self._data: "collections.OrderedDict" = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                value = self._data[key]
+            except KeyError:
+                self.misses += 1
+                hit = False
+            else:
+                self._data.move_to_end(key)
+                self.hits += 1
+                hit = True
+        if self._on is not None:
+            self._on("hit" if hit else "miss")
+        return value if hit else default
+
+    def put(self, key, value) -> None:
+        evicted = 0
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self._max:
+                self._data.popitem(last=False)
+                self.evictions += 1
+                evicted += 1
+        if self._on is not None:
+            for _ in range(evicted):
+                self._on("evict")
+
+    def get_or_build(self, key, build: Callable[[], object]):
+        """``get``, else ``build()`` OUTSIDE the lock and ``put``.
+        Concurrent builders of the same key may duplicate the build (the
+        values are idempotent derived data) but never block builds of
+        other keys; last put wins."""
+        value = self.get(key)
+        if value is None:
+            value = build()
+            self.put(key, value)
+        return value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+
+# low-word constant per array length for host_topk_desc's composite key
+# (read-only once published; dict assignment is atomic under the GIL)
+_TOPK_LOW: Dict[int, np.ndarray] = {}
+
+
+def _topk_low(n: int) -> np.ndarray:
+    low = _TOPK_LOW.get(n)
+    if low is None:
+        low = np.int64(2**32 - 1) - np.arange(n, dtype=np.int64)
+        if len(_TOPK_LOW) > 16:   # a serving process sees a handful of n's
+            _TOPK_LOW.clear()
+        _TOPK_LOW[n] = low
+    return low
+
+
+def host_topk_desc(s: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k of a 1-D float32 score vector reproducing ``jax.lax.top_k``
+    EXACTLY: values descending, equal values broken by LOWER index first —
+    including at the k-th boundary.  Returns ``(values, int32 indices)``.
+
+    Serving score vectors are mostly one repeated value (zeros outside
+    the user's signal, -inf outside a hard filter), which is
+    ``np.argpartition``'s introselect worst case (measured ~20× slower
+    than on distinct keys) AND leaves the boundary ties ambiguous.  Both
+    problems fall to the same trick: partition a composite int64 key —
+    the float's monotone int32 image in the high word (sign-magnitude →
+    two's-complement, the radix-sort trick, which reproduces XLA's TOTAL
+    order including ``-0.0 < +0.0``), descending index in the low word —
+    so every key is DISTINCT and the key order IS the (value desc,
+    index asc) result order.
+
+    This is the host serve tail's sort: zero device dispatch, and parity
+    tests against the device tail assert bit-exact equality of both
+    arrays, not just the item sets."""
+    n = s.shape[0]
+    k = min(int(k), n)
+    if k <= 0:
+        return s[:0].astype(np.float32), np.zeros(0, np.int32)
+    f = s.astype(np.float32)                 # fresh buffer we may clobber
+    i = f.view(np.int32)
+    m = i >> 31
+    np.bitwise_and(m, np.int32(0x7FFFFFFF), out=m)
+    np.bitwise_xor(i, m, out=i)                  # monotone float→int map
+    kk = i.astype(np.int64)
+    np.left_shift(kk, 32, out=kk)
+    np.add(kk, _topk_low(n), out=kk)
+    if k >= n:
+        order = np.argsort(kk)[::-1]
+    else:
+        part = np.argpartition(kk, n - k)[n - k:]
+        order = part[np.argsort(kk[part])][::-1]
+    return s[order], order.astype(np.int32)
 
 
 class DeviceCacheMixin:

@@ -27,6 +27,8 @@ from __future__ import annotations
 import dataclasses
 import math
 import os as _os
+import threading as _threading
+import time as _time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -44,6 +46,9 @@ from predictionio_tpu.controller import (
     PersistentModel,
     Preparator,
 )
+from predictionio_tpu.models.common import LRUCache, host_topk_desc
+from predictionio_tpu.obs import metrics as _obs_metrics
+from predictionio_tpu.obs import spans as _spans
 from predictionio_tpu.ops import cco as cco_ops
 from predictionio_tpu.ops.als import (
     bucket_width,
@@ -53,6 +58,43 @@ from predictionio_tpu.ops.als import (
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
 from predictionio_tpu.store.columnar import CSRLookup, IdDict, fold_properties
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
+
+# -- serving instruments (obs registry; linted by check_metrics_names) -------
+
+_REG = _obs_metrics.get_registry()
+_M_STAGE = _REG.histogram(
+    "pio_ur_serve_stage_duration_seconds",
+    "UR serve-tail stage wall time by stage (history/score/mask/topk/"
+    "assemble) and resolved tail (host/device)")
+_M_MASK_CACHE = _REG.counter(
+    "pio_ur_rule_mask_cache_total",
+    "Composed business-rule mask cache lookups by outcome "
+    "(hit/miss/evict); one entry per (model generation, canonical rule "
+    "set, tail)")
+_M_SERVE_CACHE = _REG.counter(
+    "pio_ur_serve_cache_total",
+    "Serving lookup-cache events by cache (value_mask/date) and outcome "
+    "(hit/miss/evict)")
+_M_INV_BUILD = _REG.gauge(
+    "pio_ur_host_inverted_build_seconds",
+    "Wall seconds spent building the host inverted postings index, by "
+    "event type (set once per model load)")
+
+
+def _cache_event(cache: str):
+    def on_event(outcome: str) -> None:
+        _M_SERVE_CACHE.inc(1, cache=cache, outcome=outcome)
+    return on_event
+
+
+def _mask_cache_event(outcome: str) -> None:
+    _M_MASK_CACHE.inc(1, outcome=outcome)
+
+
+# builds of lazily-derived model state (the CSR postings inversion) are
+# serialized here: two concurrent first queries must not both pay the
+# argsort — one builds, the other waits and reuses (double-checked cache)
+_HOST_INV_LOCK = _threading.Lock()
 
 
 # -- query / result ----------------------------------------------------------
@@ -384,13 +426,15 @@ class URPreparator(Preparator):
         return td
 
 
-def _evict_oldest(cache: Dict) -> None:
-    """FIFO-evict one entry, tolerating concurrent serving threads racing
-    the same eviction (dict iteration/pop may raise under mutation)."""
+def _rule_mask_cache_max() -> int:
+    """PIO_UR_RULE_MASK_CACHE bounds the composed rule-mask LRU per model
+    generation × tail kind (default 128 canonical rule sets; each cached
+    mask is an n_items f32 vector — 400 KB at a 100k catalog, so the
+    default caps the cache at ~50 MB of host RAM or device HBM)."""
     try:
-        cache.pop(next(iter(cache)), None)
-    except (StopIteration, RuntimeError, KeyError):
-        pass
+        return max(int(_os.environ.get("PIO_UR_RULE_MASK_CACHE", "128")), 1)
+    except ValueError:
+        return 128
 
 
 # -- model -------------------------------------------------------------------
@@ -491,7 +535,18 @@ class URModel(PersistentModel):
         turns a query into |hist| posting-list slices and ~|hist|·K/I_t·I_p
         scatter-adds — microseconds of host work."""
         cache = self.__dict__.setdefault("_host_inv", {})
-        if name not in cache:
+        hit = cache.get(name)
+        if hit is not None:
+            return hit
+        # build ONCE under a lock: two concurrent first queries used to
+        # both pay the full argsort/bincount build (and publish different
+        # array objects).  Double-checked — the loser of the race reuses
+        # the winner's build.
+        with _HOST_INV_LOCK:
+            hit = cache.get(name)
+            if hit is not None:
+                return hit
+            t0 = _time.perf_counter()
             idx, llr = self.indicator_idx[name], self.indicator_llr[name]
             if idx.ndim != 2:
                 # degenerate table (no [I_p, K] shape to invert): an empty
@@ -500,39 +555,47 @@ class URModel(PersistentModel):
                 # with the FULL idx length (IndexError for any non-empty
                 # non-2D input)
                 n_t = max(len(self.event_item_dicts[name]), 1)
-                cache[name] = (np.zeros(n_t + 1, dtype=np.int64),
-                               np.zeros(0, dtype=np.int32),
-                               np.zeros(0, dtype=np.float32))
-                return cache[name]
-            i_p, k = idx.shape
-            valid = idx >= 0
-            rows = np.repeat(np.arange(i_p, dtype=np.int32), k)[valid.ravel()]
-            tgt = idx[valid]
-            w = llr[valid].astype(np.float32)
-            order = np.argsort(tgt, kind="stable")
-            tgt, rows, w = tgt[order], rows[order], w[order]
-            n_t = max(len(self.event_item_dicts[name]), 1)
-            indptr = np.concatenate(
-                [[0], np.cumsum(np.bincount(tgt, minlength=n_t))]
-            ).astype(np.int64)
-            cache[name] = (indptr, rows, w)
-        return cache[name]
+                built = (np.zeros(n_t + 1, dtype=np.int64),
+                         np.zeros(0, dtype=np.int32),
+                         np.zeros(0, dtype=np.float32))
+            else:
+                i_p, k = idx.shape
+                valid = idx >= 0
+                rows = np.repeat(
+                    np.arange(i_p, dtype=np.int32), k)[valid.ravel()]
+                tgt = idx[valid]
+                w = llr[valid].astype(np.float32)
+                order = np.argsort(tgt, kind="stable")
+                tgt, rows, w = tgt[order], rows[order], w[order]
+                n_t = max(len(self.event_item_dicts[name]), 1)
+                indptr = np.concatenate(
+                    [[0], np.cumsum(np.bincount(tgt, minlength=n_t))]
+                ).astype(np.int64)
+                built = (indptr, rows, w)
+            cache[name] = built
+            _M_INV_BUILD.set(_time.perf_counter() - t0, event=name)
+            return built
 
     def warm(self) -> None:
-        # stage only what the resolved scorer will read: the device
-        # tables are the model's largest arrays (~80 MB at 100k items ×
-        # 2 event types) and the host scorer never touches them — and
-        # vice versa, the CSR inversion is an argsort over ~I_p·K
+        # stage only what the resolved scorer AND tail will read: the
+        # device tables are the model's largest arrays (~80 MB at 100k
+        # items × 2 event types) and the host scorer never touches them —
+        # and vice versa, the CSR inversion is an argsort over ~I_p·K
         # entries per event type that must not stall the first query's
-        # micro-batch leader.  Both stay lazy, so a runtime scorer
+        # micro-batch leader.  Both stay lazy, so a runtime scorer/tail
         # switch still works — it just pays its build on first use.
         if _serve_scorer() == "host":
             for name in self.indicator_idx:
                 self.host_inverted(name)
         else:
             self.device_indicators()
-        self.device_popularity()
-        self.device_ones()
+        if _serve_tail() == "host":
+            self.host_popularity()
+            self.host_zeros()
+        else:
+            self.device_popularity()
+            self.device_ones()
+            self.device_zeros()
         self.pop_norm()
 
     def pop_norm(self) -> float:
@@ -566,8 +629,49 @@ class URModel(PersistentModel):
             self.__dict__["_dev_zeros"] = dev
         return dev
 
+    # -- host-resident serving state (the zero-dispatch serve tail) ---------
+
+    def host_popularity(self) -> np.ndarray:
+        """float32 backfill scores on host — same values device_popularity
+        stages (both cast the stored array to f32), so the two tails rank
+        the fallback identically."""
+        pop = self.__dict__.get("_host_pop")
+        if pop is None:
+            pop = np.asarray(self.popularity, np.float32)
+            self.__dict__["_host_pop"] = pop
+        return pop
+
+    def host_zeros(self) -> np.ndarray:
+        """Shared read-only zero signal (callers must never mutate it —
+        the host tail copies before writing exclusions)."""
+        z = self.__dict__.get("_host_zeros")
+        if z is None:
+            z = np.zeros(len(self.item_dict), np.float32)
+            self.__dict__["_host_zeros"] = z
+        return z
+
     _VALUE_MASK_CACHE_MAX = 512
     _DATE_CACHE_MAX = 512
+
+    def _lru(self, attr: str, max_entries: int, metric_cache: str) -> LRUCache:
+        cache = self.__dict__.get(attr)
+        if cache is None:
+            # dict.setdefault is atomic under the GIL: racing creators
+            # both construct, one instance wins, both use it
+            cache = self.__dict__.setdefault(
+                attr, LRUCache(max_entries, on_event=_cache_event(metric_cache)
+                               if metric_cache != "rule_mask"
+                               else _mask_cache_event))
+        return cache
+
+    def rule_mask_cache(self, kind: str) -> LRUCache:
+        """Composed business-rule masks, one LRU per (model generation,
+        tail kind).  Living in ``__dict__`` (never pickled) means a
+        hot-swap/auto-reload — which loads a NEW model object — starts
+        from an empty cache: invalidation is the model generation
+        itself."""
+        return self._lru(f"_rule_mask_{kind}", _rule_mask_cache_max(),
+                         "rule_mask")
 
     def known_prop_names(self) -> frozenset:
         """Property names that exist on at least one item — the gate that
@@ -582,51 +686,84 @@ class URModel(PersistentModel):
             self.__dict__["_known_prop_names"] = names
         return names
 
+    def _value_mask_ids(self, name: str, value: str) -> Optional[np.ndarray]:
+        """Item ids holding (name, value); None for unknown names/values
+        (the match-nothing case — callers substitute their zero mask
+        WITHOUT caching: query fields are user input, caching unknowns
+        would let arbitrary queries pin unbounded memory)."""
+        if name not in self.known_prop_names():
+            return None
+        return self.prop_value_index(name).get(value)
+
+    def _ids_to_mask(self, ids: np.ndarray) -> np.ndarray:
+        m = np.zeros(len(self.item_dict), np.float32)
+        m[ids] = 1.0
+        return m
+
+    def host_value_mask(self, name: str, value: str) -> np.ndarray:
+        """Host twin of device_value_mask; both tails derive their bitsets
+        from the same _ids_to_mask build, so they match bit-for-bit.  The
+        O(n_items) build runs only on a cache MISS — a hit costs the id
+        lookup plus one LRU probe."""
+        ids = self._value_mask_ids(name, value)
+        if ids is None:
+            return self.host_zeros()
+        cache = self._lru("_host_value_mask", self._VALUE_MASK_CACHE_MAX,
+                          "value_mask")
+        return cache.get_or_build((name, value),
+                                  lambda: self._ids_to_mask(ids))
+
     def device_value_mask(self, name: str, value: str) -> jnp.ndarray:
         """0/1 device mask of items whose property ``name`` holds ``value``
         — the Elasticsearch-filter-bitset analogue, cached per (name, value)
-        so repeated business rules cost one gather-free multiply.  Values
-        absent from the catalog return the shared zero mask WITHOUT caching
-        (query fields are user input; caching unknowns would let arbitrary
-        queries pin unbounded HBM), and the cache itself is FIFO-bounded."""
-        if name not in self.known_prop_names():
-            return self.device_zeros()
-        ids = self.prop_value_index(name).get(value)
+        so repeated business rules cost one gather-free multiply.  The
+        cache is a bounded thread-safe LRU (touch-on-hit): hot values stay
+        resident under concurrent serving threads instead of aging out in
+        insertion order."""
+        ids = self._value_mask_ids(name, value)
         if ids is None:
             return self.device_zeros()
-        cache = self.__dict__.setdefault("_dev_value_mask", {})
-        key = (name, value)
-        if key not in cache:
-            if len(cache) >= self._VALUE_MASK_CACHE_MAX:
-                _evict_oldest(cache)
-            m = np.zeros(len(self.item_dict), np.float32)
-            m[ids] = 1.0
-            cache[key] = jax.device_put(jnp.asarray(m))
-        return cache[key]
+        cache = self._lru("_dev_value_mask", self._VALUE_MASK_CACHE_MAX,
+                          "value_mask_dev")
+        return cache.get_or_build(
+            (name, value),
+            lambda: jax.device_put(jnp.asarray(self._ids_to_mask(ids))))
 
-    def device_date(self, name: str) -> Optional[Tuple[float, jnp.ndarray]]:
-        """(base_epoch_s, device int32 offsets) for a date property; -1
-        where missing; None when NO item has the property (callers must
-        treat that as match-nothing — and it keeps query-supplied names
-        from growing the cache).  Integer seconds relative to the earliest
+    def date_offsets(self, name: str) -> Optional[Tuple[float, np.ndarray]]:
+        """(base_epoch_s, int32 offsets) for a date property; -1 where
+        missing; None when NO item has the property (callers must treat
+        that as match-nothing — and it keeps query-supplied names from
+        growing the cache).  Integer seconds relative to the earliest
         value keep boundary comparisons EXACT (f32 epoch offsets would
         quantize to ~32 s over decade spans); sub-second precision is
         rounded, matching the second-granularity date semantics of the
-        reference's ES range filters."""
+        reference's ES range filters.  This is the ONE canonical
+        computation — the device path stages exactly these offsets, so
+        host and device tails agree on every boundary instant."""
         if name not in self.known_prop_names():
             return None
-        cache = self.__dict__.setdefault("_dev_date", {})
-        if name not in cache:
-            if len(cache) >= self._DATE_CACHE_MAX:
-                _evict_oldest(cache)
+        cache = self._lru("_date_off", self._DATE_CACHE_MAX, "date")
+
+        def build():
             ts = self.prop_date_array(name)
             missing = np.isnan(ts)
             finite = ts[~missing]
             base = float(finite.min()) if len(finite) else 0.0
             off = np.where(missing, -1.0, np.rint(ts - base))
-            off = np.clip(off, -1, 2**31 - 2).astype(np.int32)
-            cache[name] = (base, jax.device_put(jnp.asarray(off)))
-        return cache[name]
+            return base, np.clip(off, -1, 2**31 - 2).astype(np.int32)
+
+        return cache.get_or_build(name, build)
+
+    def device_date(self, name: str) -> Optional[Tuple[float, jnp.ndarray]]:
+        """Device staging of date_offsets (same base, same int32 array).
+        Separate metric label ("date_dev") so the offsets cache and its
+        device staging don't fold into one hit-ratio series."""
+        d = self.date_offsets(name)
+        if d is None:
+            return None
+        cache = self._lru("_dev_date", self._DATE_CACHE_MAX, "date_dev")
+        return cache.get_or_build(
+            name, lambda: (d[0], jax.device_put(jnp.asarray(d[1]))))
 
     # -- serving-time property indexes (built lazily, never serialized) ----
 
@@ -714,6 +851,23 @@ def _serve_scorer() -> str:
     everywhere else (the gather program keeps the [I_p] signal on the
     accelerator and ships only id lists).  PIO_UR_SERVE_SCORER forces."""
     conf = _os.environ.get("PIO_UR_SERVE_SCORER", "auto").lower()
+    if conf in ("host", "device"):
+        return conf
+    return "host" if jax.default_backend() == "cpu" else "device"
+
+
+def _serve_tail() -> str:
+    """'device' | 'host' — which serve TAIL finishes queries (business-rule
+    mask, blacklist, both top-ks, readback).
+
+    auto (default): host on the CPU backend — the jax CPU tail was the
+    measured 58% of predict at 100k items (two full-width lax.top_k
+    programs + dispatch + readback for work argpartition does in
+    microseconds), device everywhere else (on an accelerator the signal
+    already lives device-side and only [4, k] crosses back).
+    PIO_UR_SERVE_TAIL forces.  Both tails are exact twins: same items,
+    same scores, same tie order (host_topk_desc reproduces lax.top_k)."""
+    conf = _os.environ.get("PIO_UR_SERVE_TAIL", "auto").lower()
     if conf in ("host", "device"):
         return conf
     return "host" if jax.default_backend() == "cpu" else "device"
@@ -1065,8 +1219,9 @@ class URAlgorithm(Algorithm):
         per event type are the measured CPU serving bottleneck at 100k
         items (13 ms of a 15.6 ms p50).  PIO_UR_SERVE_SCORER overrides."""
         if _serve_scorer() == "host":
-            s = self._score_history_host(model, hist)
-            return None if s is None else jnp.asarray(s)
+            # stays a NUMPY array: under the host tail the signal never
+            # touches the device at all; the device tail uploads it
+            return self._score_history_host(model, hist)
         use_llr = jnp.asarray(self.params.use_llr_weights)
         total = None
         for name, (idx_dev, llr_dev) in model.device_indicators().items():
@@ -1133,31 +1288,139 @@ class URAlgorithm(Algorithm):
 
     def predict(self, model: URModel, query: URQuery,
                 hist_override: Optional[Dict[str, np.ndarray]] = None) -> URResult:
-        """Device-final serving: signal accumulation, business-rule masks,
-        blacklist, and BOTH top-ks (signal + backfill) run on device; only
-        4 [k]-sized arrays and the small history/blacklist id lists cross
-        the host boundary.  Query shapes are bucketed (pad_ids, k buckets)
-        so every shape traces once per deployment."""
+        """Serve one query through the resolved tail (_serve_tail):
+
+        device — signal accumulation, business-rule masks, blacklist, and
+        BOTH top-ks (signal + backfill) run on device; only 4 [k]-sized
+        arrays and the small history/blacklist id lists cross the host
+        boundary.  Query shapes are bucketed (pad_ids, k buckets) so
+        every shape traces once per deployment.
+
+        host — the whole tail is numpy: cached rule masks compose as one
+        boolean/bias pass over the scores, top-k is argpartition + a
+        stable tie-order sort reproducing lax.top_k exactly, ZERO device
+        dispatch and zero readback when the scorer is already host-side.
+
+        Tail-stage wall times land in pio_ur_serve_stage_duration_seconds
+        and, when a span journal is active (eval/batch runs), as a
+        per-query span with the stage breakdown in its attrs."""
+        stages: List[Tuple[str, float]] = []
+        journal = _spans.current_journal()
+        if journal is None:
+            return self._predict_staged(model, query, hist_override, stages)
+        with journal.span("ur_predict") as rec:
+            res = self._predict_staged(model, query, hist_override, stages)
+            rec["attrs"] = {"tail": _serve_tail(),
+                            **{f"{n}_ms": round(dt * 1e3, 4)
+                               for n, dt in stages}}
+            return res
+
+    def _predict_staged(self, model: URModel, query: URQuery,
+                        hist_override, stages: List[Tuple[str, float]],
+                        ) -> URResult:
         n_items = len(model.item_dict)
         if n_items == 0:
             return URResult([])
+        tail = _serve_tail()
+        t = [_time.perf_counter()]
+
+        def lap(name: str) -> None:
+            now = _time.perf_counter()
+            stages.append((name, now - t[0]))
+            t[0] = now
+
         hist = self._query_hist(model, query, hist_override)
+        lap("history")
         signal = self._score_history(model, hist) if hist is not None else None
+        lap("score")
         have_signal = signal is not None
-        if signal is None:
-            signal = model.device_zeros()
-        mask = self._device_mask(model, query)
-        black_ids = self._blacklist_ids(model, query)
         num = min(query.num, n_items)
+        if tail == "host":
+            sig_np = None if signal is None else np.asarray(signal)
+            res = self._host_tail(model, query, sig_np, num, lap)
+        else:
+            res = self._device_tail(model, query, signal, have_signal, num,
+                                    lap)
+        for name, dt in stages:
+            _M_STAGE.observe(dt, stage=name, tail=tail)
+        return res
+
+    def _device_tail(self, model: URModel, query: URQuery, signal,
+                     have_signal: bool, num: int, lap) -> URResult:
+        mask = self._mask_for(model, query, host=False)
+        black_ids = self._blacklist_ids(model, query)
+        lap("mask")
+        sig = model.device_zeros() if signal is None else jnp.asarray(signal)
         # k covers the worst case: every signal pick also occupying a
         # backfill slot; bucketed so distinct nums share compiles
-        k = min(bucket_width(2 * num, 16), n_items)
+        k = min(bucket_width(2 * num, 16), len(model.item_dict))
         out = np.asarray(_serve_topk(
-            signal, mask, model.device_popularity(),
+            sig, mask if mask is not None else model.device_ones(),
+            model.device_popularity(),
             jnp.asarray(als_pad_ids(black_ids)), k))  # ONE [4, k] readback
-        return self._assemble(model, num, have_signal,
-                              out[0], out[1].astype(np.int32),
-                              out[2], out[3].astype(np.int32))
+        lap("topk")
+        res = self._assemble(model, num, have_signal,
+                             out[0], out[1].astype(np.int32),
+                             out[2], out[3].astype(np.int32))
+        lap("assemble")
+        return res
+
+    def _host_tail(self, model: URModel, query: URQuery,
+                   signal: Optional[np.ndarray], num: int,
+                   lap=None) -> URResult:
+        """The zero-dispatch serve tail: same math as _serve_topk, in
+        numpy, with the composed rule mask cached per canonical rule set.
+        Elementwise f32 products match XLA's bit-for-bit and
+        host_topk_desc reproduces lax.top_k's tie order, so this tail is
+        EXACTLY the device tail's output."""
+        n_items = len(model.item_dict)
+        mask = self._mask_for(model, query, host=True)
+        black = self._blacklist_ids(model, query)
+        if lap is not None:
+            lap("mask")
+        k = min(bucket_width(2 * num, 16), n_items)
+        bidx = np.asarray(black, np.int32) if black else None
+        # signal top-k over only the POSITIVE entries: _assemble accepts a
+        # signal pick only when finite and > 0, so the candidate set is
+        # s > 0 minus the blacklist — typically a few thousand items of a
+        # 100k catalog, and a cold query skips the pass entirely.  The
+        # subset preserves index order, so (value desc, index asc) over it
+        # is exactly the device tail's tie order.
+        st = si = None
+        if signal is not None:
+            s = signal * mask if mask is not None else signal
+            pos = np.flatnonzero(s > 0)
+            if bidx is not None and len(pos):
+                pos = pos[np.isin(pos, bidx, invert=True)]
+            if len(pos):
+                vals, oi = host_topk_desc(s[pos], min(k, len(pos)))
+                st, si = vals, pos[oi].astype(np.int32)
+        n_signal = min(len(st) if st is not None else 0, num)
+        # the backfill ranking only matters when the signal picks leave
+        # slots to pad — the device tail computes it unconditionally (it
+        # is one fused program), the host tail just skips it
+        bt = bi = None
+        if n_signal < num and self.params.backfill_type != "none":
+            bf = model.host_popularity()
+            bfm = bf * mask if mask is not None else bf.copy()
+            if mask is not None:
+                bfm[mask <= 0] = -np.inf
+            if bidx is not None:
+                bfm[bidx] = -np.inf
+            bt, bi = host_topk_desc(bfm, k)
+        if lap is not None:
+            lap("topk")
+        empty_f = np.zeros(0, np.float32)
+        empty_i = np.zeros(0, np.int32)
+        res = self._assemble(
+            model, num, st is not None,
+            st if st is not None else empty_f,
+            si if si is not None else empty_i,
+            bt if bt is not None else empty_f,
+            bi if bi is not None else empty_i)
+        if lap is not None:
+            lap("assemble")
+        return res
 
     def _query_hist(self, model: URModel, query: URQuery,
                     hist_override: Optional[Dict[str, np.ndarray]] = None,
@@ -1230,8 +1493,27 @@ class URAlgorithm(Algorithm):
         hists = [self._query_hist(model, q) for q in queries]
         have_signal = [h is not None and any(len(v) for v in h.values())
                        for h in hists]
+        scorer = _serve_scorer()
+        if _serve_tail() == "host":
+            # host tail per query.  With the host scorer nothing touches
+            # the device at all; with the device scorer the batched gather
+            # program still amortizes dispatch and every row comes back in
+            # ONE readback before the numpy tails run.
+            if scorer == "host":
+                rows = [self._score_history_host(model, h) if h else None
+                        for h in hists]
+            else:
+                total = self._score_batch_device(model, hists, bp, n_items)
+                rows_all = (None if total is None
+                            else np.asarray(total)[:b])
+                rows = [rows_all[r] if rows_all is not None and have_signal[r]
+                        else None for r in range(b)]
+            return [
+                self._host_tail(model, q, rows[r], min(q.num, n_items))
+                for r, q in enumerate(queries)
+            ]
         total = None
-        if _serve_scorer() == "host":
+        if scorer == "host":
             rows_np = [self._score_history_host(model, h) if h else None
                        for h in hists]
             if any(r is not None for r in rows_np):
@@ -1240,26 +1522,12 @@ class URAlgorithm(Algorithm):
                      for r in rows_np]
                     + [np.zeros(n_items, np.float32)] * (bp - b)))
         else:
-            use_llr = jnp.asarray(self.params.use_llr_weights)
-            for name, (idx_dev, llr_dev) in model.device_indicators().items():
-                lens = [len(h[name]) if h and name in h else 0 for h in hists]
-                if not any(lens):
-                    continue
-                w = bucket_width(max(lens))
-                hm = np.full((bp, w), -1, np.int32)
-                for r, h in enumerate(hists):
-                    if h and name in h and len(h[name]):
-                        hm[r, : len(h[name])] = h[name]
-                n_t = max(len(model.event_item_dicts[name]), 1)
-                s = _indicator_score_ids_batch(
-                    idx_dev, llr_dev, jnp.asarray(hm), use_llr, n_t)
-                weight = float(self.params.indicator_weights.get(name, 1.0))
-                s = s * weight if weight != 1.0 else s
-                total = s if total is None else total + s
+            total = self._score_batch_device(model, hists, bp, n_items)
         if total is None:
             total = jnp.zeros((bp, n_items), jnp.float32)
         masks = jnp.stack(
-            [self._device_mask(model, q) for q in queries]
+            [m if (m := self._mask_for(model, q, host=False)) is not None
+             else model.device_ones() for q in queries]
             + [model.device_zeros()] * (bp - b))
         blacks = [self._blacklist_ids(model, q) for q in queries]
         wb = bucket_width(max((len(x) for x in blacks), default=1))
@@ -1276,6 +1544,30 @@ class URAlgorithm(Algorithm):
                            out[r, 2], out[r, 3].astype(np.int32))
             for r in range(b)
         ]
+
+    def _score_batch_device(self, model: URModel, hists, bp: int,
+                            n_items: int) -> Optional[jnp.ndarray]:
+        """The batched device gather scorer: every event type's histories
+        score against the resident table in one [B, I_p, K] program;
+        None when no query carries any history."""
+        total = None
+        use_llr = jnp.asarray(self.params.use_llr_weights)
+        for name, (idx_dev, llr_dev) in model.device_indicators().items():
+            lens = [len(h[name]) if h and name in h else 0 for h in hists]
+            if not any(lens):
+                continue
+            w = bucket_width(max(lens))
+            hm = np.full((bp, w), -1, np.int32)
+            for r, h in enumerate(hists):
+                if h and name in h and len(h[name]):
+                    hm[r, : len(h[name])] = h[name]
+            n_t = max(len(model.event_item_dicts[name]), 1)
+            s = _indicator_score_ids_batch(
+                idx_dev, llr_dev, jnp.asarray(hm), use_llr, n_t)
+            weight = float(self.params.indicator_weights.get(name, 1.0))
+            s = s * weight if weight != 1.0 else s
+            total = s if total is None else total + s
+        return total
 
     def _blacklist_ids(self, model: URModel, query: URQuery) -> List[int]:
         """Item ids to exclude: the user's seen items under every configured
@@ -1305,42 +1597,148 @@ class URAlgorithm(Algorithm):
                 ids.append(bid)
         return ids
 
-    def _device_mask(self, model: URModel, query: URQuery) -> jnp.ndarray:
-        """Business-rule mask composed ON DEVICE from cached per-(property,
-        value) bitsets and base-relative date arrays — the Elasticsearch
-        filter/boost analogue (reference: URAlgorithm field biases and date
-        rules as ES bool-query filters).  Items missing a checked date
-        property fail the check, like ES range filters."""
-        mask = model.device_ones()
-        for rule in query.fields:
+    def _mask_rule_key(self, query: URQuery) -> Optional[tuple]:
+        """Canonical business-rule key for the mask cache, or None when
+        the query carries no rules at all (the fast path: no mask work).
+
+        Canonical = field rules sorted (mask composition is a product, so
+        order never changes the value; sorting makes differently-ordered
+        but equivalent queries share one cache entry) and query dates
+        parsed to epoch seconds QUANTIZED to whole seconds — the mask
+        only ever consumes second-granularity offsets, and live traffic
+        sending ``currentDate=now()`` would otherwise mint a unique key
+        (and pin a full-catalog mask) per query.  Strict date parsing
+        happens HERE, before any cache interaction, so a malformed date
+        still rejects the query with 400 and never poisons the cache."""
+        def q_ts(raw, field):
+            # falsy (absent/empty) date fields stay unset, as before
+            return None if not raw else int(np.rint(_query_ts(raw, field)))
+
+        fields = tuple(sorted(
+            (r.name, tuple(r.values), float(r.bias)) for r in query.fields))
+        dr = query.date_range
+        drk = None
+        if dr is not None:
+            drk = (dr.name,
+                   q_ts(dr.after, "dateRange.after"),
+                   q_ts(dr.before, "dateRange.before"))
+        # strict-parse currentDate even when no avail/expire property is
+        # configured (a malformed date is a 400 regardless), but an INERT
+        # currentDate must not force mask builds or unique cache entries
+        now = q_ts(query.current_date, "currentDate")
+        if not (self.params.available_date_name
+                or self.params.expire_date_name):
+            now = None
+        if not fields and drk is None and now is None:
+            return None
+        # the avail/expire property names are engine params, constant per
+        # deployment — included so a params change can't alias an entry
+        return (fields, drk, now, self.params.available_date_name,
+                self.params.expire_date_name)
+
+    def _mask_for(self, model: URModel, query: URQuery, host: bool):
+        """The composed business-rule mask for one query, memoized per
+        (model generation, canonical rule set, tail kind) in a bounded
+        thread-safe LRU — steady-state queries with repeated rules skip
+        mask construction entirely (hit/miss/evict in
+        pio_ur_rule_mask_cache_total).  None = no rules (all-ones)."""
+        key = self._mask_rule_key(query)
+        if key is None:
+            return None
+        cache = model.rule_mask_cache("host" if host else "device")
+        return cache.get_or_build(
+            key, lambda: self._mask_from_key(model, key, host))
+
+    def _mask_from_key(self, model: URModel, key: tuple, host: bool):
+        """Build the mask from the CANONICAL key (not the query object):
+        both tails compose the identical factors in the identical order,
+        so host and device masks agree bit-for-bit even for float biases.
+
+        Semantics are the Elasticsearch filter/boost analogue (reference:
+        URAlgorithm field biases and date rules as ES bool-query
+        filters); items missing a checked date property fail the check,
+        like ES range filters."""
+        fields, drk, now, avail, expire = key
+        if host:
+            return self._mask_from_key_host(model, fields, drk, now,
+                                            avail, expire)
+        return self._mask_from_key_device(model, fields, drk, now,
+                                          avail, expire)
+
+    @staticmethod
+    def _date_bound(epoch_s: float, base: float) -> int:
+        # same rounding as the item offsets → exact boundary equality
+        return int(np.clip(np.rint(epoch_s - base), -1, 2**31 - 2))
+
+    def _mask_from_key_host(self, model, fields, drk, now, avail, expire
+                            ) -> np.ndarray:
+        one = np.float32(1.0)
+        mask = np.ones(len(model.item_dict), np.float32)
+        for name, values, bias in fields:
             match = None
-            for val in rule.values:
-                m = model.device_value_mask(rule.name, val)
+            for val in values:
+                m = model.host_value_mask(name, val)
+                match = m if match is None else np.maximum(match, m)
+            if match is None:
+                match = model.host_zeros()
+            if bias < 0:
+                mask = mask * match              # hard filter
+            else:
+                mask = mask * np.where(match > 0, np.float32(bias), one)
+        if drk is not None:
+            name, after_s, before_s = drk
+            d = model.date_offsets(name)
+            if d is None:            # no item has the property: match nothing
+                return model.host_zeros()
+            base, ts = d
+            present = (ts >= 0)
+            mask = mask * present.astype(np.float32)
+            if after_s is not None:
+                mask = mask * ((ts >= self._date_bound(after_s, base))
+                               & present).astype(np.float32)
+            if before_s is not None:
+                mask = mask * ((ts <= self._date_bound(before_s, base))
+                               & present).astype(np.float32)
+        if now is not None:
+            for prop, op in ((avail, np.less_equal), (expire,
+                                                      np.greater_equal)):
+                # available <= now <= expire; boundary instants still valid
+                if not prop:
+                    continue
+                d = model.date_offsets(prop)
+                if d is None:
+                    return model.host_zeros()
+                base, ts = d
+                b = self._date_bound(now, base)
+                mask = mask * (op(ts, b) & (ts >= 0)).astype(np.float32)
+        return mask
+
+    def _mask_from_key_device(self, model, fields, drk, now, avail, expire
+                              ) -> jnp.ndarray:
+        mask = model.device_ones()
+        for name, values, bias in fields:
+            match = None
+            for val in values:
+                m = model.device_value_mask(name, val)
                 match = m if match is None else _m_or(match, m)
             if match is None:
                 match = model.device_zeros()
-            if rule.bias < 0:
+            if bias < 0:
                 mask = _m_hard(mask, match)      # hard filter
             else:
-                mask = _m_boost(mask, match, float(rule.bias))
-        def bound(epoch_s: float, base: float) -> int:
-            # same rounding as the item offsets → exact boundary equality
-            return int(np.clip(np.rint(epoch_s - base), -1, 2**31 - 2))
-
-        dr = query.date_range
-        if dr is not None:
-            dd = model.device_date(dr.name)
+                mask = _m_boost(mask, match, float(bias))
+        if drk is not None:
+            name, after_s, before_s = drk
+            dd = model.device_date(name)
             if dd is None:           # no item has the property: match nothing
                 return model.device_zeros()
             base, ts = dd
             mask = _m_present(mask, ts)
-            if dr.after:
-                mask = _m_ge(mask, ts, bound(_query_ts(dr.after, "dateRange.after"), base))
-            if dr.before:
-                mask = _m_le(mask, ts, bound(_query_ts(dr.before, "dateRange.before"), base))
-        now = _query_ts(query.current_date, "currentDate") if query.current_date else None
+            if after_s is not None:
+                mask = _m_ge(mask, ts, self._date_bound(after_s, base))
+            if before_s is not None:
+                mask = _m_le(mask, ts, self._date_bound(before_s, base))
         if now is not None:
-            avail, expire = self.params.available_date_name, self.params.expire_date_name
             for prop, op in ((avail, _m_le), (expire, _m_ge)):
                 # available <= now <= expire; boundary instants still valid
                 if not prop:
@@ -1349,7 +1747,7 @@ class URAlgorithm(Algorithm):
                 if dd is None:
                     return model.device_zeros()
                 base, ts = dd
-                mask = op(mask, ts, bound(now, base))
+                mask = op(mask, ts, self._date_bound(now, base))
         return mask
 
 

@@ -368,6 +368,12 @@ class QueryServerState:
     def info(self) -> Dict:
         return {
             "status": "alive",
+            # pid identifies WHICH prefork worker answered — the readiness
+            # probe for `deploy --workers N` (poll fresh connections until
+            # N distinct pids have been seen), same contract as the event
+            # server's GET /
+            "pid": os.getpid(),
+            "workerTag": obs_metrics.worker_tag(),
             "engineId": self.engine_id,
             "engineVersion": self.engine_version,
             "variant": self.engine_variant,
@@ -403,8 +409,10 @@ GET /metrics &middot; GET /stats.json</p>
 def make_handler(state: QueryServerState):
     class QueryHandler(JsonHandler):
         # per-(route, status) windows for /stats.json, fed by the
-        # http_util middleware
-        stats_collector = StatsCollector()
+        # http_util middleware; None under PIO_METRICS=off (the
+        # middleware skips recording and /stats.json answers 503)
+        stats_collector = (StatsCollector()
+                           if obs_metrics.get_registry().enabled else None)
 
         def do_GET(self):
             path, _query = self.route
@@ -419,6 +427,10 @@ def make_handler(state: QueryServerState):
                                ctype="text/plain; version=0.0.4; "
                                      "charset=utf-8")
             elif path == "/stats.json":
+                if self.stats_collector is None:
+                    self.send_error_json(
+                        503, "stats disabled (PIO_METRICS=off)")
+                    return
                 doc = self.stats_collector.to_json()
                 doc["engineId"] = state.engine_id
                 doc["queryCount"] = state.query_count

@@ -35,6 +35,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.storage.snapshot",
     "predictionio_tpu.workflow.core_workflow",
     "predictionio_tpu.workflow.create_server",
+    "predictionio_tpu.models.universal_recommender.engine",
 ]
 
 

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Verify host-tail ≡ device-tail serving parity on a trained UR model.
+
+Trains a small Universal Recommender model on deterministic synthetic
+commerce data (two clusters, category properties, availability dates),
+then replays a fixed query corpus — user, cold user, item-similarity,
+itemSet, hard field filter, field boost, blacklist, dateRange,
+currentDate avail/expire, an all-masked query, and a no-match empty
+result — through BOTH serve tails (``PIO_UR_SERVE_TAIL=host`` vs
+``device``) and through ``serve_batch_predict`` vs serial ``predict``
+under each tail, diffing results EXACTLY: same items, same float scores,
+same order.
+
+The host tail's contract is that it is a bit-exact twin of the device
+tail (elementwise f32 mask math matches XLA, host_topk_desc reproduces
+``lax.top_k``'s tie order), so any diff here is a real divergence, not
+float noise.
+
+Exit 0 = every query identical across all four paths; 1 = any diff
+(printed).  Run standalone (``python scripts/check_serve_parity.py``) or
+via the tier-1 suite (tests/test_serve_tail.py wraps it), like
+check_metrics_names.py and check_snapshot_integrity.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable from any cwd without an installed package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the parity contract is backend-independent; CPU keeps the script fast
+# and runnable inside tier-1 (PIO_JAX_PLATFORM survives sitecustomize)
+os.environ.setdefault("PIO_JAX_PLATFORM", "cpu")
+
+
+def build_app():
+    import numpy as np
+
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.storage.locator import (
+        Storage, StorageConfig, set_storage,
+    )
+
+    storage = Storage(StorageConfig(
+        sources={"MEM": {"type": "memory"}},
+        repositories={r: "MEM" for r in ("METADATA", "EVENTDATA",
+                                         "MODELDATA")},
+    ))
+    set_storage(storage)
+    app_id = storage.apps.insert(App(0, "parityapp"))
+    rng = np.random.default_rng(42)
+    e_items = [f"e{i}" for i in range(8)]
+    b_items = [f"b{i}" for i in range(8)]
+    events = []
+    for u in range(40):
+        mine = e_items if u < 20 else b_items
+        for it in mine:
+            if rng.random() < 0.7:
+                events.append(Event(
+                    event="purchase", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=it))
+            if rng.random() < 0.9:
+                events.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=it))
+    for k, it in enumerate(e_items):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({
+                "category": "electronics",
+                "availableDate": "2026-01-01T00:00:00",
+                "expireDate": f"2026-0{(k % 6) + 1}-15T00:00:00"})))
+    for it in b_items:
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({"category": "books",
+                                "availableDate": "2026-02-01T00:00:00"})))
+    storage.l_events.insert_batch(events, app_id)
+    return storage
+
+
+def corpus(query_cls, field_cls):
+    q = query_cls.from_json
+    return [
+        q({"user": "u2", "num": 6}),
+        q({"user": "u25", "num": 6}),
+        q({"user": "nobody-cold", "num": 5}),
+        q({"item": "e1", "num": 5}),
+        q({"itemSet": ["e0", "e2"], "num": 6}),
+        q({"user": "u3", "num": 6,
+           "fields": [{"name": "category", "values": ["books"],
+                       "bias": -1}]}),
+        q({"user": "u3", "num": 6,
+           "fields": [{"name": "category", "values": ["electronics"],
+                       "bias": 4.0}]}),
+        q({"user": "u4", "num": 6, "blacklistItems": ["e0", "e1", "e2"]}),
+        q({"user": "u5", "num": 6,
+           "dateRange": {"name": "expireDate",
+                         "after": "2026-02-01T00:00:00"}}),
+        q({"user": "u6", "num": 8, "currentDate": "2026-03-01T00:00:00"}),
+        # all-masked: no item carries this category value → empty result
+        q({"user": "u7", "num": 6,
+           "fields": [{"name": "category", "values": ["no-such-cat"],
+                       "bias": -1}]}),
+        # empty-history user + hard filter (pure backfill under a mask)
+        q({"user": "ghost", "num": 4,
+           "fields": [{"name": "category", "values": ["books"],
+                       "bias": -1}]}),
+    ]
+
+
+def canon(result):
+    return [(s.item, float(s.score)) for s in result.item_scores]
+
+
+def main() -> int:
+    # pin the scorer so both tails consume the IDENTICAL signal array and
+    # any diff is attributable to the tail under test
+    os.environ["PIO_UR_SERVE_SCORER"] = "host"
+    build_app()
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine, URQuery,
+    )
+    from predictionio_tpu.models.universal_recommender.engine import (
+        FieldRule, URAlgorithm, URAlgorithmParams, URDataSourceParams,
+    )
+
+    engine = UniversalRecommenderEngine.apply()
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="parityapp", event_names=["purchase", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(
+            app_name="parityapp", mesh_dp=1, max_correlators_per_item=8,
+            min_llr=0.0, available_date_name="availableDate",
+            expire_date_name="expireDate"))],
+    )
+    models = engine.train(ep)
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    queries = corpus(URQuery, FieldRule)
+
+    runs = {}
+    for tail in ("host", "device"):
+        os.environ["PIO_UR_SERVE_TAIL"] = tail
+        runs[f"{tail}/serial"] = [canon(algo.predict(model, q))
+                                  for q in queries]
+        runs[f"{tail}/batch"] = [canon(r) for r in
+                                 algo.serve_batch_predict(model, queries)]
+    problems = []
+    reference = runs["device/serial"]
+    some_nonempty = any(reference)
+    if not some_nonempty:
+        problems.append("corpus produced only empty results — the parity "
+                        "check would be vacuous (fixture drift?)")
+    for name, results in runs.items():
+        for qi, (got, want) in enumerate(zip(results, reference)):
+            if got != want:
+                problems.append(
+                    f"query #{qi} differs on {name} vs device/serial:\n"
+                    f"  got:  {got}\n  want: {want}")
+    # the all-masked query must be an exact empty result everywhere
+    if reference[10] != []:
+        problems.append(f"all-masked query returned items: {reference[10]}")
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    if not problems:
+        print(f"ok: {len(queries)} queries × 4 serving paths identical "
+              "(items, scores, order)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

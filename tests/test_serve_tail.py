@@ -1,0 +1,497 @@
+"""Serving hot-path tests (PR 4): host serve tail ≡ device tail exact
+parity (items, scores, tie order), batch ≡ serial across tails, the
+rule-mask cache (hits, canonicalization, per-generation invalidation,
+eviction), the thread-safe LRU lookup caches, the locked host-inverted
+build, serve-stage metrics/spans, and the /stats.json 503 contract under
+PIO_METRICS=off."""
+
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.universal_recommender import (
+    UniversalRecommenderEngine,
+    URQuery,
+)
+from predictionio_tpu.models.universal_recommender.engine import (
+    URAlgorithm,
+    URAlgorithmParams,
+    URDataSourceParams,
+)
+from predictionio_tpu.storage import App
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture()
+def rules_app(mem_storage):
+    """Two-cluster commerce data with category AND date properties, so
+    every business-rule shape (filter, boost, dateRange, currentDate
+    avail/expire) has matching items."""
+    app_id = mem_storage.apps.insert(App(0, "tailapp"))
+    rng = np.random.default_rng(7)
+    events = []
+    e_items = [f"e{i}" for i in range(6)]
+    b_items = [f"b{i}" for i in range(6)]
+    for u in range(30):
+        mine = e_items if u < 15 else b_items
+        for it in mine:
+            if rng.random() < 0.7:
+                events.append(Event(
+                    event="purchase", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=it))
+            if rng.random() < 0.9:
+                events.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=it))
+    for k, it in enumerate(e_items):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({
+                "category": "electronics",
+                "availableDate": "2026-01-01T00:00:00",
+                "expireDate": f"2026-0{(k % 6) + 1}-15T00:00:00"})))
+    for it in b_items:
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({"category": "books",
+                                "availableDate": "2026-02-01T00:00:00"})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+def make_ep(**algo_over):
+    algo = dict(app_name="tailapp", mesh_dp=1, max_correlators_per_item=8,
+                min_llr=0.0, available_date_name="availableDate",
+                expire_date_name="expireDate")
+    algo.update(algo_over)
+    return EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="tailapp", event_names=["purchase", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(**algo))],
+    )
+
+
+@pytest.fixture()
+def trained_rules(rules_app):
+    engine = UniversalRecommenderEngine.apply()
+    ep = make_ep()
+    models = engine.train(ep)
+    return engine, ep, models
+
+
+def rule_queries():
+    q = URQuery.from_json
+    return [
+        q({"user": "u2", "num": 6}),
+        q({"user": "stranger", "num": 5}),
+        q({"item": "e1", "num": 4}),
+        q({"itemSet": ["e0", "e2"], "num": 5}),
+        q({"user": "u3", "num": 6,
+           "fields": [{"name": "category", "values": ["books"],
+                       "bias": -1}]}),
+        q({"user": "u3", "num": 6,
+           "fields": [{"name": "category", "values": ["electronics"],
+                       "bias": 3.0}]}),
+        q({"user": "u4", "num": 6, "blacklistItems": ["e0", "b0"]}),
+        q({"user": "u5", "num": 6,
+           "dateRange": {"name": "expireDate",
+                         "after": "2026-02-01T00:00:00"}}),
+        q({"user": "u6", "num": 8, "currentDate": "2026-03-01T00:00:00"}),
+        # all-masked: no item carries this value → exact empty result
+        q({"user": "u7", "num": 6,
+           "fields": [{"name": "category", "values": ["no-such"],
+                       "bias": -1}]}),
+        q({"user": "u20", "num": 0}),
+    ]
+
+
+def canon(result):
+    return [(s.item, float(s.score)) for s in result.item_scores]
+
+
+def test_host_tail_matches_device_tail_exact(trained_rules, monkeypatch):
+    """The host tail is a bit-exact twin of the device tail: same items,
+    same float scores, same tie order, for every business-rule shape —
+    including the all-masked empty result."""
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")  # identical signal in
+    queries = rule_queries()
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "device")
+    dev = [canon(algo.predict(model, q)) for q in queries]
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    host = [canon(algo.predict(model, q)) for q in queries]
+    assert any(dev), "fixture produced only empty results"
+    for qi, (d, h) in enumerate(zip(dev, host)):
+        assert d == h, (qi, d, h)
+    assert dev[9] == []          # all-masked
+    assert dev[10] == []         # num=0
+
+
+@pytest.mark.parametrize("tail", ["host", "device"])
+@pytest.mark.parametrize("scorer", ["host", "device"])
+def test_serve_batch_matches_serial_all_paths(trained_rules, monkeypatch,
+                                              tail, scorer):
+    """serve_batch_predict ≡ predict exactly, under every scorer × tail
+    combination (the micro-batcher must be response-invisible)."""
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", scorer)
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", tail)
+    queries = rule_queries()
+    serial = [canon(algo.predict(model, q)) for q in queries]
+    batched = [canon(r) for r in algo.serve_batch_predict(model, queries)]
+    assert serial == batched
+
+
+def test_host_topk_desc_matches_lax_top_k():
+    """host_topk_desc reproduces lax.top_k exactly — descending values,
+    lower-index-first ties (XLA's total order, including -0.0 < +0.0),
+    across dense, mostly-constant, -inf-heavy and edge-size inputs."""
+    import jax
+
+    from predictionio_tpu.models.common import host_topk_desc
+
+    rng = np.random.default_rng(3)
+    sparse = np.zeros(20_000, np.float32)
+    sparse[rng.integers(0, 20_000, 500)] = rng.random(500).astype(np.float32)
+    ties = np.round(rng.random(5_000).astype(np.float32) * 4) / 2
+    ties[rng.integers(0, 5_000, 800)] = -np.inf
+    cases = [
+        (np.array([0.0, -0.0, 1.0, -0.0, 0.0, 0.5], np.float32), 6),
+        (rng.normal(size=3_000).astype(np.float32), 77),
+        (sparse, 64),
+        (ties, 128),
+        (np.full(300, -np.inf, np.float32), 32),
+        (rng.normal(size=10).astype(np.float32), 10),   # k == n
+        (rng.normal(size=5).astype(np.float32), 9),     # k > n
+    ]
+    for arr, k in cases:
+        sv, si = jax.lax.top_k(arr, min(k, len(arr)))
+        hv, hi = host_topk_desc(arr, k)
+        np.testing.assert_array_equal(np.asarray(si), hi)
+        np.testing.assert_array_equal(np.asarray(sv), hv)
+    hv, hi = host_topk_desc(np.ones(4, np.float32), 0)
+    assert len(hv) == 0 and len(hi) == 0
+
+
+def test_rule_mask_cache_hits_and_canonicalization(trained_rules,
+                                                   monkeypatch):
+    """Repeated business rules hit the composed-mask cache; rule ORDER
+    does not fragment it (canonical key), and the no-rule query never
+    touches it."""
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    f1 = {"name": "category", "values": ["books"], "bias": -1}
+    f2 = {"name": "category", "values": ["electronics"], "bias": 2.0}
+    qa = URQuery.from_json({"user": "u2", "num": 5, "fields": [f1, f2]})
+    qb = URQuery.from_json({"user": "u3", "num": 5, "fields": [f2, f1]})
+    algo.predict(model, qa)
+    cache = model.rule_mask_cache("host")
+    assert len(cache) == 1 and cache.misses == 1
+    algo.predict(model, qb)          # reversed order → same canonical key
+    assert len(cache) == 1 and cache.hits >= 1
+    algo.predict(model, URQuery(user="u2", num=5))   # no rules: no lookup
+    assert cache.hits + cache.misses == 2
+
+
+def test_rule_mask_cache_invalidated_per_model_generation(trained_rules,
+                                                          monkeypatch):
+    """Hot-swap/auto-reload loads a NEW model object; its rule-mask cache
+    starts empty (nothing survives pickling)."""
+    import pickle
+
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    q = URQuery.from_json({"user": "u2", "num": 5, "fields": [
+        {"name": "category", "values": ["books"], "bias": -1}]})
+    algo.predict(model, q)
+    assert len(model.rule_mask_cache("host")) == 1
+    swapped = pickle.loads(pickle.dumps(model))
+    assert "_rule_mask_host" not in swapped.__dict__
+    algo.predict(swapped, q)
+    fresh = swapped.rule_mask_cache("host")
+    assert fresh.misses == 1 and fresh.hits == 0
+
+
+def test_rule_mask_cache_eviction_bounded(trained_rules, monkeypatch):
+    import pickle
+
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_RULE_MASK_CACHE", "2")
+    model = pickle.loads(pickle.dumps(models[0]))   # fresh caches
+    for bias in (2.0, 3.0, 4.0):
+        algo.predict(model, URQuery.from_json({
+            "user": "u2", "num": 5,
+            "fields": [{"name": "category", "values": ["books"],
+                        "bias": bias}]}))
+    cache = model.rule_mask_cache("host")
+    assert len(cache) == 2 and cache.evictions == 1
+
+
+def test_lru_cache_touch_on_hit_and_threads():
+    from predictionio_tpu.models.common import LRUCache
+
+    events = []
+    c = LRUCache(2, on_event=events.append)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1           # touch: a is now most-recent
+    c.put("c", 3)                    # evicts b, NOT a
+    assert c.get("a") == 1 and c.get("b") is None and c.get("c") == 3
+    assert c.evictions == 1 and events.count("evict") == 1
+
+    big = LRUCache(8)
+    errors = []
+
+    def hammer(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            for _ in range(2_000):
+                k = int(rng.integers(0, 32))
+                if big.get(k) is None:
+                    big.put(k, k)
+        except Exception as e:   # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors and len(big) <= 8
+
+
+def test_host_inverted_builds_once_under_race(trained_rules):
+    """Concurrent first queries must share ONE postings-index build: every
+    thread gets the identical object, and the build-duration gauge is
+    recorded."""
+    from predictionio_tpu.models.universal_recommender.engine import (
+        _M_INV_BUILD,
+    )
+
+    _, _, models = trained_rules
+    model = models[0]
+    name = next(iter(model.indicator_idx))
+    model.__dict__.pop("_host_inv", None)
+    got = []
+    barrier = threading.Barrier(8)
+
+    def build():
+        barrier.wait()
+        got.append(model.host_inverted(name))
+
+    threads = [threading.Thread(target=build) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(got) == 8
+    assert all(g[0] is got[0][0] for g in got), "race built twice"
+    assert _M_INV_BUILD.value(event=name) > 0.0, "build gauge not recorded"
+
+
+def test_rule_mask_key_quantizes_and_ignores_inert_current_date(
+        trained_rules, monkeypatch):
+    """currentDate instants quantize to whole seconds in the cache key
+    (now()-style traffic shares one entry per second), and a currentDate
+    with NO configured avail/expire property is inert: no mask build, no
+    cache entry — but still strictly parsed."""
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    sub_second = [
+        URQuery.from_json({"user": "u2", "num": 5,
+                           "currentDate": "2026-03-01T00:00:00.200"}),
+        URQuery.from_json({"user": "u3", "num": 5,
+                           "currentDate": "2026-03-01T00:00:00.400"}),
+    ]
+    for q in sub_second:
+        algo.predict(model, q)
+    cache = model.rule_mask_cache("host")
+    assert len(cache) == 1 and cache.hits == 1, \
+        "sub-second currentDate instants must share one mask entry"
+
+    # no avail/expire configured → currentDate contributes nothing
+    inert_algo = URAlgorithm(URAlgorithmParams(
+        app_name="tailapp", mesh_dp=1, max_correlators_per_item=8))
+    import pickle
+
+    fresh = pickle.loads(pickle.dumps(model))
+    inert_algo.predict(fresh, URQuery.from_json(
+        {"user": "u2", "num": 5, "currentDate": "2026-03-01T00:00:00"}))
+    assert "_rule_mask_host" not in fresh.__dict__, \
+        "inert currentDate must not touch the mask cache"
+    with pytest.raises(ValueError):
+        inert_algo.predict(fresh, URQuery.from_json(
+            {"user": "u2", "num": 5, "currentDate": "garbage"}))
+
+
+def test_value_mask_cache_hit_skips_build(trained_rules, monkeypatch):
+    """A value-mask cache HIT must not re-run the O(n_items) mask build
+    (regression guard: the build used to run before the lookup)."""
+    engine, ep, models = trained_rules
+    model = models[0]
+    model.host_value_mask("category", "books")
+    builds = []
+    orig = model._ids_to_mask
+    monkeypatch.setattr(model, "_ids_to_mask",
+                        lambda ids: builds.append(1) or orig(ids))
+    again = model.host_value_mask("category", "books")
+    assert builds == [], "cache hit rebuilt the mask"
+    assert again.any()
+
+
+def test_malformed_query_date_rejected_before_cache(trained_rules,
+                                                    monkeypatch):
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    with pytest.raises(ValueError):
+        algo.predict(models[0], URQuery.from_json(
+            {"user": "u2", "num": 5, "currentDate": "not-a-date"}))
+    assert len(models[0].rule_mask_cache("host")) == 0
+
+
+def test_serve_stage_metrics_and_span_journal(trained_rules, monkeypatch,
+                                              tmp_path):
+    """predict records per-stage tail timings in the pio_* registry and,
+    when a span journal is active, a per-query span whose attrs carry the
+    stage breakdown."""
+    from predictionio_tpu.models.universal_recommender.engine import _M_STAGE
+    from predictionio_tpu.obs.spans import SpanJournal
+
+    engine, ep, models = trained_rules
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    _M_STAGE.clear_series()
+    journal = SpanJournal(tmp_path / "serve.jsonl")
+    with journal.activate():
+        algo.predict(models[0], URQuery(user="u2", num=5))
+    snap = _M_STAGE._snapshot_series()
+    stages = {s for s in ("history", "score", "mask", "topk", "assemble")
+              if any(f'stage="{s}"' in k for k in snap)}
+    assert stages == {"history", "score", "mask", "topk", "assemble"}
+    spans = [s for s in journal._spans if s["name"] == "ur_predict"]
+    assert spans and "topk_ms" in spans[0]["attrs"]
+    assert spans[0]["attrs"]["tail"] == "host"
+
+
+def test_stats_json_503_when_metrics_off(mem_storage, monkeypatch):
+    """PIO_METRICS=off: the event server's /stats.json answers 503 (not a
+    500 traceback / frozen counters); /metrics still serves."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.api.event_server import run_event_server
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.storage import AccessKey
+
+    app_id = mem_storage.apps.insert(App(0, "offapp"))
+    key = mem_storage.access_keys.insert(AccessKey("", app_id, []))
+    obs_metrics.set_enabled(False)
+    httpd = None
+    try:
+        httpd = run_event_server(host="127.0.0.1", port=0,
+                                 storage=mem_storage, background=True)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            urllib.request.urlopen(f"{base}/stats.json?accessKey={key}")
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert "PIO_METRICS" in _json.loads(e.read())["message"]
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            assert r.status == 200
+    finally:
+        obs_metrics.set_enabled(True)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_query_server_stats_json_503_when_metrics_off(tmp_path, rules_app,
+                                                      monkeypatch):
+    """Same contract on the deployed query server."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import deploy
+
+    variant = {
+        "id": "tail-qs",
+        "engineFactory":
+            "predictionio_tpu.models.universal_recommender."
+            "UniversalRecommenderEngine",
+        "datasource": {"params": {"appName": "tailapp",
+                                  "eventNames": ["purchase", "view"]}},
+        "algorithms": [{"name": "ur", "params": {
+            "appName": "tailapp", "eventNames": [], "meshDp": 1,
+            "maxCorrelatorsPerItem": 8}}],
+    }
+    ej = tmp_path / "engine.json"
+    ej.write_text(_json.dumps(variant))
+    engine = UniversalRecommenderEngine.apply()
+    ep = engine.engine_params_from_variant(variant)
+    core_workflow.run_train(engine, ep, engine_id="tail-qs",
+                            storage=rules_app)
+    obs_metrics.set_enabled(False)
+    httpd = None
+    try:
+        httpd = deploy(engine_json=str(ej), host="127.0.0.1", port=0,
+                       storage=rules_app, background=True)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            urllib.request.urlopen(f"{base}/stats.json")
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # /queries.json still serves, and GET / reports the worker pid
+        req = urllib.request.Request(
+            f"{base}/queries.json",
+            data=_json.dumps({"user": "u2", "num": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(base + "/") as r:
+            assert "pid" in _json.loads(r.read())
+    finally:
+        obs_metrics.set_enabled(True)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_check_serve_parity_script():
+    """The tier-1 CI wrapper for scripts/check_serve_parity.py (same
+    pattern as the metric-name and snapshot-integrity lints): trains a
+    small UR model in a fresh process and replays the fixed corpus
+    through both tails, serial and batched, diffing exactly."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_serve_parity.py")],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr

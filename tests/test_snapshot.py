@@ -298,14 +298,34 @@ def test_torn_snapshot_quarantined_and_rebuilt(fsev):
 
 
 def test_concurrent_build_is_exactly_once(fsev, tmp_path):
+    from predictionio_tpu.storage.snapshot import LOCK, SNAP_DIR
+
     fsev.insert_batch(mixed_events(500), 1)
-    proc = _spawn_slow_build(tmp_path / "store", "0.01")
-    assert proc.stdout.readline().strip() == "START"
-    time.sleep(0.5)
-    with pytest.raises(RuntimeError, match="already in progress"):
-        fsev.build_snapshot(1)
-    os.kill(proc.pid, signal.SIGKILL)
-    proc.wait()
+    # hold the builder's flock from another process and only signal once
+    # it is HELD — deterministic, unlike the old fixed sleep (which raced
+    # the child's startup under suite load) or probing the lock from here
+    # (a probe's own momentary exclusive flock could steal the child's
+    # single acquisition attempt)
+    lock_path = fsev._chan_dir(1, None) / SNAP_DIR / LOCK
+    lock_path.parent.mkdir(parents=True, exist_ok=True)
+    script = (
+        "import fcntl, sys, time\n"
+        f"f = open({str(lock_path)!r}, 'a')\n"
+        "fcntl.flock(f.fileno(), fcntl.LOCK_EX)\n"
+        "print('LOCKED', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "LOCKED"
+        with pytest.raises(RuntimeError, match="already in progress"):
+            fsev.build_snapshot(1)
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+    # lock released with the holder: the next build succeeds
+    assert fsev.build_snapshot(1)["events"] == 500
 
 
 # -- delta-aware retrain -----------------------------------------------------
