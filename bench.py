@@ -1305,6 +1305,108 @@ def _measure_qps_latency(port: int, bodies, seconds: float, workers: int):
             sum(len(x) for x in lat_ms))
 
 
+def _serve_trace_overhead(smoke: bool, storage, ur_json: str) -> float:
+    """Flight-recorder overhead guard (the serving twin of
+    _ingest_metrics_overhead): the SAME serial keep-alive /queries.json
+    loop against one in-process worker with the recorder enabled vs
+    disabled, interleaved A/B with min-of aggregation so scheduler noise
+    cancels — one-shot subprocess cells cannot resolve a ≤3% effect
+    (their run-to-run spread is tens of percent on a shared box; the
+    per-worker qps deltas stay recorded as informational keys).  Returns
+    the enabled-over-disabled overhead in percent; raises if it stays
+    above 3% across retries."""
+    import contextlib
+
+    from predictionio_tpu.obs import tracing as obs_tracing
+    from predictionio_tpu.workflow.create_server import deploy
+
+    n_q = 30 if smoke else 150
+    httpd = deploy(engine_json=ur_json, host="127.0.0.1", port=0,
+                   storage=storage, background=True)
+    port = httpd.server_address[1]
+    rec = obs_tracing.get_recorder()
+    try:
+        bodies = [{"user": f"u{j * 13}", "num": 10} for j in range(8)]
+
+        def run(enabled: bool) -> float:
+            rec.enabled = enabled
+            with contextlib.closing(_keepalive_query_conn(port)) as conn:
+                t0 = time.perf_counter()
+                for q in range(n_q):
+                    status, _ = _conn_post(conn, bodies[q % len(bodies)])
+                    assert status == 200
+                return time.perf_counter() - t0
+
+        for _attempt in range(3):
+            run(True)   # warm: shape buckets, caches
+            ons, offs = [], []
+            for _ in range(3):
+                offs.append(run(False))
+                ons.append(run(True))
+            pct = (min(ons) - min(offs)) / min(offs) * 100.0
+            if pct <= 3.0:
+                return pct
+        raise RuntimeError(
+            f"flight-recorder overhead {pct:.2f}% exceeds the 3% budget "
+            "vs PIO_TRACING=off")
+    finally:
+        rec.enabled = True
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _trace_waterfall_demo(port: int, workers: int) -> str:
+    """Cross-worker flight-recorder proof against a LIVE prefork group:
+    pin a keep-alive connection to one worker (GET / → pid), serve an
+    induced slow query on it (X-PIO-Debug forces the tail-sampling keep
+    the way a >PIO_TRACE_SLOW_MS request would be kept), then fetch the
+    full waterfall via /traces/<rid>.json from a connection pinned to a
+    DIFFERENT worker.  Returns 'ok...' or a diagnostic string."""
+    import contextlib
+
+    rid = f"bench-slow-w{workers}-{os.getpid()}"
+
+    def _get(conn, path, headers=None):
+        conn.request("GET", path, headers=headers or {})
+        r = conn.getresponse()
+        return r.status, r.read()
+
+    with contextlib.closing(_keepalive_query_conn(port)) as conn:
+        _s, body = _get(conn, "/")
+        served_pid = json.loads(body)["pid"]
+        conn.request("POST", "/queries.json",
+                     json.dumps({"user": "u1", "num": 10}).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Request-ID": rid, "X-PIO-Debug": "1"})
+        r = conn.getresponse()
+        payload = r.read()
+        if r.status != 200:
+            return f"FAILED query HTTP {r.status}: {payload[:200]!r}"
+    doc = None
+    other_pid = None
+    deadline = time.time() + 60
+    while doc is None and time.time() < deadline:
+        with contextlib.closing(_keepalive_query_conn(port)) as c2:
+            _s, body = _get(c2, "/")
+            pid2 = json.loads(body)["pid"]
+            if pid2 == served_pid:
+                continue   # kernel balanced us back; reconnect
+            status, body = _get(c2, f"/traces/{rid}.json")
+            if status == 200:
+                other_pid = pid2
+                doc = json.loads(body)
+            else:
+                time.sleep(0.2)   # sibling file may still be landing
+    if doc is None:
+        return "FAILED trace never became fetchable from a sibling worker"
+    names = {s.get("name") for s in doc.get("spans", ())}
+    need = {"ur_predict", "history", "score", "mask", "topk", "assemble"}
+    if not need <= names:
+        return f"INCOMPLETE waterfall, missing {sorted(need - names)}"
+    return (f"ok_cross_worker served_pid={served_pid} "
+            f"fetched_from_pid={other_pid} spans={len(doc['spans'])}")
+
+
 def bench_serve_scale(smoke: bool) -> dict:
     """Multi-worker query serving (the serving twin of ingest_scale): a
     REAL ``pio deploy --workers N`` CLI subprocess per cell — prefork
@@ -1318,7 +1420,17 @@ def bench_serve_scale(smoke: bool) -> dict:
     double as a cross-worker/cross-batch-mode response-parity proof.
     One /metrics scrape per worker count records the serve-tail stage
     breakdown (pio_ur_serve_stage_duration_seconds, aggregated across
-    the prefork group)."""
+    the prefork group).
+
+    Flight-recorder demo + guard (obs tentpole): the ``notrace`` cells
+    rerun the batch-off sweep with ``PIO_TRACING=off``
+    (serve_scale_trace_overhead_w{N}_qps_pct, informational); the
+    authoritative ≤3% always-on overhead guard is the interleaved
+    in-process A/B (_serve_trace_overhead → serve_scale_trace_guard);
+    and at the max worker count an induced slow query (forced keep via
+    the X-PIO-Debug header) has its full stage waterfall fetched via
+    /traces/<rid>.json from a DIFFERENT worker than the one that served
+    it (cross-worker merge e2e)."""
     import shutil
     import socket
     import subprocess
@@ -1342,12 +1454,16 @@ def bench_serve_scale(smoke: bool) -> dict:
         n_items, n_users, k, secs = 100_000, 5_000, 50, 3.0
     # deploy --workers requires the CPU backend, where auto resolves to
     # off — the auto cells document that resolution; the "on" cells force
-    # the micro-batcher so batching-vs-not is actually measured
-    batch_modes = ("off", "auto", "on")
+    # the micro-batcher so batching-vs-not is actually measured; the
+    # "notrace" cells are batch-off with PIO_TRACING=off, the baseline
+    # for the always-on flight-recorder overhead guard
+    batch_modes = ("off", "auto", "on", "notrace")
     tmp = tempfile.mkdtemp(prefix="pio_bench_servescale")
     out: dict = {
         "serve_scale_catalog_items": n_items,
         "serve_scale_parity": "not_run",
+        "serve_scale_trace_waterfall": "not_run",
+        "serve_scale_trace_guard": "not_run",
     }
     try:
         _storage, ur_json = _fabricate_ur_serving_store(
@@ -1384,7 +1500,11 @@ def bench_serve_scale(smoke: bool) -> dict:
                 with socket.socket() as s:
                     s.bind(("127.0.0.1", 0))
                     port = s.getsockname()[1]
-                env = {**env_base, "PIO_SERVE_BATCH": mode}
+                env = {**env_base,
+                       "PIO_SERVE_BATCH":
+                           "off" if mode == "notrace" else mode}
+                if mode == "notrace":
+                    env["PIO_TRACING"] = "off"
                 proc = subprocess.Popen(
                     [sys.executable, "-m", "predictionio_tpu.cli.main",
                      "deploy", "--engine-json", ur_json,
@@ -1464,6 +1584,12 @@ def bench_serve_scale(smoke: bool) -> dict:
                                 stages[stage] = round(tot / cnt * 1e3, 4)
                         out[f"serve_scale_w{workers}_tail_stage_avg_ms"] = (
                             stages)
+                    # flight-recorder e2e at the max worker count: an
+                    # induced slow query's waterfall must be retrievable
+                    # from a DIFFERENT worker than the one that served it
+                    if mode == "off" and workers == worker_counts[-1]:
+                        out["serve_scale_trace_waterfall"] = (
+                            _trace_waterfall_demo(port, workers))
                 finally:
                     # graceful /stop fan-in (undeploy-style), then escalate
                     for _ in range(16):
@@ -1487,6 +1613,29 @@ def bench_serve_scale(smoke: bool) -> dict:
             f"serve_scale_w{worker_counts[-1]}_off_"
             f"c{client_counts[-1]}_qps", 0.0)
         out["serve_scale_speedup_wmax_vs_w1"] = wmax / w1 if w1 else 0.0
+        # informational: traced (off) vs untraced (notrace) subprocess
+        # cells at the heaviest client count — noisy on a shared box,
+        # recorded for cross-round eyeballing only
+        cmax = client_counts[-1]
+        for workers in worker_counts:
+            traced = out.get(f"serve_scale_w{workers}_off_c{cmax}_qps", 0.0)
+            bare = out.get(f"serve_scale_w{workers}_notrace_c{cmax}_qps", 0.0)
+            if bare:
+                out[f"serve_scale_trace_overhead_w{workers}_qps_pct"] = (
+                    round((bare - traced) / bare * 100.0, 3))
+            p95_t = out.get(f"serve_scale_w{workers}_off_c{cmax}_p95_ms", 0.0)
+            p95_b = out.get(
+                f"serve_scale_w{workers}_notrace_c{cmax}_p95_ms", 0.0)
+            if p95_b:
+                out[f"serve_scale_trace_overhead_w{workers}_p95_pct"] = (
+                    round((p95_t - p95_b) / p95_b * 100.0, 3))
+        # authoritative ≤3% guard: interleaved in-process A/B (min-of)
+        try:
+            pct = _serve_trace_overhead(smoke, _storage, ur_json)
+            out["serve_scale_trace_overhead_pct"] = round(pct, 3)
+            out["serve_scale_trace_guard"] = "ok"
+        except RuntimeError as e:
+            out["serve_scale_trace_guard"] = f"EXCEEDED {e}"
         return out
     finally:
         set_storage(None)
@@ -1902,6 +2051,8 @@ def main() -> int:
     serve_scale = _run_section("serve_scale", args.smoke, {
         "serve_scale_catalog_items": 0,
         "serve_scale_parity": "section_failed",
+        "serve_scale_trace_waterfall": "section_failed",
+        "serve_scale_trace_guard": "section_failed",
         "serve_scale_speedup_wmax_vs_w1": 0.0,
     })
     snapshot = _run_section("snapshot", args.smoke, {

@@ -22,6 +22,7 @@ from typing import Optional
 from predictionio_tpu import __version__
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.obs import spans as obs_spans
+from predictionio_tpu.obs import tracing as obs_tracing
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.storage.locator import Storage, get_storage
 
@@ -111,6 +112,87 @@ def _snapshot_rows(storage: Storage) -> list:
     return rows
 
 
+def _fmt_epoch(ts) -> str:
+    try:
+        return _dt.datetime.fromtimestamp(
+            float(ts), _dt.timezone.utc).isoformat(timespec="seconds")
+    except (TypeError, ValueError, OSError):
+        return ""
+
+
+def _trace_rows(limit: int = 25) -> str:
+    """Recent retained traces (cross-worker merged) for the front page,
+    each linking to its waterfall."""
+    entries = obs_tracing.get_recorder().index(limit=limit)["traces"]
+    return "".join(
+        '<tr><td><a href="/traces/{rid}.html">{rid}</a></td>'
+        "<td>{meth} {route}</td><td>{status}</td><td>{dur:.1f} ms</td>"
+        "<td>{reason}</td><td>{worker}</td><td>{start}</td></tr>".format(
+            rid=html.escape(str(e.get("rid", ""))),
+            meth=html.escape(str(e.get("method", ""))),
+            route=html.escape(str(e.get("route", ""))),
+            status=e.get("status", 0),
+            dur=float(e.get("durationMs") or 0.0),
+            reason=html.escape(str(e.get("reason", ""))),
+            worker=html.escape(str(e.get("worker", ""))),
+            start=html.escape(_fmt_epoch(e.get("start"))[:19]),
+        )
+        for e in entries
+    ) or "<tr><td colspan=7><i>no retained traces</i></td></tr>"
+
+
+def _render_waterfall_html(doc: dict) -> str:
+    """Waterfall view of one trace: every span as an offset bar over the
+    request's duration, indented by parent depth."""
+    total_ms = max(float(doc.get("durationMs") or 0.0), 1e-6)
+    t0 = float(doc.get("start") or 0.0)
+    spans = sorted(doc.get("spans", ()), key=lambda s: s.get("id", 0))
+    depth = {None: -1}
+    rows = []
+    for s in spans:
+        depth[s.get("id")] = depth.get(s.get("parent"), -1) + 1
+        off_ms = max((float(s.get("start", t0)) - t0) * 1e3, 0.0)
+        dur_ms = float(s.get("duration_s", 0.0)) * 1e3
+        left = min(off_ms / total_ms * 100.0, 100.0)
+        width = max(min(dur_ms / total_ms * 100.0, 100.0 - left), 0.3)
+        attrs = s.get("attrs") or {}
+        attr_txt = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        rows.append(
+            "<tr><td style='padding-left:{ind}em'>{name}{err}</td>"
+            "<td>{dur:.3f} ms</td>"
+            "<td class=wf><div class=bar "
+            "style='margin-left:{left:.2f}%;width:{width:.2f}%'></div></td>"
+            "<td class=attrs>{attrs}</td></tr>".format(
+                ind=depth[s.get("id")] + 0.5,
+                name=html.escape(str(s.get("name", "?"))),
+                err=" &#9888;" if s.get("error") else "",
+                dur=dur_ms, left=left, width=width,
+                attrs=html.escape(attr_txt)))
+    head = (f"{html.escape(str(doc.get('method', '')))} "
+            f"{html.escape(str(doc.get('route', '')))} &rarr; "
+            f"{doc.get('status', 0)} in {total_ms:.1f} ms "
+            f"(worker {html.escape(str(doc.get('worker', '')))}, "
+            f"kept: {html.escape(str(doc.get('reason', '')))})")
+    return f"""<!DOCTYPE html>
+<html><head><title>trace {html.escape(str(doc.get('rid', '')))}</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ border: 1px solid #ccc; padding: 4px 8px; text-align: left; }}
+ td.wf {{ width: 45%; position: relative; }}
+ td.attrs {{ color: #666; font-size: 85%; }}
+ div.bar {{ background: #4a90d9; height: 0.9em; border-radius: 2px; }}
+</style></head>
+<body><h1>Trace {html.escape(str(doc.get('rid', '')))}</h1>
+<p>{head}</p>
+<table><tr><th>span</th><th>duration</th><th>waterfall</th><th>attrs</th></tr>
+{''.join(rows) or '<tr><td colspan=4><i>no spans recorded</i></td></tr>'}
+</table>
+<p><a href="/traces/{html.escape(str(doc.get('rid', '')))}.json">raw JSON</a>
+&middot; <a href="/">dashboard</a></p>
+</body></html>"""
+
+
 def _render_html(storage: Storage) -> str:
     evals = storage.evaluation_instances.get_completed()
     engines = sorted(storage.engine_instances.get_all(),
@@ -180,9 +262,14 @@ def _render_html(storage: Storage) -> str:
 <th>events in tail</th><th>coverage</th><th>built</th>
 <th>build time</th></tr>
 {rows_snap}</table>
+<h2>Recent traces <small>(flight recorder)</small></h2>
+<table><tr><th>request id</th><th>route</th><th>status</th><th>duration</th>
+<th>kept</th><th>worker</th><th>started</th></tr>
+{_trace_rows()}</table>
 <p><a href="/metrics">/metrics</a> &middot;
 <a href="/stats.json">/stats.json</a> &middot;
-<a href="/snapshots.json">/snapshots.json</a></p>
+<a href="/snapshots.json">/snapshots.json</a> &middot;
+<a href="/traces.json">/traces.json</a></p>
 </body></html>"""
 
 
@@ -214,6 +301,16 @@ def make_handler(storage: Storage):
                 # exports, so scraping /metrics right after sees the
                 # same coverage the JSON reports
                 self.send_json({"snapshots": _snapshot_rows(storage)})
+            elif obs_tracing.handle_trace_request(self, path):
+                pass   # /traces.json + /traces/{rid}.json
+            elif path.startswith("/traces/") and path.endswith(".html"):
+                rid = path[len("/traces/"):-len(".html")]
+                doc = obs_tracing.get_recorder().get(rid)
+                if doc is None:
+                    self.send_error_json(
+                        404, f"no retained trace for request id {rid!r}")
+                else:
+                    self.send_html(_render_waterfall_html(doc))
             elif path.startswith("/spans/") and path.endswith(".json"):
                 instance_id = path[len("/spans/"):-len(".json")]
                 spans = obs_spans.read_journal(
@@ -243,6 +340,9 @@ def run_dashboard(
     background: bool = False,
 ):
     storage = storage or get_storage()
+    # join the deployment's traces dir so the flight-recorder tables can
+    # show traces retained by the event/query servers sharing this storage
+    obs_tracing.arm(storage=storage)
     httpd = start_server(make_handler(storage), host, port, background=background)
     log.info("Dashboard listening on %s:%d", host, httpd.server_address[1])
     if background:

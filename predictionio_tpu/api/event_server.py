@@ -32,6 +32,7 @@ from predictionio_tpu import __version__
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.events.event import Event, parse_time
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import tracing as obs_tracing
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.storage.base import AccessKey
 from predictionio_tpu.storage.locator import Storage, get_storage
@@ -174,6 +175,9 @@ def make_handler(state: EventServerState):
                 self._send_raw(200, metrics_payload(),
                                ctype="text/plain; version=0.0.4; "
                                      "charset=utf-8")
+                return
+            if obs_tracing.handle_trace_request(self, path):
+                # flight-recorder index + waterfalls, cross-worker merged
                 return
             if path == "/stop":
                 # graceful shutdown (same contract as the query server's
@@ -500,6 +504,10 @@ def run_event_server(
             os.environ.pop("PIO_WRITER_TAG", None)
         else:
             os.environ["PIO_WRITER_TAG"] = prev_tag
+    # flight recorder: retained traces persist where siblings (prefork
+    # workers via PIO_METRICS_DIR env; a dashboard via the shared storage
+    # path) can merge them into their /traces.json
+    obs_tracing.arm(storage=state.storage)
     httpd = start_server(make_handler(state), host, port,
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
@@ -515,6 +523,10 @@ def run_event_server(
 
         metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
         obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
+        # the parent's traces join the group dir the children will
+        # resolve from their PIO_METRICS_DIR environment
+        obs_tracing.arm(directory=os.path.join(metrics_dir, "traces"),
+                        tag=f"w0-{os.getpid()}")
         children = prefork.spawn_workers(
             workers - 1,
             lambda w: [sys.executable, "-m", "predictionio_tpu.cli.main",

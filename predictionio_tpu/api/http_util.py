@@ -17,12 +17,14 @@ import itertools
 import json
 import logging
 import os
+import re
 import socketserver
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.obs.metrics import get_registry
 
 _access_log = logging.getLogger("pio.http")
@@ -40,14 +42,19 @@ _M_INFLIGHT = _REG.gauge(
 # request-id generation: cheap monotonic id, unique per process
 _RID = itertools.count(1)
 _RID_PREFIX = f"{os.getpid():x}"
+# an incoming X-Request-ID is honored only in this shape: it is echoed
+# into headers, trace files, /traces/<rid>.json URLs, and /metrics
+# exemplar annotations, so an unconstrained client value could corrupt
+# any of those surfaces
+_RID_SAFE = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
 
 # static routes exposed verbatim; everything else is normalized (or
 # bucketed) so per-id paths can't explode label cardinality
 _KNOWN_ROUTES = frozenset({
-    "/", "/stop", "/reload", "/metrics", "/stats.json",
+    "/", "/stop", "/reload", "/metrics", "/stats.json", "/traces.json",
     "/events.json", "/batch/events.json", "/queries.json",
     "/dashboard.json", "/engine_instances.json", "/evaluations.json",
-    "/cmd/app",
+    "/snapshots.json", "/cmd/app",
 })
 
 
@@ -62,6 +69,9 @@ def route_label(path: str) -> str:
         return "/webhooks/{name}.json"
     if route.startswith("/spans/") and route.endswith(".json"):
         return "/spans/{id}.json"
+    if route.startswith("/traces/"):
+        return ("/traces/{rid}.html" if route.endswith(".html")
+                else "/traces/{rid}.json")
     if route.startswith("/cmd/app/"):
         if route.endswith("/accesskeys"):
             return "/cmd/app/{name}/accesskeys"
@@ -194,9 +204,18 @@ class JsonHandler(socketserver.StreamRequestHandler):
         # or mint one, so one id links client logs, access logs, and the
         # echoed response header across the prefork worker group
         rid = headers.get("x-request-id")
-        self.request_id = (rid if rid and len(rid) <= 64
+        self.request_id = (rid if rid and _RID_SAFE.match(rid)
                            else f"{_RID_PREFIX}-{next(_RID):x}")
         self._status_sent = 0
+        # flight recorder: open a live trace keyed by the request id;
+        # spans from instrumented layers accumulate via the contextvar,
+        # and the tail-sampling keep/drop decision happens at the end
+        # (near-zero cost for the dropped 99.9%)
+        recorder = _tracing.get_recorder()
+        trace = recorder.begin(
+            self.request_id, self.command,
+            debug=headers.get("x-pio-debug") is not None)
+        token = _tracing._CURRENT.set(trace) if trace is not None else None
         _M_INFLIGHT.inc()
         t0 = time.perf_counter()
         try:
@@ -211,7 +230,14 @@ class JsonHandler(socketserver.StreamRequestHandler):
         finally:
             _M_INFLIGHT.dec()
             route = route_label(self.path)
-            _M_LAT.observe(time.perf_counter() - t0, route=route)
+            if token is not None:
+                _tracing._CURRENT.reset(token)
+                recorder.finish(trace, self._status_sent or 0, route)
+            # exemplar: the max-latency observation per window carries
+            # its trace id, linking /metrics tails to /traces/<rid>.json
+            _M_LAT.observe(time.perf_counter() - t0, route=route,
+                           exemplar=self.request_id if trace is not None
+                           else None)
             _M_REQS.inc(1, route=route, status=str(self._status_sent or 0))
             sc = self.stats_collector
             if sc is not None:

@@ -13,6 +13,7 @@ bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
   import / export                         event batch files
   eventserver / adminserver / dashboard   REST ingestion / admin API / eval dashboard
   metrics                                 scrape + pretty-print a server's /metrics
+  trace                                   browse a server's request flight recorder
   status                                  storage + env sanity report
   version
 
@@ -389,6 +390,70 @@ def _cmd_metrics(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    """`pio trace <url>` — browse a server's flight recorder: the
+    retained-trace index by default, one request's full waterfall with
+    `--rid`, or the slowest retained request's waterfall with `--slow`.
+    Any pio server works; one worker of a prefork group answers for the
+    whole group (cross-worker merge)."""
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.obs.tracing import render_waterfall_text
+
+    base = args.url
+    if "://" not in base:
+        base = f"http://{base}"
+    base = base.rstrip("/")
+    for suffix in ("/traces.json", "/traces"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+
+    def fetch(path):
+        with urllib.request.urlopen(base + path, timeout=args.timeout) as r:
+            return json.loads(r.read().decode("utf-8", "replace"))
+
+    try:
+        if args.rid:
+            doc = fetch(f"/traces/{args.rid}.json")
+            sys.stdout.write(render_waterfall_text(doc))
+            return 0
+        index = fetch("/traces.json")
+        traces = index.get("traces", [])
+        if args.slow:
+            if not traces:
+                print("No retained traces (nothing slow/errored/sampled "
+                      "yet — send a request with an X-PIO-Debug header to "
+                      "force one).", file=sys.stderr)
+                return 1
+            slowest = max(traces,
+                          key=lambda t: float(t.get("durationMs") or 0.0))
+            doc = fetch(f"/traces/{slowest['rid']}.json")
+            sys.stdout.write(render_waterfall_text(doc))
+            return 0
+        print(f"{len(traces)} retained trace(s) "
+              f"(answered by worker {index.get('worker', '?')}):")
+        for t in traces:
+            print("  %-28s %7.1f ms  %s %-24s %s  kept=%s worker=%s"
+                  % (t.get("rid", "?"), float(t.get("durationMs") or 0.0),
+                     t.get("method", ""), t.get("route", ""),
+                     t.get("status", 0), t.get("reason", "?"),
+                     t.get("worker", "?")))
+        if traces:
+            print(f"(pio trace {args.url} --rid <id> renders a waterfall)")
+        return 0
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read()).get("message", "")
+        except Exception:
+            msg = str(e)
+        print(f"Error: {base}: HTTP {e.code}: {msg}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"Error: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+
+
 def _cmd_train(args) -> int:
     from predictionio_tpu.workflow.create_workflow import run_train_from_args
 
@@ -635,6 +700,20 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--raw", action="store_true",
                     help="dump the raw Prometheus text instead")
     mt.set_defaults(func=_cmd_metrics)
+
+    tc = sub.add_parser(
+        "trace",
+        help="browse a server's request flight recorder "
+             "(/traces.json index; --rid/--slow render a waterfall)")
+    tc.add_argument("url",
+                    help="server base URL or host:port (e.g. "
+                         "http://127.0.0.1:8000 or 127.0.0.1:8000)")
+    tc.add_argument("--rid", default=None,
+                    help="render the waterfall of this request id")
+    tc.add_argument("--slow", action="store_true",
+                    help="render the slowest retained trace's waterfall")
+    tc.add_argument("--timeout", type=float, default=10.0)
+    tc.set_defaults(func=_cmd_trace)
 
     tr = sub.add_parser("train")
     tr.add_argument("--engine-json", default="engine.json")

@@ -49,6 +49,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.models.common import LRUCache, host_topk_desc
 from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.obs import spans as _spans
+from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.ops import cco as cco_ops
 from predictionio_tpu.ops.als import (
     bucket_width,
@@ -1302,18 +1303,31 @@ class URAlgorithm(Algorithm):
         dispatch and zero readback when the scorer is already host-side.
 
         Tail-stage wall times land in pio_ur_serve_stage_duration_seconds
-        and, when a span journal is active (eval/batch runs), as a
-        per-query span with the stage breakdown in its attrs."""
+        and, when a span journal is active (eval/batch runs) or a request
+        trace is live (the flight recorder), as a per-query ``ur_predict``
+        span — under a trace the stage laps also become child spans, so
+        /traces/<rid>.json shows the history→score→mask→topk→assemble
+        waterfall."""
         stages: List[Tuple[str, float]] = []
         journal = _spans.current_journal()
-        if journal is None:
+        trace = _tracing.current_trace() if journal is None else None
+        if journal is None and trace is None:
             return self._predict_staged(model, query, hist_override, stages)
-        with journal.span("ur_predict") as rec:
+        sink = journal if journal is not None else trace
+        with sink.span("ur_predict") as rec:
             res = self._predict_staged(model, query, hist_override, stages)
             rec["attrs"] = {"tail": _serve_tail(),
                             **{f"{n}_ms": round(dt * 1e3, 4)
                                for n, dt in stages}}
-            return res
+        if trace is not None:
+            # laps are strictly sequential, so reconstructed offsets give
+            # exact child-span boundaries without a contextmanager per
+            # stage on the serve hot path
+            off = rec["start"]
+            for n, dt in stages:
+                trace.add_span(n, off, dt, parent=rec["id"])
+                off += dt
+        return res
 
     def _predict_staged(self, model: URModel, query: URQuery,
                         hist_override, stages: List[Tuple[str, float]],

@@ -56,8 +56,22 @@ def render_prometheus(snapshot: dict) -> str:
                     cum += n
                     lines.append(_series_line(
                         name + "_bucket", key, cum, f'le="{_fmt_value(le)}"'))
-                lines.append(_series_line(
-                    name + "_bucket", key, s["count"], 'le="+Inf"'))
+                inf_line = _series_line(
+                    name + "_bucket", key, s["count"], 'le="+Inf"')
+                ex = s.get("ex")
+                if ex:
+                    # OpenMetrics-style exemplar on the +Inf bucket: the
+                    # trace id of the max-latency observation in the
+                    # current exemplar window — the metrics→traces link.
+                    # The middleware only honors [A-Za-z0-9._:-] request
+                    # ids, but escape label-style anyway: a programmatic
+                    # observe(exemplar=...) caller is not so constrained
+                    rid = (str(ex[1]).replace("\\", "\\\\")
+                           .replace('"', '\\"').replace("\n", "\\n"))
+                    inf_line += (' # {trace_id="%s"} %s %s'
+                                 % (rid, _fmt_value(ex[0]),
+                                    _fmt_value(ex[2])))
+                lines.append(inf_line)
                 lines.append(_series_line(name + "_sum", key, s["sum"]))
                 lines.append(_series_line(name + "_count", key, s["count"]))
         else:
@@ -84,6 +98,11 @@ def parse_prometheus_text(text: str):
             if len(parts) >= 4 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3]
             continue
+        if " # {" in line:
+            # strip the OpenMetrics exemplar suffix (see parse_exemplars
+            # for reading it); a label VALUE containing ' # {' would be
+            # truncated here — our own label escaping never produces one
+            line = line.split(" # {", 1)[0]
         try:
             if "{" in line:
                 name, rest = line.split("{", 1)
@@ -156,6 +175,35 @@ def _split_label_body(body: str) -> List[str]:
     return [p for p in (s.strip() for s in parts) if p]
 
 
+def parse_exemplars(text: str) -> Dict[str, List[Tuple[Dict[str, str],
+                                                       str, float]]]:
+    """Extract the exemplars render_prometheus attaches to ``+Inf``
+    bucket lines: ``{line_name: [(labels, trace_id, value), ...]}``."""
+    out: Dict[str, List[Tuple[Dict[str, str], str, float]]] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("#") or " # {" not in line:
+            continue
+        main, _, ex = line.partition(" # {")
+        body, _, tail = ex.partition("}")
+        k, _, v = body.partition("=")
+        if k.strip() != "trace_id":
+            continue
+        trace_id = v.strip().strip('"')
+        try:
+            ex_value = float(tail.split()[0])
+        except (ValueError, IndexError):
+            continue
+        try:
+            name = main.split("{", 1)[0]
+            fams, _t = parse_prometheus_text(main)
+            labels = fams[name][0][0]
+        except (KeyError, IndexError):
+            continue
+        out.setdefault(name, []).append((labels, trace_id, ex_value))
+    return out
+
+
 def family_total(families: dict, name: str,
                  **match: str) -> float:
     """Sum every series of ``name`` whose labels include ``match``."""
@@ -168,15 +216,27 @@ def family_total(families: dict, name: str,
 
 def _quantile_from_buckets(buckets: List[Tuple[float, float]],
                            total: float, q: float) -> float:
-    """Estimate a quantile from cumulative (le, count) pairs by linear
-    interpolation inside the winning bucket."""
+    """Estimate a quantile from cumulative (le, count) pairs by
+    midpoint-rank interpolation inside the winning bucket: the r-th of m
+    observations in a bucket sits at fraction (r − ½)/m of its width.
+    The old target/cum ratio degenerated to the bucket's UPPER bound for
+    high quantiles of a sparsely-hit bucket (a single observation
+    reported p99 ≈ le, overstating the measured latency by up to a whole
+    log-scaled bucket)."""
+    import math
+
     target = q * total
     prev_le, prev_cum = 0.0, 0.0
     for le, cum in buckets:
         if cum >= target:
             if le == float("inf"):
                 return prev_le
-            frac = ((target - prev_cum) / (cum - prev_cum)) if cum > prev_cum else 1.0
+            m = cum - prev_cum
+            if m <= 0:
+                return prev_le
+            # the quantile falls on the r-th observation in this bucket
+            r = max(math.ceil(target - prev_cum), 1)
+            frac = min(max((r - 0.5) / m, 0.0), 1.0)
             return prev_le + (le - prev_le) * frac
         prev_le, prev_cum = le, cum
     return prev_le
@@ -217,8 +277,10 @@ def summarize_prometheus(text: str) -> str:
             if count <= 0:
                 continue
             p50 = _quantile_from_buckets(buckets, count, 0.50)
-            p95 = _quantile_from_buckets(buckets, count, 0.95)
-            p99 = _quantile_from_buckets(buckets, count, 0.99)
+            # clamp p50 ≤ p95 ≤ p99: per-bucket interpolation of a sparse
+            # histogram can otherwise invert adjacent quantiles
+            p95 = max(_quantile_from_buckets(buckets, count, 0.95), p50)
+            p99 = max(_quantile_from_buckets(buckets, count, 0.99), p95)
             out.append(
                 f"  {rest or '(no labels)':40s} count={_fmt_value(count)} "
                 f"sum={total:.4g} avg={total / count:.4g} "

@@ -114,6 +114,13 @@ class Gauge(_Metric):
         return float(self._series.get(_label_key(labels), 0.0))
 
 
+def _exemplar_window_s() -> float:
+    try:
+        return max(float(os.environ.get("PIO_EXEMPLAR_WINDOW_S", "60")), 0.1)
+    except ValueError:
+        return 60.0
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -122,7 +129,13 @@ class Histogram(_Metric):
         super().__init__(registry, name, help)
         self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels: str) -> None:
+        """Record an observation.  ``exemplar`` (keyword-only by
+        convention; it is NOT a label) attaches a trace id: the series
+        keeps the max-value observation's id per rolling
+        PIO_EXEMPLAR_WINDOW_S window, linking the histogram's tail back
+        to a retrievable flight-recorder trace."""
         if not self._reg.enabled:
             return
         key = _label_key(labels)
@@ -137,12 +150,23 @@ class Histogram(_Metric):
             s["counts"][i] += 1
             s["sum"] += value
             s["count"] += 1
+            if exemplar:
+                ex = s.get("ex")
+                now = _time.time()
+                if (ex is None or value >= ex[0]
+                        or now - ex[2] > _exemplar_window_s()):
+                    s["ex"] = [value, exemplar, now]
 
     def _snapshot_series(self):
         with self._lock:
-            return {k: {"counts": list(v["counts"]), "sum": v["sum"],
-                        "count": v["count"]}
-                    for k, v in self._series.items()}
+            out = {}
+            for k, v in self._series.items():
+                d = {"counts": list(v["counts"]), "sum": v["sum"],
+                     "count": v["count"]}
+                if "ex" in v:
+                    d["ex"] = list(v["ex"])
+                out[k] = d
+            return out
 
 
 class MetricsRegistry:
@@ -201,10 +225,27 @@ class MetricsRegistry:
         return out
 
 
+def _merge_exemplar(a, b):
+    """Pick the cross-worker exemplar: prefer a fresh one over a stale
+    one (a dead worker's max must not pin the link forever), then the
+    larger observed value."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    now = _time.time()
+    window = _exemplar_window_s()
+    a_fresh = now - a[2] <= window
+    b_fresh = now - b[2] <= window
+    if a_fresh != b_fresh:
+        return a if a_fresh else b
+    return a if a[0] >= b[0] else b
+
+
 def merge_snapshots(snapshots: Sequence[dict]) -> dict:
     """Sum snapshots across workers: counters/gauges add per series,
     histograms add bucket-wise (boundaries must agree — they come from
-    the same code in every worker)."""
+    the same code in every worker) and keep one exemplar per series."""
     merged: dict = {}
     for snap in snapshots:
         for name, entry in snap.items():
@@ -219,7 +260,7 @@ def merge_snapshots(snapshots: Sequence[dict]) -> dict:
                 cur = tgt["series"].get(key)
                 if entry["type"] == "histogram":
                     if cur is None:
-                        tgt["series"][key] = {
+                        cur = tgt["series"][key] = {
                             "counts": list(val["counts"]),
                             "sum": val["sum"], "count": val["count"]}
                     else:
@@ -227,6 +268,9 @@ def merge_snapshots(snapshots: Sequence[dict]) -> dict:
                                          zip(cur["counts"], val["counts"])]
                         cur["sum"] += val["sum"]
                         cur["count"] += val["count"]
+                    ex = _merge_exemplar(cur.get("ex"), val.get("ex"))
+                    if ex is not None:
+                        cur["ex"] = list(ex)
                 else:
                     tgt["series"][key] = (cur or 0.0) + val
     return merged

@@ -1,10 +1,15 @@
-"""Structured span journal for training/evaluation runs.
+"""Structured span collection: train/eval journals and the building
+block the request flight recorder (``obs.tracing``) shares.
 
 ``utils.tracing.timed`` logged wall-clock spans and accumulated them in a
-dict; this extends that into a persisted artifact: one JSONL file per
-workflow run (train or eval), each line a span with parent/child links,
-written next to the engine instances so ``pio dashboard`` can render the
-breakdown of every completed run.
+dict; :class:`SpanCollector` extends that into structured records with
+parent/child links.  Two consumers build on it:
+
+- :class:`SpanJournal` — one JSONL file per workflow run (train or
+  eval), written next to the engine instances so ``pio dashboard`` can
+  render the breakdown of every completed run;
+- ``obs.tracing.Trace`` — the per-HTTP-request live trace of the flight
+  recorder.
 
 Parent/child structure comes from a per-thread stack: a span opened
 while another is active on the same thread becomes its child.  The
@@ -37,11 +42,15 @@ def current_journal() -> Optional["SpanJournal"]:
     return _CURRENT.get()
 
 
-class SpanJournal:
-    """Collects spans for one run and writes them as JSONL on close."""
+class SpanCollector:
+    """Accumulates spans with parent/child links (per-thread stacks).
 
-    def __init__(self, path):
-        self.path = Path(path)
+    Span record shape (shared by journals, traces, and the dashboard
+    renderers): ``{id, parent, name, start, duration_s, end, attrs?,
+    error?}`` — ``start``/``end`` are wall-clock epoch seconds,
+    ``duration_s`` is measured on the monotonic clock."""
+
+    def __init__(self):
         self._lock = threading.Lock()
         self._spans: List[dict] = []
         self._next_id = 1
@@ -77,23 +86,96 @@ class SpanJournal:
             stack.pop()
             with self._lock:
                 self._spans.append(rec)
+            if parent is None:
+                self._on_root_complete()
+
+    def add_span(self, name: str, start: float, duration_s: float,
+                 parent: Optional[int] = None,
+                 attrs: Optional[dict] = None) -> dict:
+        """Record an already-measured span (e.g. serve-tail stage laps
+        reconstructed from accumulated wall times) without paying a
+        contextmanager per stage on the hot path."""
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+            rec = {"id": span_id, "parent": parent, "name": name,
+                   "start": start, "duration_s": duration_s,
+                   "end": start + duration_s}
+            if attrs:
+                rec["attrs"] = dict(attrs)
+            self._spans.append(rec)
+        return rec
+
+    def spans(self) -> List[dict]:
+        with self._lock:
+            return sorted(self._spans, key=lambda s: s["id"])
+
+    def _on_root_complete(self) -> None:
+        """Hook: a top-level span just finished (journals flush here)."""
+
+
+class SpanJournal(SpanCollector):
+    """Collects spans for one run and persists them as JSONL
+    incrementally: every completed ROOT span flushes the buffered
+    records, so a crashed train/eval run keeps every phase that finished
+    before the crash instead of losing the whole journal (the old
+    write-once-at-close behavior)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = Path(path)
+        self._file = None
+        self._flushed = 0   # count of spans already appended to the file
+
+    def _on_root_complete(self) -> None:
+        try:
+            self.flush()
+        except OSError:
+            import logging
+
+            logging.getLogger("pio.trace").exception(
+                "span journal flush failed: %s", self.path)
+
+    def flush(self) -> None:
+        """Append every not-yet-persisted completed span to the file and
+        flush to the OS, so a SIGKILLed process loses at most the spans
+        still open (never a completed root and its children)."""
+        with self._lock:
+            pending = sorted(self._spans[self._flushed:],
+                             key=lambda s: s["id"])
+            self._flushed = len(self._spans)
+            if not pending:
+                return
+            if self._file is None:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                # "w": a journal owns its path for exactly one run; any
+                # stale file from a recycled instance id must not prepend
+                # a previous run's spans
+                self._file = open(self.path, "w")
+            for rec in pending:
+                self._file.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._file.flush()
 
     def write(self) -> None:
-        """Persist atomically (tmp+rename): a crashed run leaves either
-        the previous journal or the full new one, never a torn file."""
+        """Final drain + close (kept under its historical name: callers
+        treat it as 'persist everything now')."""
+        self.flush()
         with self._lock:
-            spans = sorted(self._spans, key=lambda s: s["id"])
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = self.path.with_suffix(self.path.suffix + f".tmp{os.getpid()}")
-        with open(tmp, "w") as f:
-            for rec in spans:
-                f.write(json.dumps(rec, sort_keys=True) + "\n")
-        tmp.replace(self.path)
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            elif not self._spans:
+                # a run that recorded nothing still leaves an empty
+                # journal, preserving the old write()'s contract that the
+                # file exists after a completed run
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                self.path.touch()
 
     @contextlib.contextmanager
     def activate(self) -> Iterator["SpanJournal"]:
         """Make this the process-current journal (timed() feeds it) for
-        the duration; the journal is written on exit, success or not."""
+        the duration; the journal is fully persisted on exit, success or
+        not (and incrementally while running)."""
         token = _CURRENT.set(self)
         try:
             yield self
@@ -109,7 +191,8 @@ class SpanJournal:
 
 
 def read_journal(path) -> List[dict]:
-    """Load a journal; missing file → []."""
+    """Load a journal; missing file → [].  A torn final line (crash
+    mid-append) is skipped, matching the incremental-append format."""
     p = Path(path)
     if not p.exists():
         return []

@@ -9,19 +9,35 @@ from __future__ import annotations
 
 import datetime as _dt
 import http.client
+import itertools
 import json
+import os
 import threading
 import time
 import urllib.parse
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
+# per-request X-Request-ID minting: unique across processes and across
+# client instances in one process, cheap (no uuid4 per request).  The
+# server echoes the id and keys its flight-recorder trace on it, so a
+# client-side failure is joinable against the server's /traces/<rid>.json
+_RID_SEED = f"sdk-{os.getpid():x}-{os.urandom(3).hex()}"
+_RID_COUNTER = itertools.count(1)
+
+
+def _mint_rid() -> str:
+    return f"{_RID_SEED}-{next(_RID_COUNTER):x}"
+
 
 class PIOError(Exception):
-    def __init__(self, status: int, message: str):
-        super().__init__(f"HTTP {status}: {message}")
+    def __init__(self, status: int, message: str,
+                 request_id: Optional[str] = None):
+        tail = f" [request-id {request_id}]" if request_id else ""
+        super().__init__(f"HTTP {status}: {message}{tail}")
         self.status = status
         self.message = message
+        self.request_id = request_id
 
 
 class _Conn:
@@ -48,7 +64,9 @@ class _Conn:
 
     def request(self, method: str, path_qs: str, body: Any = None) -> Any:
         data = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
+        rid = _mint_rid()
+        headers = {"Content-Type": "application/json",
+                   "X-Request-ID": rid}
         tl = self._tl
         # a long-idle keep-alive socket may have been reaped by the
         # server; reconnecting up front keeps the no-retry-after-send
@@ -88,13 +106,17 @@ class _Conn:
                     BrokenPipeError, http.client.RemoteDisconnected,
                 )) and (not sent or method in ("GET", "DELETE"))
                 if attempt or not retriable:
+                    # transport failures keep their type (callers and the
+                    # retry contract depend on it); the request id rides
+                    # along as an attribute for log joining
+                    e.request_id = rid
                     raise
         if resp.status >= 400:
             try:
                 message = json.loads(payload).get("message", "")
             except Exception:
                 message = resp.reason
-            raise PIOError(resp.status, message)
+            raise PIOError(resp.status, message, request_id=rid)
         return json.loads(payload) if payload else None
 
 
@@ -131,13 +153,17 @@ class AsyncResult:
     been read, then returns the parsed body (raising PIOError for HTTP
     errors) — responses arrive strictly in request order (HTTP/1.1)."""
 
-    __slots__ = ("_pipe", "_value", "_error", "done")
+    __slots__ = ("_pipe", "_value", "_error", "done", "request_id")
 
-    def __init__(self, pipe: "EventPipeline"):
+    def __init__(self, pipe: "EventPipeline", request_id: str = ""):
         self._pipe = pipe
         self._value: Any = None
         self._error: Optional[Exception] = None
         self.done = False
+        # the X-Request-ID this request was sent with: echoed by the
+        # server, keyed into its flight recorder, and carried in any
+        # PIOError this handle raises
+        self.request_id = request_id
 
     def result(self) -> Any:
         if not self.done:
@@ -237,13 +263,14 @@ class EventPipeline:
         if self._closed:
             raise PIOError(0, "pipeline is closed")
         data = json.dumps(body).encode()
+        rid = _mint_rid()
         self._buf += (
-            b"%s %s HTTP/1.1\r\nHost: %s\r\n"
+            b"%s %s HTTP/1.1\r\nHost: %s\r\nX-Request-ID: %s\r\n"
             b"Content-Type: application/json\r\nContent-Length: %d\r\n\r\n"
             % (method.encode(), (self._prefix + path_qs).encode(),
-               self._host, len(data))
+               self._host, rid.encode(), len(data))
         ) + data
-        h = AsyncResult(self)
+        h = AsyncResult(self, request_id=rid)
         self._pending.append(h)
         if len(self._buf) >= self._SEND_BUF:
             self._flush_buf()
@@ -292,7 +319,12 @@ class EventPipeline:
         this, pending ``result()`` calls raise ``err`` instead of
         touching the dead/closed stream."""
         for h in self._pending:
-            h.done, h._error = True, err
+            h.done = True
+            # PIOErrors are re-minted per handle so each carries ITS
+            # request id (the joinable key against server-side traces)
+            h._error = (PIOError(err.status, err.message,
+                                 request_id=h.request_id)
+                        if isinstance(err, PIOError) else err)
         self._pending.clear()
         del self._buf[:]
         self._release_socket()
@@ -326,7 +358,8 @@ class EventPipeline:
                     message = json.loads(payload).get("message", "")
                 except Exception:
                     message = ""
-                h._error = PIOError(status, message)
+                h._error = PIOError(status, message,
+                                    request_id=h.request_id)
             else:
                 h._value = json.loads(payload) if payload else None
             if closing:

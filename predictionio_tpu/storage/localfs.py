@@ -28,6 +28,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from predictionio_tpu.events.event import Event, canonical_event_json
 from predictionio_tpu.obs.metrics import LATENCY_BUCKETS, SIZE_BUCKETS, get_registry
+from predictionio_tpu.obs.tracing import trace_span
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.base import (
     AccessKey,
@@ -849,6 +850,11 @@ class FSEvents(base.LEvents, base.PEvents):
         serving micro-batcher.  Buffers arriving while a commit is in
         flight queue for the next leader — any waiter claims the vacancy
         when woken (leadership is released, never transferred)."""
+        with trace_span("group_commit_append"):
+            self._append_lines_traced(lines, app_id, channel_id)
+
+    def _append_lines_traced(self, lines: str, app_id: int,
+                             channel_id: Optional[int]) -> None:
         key = (app_id, channel_id)
         with self._lock:
             g = self._groups.get(key)
@@ -1073,9 +1079,10 @@ class FSEvents(base.LEvents, base.PEvents):
 
         if not _snap.enabled():
             return None
-        self.segment_paths(app_id, channel_id)   # recover crashed compaction
-        d = self._chan_dir(app_id, channel_id)
-        res = _snap.scan_snapshot(d, self._tombstones(d))
+        with trace_span("snapshot_scan"):
+            self.segment_paths(app_id, channel_id)  # recover crashed compaction
+            d = self._chan_dir(app_id, channel_id)
+            res = _snap.scan_snapshot(d, self._tombstones(d))
         if res is None:
             _snap.record_miss()
         else:

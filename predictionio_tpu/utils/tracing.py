@@ -67,12 +67,15 @@ def timed(name: str, sink: Optional[dict] = None) -> Iterator[None]:
     ``sink[name]`` accumulates seconds across calls and
     ``sink[name + ".count"]`` the number of calls, so a sink consumer can
     tell one 10 s span from a thousand 10 ms ones.  When a span journal
-    is active (obs.spans), the block is also recorded there as a
-    structured span (with parent/child nesting)."""
+    is active (obs.spans: train/eval runs), the block is also recorded
+    there as a structured span (with parent/child nesting); otherwise,
+    when a request trace is live (obs.tracing flight recorder), it lands
+    in that trace's waterfall instead."""
     from predictionio_tpu.obs import spans as _spans
+    from predictionio_tpu.obs import tracing as _tracing
 
-    journal = _spans.current_journal()
-    ctx = journal.span(name) if journal is not None else contextlib.nullcontext()
+    sink_obj = _spans.current_journal() or _tracing.current_trace()
+    ctx = sink_obj.span(name) if sink_obj is not None else contextlib.nullcontext()
     t0 = time.perf_counter()
     try:
         with ctx:

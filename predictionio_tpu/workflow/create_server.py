@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional
 from predictionio_tpu.api import prefork
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import tracing as obs_tracing
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.obs.metrics import SIZE_BUCKETS
 from predictionio_tpu.storage.locator import Storage, get_storage
@@ -426,6 +427,8 @@ def make_handler(state: QueryServerState):
                 self._send_raw(200, metrics_payload(),
                                ctype="text/plain; version=0.0.4; "
                                      "charset=utf-8")
+            elif obs_tracing.handle_trace_request(self, path):
+                pass   # /traces.json + /traces/{rid}.json (flight recorder)
             elif path == "/stats.json":
                 if self.stats_collector is None:
                     self.send_error_json(
@@ -558,6 +561,10 @@ def deploy(
         plugins=plugins, auto_reload=auto_reload,
     )
     child_procs: list = []
+    # flight recorder: prefork children resolve the group's traces dir
+    # from PIO_METRICS_DIR; single workers persist next to the storage
+    # spans dir so the dashboard can merge them
+    obs_tracing.arm(storage=state.storage)
     httpd = start_server(make_handler(state), host, port,
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
@@ -568,6 +575,8 @@ def deploy(
 
         metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
         obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
+        obs_tracing.arm(directory=os.path.join(metrics_dir, "traces"),
+                        tag=f"w0-{os.getpid()}")
         child_procs = prefork.spawn_workers(
             workers - 1,
             lambda w: (

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Lint the metric-name contract.
+"""Lint the metric-name AND trace-span-name contracts.
 
 Imports every module that declares instruments (they register at import
 time) and verifies each registered metric:
@@ -10,14 +10,25 @@ time) and verifies each registered metric:
 - carries a non-empty help string;
 - histograms have strictly increasing bucket boundaries.
 
+Then statically scans the package source (AST, not regex — multiline
+calls and nesting are handled) for flight-recorder/journal span calls —
+``.span("name", attr=...)``, ``trace_span("name", ...)``,
+``timed("name")``, ``add_span("name", ...)`` — and lints every literal
+span name and attr keyword against ``obs.tracing.SPAN_NAME_PATTERN``
+(lowercase snake with optional dots: the pio_-style contract minus the
+prefix), so waterfall rows and span-based dashboards stay greppable and
+stable.
+
 Run standalone (``python scripts/check_metrics_names.py``) or via the
 tier-1 suite (tests/test_obs_metrics.py wraps it), exit 0 = clean.
 """
 
 from __future__ import annotations
 
+import ast
 import importlib
 import os
+import re
 import sys
 
 # runnable from any cwd without an installed package
@@ -28,6 +39,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # if its names are bad AND it happens to be imported transitively)
 INSTRUMENTED_MODULES = [
     "predictionio_tpu.obs.metrics",
+    "predictionio_tpu.obs.tracing",
     "predictionio_tpu.api.http_util",
     "predictionio_tpu.api.event_server",
     "predictionio_tpu.api.dashboard",
@@ -39,12 +51,68 @@ INSTRUMENTED_MODULES = [
 ]
 
 
+SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})
+# span attrs assigned post-hoc (rec["attrs"] = {...}) use literal dict
+# keys; f-string keys (dynamic stage suffixes) are checked on their
+# literal prefix parts only
+_ATTRS_SUBSCRIPT = "attrs"
+
+
+def lint_span_names(pkg_root: str) -> list:
+    """Every literal span name and attr key in ``pkg_root`` must match
+    SPAN_NAME_PATTERN."""
+    from predictionio_tpu.obs.tracing import SPAN_NAME_PATTERN
+
+    name_re = re.compile(SPAN_NAME_PATTERN)
+    problems = []
+
+    def check(value: str, where: str) -> None:
+        if not name_re.match(value):
+            problems.append(
+                f"{where}: span/attr name {value!r} violates "
+                f"{SPAN_NAME_PATTERN}")
+
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    problems.append(f"{path}: unparseable: {e}")
+                    continue
+            rel = os.path.relpath(path, os.path.dirname(pkg_root))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                         else node.func.id if isinstance(node.func, ast.Name)
+                         else None)
+                if fname not in SPAN_CALL_NAMES:
+                    continue
+                where = f"{rel}:{node.lineno}"
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    check(node.args[0].value, where)
+                for kw in node.keywords:
+                    if kw.arg and kw.arg not in ("parent", "attrs",
+                                                 "start", "duration_s"):
+                        check(kw.arg, where)
+    return problems
+
+
 def main() -> int:
     for mod in INSTRUMENTED_MODULES:
         importlib.import_module(mod)
     from predictionio_tpu.obs.metrics import NAME_RE, Histogram, get_registry
 
     problems = []
+    pkg_root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "predictionio_tpu")
+    problems += lint_span_names(pkg_root)
     metrics = get_registry().metrics()
     for m in metrics:
         if not NAME_RE.match(m.name):
@@ -59,7 +127,8 @@ def main() -> int:
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
-        print(f"ok: {len(metrics)} metrics, names and help strings clean")
+        print(f"ok: {len(metrics)} metrics + span-name scan, "
+              "names and help strings clean")
     return 1 if problems else 0
 
 
