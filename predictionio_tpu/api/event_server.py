@@ -51,8 +51,9 @@ def _max_batch() -> int:
 
     Raising it lets high-volume importers amortize per-request HTTP cost
     over bigger group-committed appends; the request body is bounded by
-    the cap × event size, so keep it within what one thread should buffer
-    (a 10k-event batch is ~2 MB)."""
+    the cap × event size and buffered by the event loop before dispatch,
+    so keep it comfortably under PIO_HTTP_MAX_BODY (default 64 MiB; a
+    10k-event batch is ~2 MB)."""
     raw = os.environ.get("PIO_MAX_BATCH")
     if raw is None:
         return MAX_BATCH

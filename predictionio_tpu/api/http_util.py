@@ -1,27 +1,57 @@
 """Minimal shared HTTP plumbing for the REST servers (stdlib-only — the image
 has no FastAPI; reference servers are spray-can actors, SURVEY.md §2).
 
-The request loop is hand-rolled rather than BaseHTTPRequestHandler's:
-stdlib routes every request's headers through email.parser (~0.3 ms of
-GIL-held work per request, measured the bulk of single-event ingest
-latency).  The lean loop below parses the request line + headers with
-plain splits and writes each response as ONE sendall, which with
-keep-alive and TCP_NODELAY takes the same stdlib stack from ~1.2k to
->10k single-event POSTs/s (bench_ingest).  Handler subclasses keep the
-BaseHTTPRequestHandler-ish surface they already used: ``self.path``,
-``self.headers.get``, ``do_GET``/``do_POST``, plus the JSON helpers."""
+The front end is a nonblocking event loop, not a thread per connection:
+BENCH_r05 measured the old ``socketserver.ThreadingTCPServer`` stack
+plateauing at ~426 qps (c8) and *falling* to ~369 qps at c32 while the
+serve tail itself cost 0.69 ms — 32 handler threads convoying on the
+GIL and the accept queue were the wall, not the model.  Here one
+selectors-based loop per prefork worker owns every socket: it accepts,
+parses request line + headers + body with plain buffer splits (no
+email.parser, no per-line syscalls), and hands COMPLETE requests to a
+small handler pool; responses flow back through per-connection ordered
+slots, so HTTP/1.1 keep-alive and pipelining work across arbitrarily
+interleaved handler completions.  Idle keep-alive connections are
+reaped by the loop itself (no reaper thread per connection), slow
+clients (partial headers, dribbled bodies) just occupy buffer space
+until their bytes arrive or the idle timeout fires, and response heads
+are assembled from preassembled per-(status, content-type) templates
+with ``sendmsg`` gather writes — no per-response f-string churn.
+
+Handler subclasses keep the BaseHTTPRequestHandler-ish surface they
+already used: ``self.path``, ``self.headers.get``, ``do_GET``/``do_POST``,
+``self.client_address``, ``self.server``, plus the JSON helpers.  The
+request body is fully buffered before dispatch, so ``read_json`` never
+blocks and an errored handler can never leave body bytes in the stream.
+
+Tuning knobs (all env):
+
+- ``PIO_HTTP_BACKLOG``        listen(2) backlog (default 1024)
+- ``PIO_HTTP_POOL``           handler threads per worker (default ≈
+                              cores, clamped to 2–16; 0 = run handlers
+                              inline on the loop thread)
+- ``PIO_HTTP_PIPELINE_DEPTH`` max in-flight requests per connection
+                              before the loop stops reading it (64)
+- ``PIO_HTTP_IDLE_S``         idle keep-alive reap timeout (120)
+- ``PIO_HTTP_MAX_BODY``       request body cap in bytes (64 MiB; over
+                              it: 413 + close, never buffered)
+"""
 
 from __future__ import annotations
 
+import io
 import itertools
 import json
 import logging
 import os
+import queue
 import re
-import socketserver
+import selectors
+import socket
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.obs import tracing as _tracing
@@ -38,6 +68,8 @@ _M_LAT = _REG.histogram(
     "Request handling latency by route (parse to response written)")
 _M_INFLIGHT = _REG.gauge(
     "pio_http_requests_in_flight", "Requests currently being handled")
+_M_CONNS = _REG.gauge(
+    "pio_http_connections", "Open connections held by the event loop")
 
 # request-id generation: cheap monotonic id, unique per process
 _RID = itertools.count(1)
@@ -81,17 +113,6 @@ def route_label(path: str) -> str:
     return "(other)"
 
 
-class ThreadingHTTPServer(socketserver.ThreadingTCPServer):
-    """Drop-in for http.server.ThreadingHTTPServer (daemon threads,
-    reusable address) serving the lean JsonHandler loop."""
-
-    allow_reuse_address = True
-    daemon_threads = True
-    # socketserver's default backlog of 5 RSTs connection bursts (32
-    # concurrent fresh-connection clients in the QPS sweep)
-    request_queue_size = 128
-
-
 class _Headers(Dict[str, str]):
     """Case-insensitive .get over lower-cased header names."""
 
@@ -102,159 +123,804 @@ class _Headers(Dict[str, str]):
 _REASON = {
     200: "OK", 201: "Created", 400: "Bad Request", 401: "Unauthorized",
     403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
-    411: "Length Required", 500: "Internal Server Error",
+    411: "Length Required", 413: "Payload Too Large",
+    431: "Request Header Fields Too Large", 500: "Internal Server Error",
     501: "Not Implemented", 503: "Service Unavailable",
 }
 
+_CT_JSON = "application/json; charset=utf-8"
+_KEEP_TAIL = b"Connection: keep-alive\r\n\r\n"
+_CLOSE_TAIL = b"Connection: close\r\n\r\n"
+_CONTINUE = b"HTTP/1.1 100 Continue\r\n\r\n"
+# preassembled status+static-header prefixes, keyed by (status, ctype):
+# the hot path joins [prefix, rid line, length line, connection tail,
+# body] instead of formatting a fresh head string per response
+_HEAD_CACHE: Dict[Tuple[int, str], bytes] = {}
 
-class JsonHandler(socketserver.StreamRequestHandler):
-    """Base handler with JSON request/response helpers; quiet logging."""
 
-    server_version = "pio-tpu"
-    protocol_version = "HTTP/1.1"
-    # per-server-class stats.json window collector (obs.exposition
-    # StatsCollector); the middleware records (status, route) into it
-    stats_collector = None
-    # Nagle + delayed-ACK interact catastrophically with keep-alive
-    # request/response traffic: the response's last segment sits in the
-    # kernel ~40 ms waiting for an ACK the client won't send until its
-    # delayed-ACK timer fires.  Measured 23 events/s serial keep-alive
-    # without this; wire-speed with it.
-    disable_nagle_algorithm = True
-    # reap idle keep-alive connections (each holds a daemon thread)
-    timeout = 120
+def _head_prefix(status: int, ctype: str) -> bytes:
+    pre = _HEAD_CACHE.get((status, ctype))
+    if pre is None:
+        pre = (f"HTTP/1.1 {status} {_REASON.get(status, '')}\r\n"
+               f"Server: pio-tpu\r\n"
+               f"Content-Type: {ctype}\r\n").encode("latin-1")
+        if len(_HEAD_CACHE) < 256:   # bounded: ctype values are static
+            _HEAD_CACHE[(status, ctype)] = pre
+    return pre
 
-    def log_message(self, fmt, *args):  # route access logs to logging, not stderr
-        _access_log.debug(fmt, *args)
 
-    # -- request loop --------------------------------------------------------
+def assemble_response(status: int, body: bytes, ctype: str = _CT_JSON,
+                      rid: str = "", close: bool = False) -> bytes:
+    parts = [_head_prefix(status, ctype)]
+    if rid:
+        parts.append(b"X-Request-ID: %s\r\n" % rid.encode("latin-1"))
+    parts.append(b"Content-Length: %d\r\n" % len(body))
+    parts.append(_CLOSE_TAIL if close else _KEEP_TAIL)
+    parts.append(body)
+    return b"".join(parts)
 
-    def handle(self) -> None:
-        self.close_connection = False
-        try:
-            while not self.close_connection:
-                if not self._handle_one():
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class _Request:
+    __slots__ = ("seq", "command", "path", "headers", "body", "close")
+
+    def __init__(self, seq, command, path, headers, body, close):
+        self.seq = seq
+        self.command = command
+        self.path = path
+        self.headers = headers
+        self.body = body
+        self.close = close
+
+
+class _Connection:
+    """One accepted socket: read buffer + parse state (loop thread only)
+    and ordered response slots + write queue (shared with handler
+    threads under ``lock``)."""
+
+    __slots__ = (
+        "server", "sock", "addr", "fd", "lock", "inbuf", "pending_req",
+        "outq", "out_off", "next_seq", "next_send", "done", "inflight",
+        "inflight_bytes", "paused", "no_more_requests", "peer_eof",
+        "closing", "dead", "closed", "interest", "last_activity",
+    )
+
+    def __init__(self, server: "EventLoopHTTPServer", sock, addr):
+        self.server = server
+        self.sock = sock
+        self.addr = addr
+        self.fd = sock.fileno()
+        self.lock = threading.Lock()
+        self.inbuf = bytearray()
+        self.pending_req = None      # parsed head awaiting its body bytes
+        self.outq: deque = deque()   # response byte blobs, flush order
+        self.out_off = 0             # bytes of outq[0] already sent
+        self.next_seq = 0            # next response slot to allocate
+        self.next_send = 0           # next slot eligible to hit the wire
+        self.done: Dict[int, Tuple[bytes, bool]] = {}
+        self.inflight = 0            # dispatched, response not yet slotted
+        self.inflight_bytes = 0      # body bytes held by dispatched reqs
+        self.paused = False          # pipeline depth hit: reads suspended
+        self.no_more_requests = False
+        self.peer_eof = False
+        self.closing = False         # close once outq drains
+        self.dead = False            # socket error: close asap
+        self.closed = False
+        self.interest = 0            # currently-registered selector mask
+        self.last_activity = time.monotonic()
+
+    # loop thread only
+    def alloc_seq(self) -> int:
+        s = self.next_seq
+        self.next_seq += 1
+        return s
+
+    def push_slot(self, seq: int, data: bytes, close: bool) -> None:
+        """Complete response slot ``seq``; safe from any thread.  Flushes
+        every consecutive completed slot inline (the common in-order case
+        hits the socket without a loop round trip); leftovers are picked
+        up by the loop via the wake pipe."""
+        with self.lock:
+            if self.closed or self.dead or self.closing:
+                # closing: a close-marked response already flushed —
+                # nothing may follow it on the wire, even a completion
+                # that raced in while it drained
+                return
+            self.done[seq] = (data, close)
+            progressed = False
+            while self.next_send in self.done:
+                d, c = self.done.pop(self.next_send)
+                self.next_send += 1
+                self.outq.append(d)
+                progressed = True
+                if c:
+                    # this response ends the connection: anything already
+                    # slotted after it will never be sent
+                    self.closing = True
+                    self.no_more_requests = True
+                    self.done.clear()
                     break
-        except (ConnectionError, TimeoutError, OSError):
+            if progressed:
+                self._flush_locked()
+            self.last_activity = time.monotonic()
+        self.server._wake(self)
+
+    def _flush_locked(self) -> None:
+        """Send as much of outq as the kernel will take; gather writes
+        via sendmsg so pipelined responses leave in one syscall."""
+        if self.dead or self.closed:
+            self.outq.clear()
+            return
+        try:
+            while self.outq:
+                if len(self.outq) == 1 and not self.out_off:
+                    n = self.sock.send(self.outq[0])
+                    self.last_activity = time.monotonic()
+                else:
+                    bufs = [memoryview(self.outq[0])[self.out_off:]]
+                    for i, b in enumerate(self.outq):
+                        if i == 0:
+                            continue
+                        if len(bufs) >= 16:
+                            break
+                        bufs.append(memoryview(b))
+                    n = self.sock.sendmsg(bufs)
+                    self.last_activity = time.monotonic()
+                    n += self.out_off
+                self.out_off = 0
+                while self.outq and n >= len(self.outq[0]):
+                    n -= len(self.outq[0])
+                    self.outq.popleft()
+                if n:
+                    self.out_off = n   # kernel buffer full: partial send
+                    break
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self.dead = True
+            self.outq.clear()
+
+    # loop thread only
+    def close(self) -> None:
+        if self.closed:
+            return
+        with self.lock:
+            self.closed = True
+            self.outq.clear()
+            self.done.clear()
+        if self.interest:
+            try:
+                self.server._sel.unregister(self.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            self.interest = 0
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self.server._conns.pop(self.fd, None) is not None:
+            _M_CONNS.dec()
+
+
+class EventLoopHTTPServer:
+    """Nonblocking event-loop HTTP server with a handler thread pool.
+
+    API-compatible with the ``socketserver`` surface the servers and
+    tests already use: ``server_address``, ``serve_forever()``,
+    ``shutdown()``, ``server_close()`` (instance-patchable — prefork's
+    ``wire_shutdown`` wraps it).  One instance per prefork worker;
+    scale across cores with SO_REUSEPORT workers, scale within a worker
+    with the pool/in-flight knobs.
+    """
+
+    allow_reuse_address = True   # honored in __init__, socketserver-style
+
+    def __init__(self, server_address, RequestHandlerClass,
+                 reuse_port: bool = False):
+        self.RequestHandlerClass = RequestHandlerClass
+        self.backlog = _int_env("PIO_HTTP_BACKLOG", 1024)
+        self.max_body = _int_env("PIO_HTTP_MAX_BODY", 64 << 20)
+        self.pipeline_depth = max(1, _int_env("PIO_HTTP_PIPELINE_DEPTH", 64))
+        try:
+            self.idle_timeout = float(os.environ["PIO_HTTP_IDLE_S"])
+        except (KeyError, ValueError):
+            self.idle_timeout = float(
+                getattr(RequestHandlerClass, "timeout", 120) or 120)
+        # handlers are mostly GIL-bound Python (parse → storage/model →
+        # JSON): threads beyond the core count just convoy on the GIL
+        # and measurably LOSE qps (pool=8 on a 2-core box: −30% at c8
+        # vs pool=2), so the default tracks cores; raise it only for
+        # genuinely blocking handlers (slow shared-fs storage)
+        pool = _int_env("PIO_HTTP_POOL", -1)
+        if pool < 0:
+            pool = max(2, min(16, os.cpu_count() or 1))
+        self._pool_size = pool
+        self._nagle_off = getattr(
+            RequestHandlerClass, "disable_nagle_algorithm", True)
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        if self.allow_reuse_address:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._sock.bind(server_address)
+        self._sock.listen(self.backlog)
+        self._sock.setblocking(False)
+        self.server_address = self._sock.getsockname()
+
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._sock, selectors.EVENT_READ, "accept")
+        # self-pipe: handler threads wake the loop after completing a
+        # response (selector mutation is loop-thread-only)
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._wake_lock = threading.Lock()
+        self._wake_set: set = set()
+        self._wake_armed = False
+
+        self._conns: Dict[int, _Connection] = {}
+        self._tasks: "queue.SimpleQueue" = queue.SimpleQueue()
+        # server-global count of queued + executing handler tasks,
+        # INCLUDING the post-response middleware tail (metrics, trace
+        # persistence).  Per-connection inflight can't serve as the
+        # shutdown barrier: a close-marked response closes its
+        # connection the moment it flushes, while the handler thread is
+        # still persisting the trace — the old ThreadingMixIn
+        # server_close() joined handler threads, and shutdown here must
+        # give the same guarantee
+        self._task_cv = threading.Condition()
+        self._active_tasks = 0
+        self._shutdown_request = False
+        self._is_shut_down = threading.Event()
+        self._is_shut_down.set()
+        self._close_lock = threading.Lock()
+        self._closed = False
+        self._last_reap = time.monotonic()
+        self._pool = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"pio-http-{k}")
+            for k in range(self._pool_size)
+        ]
+        for t in self._pool:
+            t.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def serve_forever(self, poll_interval: float = 0.5) -> None:
+        self._is_shut_down.clear()
+        timeout = min(max(poll_interval, 0.05), 1.0)
+        try:
+            while not self._shutdown_request:
+                try:
+                    events = self._sel.select(timeout)
+                except (OSError, RuntimeError):
+                    if self._closed or self._shutdown_request:
+                        break
+                    raise
+                for key, mask in events:
+                    tag = key.data
+                    if tag == "accept":
+                        self._accept()
+                    elif tag == "wake":
+                        self._drain_wake_pipe()
+                    else:
+                        self._service(tag, mask)
+                self._drain_wake_set()
+                self._reap_idle()
+            self._final_flush()
+        finally:
+            self._is_shut_down.set()
+
+    def shutdown(self) -> None:
+        self._shutdown_request = True
+        self._wake()
+        self._is_shut_down.wait()
+
+    def _wait_idle(self, timeout: float) -> None:
+        """Block until every queued/executing handler task (including
+        its middleware tail) has finished, or the timeout lapses."""
+        with self._task_cv:
+            self._task_cv.wait_for(lambda: self._active_tasks == 0, timeout)
+
+    def server_close(self) -> None:
+        with self._close_lock:
+            if self._closed:
+                return   # e.g. /stop's thread and deploy's finally racing
+            self._closed = True
+        # old-stack parity (ThreadingMixIn joined its handler threads on
+        # close): give in-flight handlers a bounded window to finish —
+        # unless WE are a pool thread (a handler closing its own server
+        # must not wait on itself)
+        if threading.current_thread() not in self._pool:
+            self._wait_idle(10.0)
+        self._shutdown_request = True
+        self._wake()
+        for _ in self._pool:
+            self._tasks.put(None)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in list(self._conns.values()):
+            conn.closed = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        if self._conns:
+            _M_CONNS.dec(len(self._conns))
+            self._conns.clear()
+        try:
+            self._sel.close()
+        except Exception:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _final_flush(self) -> None:
+        """Best-effort drain after shutdown: let in-flight handler tasks
+        (e.g. the /stop response itself, a trace still persisting)
+        finish and their bytes leave.  Exits as soon as everything is
+        idle — the deadline only bounds a wedged handler."""
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            busy = self._active_tasks > 0
+            for conn in list(self._conns.values()):
+                with conn.lock:
+                    if conn.outq and not conn.dead and not conn.closed:
+                        conn._flush_locked()
+                        if conn.outq:
+                            busy = True
+                    if conn.inflight:
+                        busy = True
+            if not busy:
+                return
+            time.sleep(0.02)
+
+    # -- loop internals ------------------------------------------------------
+
+    def _wake(self, conn: Optional[_Connection] = None) -> None:
+        with self._wake_lock:
+            if conn is not None:
+                self._wake_set.add(conn)
+            if self._wake_armed:
+                return
+            self._wake_armed = True
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
             pass
 
-    def _handle_one(self) -> bool:
-        self.request_id = ""   # early-error responses must not reuse a
-        self._status_sent = 0  # previous keep-alive request's id/status
-        line = self.rfile.readline(65537)
-        if not line or line in (b"\r\n", b"\n"):
-            return False
+    def _drain_wake_pipe(self) -> None:
         try:
-            self.command, self.path, version = (
-                line.decode("latin-1").rstrip("\r\n").split(" ", 2))
-        except ValueError:
-            # close first so the 400 doesn't advertise keep-alive on a
-            # connection we're about to drop (matches the other early-error
-            # paths)
-            self.close_connection = True
-            self._send_raw(400, b'{"message": "malformed request line"}')
-            return False
-        headers = _Headers()
-        while True:
-            h = self.rfile.readline(65537)
-            if h in (b"\r\n", b"\n", b""):
-                break
-            if len(headers) >= 100:            # stdlib's header-count cap
-                self.close_connection = True
-                self._send_raw(400, b'{"message": "too many headers"}')
-                return False
-            name, _, value = h.decode("latin-1").partition(":")
-            headers[name.strip().lower()] = value.strip()
-        self.headers = headers
-        conn_tok = (headers.get("connection") or "").lower()
-        self.close_connection = (
-            conn_tok == "close"
-            or (version == "HTTP/1.0" and conn_tok != "keep-alive"))
-        if headers.get("transfer-encoding") is not None:
-            # we don't decode chunked bodies; silently ignoring the header
-            # would leave the chunk bytes in the stream to be parsed as the
-            # next pipelined request — a desync / request-smuggling vector
-            # behind a chunked-forwarding proxy.  RFC 9112 §6.1: respond
-            # 501 and close.  Checked BEFORE Expect handling so we never
-            # send 100 Continue inviting a body we are about to refuse.
-            self.close_connection = True
-            self._body_unread = 0
-            self._send_raw(
-                501, b'{"message": "Transfer-Encoding not supported"}')
-            return False
-        if (headers.get("expect") or "").lower() == "100-continue":
-            self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
-        cl = headers.get("content-length")
-        # strict 1*DIGIT per RFC 9110 — int() alone accepts '1_0', ' 10 ',
-        # and non-ASCII digits, values an intermediary may interpret
-        # differently and desync the body boundary on
-        if cl is None:
-            self._body_unread = 0
-        elif cl.isascii() and cl.isdigit():
-            self._body_unread = int(cl)
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            return
+        with self._wake_lock:
+            self._wake_armed = False
+
+    def _drain_wake_set(self) -> None:
+        with self._wake_lock:
+            if not self._wake_set:
+                return
+            pending = list(self._wake_set)
+            self._wake_set.clear()
+        for conn in pending:
+            self._sync(conn)
+
+    def _accept(self) -> None:
+        for _ in range(64):
+            try:
+                sock, addr = self._sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            sock.setblocking(False)
+            if self._nagle_off:
+                # Nagle + delayed-ACK interact catastrophically with
+                # keep-alive request/response traffic (~40 ms stalls);
+                # measured 23 events/s serial without this, wire-speed with
+                try:
+                    sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+            conn = _Connection(self, sock, addr)
+            try:
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+            except (ValueError, OSError):
+                sock.close()
+                continue
+            conn.interest = selectors.EVENT_READ
+            self._conns[conn.fd] = conn
+            _M_CONNS.inc()
+
+    def _service(self, conn: _Connection, mask: int) -> None:
+        if conn.closed:
+            return
+        if mask & selectors.EVENT_WRITE:
+            with conn.lock:
+                conn._flush_locked()
+        if mask & selectors.EVENT_READ:
+            self._read(conn)
+            if conn.closed:
+                return
+        self._sync(conn)
+
+    def _read(self, conn: _Connection) -> None:
+        try:
+            data = conn.sock.recv(1 << 18)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            conn.dead = True
+            return
+        if not data:
+            # half/full close from the peer: stop reading; pending
+            # responses still flush (a pipelining client may have shut
+            # down its write side), then _sync closes us
+            conn.peer_eof = True
+            return
+        conn.last_activity = time.monotonic()
+        if conn.no_more_requests:
+            return   # discard bytes pipelined after a close-marked request
+        conn.inbuf += data
+        self._parse(conn)
+
+    def _sync(self, conn: _Connection) -> None:
+        """Loop-side state reconciliation: close finished/dead
+        connections, resume paused reads, update selector interest."""
+        if conn.closed:
+            return
+        with conn.lock:
+            has_out = bool(conn.outq)
+            done_for_good = (
+                conn.dead
+                or (conn.closing and not has_out)
+                or (conn.peer_eof and conn.inflight == 0 and not has_out
+                    and not conn.done))
+        if done_for_good:
+            conn.close()
+            return
+        if conn.paused:
+            with conn.lock:
+                resume = (conn.inflight <= self.pipeline_depth // 2
+                          and conn.inflight_bytes <= self.max_body // 2)
+            if resume:
+                conn.paused = False
+                self._parse(conn)
+                if conn.closed:
+                    return
+        self._update_interest(conn)
+
+    def _update_interest(self, conn: _Connection) -> None:
+        want = 0
+        if (not conn.no_more_requests and not conn.paused
+                and not conn.peer_eof):
+            want |= selectors.EVENT_READ
+        with conn.lock:
+            if conn.outq:
+                want |= selectors.EVENT_WRITE
+        if want == conn.interest:
+            return
+        try:
+            if conn.interest == 0:
+                self._sel.register(conn.sock, want, conn)
+            elif want == 0:
+                self._sel.unregister(conn.sock)
+            else:
+                self._sel.modify(conn.sock, want, conn)
+            conn.interest = want
+        except (KeyError, ValueError, OSError):
+            conn.dead = True
+            conn.close()
+
+    def _reap_idle(self) -> None:
+        now = time.monotonic()
+        if now - self._last_reap < 1.0:
+            return
+        self._last_reap = now
+        cutoff = now - self.idle_timeout
+        for conn in list(self._conns.values()):
+            with conn.lock:
+                # inflight > 0 is the only pardon (a handler may be
+                # legitimately slow): parked keep-alives, slowloris
+                # partials, AND stuck writers (a peer that stopped
+                # reading while outq holds its response — successful
+                # flush progress refreshes last_activity) all reap once
+                # their last byte of progress is older than the timeout
+                idle = conn.inflight == 0 and conn.last_activity < cutoff
+            if idle:
+                conn.close()
+
+    # -- parsing (loop thread only) ------------------------------------------
+
+    def _parse(self, conn: _Connection) -> None:
+        inbuf = conn.inbuf
+        while not conn.no_more_requests and not conn.paused:
+            if conn.pending_req is not None:
+                command, path, headers, need, close_req = conn.pending_req
+                if len(inbuf) < need:
+                    return
+                conn.pending_req = None
+                body = bytes(inbuf[:need])
+                del inbuf[:need]
+                self._dispatch(conn, command, path, headers, body, close_req)
+                if close_req:
+                    conn.no_more_requests = True
+                    inbuf.clear()
+                    return
+                continue
+            while inbuf[:2] == b"\r\n":   # stray CRLFs between requests
+                del inbuf[:2]
+            if not inbuf:
+                return
+            hend = inbuf.find(b"\r\n\r\n")
+            if hend < 0:
+                if len(inbuf) > 65536:
+                    self._refuse(conn, 431, "header section too large")
+                return
+            lines = bytes(inbuf[:hend]).split(b"\r\n")
+            del inbuf[:hend + 4]
+            try:
+                command, path, version = (
+                    lines[0].decode("latin-1").split(" ", 2))
+            except ValueError:
+                # never advertises keep-alive: the refusal closes
+                self._refuse(conn, 400, "malformed request line")
+                return
+            if len(lines) - 1 > 100:       # stdlib's header-count cap
+                self._refuse(conn, 400, "too many headers")
+                return
+            headers = _Headers()
+            bad_header = None
+            for ln in lines[1:]:
+                if ln[:1] in (b" ", b"\t"):
+                    # obs-fold continuations would otherwise parse as a
+                    # fresh header after .strip() — " Content-Length: 7"
+                    # overwriting the real one is a body-boundary desync
+                    # (request smuggling behind a fold-forwarding proxy).
+                    # RFC 9112 §5.2: reject outside message/http.
+                    bad_header = "obsolete header line folding"
+                    break
+                name, _, value = ln.decode("latin-1").partition(":")
+                name = name.strip().lower()
+                value = value.strip()
+                if (name == "content-length"
+                        and headers.get(name, value) != value):
+                    # repeated differing Content-Length: an intermediary
+                    # honoring the FIRST one would desync on our LAST-wins
+                    bad_header = "conflicting Content-Length headers"
+                    break
+                headers[name] = value
+            if bad_header is not None:
+                self._refuse(conn, 400, bad_header)
+                return
+            if headers.get("transfer-encoding") is not None:
+                # we don't decode chunked bodies; silently ignoring the
+                # header would leave the chunk bytes in the stream to be
+                # parsed as the next pipelined request — a desync /
+                # request-smuggling vector behind a chunked-forwarding
+                # proxy.  RFC 9112 §6.1: respond 501 and close.  Checked
+                # BEFORE Expect handling so we never send 100 Continue
+                # inviting a body we are about to refuse.
+                self._refuse(
+                    conn, 501, "Transfer-Encoding not supported")
+                return
+            cl = headers.get("content-length")
+            # strict 1*DIGIT per RFC 9110 — int() alone accepts '1_0',
+            # ' 10 ', and non-ASCII digits, values an intermediary may
+            # interpret differently and desync the body boundary on
+            if cl is None:
+                need = 0
+            elif cl.isascii() and cl.isdigit():
+                need = int(cl)
+            else:
+                self._refuse(conn, 400, "bad Content-Length")
+                return
+            if need > self.max_body:
+                # refuse before buffering, not after: the old drain-based
+                # loop read oversized bodies just to discard them
+                self._refuse(conn, 413, "request body too large")
+                return
+            conn_tok = (headers.get("connection") or "").lower()
+            close_req = (
+                conn_tok == "close"
+                or (version == "HTTP/1.0" and conn_tok != "keep-alive"))
+            if need and len(inbuf) < need:
+                if (headers.get("expect") or "").lower() == "100-continue":
+                    # interim response gets its own pre-completed slot so
+                    # it stays ordered ahead of this request's final
+                    # response but behind earlier pipelined responses
+                    conn.push_slot(conn.alloc_seq(), _CONTINUE, False)
+                conn.pending_req = (command, path, headers, need, close_req)
+                return
+            body = bytes(inbuf[:need])
+            del inbuf[:need]
+            self._dispatch(conn, command, path, headers, body, close_req)
+            if close_req:
+                # Connection: close honored mid-pipeline — requests the
+                # client wrote after it are never parsed or answered
+                conn.no_more_requests = True
+                inbuf.clear()
+                return
+
+    def _refuse(self, conn: _Connection, status: int, message: str) -> None:
+        conn.no_more_requests = True
+        conn.pending_req = None
+        conn.inbuf.clear()
+        body = json.dumps({"message": message}).encode()
+        conn.push_slot(conn.alloc_seq(),
+                       assemble_response(status, body, close=True), True)
+
+    def _dispatch(self, conn, command, path, headers, body, close_req):
+        seq = conn.alloc_seq()
+        with conn.lock:
+            conn.inflight += 1
+            conn.inflight_bytes += len(body)
+            # backpressure: stop reading this conn at the request-count
+            # OR buffered-body-byte cap (64 max-size bodies pipelined on
+            # one socket must not pin pipeline_depth × max_body of RAM)
+            if (conn.inflight >= self.pipeline_depth
+                    or conn.inflight_bytes >= self.max_body):
+                conn.paused = True
+        with self._task_cv:
+            self._active_tasks += 1
+        req = _Request(seq, command, path, headers, body, close_req)
+        if self._pool_size == 0:
+            self._run_task(conn, req)
         else:
-            # reject without ever calling rfile.read(-1) (reads to EOF,
-            # pinning the thread)
-            self.close_connection = True
-            self._body_unread = 0
-            self._send_raw(400, b'{"message": "bad Content-Length"}')
-            return False
-        method = getattr(self, "do_" + self.command, None)
+            self._tasks.put((conn, req))
+
+    # -- handler execution (pool threads) ------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._tasks.get()
+            if item is None:
+                return
+            self._run_task(*item)
+
+    def _run_task(self, conn: _Connection, req: _Request) -> None:
+        """Execute one request end to end, then settle the connection's
+        accounting.  The in-flight decrement happens HERE — after the
+        middleware tail (trace persist, metrics), not at response-send
+        time — so shutdown's final flush and the idle reaper never
+        observe a request as done while its trace is still being
+        written."""
+        try:
+            self._execute(conn, req)
+        except Exception:
+            _access_log.exception(
+                "unhandled error serving %s %s", req.command, req.path)
+        finally:
+            with conn.lock:
+                unanswered = (req.seq >= conn.next_send
+                              and req.seq not in conn.done)
+            if unanswered:
+                # an empty slot would wedge every later pipelined
+                # response behind it, and the reaper skips connections
+                # with queued slots — always settle the slot
+                conn.push_slot(req.seq, assemble_response(
+                    500, b'{"message": "internal server error"}',
+                    close=True), True)
+            with conn.lock:
+                conn.inflight -= 1
+                conn.inflight_bytes -= len(req.body)
+            with self._task_cv:
+                self._active_tasks -= 1
+                if not self._active_tasks:
+                    self._task_cv.notify_all()
+            self._wake(conn)
+
+    def _execute(self, conn: _Connection, req: _Request) -> None:
+        cls = self.RequestHandlerClass
+        h = cls.__new__(cls)
+        h.server = self
+        h.connection = conn
+        h.client_address = conn.addr
+        h.command = req.command
+        h.path = req.path
+        h.headers = req.headers
+        h.rfile = io.BytesIO(req.body)
+        h.close_connection = req.close
+        h._conn = conn
+        h._seq = req.seq
+        h._responded = False
+        h._status_sent = 0
+        h._body_unread = 0   # the loop buffered the body; stream is clean
         # request-id propagation: honor an incoming X-Request-ID (bounded)
-        # or mint one, so one id links client logs, access logs, and the
-        # echoed response header across the prefork worker group
-        rid = headers.get("x-request-id")
-        self.request_id = (rid if rid and _RID_SAFE.match(rid)
-                           else f"{_RID_PREFIX}-{next(_RID):x}")
-        self._status_sent = 0
+        # or mint one PER REQUEST — pipelined requests each get their own
+        rid = req.headers.get("x-request-id")
+        h.request_id = (rid if rid and _RID_SAFE.match(rid)
+                        else f"{_RID_PREFIX}-{next(_RID):x}")
+        method = getattr(h, "do_" + req.command, None)
         # flight recorder: open a live trace keyed by the request id;
-        # spans from instrumented layers accumulate via the contextvar,
-        # and the tail-sampling keep/drop decision happens at the end
-        # (near-zero cost for the dropped 99.9%)
+        # spans from instrumented layers accumulate via the contextvar
+        # (set in THIS thread, where the handler runs), and the
+        # tail-sampling keep/drop decision happens at the end
         recorder = _tracing.get_recorder()
         trace = recorder.begin(
-            self.request_id, self.command,
-            debug=headers.get("x-pio-debug") is not None)
+            h.request_id, req.command,
+            debug=req.headers.get("x-pio-debug") is not None)
         token = _tracing._CURRENT.set(trace) if trace is not None else None
         _M_INFLIGHT.inc()
         t0 = time.perf_counter()
         try:
             try:
                 if method is None:
-                    self.send_error_json(
-                        501, f"Unsupported method ({self.command!r})")
+                    h.send_error_json(
+                        501, f"Unsupported method ({req.command!r})")
                 else:
                     method()
-            except (BrokenPipeError, ConnectionResetError):
-                return False
+            except Exception:
+                _access_log.exception("handler failed: %s %s",
+                                      req.command, req.path)
+                if not h._responded:
+                    h.close_connection = True
+                    h.send_error_json(500, "internal server error")
         finally:
+            if not h._responded:
+                # a handler that returned without answering would wedge
+                # every later pipelined response behind its empty slot;
+                # send the 500 BEFORE the instruments record so metrics,
+                # stats, and the trace all see the status the client got
+                h.close_connection = True
+                h.send_error_json(500, "handler sent no response")
             _M_INFLIGHT.dec()
-            route = route_label(self.path)
+            route = route_label(req.path)
             if token is not None:
                 _tracing._CURRENT.reset(token)
-                recorder.finish(trace, self._status_sent or 0, route)
+                recorder.finish(trace, h._status_sent or 0, route)
             # exemplar: the max-latency observation per window carries
             # its trace id, linking /metrics tails to /traces/<rid>.json
             _M_LAT.observe(time.perf_counter() - t0, route=route,
-                           exemplar=self.request_id if trace is not None
+                           exemplar=h.request_id if trace is not None
                            else None)
-            _M_REQS.inc(1, route=route, status=str(self._status_sent or 0))
-            sc = self.stats_collector
+            _M_REQS.inc(1, route=route, status=str(h._status_sent or 0))
+            sc = h.stats_collector
             if sc is not None:
-                sc.record(None, self._status_sent or 0, event=route)
-        # a handler that errored before read_json (auth failure, 404 route)
-        # leaves the request body in the stream; drain it or the next
-        # keep-alive request would be parsed out of body bytes (>1 MB:
-        # close instead — _send_raw already advertised Connection: close)
-        if self._body_unread:
-            if self._body_unread > (1 << 20):
-                self.close_connection = True
-            else:
-                self.rfile.read(self._body_unread)
+                sc.record(None, h._status_sent or 0, event=route)
         if _access_log.isEnabledFor(logging.DEBUG):
-            self.log_message('"%s %s" %s rid=%s', self.command, self.path,
-                             self._status_sent or "-", self.request_id)
-        return True
+            _access_log.debug('"%s %s" %s rid=%s', req.command, req.path,
+                              h._status_sent or "-", h.request_id)
+
+
+class JsonHandler:
+    """Base handler with JSON request/response helpers.
+
+    Instantiated once per REQUEST by the event loop with the body fully
+    buffered (``rfile`` is a BytesIO — ``read_json`` never blocks) and
+    responses routed through the connection's ordered slots, so the same
+    subclass serves serial keep-alive and pipelined clients alike."""
+
+    server_version = "pio-tpu"
+    protocol_version = "HTTP/1.1"
+    # per-server-class stats.json window collector (obs.exposition
+    # StatsCollector); the middleware records (status, route) into it
+    stats_collector = None
+    # TCP_NODELAY on accepted sockets (see _accept)
+    disable_nagle_algorithm = True
+    # default idle keep-alive reap seconds (PIO_HTTP_IDLE_S overrides)
+    timeout = 120
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        _access_log.debug(fmt, *args)
 
     # -- helpers -------------------------------------------------------------
 
@@ -284,26 +950,19 @@ class JsonHandler(socketserver.StreamRequestHandler):
         return json.loads(raw)
 
     def _send_raw(self, status: int, body: bytes,
-                  ctype: str = "application/json; charset=utf-8") -> None:
-        # if the request body is too large to drain after this response,
-        # the connection will close — say so in the header we send NOW
-        # (advertising keep-alive and then closing makes well-behaved
-        # clients see spurious mid-pipeline disconnects)
-        if getattr(self, "_body_unread", 0) > (1 << 20):
-            self.close_connection = True
+                  ctype: str = _CT_JSON) -> None:
+        if self._responded:
+            _access_log.warning(
+                "duplicate response (%d) for %s %s dropped",
+                status, self.command, self.path)
+            return
+        self._responded = True
         self._status_sent = status
         rid = getattr(self, "request_id", "")
-        rid_line = "X-Request-ID: %s\r\n" % rid if rid else ""
-        head = (
-            f"HTTP/1.1 {status} {_REASON.get(status, '')}\r\n"
-            f"Server: {self.server_version}\r\n"
-            f"{rid_line}"
-            f"Content-Type: {ctype}\r\n"
-            f"Content-Length: {len(body)}\r\n"
-            f"{'Connection: close' if self.close_connection else 'Connection: keep-alive'}\r\n"
-            "\r\n"
-        ).encode("latin-1")
-        self.wfile.write(head + body)
+        close = self.close_connection
+        self._conn.push_slot(
+            self._seq, assemble_response(status, body, ctype, rid, close),
+            close)
 
     def send_json(self, obj: Any, status: int = 200) -> None:
         self._send_raw(status, json.dumps(obj).encode())
@@ -318,24 +977,15 @@ class JsonHandler(socketserver.StreamRequestHandler):
 def start_server(
     handler_cls, host: str, port: int, background: bool = False,
     reuse_port: bool = False,
-) -> ThreadingHTTPServer:
+) -> EventLoopHTTPServer:
     """``reuse_port`` binds with SO_REUSEPORT so several OS processes can
     serve one port (the prefork `pio deploy --workers N` path: the kernel
     load-balances accepts across workers — the CPython-GIL answer to
     multi-core serving, where the reference scaled by adding spray
-    nodes behind a balancer)."""
-    if reuse_port:
-        import socket
-
-        class _ReusePortServer(ThreadingHTTPServer):
-            def server_bind(self):
-                self.socket.setsockopt(
-                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-                super().server_bind()
-
-        httpd = _ReusePortServer((host, port), handler_cls)
-    else:
-        httpd = ThreadingHTTPServer((host, port), handler_cls)
+    nodes behind a balancer).  Each worker runs one event loop plus a
+    small handler pool; total concurrency is workers × pool."""
+    httpd = EventLoopHTTPServer((host, port), handler_cls,
+                                reuse_port=reuse_port)
     if background:
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
         t.start()

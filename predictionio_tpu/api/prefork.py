@@ -8,6 +8,12 @@ processes that bind the SAME port — the kernel load-balances accepted
 connections across all listeners (the analogue of the reference running
 several spray nodes behind a balancer).
 
+Each worker runs http_util's event-loop front end: one loop thread
+owning every socket plus a small handler pool (PIO_HTTP_POOL, default ≈
+cores).  Worker count × per-worker handler parallelism is the node's
+concurrency budget — size ``--workers`` toward cores and leave the
+per-worker pool at its default rather than multiplying both.
+
 This module holds the machinery both servers share:
 
 - ``watch_parent_process`` / ``maybe_watch_parent``: a child exits when

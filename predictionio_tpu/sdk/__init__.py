@@ -4,4 +4,5 @@ from predictionio_tpu.sdk.client import (  # noqa: F401
     EventClient,
     EventPipeline,
     PIOError,
+    QueryPipeline,
 )

@@ -173,31 +173,32 @@ class AsyncResult:
         return self._value
 
 
-class EventPipeline:
-    """HTTP/1.1-pipelined single-event ingestion over one keep-alive socket.
+class _Pipeline:
+    """HTTP/1.1-pipelined requests over one keep-alive socket — the
+    transport shared by ``EventPipeline`` (ingestion) and
+    ``QueryPipeline`` (serving).
 
-    Why: a serial client pays one full round trip per event — request
+    Why: a serial client pays one full round trip per request — request
     construction, send, *wait*, read — and measures well under half of
     what the server sustains on the same box.  Pipelining keeps up to
     ``depth`` requests in flight on the wire: requests are written
     back-to-back into a userspace buffer (flushed at ``_SEND_BUF``
     bytes), and responses — strictly ordered per HTTP/1.1 — are read in
     bulk when the in-flight cap is reached.  ``depth`` bounds the
-    responses the server can have queued toward us (~100 B each), so
-    neither side's socket buffer can fill and deadlock the pair.
+    responses the server can have queued toward us, so neither side's
+    socket buffer can fill and deadlock the pair.  Against the
+    event-loop front end, pipelined queries are exactly what feeds the
+    cross-request micro-batcher: every request in flight on this socket
+    can coalesce into one ``serve_batch_predict`` pass server-side.
 
-    stdlib-only, single-threaded.  Use via ``EventClient.pipeline()``:
-
-        with client.pipeline() as p:
-            handles = [p.create_event(...) for _ in events]
-        ids = [h.result()["eventId"] for h in handles]   # all done here
+    stdlib-only, single-threaded.
 
     Failure semantics — at-least-once ambiguity: if the server signals
     ``Connection: close`` (or the socket dies) while requests are still
     in flight, every outstanding handle fails with PIOError — but the
-    server may already have COMMITTED some of those events before
+    server may already have COMMITTED some of those requests before
     closing; the close only guarantees their acknowledgements will never
-    arrive.  A caller that retries failed handles can therefore
+    arrive.  A caller that retries failed event handles can therefore
     duplicate events unless it supplies its own ``eventId`` per event
     (the server stores a client-supplied id verbatim, making the retry
     idempotent at read time).  After a server-signaled close the
@@ -207,11 +208,11 @@ class EventPipeline:
 
     _SEND_BUF = 32 * 1024
 
-    def __init__(self, client: "EventClient", depth: int = 128,
-                 timeout: float = 10.0):
+    def __init__(self, base_url: str, depth: int = 128,
+                 timeout: float = 10.0, qs: str = ""):
         import socket as _socket
 
-        u = urllib.parse.urlsplit(client._base_url)
+        u = urllib.parse.urlsplit(base_url)
         if u.scheme == "https":
             import ssl
 
@@ -227,7 +228,7 @@ class EventPipeline:
         self._rfile = self._sock.makefile("rb")
         self._host = (u.hostname or "localhost").encode("ascii")
         self._prefix = u.path.rstrip("/")
-        self._qs = client._qs()
+        self._qs = qs
         # the deadlock-avoidance invariant (see docstring) only holds if
         # queued responses stay well under a default socket buffer
         # (~128 KiB): clamp depth so ~100 B/response can't fill it
@@ -237,27 +238,6 @@ class EventPipeline:
         self._closed = False
 
     # -- request side -------------------------------------------------------
-
-    def create_event(
-        self,
-        event: str,
-        entity_type: str,
-        entity_id: str,
-        target_entity_type: Optional[str] = None,
-        target_entity_id: Optional[str] = None,
-        properties: Optional[Dict[str, Any]] = None,
-        event_time: Optional[_dt.datetime] = None,
-    ) -> AsyncResult:
-        body = _event_body(event, entity_type, entity_id,
-                           target_entity_type, target_entity_id,
-                           properties, event_time)
-        return self._send("POST", f"/events.json?{self._qs}", body)
-
-    def record_user_action_on_item(
-        self, action: str, uid: str, iid: str,
-        properties: Optional[Dict] = None,
-    ) -> AsyncResult:
-        return self.create_event(action, "user", uid, "item", iid, properties)
 
     def _send(self, method: str, path_qs: str, body: Any) -> AsyncResult:
         if self._closed:
@@ -396,7 +376,7 @@ class EventPipeline:
         finally:
             self._release_socket()
 
-    def __enter__(self) -> "EventPipeline":
+    def __enter__(self) -> "_Pipeline":
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -408,6 +388,63 @@ class EventPipeline:
                 0, "pipeline aborted before this response was read"))
         else:
             self.close()
+
+
+class EventPipeline(_Pipeline):
+    """Pipelined single-event ingestion (reference: the official Python
+    SDK's ``acreate_event`` path).  Use via ``EventClient.pipeline()``:
+
+        with client.pipeline() as p:
+            handles = [p.create_event(...) for _ in events]
+        ids = [h.result()["eventId"] for h in handles]   # all done here
+    """
+
+    def __init__(self, client: "EventClient", depth: int = 128,
+                 timeout: float = 10.0):
+        super().__init__(client._base_url, depth=depth, timeout=timeout,
+                         qs=client._qs())
+
+    def create_event(
+        self,
+        event: str,
+        entity_type: str,
+        entity_id: str,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        properties: Optional[Dict[str, Any]] = None,
+        event_time: Optional[_dt.datetime] = None,
+    ) -> AsyncResult:
+        body = _event_body(event, entity_type, entity_id,
+                           target_entity_type, target_entity_id,
+                           properties, event_time)
+        return self._send("POST", f"/events.json?{self._qs}", body)
+
+    def record_user_action_on_item(
+        self, action: str, uid: str, iid: str,
+        properties: Optional[Dict] = None,
+    ) -> AsyncResult:
+        return self.create_event(action, "user", uid, "item", iid, properties)
+
+
+class QueryPipeline(_Pipeline):
+    """Pipelined /queries.json against a deployed query server.  Keeps
+    up to ``depth`` queries in flight on one keep-alive socket; the
+    event-loop server answers them strictly in order, and concurrently
+    in-flight queries coalesce through the server's cross-request
+    micro-batcher when batching is enabled.  Use via
+    ``EngineClient.pipeline()``:
+
+        with engine_client.pipeline(depth=32) as p:
+            handles = [p.send_query({"user": u, "num": 10}) for u in users]
+        predictions = [h.result() for h in handles]
+    """
+
+    def __init__(self, client: "EngineClient", depth: int = 64,
+                 timeout: float = 10.0):
+        super().__init__(client._base_url, depth=depth, timeout=timeout)
+
+    def send_query(self, query: Dict[str, Any]) -> AsyncResult:
+        return self._send("POST", "/queries.json", query)
 
 
 class EventClient:
@@ -485,7 +522,15 @@ class EngineClient:
 
     def __init__(self, url: str = "http://localhost:8000", timeout: float = 10.0):
         self.timeout = timeout
+        self._base_url = url
         self._conn = _Conn(url, timeout)
 
     def send_query(self, query: Dict[str, Any]) -> Dict[str, Any]:
         return self._conn.request("POST", "/queries.json", query)
+
+    def pipeline(self, depth: int = 64) -> QueryPipeline:
+        """Open a pipelined query session (see QueryPipeline): many
+        queries in flight on one keep-alive socket, answered in order —
+        the client-side feed for the server's cross-request
+        micro-batcher."""
+        return QueryPipeline(self, depth=depth, timeout=self.timeout)

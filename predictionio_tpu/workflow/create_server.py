@@ -24,6 +24,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, Optional
 
@@ -67,7 +68,8 @@ _WAIT_TIMEOUT_S = 600.0
 
 
 class _MicroBatcher:
-    """Group-commit micro-batching for concurrent queries.
+    """Group-commit micro-batching for concurrent queries — across
+    requests, threads, and (since the event-loop front end) connections.
 
     The first thread into an idle batcher becomes the leader and
     immediately executes whatever is queued (usually just itself);
@@ -80,18 +82,37 @@ class _MicroBatcher:
     queued queries as one [B, …] program amortizes the dispatch (and,
     behind a tunneled accelerator, the ~70 ms readback round trip) across
     the batch — the single-chip answer to concurrent serving load, where
-    the reference scaled by adding spray nodes.
+    the reference scaled by adding spray nodes.  The http_util event
+    loop executes handlers on a small pool, so queries that are
+    concurrently in flight across DIFFERENT client connections (and
+    different pipelined requests on one connection) meet here and leave
+    as one ``serve_batch_predict`` pass — the host numpy tail is
+    amortized over the whole in-flight set the same way the device
+    dispatch is.
+
+    ``PIO_SERVE_BATCH_WINDOW_MS`` (default 0) optionally makes the
+    leader dwell that long before executing its first batch, trading a
+    bounded p50 hit for bigger batches when callers prefer throughput;
+    0 keeps the pure group-commit behavior (nothing waits on a timer).
     """
 
     def __init__(self, run_batch: Callable, run_one: Callable,
-                 max_batch: Optional[int] = None):
+                 max_batch: Optional[int] = None,
+                 window_s: Optional[float] = None):
         from predictionio_tpu.controller.engine import DEFAULT_SERVE_BATCH
 
         if max_batch is None:
             max_batch = DEFAULT_SERVE_BATCH
+        if window_s is None:
+            try:
+                window_s = float(
+                    os.environ.get("PIO_SERVE_BATCH_WINDOW_MS", "0")) / 1e3
+            except ValueError:
+                window_s = 0.0
         self._run = run_batch
         self._run_one = run_one
         self._max = max_batch
+        self._window = max(0.0, window_s)
         self._lock = threading.Lock()
         self._queue: list = []
         self._leader_active = False
@@ -163,6 +184,10 @@ class _MicroBatcher:
         ``_leader_active`` stuck True forever (every later query waits
         600 s and fails).  Releasing means any thread — the nudged waiter
         or a fresh arrival — can claim the vacancy."""
+        if self._window:
+            # opt-in dwell: let concurrently-arriving queries (other
+            # connections' handler threads) join this leader's first batch
+            time.sleep(self._window)
         while True:
             with self._lock:
                 batch = self._queue[: self._max]

@@ -51,6 +51,18 @@ INSTRUMENTED_MODULES = [
 ]
 
 
+# contract names external dashboards/alerts key on: the HTTP middleware
+# family must survive any front-end rewrite (the event-loop migration is
+# exactly the kind of change that could silently drop one)
+REQUIRED_METRICS = frozenset({
+    "pio_http_requests_total",
+    "pio_http_request_duration_seconds",
+    "pio_http_requests_in_flight",
+    "pio_http_connections",
+    "pio_serve_batch_size",
+    "pio_events_ingested_total",
+})
+
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})
 # span attrs assigned post-hoc (rec["attrs"] = {...}) use literal dict
 # keys; f-string keys (dynamic stage suffixes) are checked on their
@@ -124,6 +136,11 @@ def main() -> int:
                 problems.append(f"{m.name}: buckets not strictly increasing")
     if not metrics:
         problems.append("no metrics registered — imports broken?")
+    names = {m.name for m in metrics}
+    for req in sorted(REQUIRED_METRICS - names):
+        problems.append(
+            f"required metric {req} not registered (middleware contract "
+            "broken by a front-end change?)")
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:

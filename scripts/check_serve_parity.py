@@ -11,12 +11,20 @@ result — through BOTH serve tails (``PIO_UR_SERVE_TAIL=host`` vs
 under each tail, diffing results EXACTLY: same items, same float scores,
 same order.
 
+Then the same corpus goes over HTTP against the event-loop front end —
+a live deployed query server — in BOTH wire modes: serial keep-alive
+(one request/response at a time) and HTTP/1.1 pipelined (the SDK's
+QueryPipeline, every query in flight at once), diffing the JSON
+responses exactly against the in-process reference.  Any divergence —
+tail math, micro-batching, request-loop parsing, response ordering
+under pipelining — fails the script.
+
 The host tail's contract is that it is a bit-exact twin of the device
 tail (elementwise f32 mask math matches XLA, host_topk_desc reproduces
 ``lax.top_k``'s tie order), so any diff here is a real divergence, not
 float noise.
 
-Exit 0 = every query identical across all four paths; 1 = any diff
+Exit 0 = every query identical across all paths; 1 = any diff
 (printed).  Run standalone (``python scripts/check_serve_parity.py``) or
 via the tier-1 suite (tests/test_serve_tail.py wraps it), like
 check_metrics_names.py and check_snapshot_integrity.py.
@@ -82,38 +90,105 @@ def build_app():
     return storage
 
 
-def corpus(query_cls, field_cls):
-    q = query_cls.from_json
+def corpus_bodies():
+    """The corpus as wire-format JSON bodies — shared verbatim by the
+    in-process phase (parsed via query_cls.from_json, exactly what the
+    query server does) and the HTTP phases."""
     return [
-        q({"user": "u2", "num": 6}),
-        q({"user": "u25", "num": 6}),
-        q({"user": "nobody-cold", "num": 5}),
-        q({"item": "e1", "num": 5}),
-        q({"itemSet": ["e0", "e2"], "num": 6}),
-        q({"user": "u3", "num": 6,
-           "fields": [{"name": "category", "values": ["books"],
-                       "bias": -1}]}),
-        q({"user": "u3", "num": 6,
-           "fields": [{"name": "category", "values": ["electronics"],
-                       "bias": 4.0}]}),
-        q({"user": "u4", "num": 6, "blacklistItems": ["e0", "e1", "e2"]}),
-        q({"user": "u5", "num": 6,
-           "dateRange": {"name": "expireDate",
-                         "after": "2026-02-01T00:00:00"}}),
-        q({"user": "u6", "num": 8, "currentDate": "2026-03-01T00:00:00"}),
+        {"user": "u2", "num": 6},
+        {"user": "u25", "num": 6},
+        {"user": "nobody-cold", "num": 5},
+        {"item": "e1", "num": 5},
+        {"itemSet": ["e0", "e2"], "num": 6},
+        {"user": "u3", "num": 6,
+         "fields": [{"name": "category", "values": ["books"],
+                     "bias": -1}]},
+        {"user": "u3", "num": 6,
+         "fields": [{"name": "category", "values": ["electronics"],
+                     "bias": 4.0}]},
+        {"user": "u4", "num": 6, "blacklistItems": ["e0", "e1", "e2"]},
+        {"user": "u5", "num": 6,
+         "dateRange": {"name": "expireDate",
+                       "after": "2026-02-01T00:00:00"}},
+        {"user": "u6", "num": 8, "currentDate": "2026-03-01T00:00:00"},
         # all-masked: no item carries this category value → empty result
-        q({"user": "u7", "num": 6,
-           "fields": [{"name": "category", "values": ["no-such-cat"],
-                       "bias": -1}]}),
+        {"user": "u7", "num": 6,
+         "fields": [{"name": "category", "values": ["no-such-cat"],
+                     "bias": -1}]},
         # empty-history user + hard filter (pure backfill under a mask)
-        q({"user": "ghost", "num": 4,
-           "fields": [{"name": "category", "values": ["books"],
-                       "bias": -1}]}),
+        {"user": "ghost", "num": 4,
+         "fields": [{"name": "category", "values": ["books"],
+                     "bias": -1}]},
     ]
+
+
+def corpus(query_cls, field_cls):
+    return [query_cls.from_json(b) for b in corpus_bodies()]
 
 
 def canon(result):
     return [(s.item, float(s.score)) for s in result.item_scores]
+
+
+def canon_http(resp: dict):
+    return [(r["item"], float(r["score"])) for r in resp["itemScores"]]
+
+
+def http_phase(engine, ep, query_cls, storage, reference, problems) -> None:
+    """Deploy the trained model behind the event-loop front end and
+    replay the corpus in serial-keep-alive and pipelined wire modes;
+    responses must match the in-process reference EXACTLY (JSON
+    round-trips floats losslessly, so this is float-equality, not
+    tolerance)."""
+    import http.client
+    import json as _json
+
+    from predictionio_tpu.api.http_util import start_server
+    from predictionio_tpu.sdk import EngineClient
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import (
+        QueryServerState, make_handler,
+    )
+
+    core_workflow.run_train(engine, ep, engine_id="parity-engine",
+                            storage=storage)
+    state = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
+                             "default", storage=storage)
+    httpd = start_server(make_handler(state), "127.0.0.1", 0,
+                         background=True)
+    port = httpd.server_address[1]
+    bodies = corpus_bodies()
+    try:
+        # serial keep-alive: one request/response at a time on one socket
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        serial = []
+        for body in bodies:
+            conn.request("POST", "/queries.json", _json.dumps(body).encode(),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            payload = r.read()
+            if r.status != 200:
+                problems.append(
+                    f"http/serial HTTP {r.status}: {payload[:200]!r}")
+                return
+            serial.append(canon_http(_json.loads(payload)))
+        conn.close()
+        # pipelined: every query in flight at once on one socket; the
+        # event loop must answer strictly in order
+        with EngineClient(f"http://127.0.0.1:{port}").pipeline(
+                depth=len(bodies)) as p:
+            handles = [p.send_query(body) for body in bodies]
+        pipelined = [canon_http(h.result()) for h in handles]
+        for name, results in (("http/serial", serial),
+                              ("http/pipelined", pipelined)):
+            for qi, (got, want) in enumerate(zip(results, reference)):
+                if got != want:
+                    problems.append(
+                        f"query #{qi} differs on {name} vs in-process:\n"
+                        f"  got:  {got}\n  want: {want}")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
 
 
 def main() -> int:
@@ -165,10 +240,19 @@ def main() -> int:
     # the all-masked query must be an exact empty result everywhere
     if reference[10] != []:
         problems.append(f"all-masked query returned items: {reference[10]}")
+    # HTTP phase against the event-loop front end (host tail — the CPU
+    # default a deployed server resolves), serial + pipelined wire modes
+    os.environ["PIO_UR_SERVE_TAIL"] = "host"
+    from predictionio_tpu.storage.locator import get_storage
+
+    if not problems:
+        http_phase(engine, ep, URQuery, get_storage(),
+                   runs["host/serial"], problems)
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
-        print(f"ok: {len(queries)} queries × 4 serving paths identical "
+        print(f"ok: {len(queries)} queries × (4 serving paths + "
+              "http serial + http pipelined) identical "
               "(items, scores, order)")
     return 1 if problems else 0
 

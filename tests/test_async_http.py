@@ -1,0 +1,370 @@
+"""Event-loop front-end behavior the thread-per-connection stack never
+had to state explicitly: slow-client robustness (a stalled connection
+must cost a buffer, not a thread, and must never stall other
+connections) and keep-alive/pipelining semantics (ordered responses,
+per-request X-Request-IDs, Connection: close honored mid-pipeline,
+errors never advertising keep-alive)."""
+
+import json
+import socket
+import struct
+import time
+
+import pytest
+
+from predictionio_tpu.api.event_server import run_event_server
+from predictionio_tpu.storage import AccessKey, App
+
+
+@pytest.fixture()
+def es(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "asyncapp"))
+    key = mem_storage.access_keys.insert(AccessKey("", app_id, []))
+    httpd = run_event_server(host="127.0.0.1", port=0, storage=mem_storage,
+                             background=True)
+    yield {"port": httpd.server_address[1], "key": key}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _connect(port):
+    s = socket.create_connection(("127.0.0.1", port))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _post_bytes(key, eid="u1", body_extra=""):
+    body = json.dumps({"event": "buy", "entityType": "user",
+                       "entityId": eid, "targetEntityType": "item",
+                       "targetEntityId": "i1"}).encode()
+    return (b"POST /events.json?accessKey=" + key.encode() +
+            b" HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body) + body)
+
+
+def _read_responses(sock, n, timeout=20.0):
+    """Read exactly n HTTP responses off the socket; returns a list of
+    (status, headers_dict, body_bytes) in wire order."""
+    sock.settimeout(timeout)
+    buf = b""
+    out = []
+    while len(out) < n:
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError(
+                    f"connection closed after {len(out)}/{n} responses")
+            buf += chunk
+        head, _, buf = buf.partition(b"\r\n\r\n")
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        headers = {}
+        for ln in lines[1:]:
+            name, _, value = ln.partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", 0))
+        while len(buf) < length:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError("closed mid-body")
+            buf += chunk
+        out.append((status, headers, buf[:length]))
+        buf = buf[length:]
+    return out
+
+
+# -- slow clients -------------------------------------------------------------
+
+def test_slowloris_partial_header_does_not_stall_others(es):
+    """A connection dribbling half a request line holds only its own
+    buffer; requests on other connections are served immediately (the
+    old stack parked a whole thread on the slow read — survivable; an
+    event loop that blocked on it would stall EVERY connection)."""
+    slow = _connect(es["port"])
+    slow.sendall(b"GET / HT")          # partial request line, no CRLF
+    fast = _connect(es["port"])
+    t0 = time.perf_counter()
+    fast.sendall(_post_bytes(es["key"]))
+    (status, _h, _b), = _read_responses(fast, 1)
+    elapsed = time.perf_counter() - t0
+    assert status == 201
+    assert elapsed < 5.0, f"fast request stalled {elapsed:.1f}s behind slowloris"
+    # the slow connection is still open (idle reap is minutes by default)
+    slow.sendall(b"TP/1.1\r\nHost: x\r\n\r\n")
+    (status, _h, _b), = _read_responses(slow, 1)
+    assert status == 200               # dribbled request completes fine
+    slow.close()
+    fast.close()
+
+
+def test_partial_body_completes_and_others_proceed(es):
+    """Headers + half the body, long pause mid-POST: other connections
+    proceed; the dribbled body still lands as one event."""
+    body = json.dumps({"event": "buy", "entityType": "user",
+                       "entityId": "slowbody", "targetEntityType": "item",
+                       "targetEntityId": "i9"}).encode()
+    head = (b"POST /events.json?accessKey=" + es["key"].encode() +
+            b" HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body))
+    slow = _connect(es["port"])
+    slow.sendall(head + body[: len(body) // 2])
+    fast = _connect(es["port"])
+    fast.sendall(_post_bytes(es["key"], eid="fastu"))
+    (status, _h, _b), = _read_responses(fast, 1)
+    assert status == 201
+    fast.close()
+    slow.sendall(body[len(body) // 2:])
+    (status, _h, payload), = _read_responses(slow, 1)
+    assert status == 201 and b"eventId" in payload
+    slow.close()
+
+
+def test_idle_connection_reaped_by_loop(mem_storage, monkeypatch):
+    """With a short PIO_HTTP_IDLE_S, a parked connection (here: one that
+    never finishes its headers) is closed by the loop's reap pass — no
+    per-connection reaper thread involved."""
+    monkeypatch.setenv("PIO_HTTP_IDLE_S", "1")
+    mem_storage.apps.insert(App(0, "reapapp"))
+    httpd = run_event_server(host="127.0.0.1", port=0, storage=mem_storage,
+                             background=True)
+    try:
+        s = _connect(httpd.server_address[1])
+        s.sendall(b"GET / HT")        # stalled slowloris partial
+        s.settimeout(10)
+        t0 = time.perf_counter()
+        assert s.recv(1024) == b""    # server closes us, no response owed
+        assert time.perf_counter() - t0 < 8.0
+        s.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_mid_response_disconnect_does_not_poison_server(es):
+    """A client that sends a request and resets the connection without
+    reading the response must not wedge or crash the loop: subsequent
+    connections serve normally."""
+    for _ in range(3):
+        c = _connect(es["port"])
+        c.sendall(_post_bytes(es["key"], eid="ghost"))
+        # SO_LINGER 0: close() sends RST — the write side of the response
+        # will fail inside the server
+        c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        c.close()
+    time.sleep(0.2)
+    ok = _connect(es["port"])
+    ok.sendall(_post_bytes(es["key"], eid="alive"))
+    (status, _h, _b), = _read_responses(ok, 1)
+    assert status == 201
+    ok.close()
+
+
+# -- pipelining + keep-alive semantics ---------------------------------------
+
+def test_pipelined_responses_ordered_with_distinct_rids(es):
+    """Mixed-method pipelined requests are answered strictly in request
+    order, and every response carries its OWN minted X-Request-ID."""
+    wire = (_post_bytes(es["key"], eid="p1")
+            + b"GET / HTTP/1.1\r\nHost: x\r\n\r\n"
+            + b"GET /nope.json HTTP/1.1\r\nHost: x\r\n\r\n"
+            + _post_bytes(es["key"], eid="p2"))
+    s = _connect(es["port"])
+    s.sendall(wire)
+    resps = _read_responses(s, 4)
+    # /nope.json is 401 on the event server: auth precedes routing
+    assert [r[0] for r in resps] == [201, 200, 401, 201]
+    rids = [r[1].get("x-request-id") for r in resps]
+    assert all(rids), rids
+    assert len(set(rids)) == 4, f"request ids not per-request: {rids}"
+    s.close()
+
+
+def test_pipelined_client_rids_echoed_in_order(es):
+    """Client-supplied X-Request-IDs on pipelined requests come back on
+    exactly their own responses."""
+    reqs = b""
+    for k in range(5):
+        reqs += (b"GET / HTTP/1.1\r\nHost: x\r\nX-Request-ID: pipe-%d\r\n"
+                 b"\r\n" % k)
+    s = _connect(es["port"])
+    s.sendall(reqs)
+    resps = _read_responses(s, 5)
+    assert [r[1]["x-request-id"] for r in resps] == [
+        f"pipe-{k}" for k in range(5)]
+    s.close()
+
+
+def test_connection_close_honored_mid_pipeline(es):
+    """A Connection: close request mid-pipeline is the LAST one served:
+    its response says close, the socket closes, and the pipelined
+    requests after it are never answered (and never executed)."""
+    wire = (b"GET / HTTP/1.1\r\nHost: x\r\n\r\n"
+            + b"GET / HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            + _post_bytes(es["key"], eid="never-processed"))
+    s = _connect(es["port"])
+    s.sendall(wire)
+    resps = _read_responses(s, 2)
+    assert resps[0][0] == 200
+    assert resps[0][1]["connection"] == "keep-alive"
+    assert resps[1][0] == 200
+    assert resps[1][1]["connection"] == "close"
+    s.settimeout(10)
+    assert s.recv(1024) == b"", "socket should close after the close response"
+    s.close()
+
+
+def test_malformed_pipeline_errors_never_advertise_keepalive(es):
+    """PR 1 contract preserved by the loop rewrite: early-error responses
+    (malformed request line, bad Content-Length, oversized headers) say
+    Connection: close and the socket actually closes."""
+    cases = [
+        b"GARBAGE\r\n\r\n",
+        (b"POST /events.json HTTP/1.1\r\nHost: x\r\n"
+         b"Content-Length: 1_0\r\n\r\n"),
+        b"GET / HTTP/1.1\r\nHost: x\r\n" +
+        b"".join(b"X-F-%d: y\r\n" % i for i in range(150)) + b"\r\n",
+        # obs-fold continuation: would otherwise strip() into a fresh
+        # header and desync the body boundary (smuggling vector)
+        (b"POST /events.json HTTP/1.1\r\nHost: x\r\n"
+         b"Content-Length: 27\r\nX-Foo: bar\r\n Content-Length: 7\r\n\r\n"),
+        # conflicting repeated Content-Length: first-CL-wins proxies
+        # would disagree with our last-wins dict
+        (b"POST /events.json HTTP/1.1\r\nHost: x\r\n"
+         b"Content-Length: 27\r\nContent-Length: 7\r\n\r\n"),
+    ]
+    for wire in cases:
+        s = _connect(es["port"])
+        s.sendall(wire)
+        (status, headers, _b), = _read_responses(s, 1)
+        assert status == 400, wire[:30]
+        assert headers["connection"] == "close", wire[:30]
+        s.settimeout(10)
+        assert s.recv(1024) == b"", wire[:30]
+        s.close()
+
+
+def test_pipeline_after_close_marked_request_is_discarded(es):
+    """Bytes pipelined after a Connection: close request must not be
+    parsed as requests (no smuggled execution): the event that request
+    would have created never lands."""
+    wire = (b"GET / HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            + _post_bytes(es["key"], eid="smuggled"))
+    s = _connect(es["port"])
+    s.sendall(wire)
+    (status, headers, _b), = _read_responses(s, 1)
+    assert status == 200 and headers["connection"] == "close"
+    s.settimeout(10)
+    assert s.recv(1024) == b""
+    s.close()
+    # the smuggled POST never executed
+    check = _connect(es["port"])
+    check.sendall(
+        b"GET /events.json?accessKey=" + es["key"].encode() +
+        b"&entityId=smuggled&entityType=user HTTP/1.1\r\nHost: x\r\n\r\n")
+    (status, _h, payload), = _read_responses(check, 1)
+    assert status == 200 and json.loads(payload) == []
+    check.close()
+
+
+def test_expect_100_continue_interim_response(es):
+    """A deferred body behind Expect: 100-continue gets the interim
+    response first, then the final one — both in order on the wire."""
+    body = json.dumps({"event": "buy", "entityType": "user",
+                       "entityId": "expects", "targetEntityType": "item",
+                       "targetEntityId": "i1"}).encode()
+    s = _connect(es["port"])
+    s.sendall(b"POST /events.json?accessKey=" + es["key"].encode() +
+              b" HTTP/1.1\r\nHost: x\r\nExpect: 100-continue\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: %d\r\n\r\n" % len(body))
+    s.settimeout(10)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(65536)
+    assert buf.startswith(b"HTTP/1.1 100 Continue")
+    s.sendall(body)
+    (status, _h, payload), = _read_responses(s, 1)
+    assert status == 201 and b"eventId" in payload
+    s.close()
+
+
+def test_oversized_body_refused_without_buffering(mem_storage, monkeypatch):
+    """A Content-Length over PIO_HTTP_MAX_BODY is refused with 413 +
+    close at header-parse time — the loop never buffers the body."""
+    monkeypatch.setenv("PIO_HTTP_MAX_BODY", "1024")
+    app_id = mem_storage.apps.insert(App(0, "bigapp"))
+    key = mem_storage.access_keys.insert(AccessKey("", app_id, []))
+    httpd = run_event_server(host="127.0.0.1", port=0, storage=mem_storage,
+                             background=True)
+    try:
+        s = _connect(httpd.server_address[1])
+        s.sendall(b"POST /events.json?accessKey=" + key.encode() +
+                  b" HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 10485760\r\n\r\n")
+        (status, headers, _b), = _read_responses(s, 1)
+        assert status == 413
+        assert headers["connection"] == "close"
+        s.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_pipelined_queries_batch_parity(tmp_path, mem_storage, monkeypatch):
+    """Cross-request micro-batching fed by a pipelined client: queries in
+    flight on ONE socket coalesce through the batcher (PIO_SERVE_BATCH=on)
+    and the responses still come back in order, matching the unbatched
+    answers item-for-item."""
+    import numpy as np
+
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.models.recommendation import RecommendationEngine
+    from predictionio_tpu.sdk import EngineClient
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import deploy
+
+    app_id = mem_storage.apps.insert(App(0, "pipeq"))
+    rng = np.random.default_rng(11)
+    events = []
+    for u in range(20):
+        for i in rng.integers(0, 30, 8):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    variant = {
+        "id": "pipeq-engine",
+        "engineFactory":
+            "predictionio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "pipeq"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": 3, "lambda": 0.05, "meshDp": 1}}],
+    }
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(variant))
+    engine = RecommendationEngine.apply()
+    ep = engine.engine_params_from_variant(variant)
+    core_workflow.run_train(engine, ep, engine_id="pipeq-engine",
+                            storage=mem_storage)
+
+    def run(batch_mode):
+        monkeypatch.setenv("PIO_SERVE_BATCH", batch_mode)
+        httpd = deploy(engine_json=str(ej), host="127.0.0.1", port=0,
+                       storage=mem_storage, background=True)
+        try:
+            assert (httpd.pio_state.batcher is not None) == (
+                batch_mode == "on")
+            client = EngineClient(
+                f"http://127.0.0.1:{httpd.server_address[1]}")
+            with client.pipeline(depth=20) as p:
+                handles = [p.send_query({"user": f"u{u}", "num": 5})
+                           for u in range(20)]
+            return [[r["item"] for r in h.result()["itemScores"]]
+                    for h in handles]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    assert run("on") == run("off")
