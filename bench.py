@@ -1522,6 +1522,211 @@ def _trace_waterfall_demo(port: int, workers: int) -> str:
             f"fetched_from_pid={other_pid} spans={len(doc['spans'])}")
 
 
+def _serve_catalog_sweep(smoke: bool) -> dict:
+    """ISSUE-7 headline proof: catalog-size sweep of the candidate-pruned
+    vs dense UR host tail under a REAL ``pio deploy`` event-loop worker
+    (the PR-6 front end), items ∈ {100k, 300k, 1M}.  Every dense tail
+    stage is an [I_p] pass (score scatter, mask compose, top-k), so
+    dense p50 grows ~linearly with the catalog; the pruned tail touches
+    only the posting-union candidate rows, so its p50 must stay FLAT —
+    the guard requires pruned p50 at the largest catalog ≤ 1.5× its
+    smallest-catalog p50 (scale_serve_flatness).  Each cell first
+    replays a fixed corpus (warm users, hard filters, blacklists, cold
+    users) and diffs responses EXACTLY against the pruned cell at the
+    same catalog, so the sweep doubles as a pruned≡dense parity proof at
+    every size; the pruned cells also scrape the candidate-fraction
+    histogram and the inverted-index bytes gauge from the live
+    /metrics.
+
+    Load shape: ONE serial keep-alive client.  The guard's subject is
+    per-query tail cost vs catalog size; on a small shared box any
+    concurrent load measures queueing + generator/server core contention
+    (measured: c8 on 2 cores puts p50 at ~80 ms for BOTH modes at EVERY
+    size — pure noise), where c1 p50 is the service time itself."""
+    import contextlib
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from predictionio_tpu.obs.exposition import (
+        family_total,
+        parse_prometheus_text,
+    )
+    from predictionio_tpu.storage.locator import set_storage
+
+    if smoke:
+        sizes, k, n_users, secs, clients = (800, 3_200), 8, 200, 0.5, 1
+    else:
+        sizes, k, n_users, secs, clients = ((100_000, 300_000, 1_000_000),
+                                            16, 2_000, 2.5, 1)
+    out: dict = {"scale_serve_parity": "not_run",
+                 "scale_serve_flatness": "not_run"}
+    p50s: dict = {}
+    for n_items in sizes:
+        tmp = tempfile.mkdtemp(prefix=f"pio_bench_cat{n_items}")
+        try:
+            _storage, ur_json = _fabricate_ur_serving_store(
+                tmp, n_items, n_users, k, f"bench-ur-cat{n_items}",
+                f"cat{n_items}")
+            env_base = {
+                **os.environ,
+                "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+                "PIO_STORAGE_SOURCES_FS_PATH": f"{tmp}/store",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+                "PIO_JAX_PLATFORM": os.environ.get("PIO_JAX_PLATFORM",
+                                                   "cpu"),
+                "PIO_METRICS_FLUSH_S": "0.25",
+                "PIO_SERVE_BATCH": "off",
+            }
+            # warm-user queries first (the steady-state pruned path),
+            # then every rule shape the pruned mask must reproduce
+            corpus = [{"user": f"u{(j * 13) % n_users}", "num": 10}
+                      for j in range(24)]
+            corpus += [{"user": f"u{j}", "num": 10,
+                        "fields": [{"name": "category",
+                                    "values": [f"c{j % 7}"], "bias": -1}]}
+                       for j in range(6)]
+            corpus += [{"user": f"u{j}", "num": 10,
+                        "blacklistItems": [f"i{j}", f"i{j + 1}"]}
+                       for j in range(4)]
+            corpus += [{"user": f"cold{j}", "num": 10} for j in range(2)]
+            reference = None
+            for mode, cand in (("pruned", "on"), ("dense", "off")):
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                env = {**env_base, "PIO_UR_SERVE_CANDIDATES": cand}
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "predictionio_tpu.cli.main",
+                     "deploy", "--engine-json", ur_json,
+                     "--ip", "127.0.0.1", "--port", str(port),
+                     "--workers", "1"],
+                    env=env)
+                base = f"http://127.0.0.1:{port}"
+                try:
+                    # readiness: a 1M-item model takes a while to load +
+                    # warm (inverted CSRs, pop order) — generous deadline
+                    deadline = time.time() + 300
+                    up = False
+                    while not up:
+                        try:
+                            with urllib.request.urlopen(base + "/",
+                                                        timeout=2) as r:
+                                up = "pid" in json.loads(r.read())
+                        except Exception:
+                            pass
+                        if proc.poll() is not None:
+                            raise RuntimeError(
+                                f"catalog deploy died at {n_items} items "
+                                f"(rc {proc.returncode})")
+                        if time.time() > deadline:
+                            raise RuntimeError(
+                                f"catalog worker not up in 300s at "
+                                f"{n_items} items")
+                        if not up:
+                            time.sleep(0.2)
+                    with contextlib.closing(
+                            _keepalive_query_conn(port)) as conn:
+                        got = []
+                        for body in corpus:
+                            status, resp = _conn_post(conn, body)
+                            assert status == 200, resp
+                            got.append([(r["item"], r["score"])
+                                        for r in resp["itemScores"]])
+                    if reference is None:
+                        reference = got
+                        if out["scale_serve_parity"] == "not_run":
+                            out["scale_serve_parity"] = "ok"
+                    elif got != reference:
+                        bad = next(i for i, (g, w) in
+                                   enumerate(zip(got, reference)) if g != w)
+                        out["scale_serve_parity"] = (
+                            f"MISMATCH items{n_items} corpus #{bad}")
+                    qps, p50, p95, _n, _off, _topo = _measure_qps_latency(
+                        port, corpus[:24], secs, clients)
+                    pre = f"scale_serve_items{n_items}_{mode}"
+                    out[f"{pre}_p50_ms"] = round(p50, 4)
+                    out[f"{pre}_p95_ms"] = round(p95, 4)
+                    out[f"{pre}_qps"] = round(qps, 2)
+                    p50s[(n_items, mode)] = p50
+                    # per-stage averages over the cell's whole query run
+                    # (fresh process per cell, so the histograms are
+                    # cell-clean): history is the catalog-INDEPENDENT
+                    # floor (HTTP + event-store lookup); score/mask/topk
+                    # are where dense [I_p] passes grow with the catalog
+                    # and the pruned path must not
+                    with urllib.request.urlopen(base + "/metrics",
+                                                timeout=10) as r:
+                        fams, _ = parse_prometheus_text(r.read().decode())
+                    stages = {}
+                    tail_ms = 0.0
+                    for stage in ("history", "score", "mask", "topk",
+                                  "assemble"):
+                        cnt = family_total(
+                            fams,
+                            "pio_ur_serve_stage_duration_seconds_count",
+                            stage=stage)
+                        tot = family_total(
+                            fams,
+                            "pio_ur_serve_stage_duration_seconds_sum",
+                            stage=stage)
+                        if cnt:
+                            stages[stage] = round(tot / cnt * 1e3, 4)
+                            if stage != "history":
+                                tail_ms += tot / cnt * 1e3
+                    out[f"{pre}_stage_avg_ms"] = stages
+                    out[f"{pre}_tail_avg_ms"] = round(tail_ms, 4)
+                    if mode == "pruned":
+                        cnt = family_total(
+                            fams, "pio_ur_serve_candidate_frac_count")
+                        tot = family_total(
+                            fams, "pio_ur_serve_candidate_frac_sum")
+                        if cnt:
+                            out[f"scale_serve_items{n_items}"
+                                "_candidate_frac_mean"] = round(
+                                    tot / cnt, 6)
+                        out[f"scale_serve_items{n_items}_inverted_mb"] = (
+                            round(family_total(
+                                fams, "pio_ur_host_inverted_bytes") / 1e6,
+                                1))
+                finally:
+                    for _ in range(16):
+                        try:
+                            with urllib.request.urlopen(
+                                    base + "/stop", timeout=5) as r:
+                                r.read()
+                            time.sleep(0.3)
+                        except Exception:
+                            break
+                    try:
+                        proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+        finally:
+            set_storage(None)
+            shutil.rmtree(tmp, ignore_errors=True)
+    lo, hi = sizes[0], sizes[-1]
+    pl = p50s.get((lo, "pruned"), 0.0)
+    ph = p50s.get((hi, "pruned"), 0.0)
+    dl = p50s.get((lo, "dense"), 0.0)
+    dh = p50s.get((hi, "dense"), 0.0)
+    out["scale_serve_pruned_p50_ratio"] = round(ph / pl, 3) if pl else 0.0
+    out["scale_serve_dense_p50_ratio"] = round(dh / dl, 3) if dl else 0.0
+    out["scale_serve_flatness"] = (
+        "ok" if pl and ph <= 1.5 * pl else
+        f"VIOLATION pruned p50 {ph:.3f} ms at {hi} items > 1.5x "
+        f"{pl:.3f} ms at {lo} items")
+    return out
+
+
 def bench_serve_scale(smoke: bool) -> dict:
     """Multi-worker query serving (the serving twin of ingest_scale): a
     REAL ``pio deploy --workers N`` CLI subprocess per cell — prefork
@@ -1774,6 +1979,16 @@ def bench_serve_scale(smoke: bool) -> dict:
             out["serve_scale_trace_guard"] = "ok"
         except RuntimeError as e:
             out["serve_scale_trace_guard"] = f"EXCEEDED {e}"
+        # ISSUE-7 headline: pruned-vs-dense catalog sweep (own stores and
+        # deploys; a failure here must not discard the main sweep's keys)
+        try:
+            out.update(_serve_catalog_sweep(smoke))
+        except Exception as e:
+            out["scale_serve_flatness"] = f"section_failed: {e}"
+            # the parity verdict lives in the sweep's local dict, lost on
+            # raise — mark it failed too so the record never reads as
+            # "parity key silently dropped"
+            out["scale_serve_parity"] = f"section_failed: {e}"
         return out
     finally:
         set_storage(None)
@@ -2193,6 +2408,8 @@ def main() -> int:
         "serve_scale_trace_guard": "section_failed",
         "serve_scale_speedup_wmax_vs_w1": 0.0,
         "serve_scale_monotone": "section_failed",
+        "scale_serve_parity": "section_failed",
+        "scale_serve_flatness": "section_failed",
     })
     snapshot = _run_section("snapshot", args.smoke, {
         "train_cold_snapshot_events_per_sec": 0.0,

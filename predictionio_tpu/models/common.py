@@ -56,20 +56,32 @@ class LRUCache:
         with self._lock:
             return key in self._data
 
-    def get(self, key, default=None):
+    def get(self, key, default=None, count: bool = True):
         with self._lock:
             try:
                 value = self._data[key]
             except KeyError:
-                self.misses += 1
                 hit = False
+                if count:
+                    self.misses += 1
             else:
                 self._data.move_to_end(key)
-                self.hits += 1
                 hit = True
-        if self._on is not None:
+                if count:
+                    self.hits += 1
+        if count and self._on is not None:
             self._on("hit" if hit else "miss")
         return value if hit else default
+
+    def peek(self, key, default=None):
+        """``get`` without telemetry: touches LRU order on a hit (a peek
+        is still a use) but emits no hit/miss event and bumps no
+        counters.  For probe-only readers — e.g. the candidate-pruned
+        serve tail gathers from a cached full rule mask when one exists
+        but never populates on absence, and counting that probe as a
+        'miss' every query would make the cache's hit-ratio telemetry
+        meaningless."""
+        return self.get(key, default, count=False)
 
     def put(self, key, value) -> None:
         evicted = 0
@@ -152,6 +164,37 @@ def host_topk_desc(s: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         part = np.argpartition(kk, n - k)[n - k:]
         order = part[np.argsort(kk[part])][::-1]
     return s[order], order.astype(np.int32)
+
+
+def gather_csr_rows(indptr: np.ndarray, ids,
+                    *cols: np.ndarray) -> Tuple[np.ndarray, ...]:
+    """Concatenated CSR segments ``col[indptr[i]:indptr[i+1]]`` for every
+    in-range id in ``ids``, per column, in id order.
+
+    Replaces the per-id Python segment loop (list of ``(start, end)``
+    tuples + ``np.concatenate`` of many tiny slices — measured hot in
+    the UR host scorer): one fancy-index of ``indptr`` yields every
+    (start, length) pair and a single ``repeat + arange`` builds the
+    flat element index, so each column gathers once.  Ids outside
+    ``[0, len(indptr) - 1)`` and empty segments are dropped, matching
+    the loop's filters.  Element order is identical to the loop's
+    (segments in id order, elements in storage order), so float
+    accumulations downstream see the same addition order."""
+    n = len(indptr) - 1
+    ids = np.asarray(ids, np.int64)
+    if len(ids):
+        ids = ids[(ids >= 0) & (ids < n)]
+    starts = indptr[ids]
+    lens = indptr[ids + 1] - starts
+    nz = lens > 0
+    starts, lens = starts[nz], lens[nz]
+    total = int(lens.sum())
+    if total == 0:
+        return tuple(c[:0] for c in cols)
+    flat = np.repeat(
+        starts - np.concatenate(([0], np.cumsum(lens)[:-1])), lens
+    ) + np.arange(total, dtype=np.int64)
+    return tuple(c[flat] for c in cols)
 
 
 class DeviceCacheMixin:

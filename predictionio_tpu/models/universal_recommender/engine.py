@@ -46,7 +46,11 @@ from predictionio_tpu.controller import (
     PersistentModel,
     Preparator,
 )
-from predictionio_tpu.models.common import LRUCache, host_topk_desc
+from predictionio_tpu.models.common import (
+    LRUCache,
+    gather_csr_rows,
+    host_topk_desc,
+)
 from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.obs import spans as _spans
 from predictionio_tpu.obs import tracing as _tracing
@@ -80,6 +84,26 @@ _M_INV_BUILD = _REG.gauge(
     "pio_ur_host_inverted_build_seconds",
     "Wall seconds spent building the host inverted postings index, by "
     "event type (set once per model load)")
+_M_INV_BYTES = _REG.gauge(
+    "pio_ur_host_inverted_bytes",
+    "Resident bytes of the host inverted postings index (CSR indptr + "
+    "rows + weights), by event type (set once per build) — the memory "
+    "the candidate-pruned serve path keeps hot per million-item catalog")
+_M_CAND = _REG.counter(
+    "pio_ur_serve_candidate_total",
+    "Candidate-pruned host-tail decisions by outcome: pruned (served "
+    "from the posting-union candidate set), fallback_no_candidates "
+    "(cold user / empty postings -> dense tail), "
+    "fallback_backfill_reorder (boost mask + backfill shortfall -> "
+    "dense tail), fallback_backfill_scan (rare-match rule blew the "
+    "backfill scan budget -> dense tail)")
+_M_CAND_FRAC = _REG.histogram(
+    "pio_ur_serve_candidate_frac",
+    "Fraction of the catalog a candidate-pruned query touched "
+    "(|candidates| / n_items); the lever that keeps serve p50 flat as "
+    "the catalog grows",
+    buckets=(1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 0.01, 0.03,
+             0.1, 0.3, 1.0))
 
 
 def _cache_event(cache: str):
@@ -92,9 +116,11 @@ def _mask_cache_event(outcome: str) -> None:
     _M_MASK_CACHE.inc(1, outcome=outcome)
 
 
-# builds of lazily-derived model state (the CSR postings inversion) are
-# serialized here: two concurrent first queries must not both pay the
-# argsort — one builds, the other waits and reuses (double-checked cache)
+# guards creation of the PER-EVENT-TYPE build locks only (never held
+# across a build): inversions of different event types proceed in
+# parallel — warm() builds them on one thread each — while two
+# concurrent first queries of the SAME type still share one argsort
+# (double-checked per-name lock)
 _HOST_INV_LOCK = _threading.Lock()
 
 
@@ -539,11 +565,18 @@ class URModel(PersistentModel):
         hit = cache.get(name)
         if hit is not None:
             return hit
-        # build ONCE under a lock: two concurrent first queries used to
-        # both pay the full argsort/bincount build (and publish different
-        # array objects).  Double-checked — the loser of the race reuses
-        # the winner's build.
+        # build ONCE under a PER-NAME lock: two concurrent first queries
+        # of the same type share one argsort/bincount build (the loser
+        # of the race reuses the winner's arrays), while DIFFERENT event
+        # types build concurrently — warm() fans the types out across
+        # threads, so a two-type model inverts in the time of the
+        # slower table
         with _HOST_INV_LOCK:
+            locks = self.__dict__.setdefault("_host_inv_locks", {})
+            lock = locks.get(name)
+            if lock is None:
+                lock = locks[name] = _threading.Lock()
+        with lock:
             hit = cache.get(name)
             if hit is not None:
                 return hit
@@ -575,6 +608,8 @@ class URModel(PersistentModel):
                 built = (indptr, rows, w)
             cache[name] = built
             _M_INV_BUILD.set(_time.perf_counter() - t0, event=name)
+            _M_INV_BYTES.set(
+                sum(int(a.nbytes) for a in built), event=name)
             return built
 
     def warm(self) -> None:
@@ -586,13 +621,44 @@ class URModel(PersistentModel):
         # micro-batch leader.  Both stay lazy, so a runtime scorer/tail
         # switch still works — it just pays its build on first use.
         if _serve_scorer() == "host":
-            for name in self.indicator_idx:
-                self.host_inverted(name)
+            names = list(self.indicator_idx)
+            # one thread per extra event type: the per-name build locks
+            # let the CSR inversions run concurrently (argsort releases
+            # the GIL on large arrays), so warm() pays for the slowest
+            # table instead of the sum.  Thread failures re-raise HERE:
+            # a build that cannot complete (OOM on a huge CSR, corrupt
+            # table) must fail deploy-time warm-up, not the first
+            # serving query
+            errors: List[BaseException] = []
+
+            def build(n: str) -> None:
+                try:
+                    self.host_inverted(n)
+                except BaseException as e:
+                    errors.append(e)
+
+            extra = [
+                _threading.Thread(target=build, args=(n,), daemon=True)
+                for n in names[1:]
+            ]
+            for t in extra:
+                t.start()
+            # the main-thread build goes through the same collector, so
+            # a failure still JOINS the siblings first — deploy unwind
+            # must not race half-built threads mutating the model
+            if names:
+                build(names[0])
+            for t in extra:
+                t.join()
+            if errors:
+                raise errors[0]
         else:
             self.device_indicators()
         if _serve_tail() == "host":
             self.host_popularity()
             self.host_zeros()
+            if _serve_candidates() == "on":
+                self.host_pop_order()
         else:
             self.device_popularity()
             self.device_ones()
@@ -650,6 +716,21 @@ class URModel(PersistentModel):
             z = np.zeros(len(self.item_dict), np.float32)
             self.__dict__["_host_zeros"] = z
         return z
+
+    def host_pop_order(self) -> np.ndarray:
+        """Every item id in the backfill tail's TOTAL order — popularity
+        descending, id ascending on ties, exactly host_topk_desc /
+        ``lax.top_k``'s order — precomputed once per model generation
+        (benign build race: idempotent).  The candidate-pruned serve
+        tail merges popularity backfill by walking this order and
+        skipping ineligible ids, so a backfill pick costs O(num) instead
+        of an [I_p] materialize + top-k per query."""
+        order = self.__dict__.get("_host_pop_order")
+        if order is None:
+            _, order = host_topk_desc(self.host_popularity(),
+                                      len(self.item_dict))
+            self.__dict__["_host_pop_order"] = order
+        return order
 
     _VALUE_MASK_CACHE_MAX = 512
     _DATE_CACHE_MAX = 512
@@ -872,6 +953,40 @@ def _serve_tail() -> str:
     if conf in ("host", "device"):
         return conf
     return "host" if jax.default_backend() == "cpu" else "device"
+
+
+def _sorted_member(ids: np.ndarray,
+                   sorted_ids: Optional[np.ndarray]) -> np.ndarray:
+    """Boolean membership of ``ids`` in an ASCENDING id array via
+    searchsorted — np.isin re-sorts its second argument on every call,
+    which the pruned backfill walk would pay per chunk per field value;
+    the prop_value_index id lists are built ascending, so the sort is
+    free."""
+    if sorted_ids is None or len(sorted_ids) == 0:
+        return np.zeros(len(ids), bool)
+    pos = np.searchsorted(sorted_ids, ids)
+    np.minimum(pos, len(sorted_ids) - 1, out=pos)
+    return sorted_ids[pos] == ids
+
+
+def _serve_candidates() -> str:
+    """'on' | 'off' — whether the host tail serves from the pruned
+    posting-union candidate set instead of dense [I_p] passes.
+
+    auto (default) and on: candidates whenever BOTH the scorer and the
+    tail resolve to host (the sparse set only exists on the host side —
+    the device paths keep [I_p] vectors resident where they belong);
+    off forces the dense tail.  Per QUERY the pruned path still falls
+    back to dense when it cannot be exact: no candidates at all (cold
+    user / empty postings) or a value-boosted mask with a backfill
+    shortfall — so on/auto never change responses, only cost
+    (pio_ur_serve_candidate_total counts the outcomes)."""
+    conf = _os.environ.get("PIO_UR_SERVE_CANDIDATES", "auto").lower()
+    if conf == "off":
+        return "off"
+    if _serve_scorer() == "host" and _serve_tail() == "host":
+        return "on"
+    return "off"
 
 
 @partial(jax.jit, static_argnames=("n_items_t",))
@@ -1222,7 +1337,8 @@ class URAlgorithm(Algorithm):
         if _serve_scorer() == "host":
             # stays a NUMPY array: under the host tail the signal never
             # touches the device at all; the device tail uploads it
-            return self._score_history_host(model, hist)
+            return self._sparse_signal_dense(
+                len(model.item_dict), self._score_history_host(model, hist))
         use_llr = jnp.asarray(self.params.use_llr_weights)
         total = None
         for name, (idx_dev, llr_dev) in model.device_indicators().items():
@@ -1240,35 +1356,66 @@ class URAlgorithm(Algorithm):
 
     def _score_history_host(
         self, model: URModel, hist: Dict[str, np.ndarray]
-    ) -> Optional[np.ndarray]:
-        """Inverted-index twin of the device scorer: same signal (float32
-        sums may differ in the last ulp — addition order differs), built
-        from |hist| posting-list slices per event type."""
-        i_p = len(model.item_dict)
-        total: Optional[np.ndarray] = None
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Inverted-index twin of the device scorer, SPARSE: returns
+        ``(candidate_ids, candidate_scores)`` — the ascending unique
+        union of posting-list rows across every event type's history,
+        and the f32 signal at exactly those rows (every other row scores
+        exactly 0.0) — or None when the history carries no event type.
+
+        Posting segments come from ONE fancy-index of each CSR's indptr
+        (gather_csr_rows — no per-history-id Python loop), and scoring
+        is a weighted ``np.bincount`` over the COMPACTED candidate space
+        instead of an [I_p] zeros + scatter-add, so cost scales with the
+        user's posting footprint (typically a few thousand rows), not
+        the catalog.  The dense signal, where a caller needs it, is an
+        exact scatter of this result (_sparse_signal_dense) — one
+        scoring implementation serves both tails.  Vs the device scorer
+        the float32 sums may differ in the last ulp (addition order)."""
+        per_type: List[Tuple[str, np.ndarray, Optional[np.ndarray]]] = []
         for name in model.indicator_idx:
             h_ids = hist.get(name)
             if h_ids is None or len(h_ids) == 0:
                 continue
             indptr, rows, w = model.host_inverted(name)
-            n_t = len(indptr) - 1
-            segs = [(indptr[h], indptr[h + 1])
-                    for h in np.asarray(h_ids) if 0 <= h < n_t]
-            segs = [(a, b) for a, b in segs if b > a]
-            score = np.zeros(i_p, np.float32)
-            if segs:
-                cat_rows = np.concatenate([rows[a:b] for a, b in segs])
-                if self.params.use_llr_weights:
-                    cat_w = np.concatenate([w[a:b] for a, b in segs])
-                    np.add.at(score, cat_rows, cat_w)
-                else:
-                    score += np.bincount(
-                        cat_rows, minlength=i_p).astype(np.float32)
+            if self.params.use_llr_weights:
+                cat_rows, cat_w = gather_csr_rows(indptr, h_ids, rows, w)
+            else:
+                (cat_rows,), cat_w = gather_csr_rows(indptr, h_ids,
+                                                     rows), None
+            per_type.append((name, cat_rows, cat_w))
+        if not per_type:
+            return None
+        cand = np.unique(
+            np.concatenate([r for _, r, _ in per_type])).astype(np.int32)
+        total: Optional[np.ndarray] = None
+        for name, cat_rows, cat_w in per_type:
+            rel = np.searchsorted(cand, cat_rows)
+            if cat_w is not None:
+                score = np.bincount(rel, weights=cat_w,
+                                    minlength=len(cand)).astype(np.float32)
+            else:
+                score = np.bincount(
+                    rel, minlength=len(cand)).astype(np.float32)
             weight = float(self.params.indicator_weights.get(name, 1.0))
             if weight != 1.0:
                 score *= weight
             total = score if total is None else total + score
-        return total
+        return cand, total
+
+    @staticmethod
+    def _sparse_signal_dense(
+        n_items: int, sparse: Optional[Tuple[np.ndarray, np.ndarray]]
+    ) -> Optional[np.ndarray]:
+        """Dense [n_items] signal from the sparse scorer's result — an
+        exact scatter (rows outside the candidate set are exactly 0.0,
+        which is also what the dense accumulation produced)."""
+        if sparse is None:
+            return None
+        ids, sc = sparse
+        out = np.zeros(n_items, np.float32)
+        out[ids] = sc
+        return out
 
     def batch_predict(self, model: URModel, queries) -> List[URResult]:
         """Eval-time predictions: user history comes from the MODEL's
@@ -1309,14 +1456,18 @@ class URAlgorithm(Algorithm):
         /traces/<rid>.json shows the history→score→mask→topk→assemble
         waterfall."""
         stages: List[Tuple[str, float]] = []
+        meta: Dict[str, str] = {}
         journal = _spans.current_journal()
         trace = _tracing.current_trace() if journal is None else None
         if journal is None and trace is None:
-            return self._predict_staged(model, query, hist_override, stages)
+            return self._predict_staged(model, query, hist_override, stages,
+                                        meta)
         sink = journal if journal is not None else trace
         with sink.span("ur_predict") as rec:
-            res = self._predict_staged(model, query, hist_override, stages)
+            res = self._predict_staged(model, query, hist_override, stages,
+                                       meta)
             rec["attrs"] = {"tail": _serve_tail(),
+                            "candidates": meta.get("candidates", "off"),
                             **{f"{n}_ms": round(dt * 1e3, 4)
                                for n, dt in stages}}
         if trace is not None:
@@ -1331,7 +1482,7 @@ class URAlgorithm(Algorithm):
 
     def _predict_staged(self, model: URModel, query: URQuery,
                         hist_override, stages: List[Tuple[str, float]],
-                        ) -> URResult:
+                        meta: Optional[Dict[str, str]] = None) -> URResult:
         n_items = len(model.item_dict)
         if n_items == 0:
             return URResult([])
@@ -1345,18 +1496,49 @@ class URAlgorithm(Algorithm):
 
         hist = self._query_hist(model, query, hist_override)
         lap("history")
-        signal = self._score_history(model, hist) if hist is not None else None
-        lap("score")
-        have_signal = signal is not None
         num = min(query.num, n_items)
-        if tail == "host":
-            sig_np = None if signal is None else np.asarray(signal)
-            res = self._host_tail(model, query, sig_np, num, lap)
+        cand_label = "off"
+        if tail == "host" and _serve_candidates() == "on":
+            # candidate-pruned tail: the sparse scorer result feeds a
+            # pruned mask/topk/backfill pass; a per-query fallback
+            # (None) re-runs the dense tail on the scattered signal with
+            # fresh stage laps, so mixed traffic stays exact AND
+            # correctly attributed in the stage histogram
+            sparse = (self._score_history_host(model, hist)
+                      if hist is not None else None)
+            lap("score")
+            sub: List[Tuple[str, float]] = []
+
+            def sub_lap(name: str) -> None:
+                now = _time.perf_counter()
+                sub.append((name, now - t[0]))
+                t[0] = now
+
+            res = self._host_tail_pruned(model, query, sparse, num, sub_lap)
+            if res is not None:
+                stages.extend(sub)
+                cand_label = "on"
+            else:
+                t[0] = _time.perf_counter()   # discard the aborted laps
+                res = self._host_tail(
+                    model, query,
+                    self._sparse_signal_dense(n_items, sparse), num, lap)
         else:
-            res = self._device_tail(model, query, signal, have_signal, num,
-                                    lap)
+            signal = (self._score_history(model, hist)
+                      if hist is not None else None)
+            lap("score")
+            have_signal = signal is not None
+            if tail == "host":
+                sig_np = None if signal is None else np.asarray(signal)
+                res = self._host_tail(model, query, sig_np, num, lap)
+            else:
+                res = self._device_tail(model, query, signal, have_signal,
+                                        num, lap)
+        if meta is not None:
+            meta["candidates"] = cand_label
         for name, dt in stages:
-            _M_STAGE.observe(dt, stage=name, tail=tail)
+            _M_STAGE.observe(dt, stage=name, tail=tail,
+                             candidates=cand_label)
         return res
 
     def _device_tail(self, model: URModel, query: URQuery, signal,
@@ -1436,6 +1618,188 @@ class URAlgorithm(Algorithm):
             lap("assemble")
         return res
 
+    def _host_tail_pruned(self, model: URModel, query: URQuery,
+                          sparse: Optional[Tuple[np.ndarray, np.ndarray]],
+                          num: int, lap=None) -> Optional[URResult]:
+        """Candidate-pruned host tail: mask composition, blacklist,
+        signal top-k, and popularity backfill all touch ONLY the sparse
+        scorer's candidate rows (plus an O(num) walk of the precomputed
+        popularity order for backfill) — never an [I_p] temporary — so
+        per-query cost is flat in catalog size.
+
+        Exactness-parity with _host_tail by construction: candidate
+        scores ARE the dense signal at those rows and the dense signal
+        is exactly 0 elsewhere, so the dense positive set is a subset of
+        the candidates; the sliced mask equals the full mask gathered
+        (elementwise factors commute with the gather); candidates are
+        id-ascending, so subset top-k reproduces the dense tie order;
+        and the backfill merge walks host_pop_order, which IS the dense
+        ``host_topk_desc(bf * mask)`` order whenever the mask is binary.
+
+        Returns None when this query must fall back to the dense tail:
+        no candidates at all (cold user / empty postings — nothing to
+        prune, and backfill would still rank the whole catalog), a
+        value-boosted (non-binary) mask with a backfill shortfall (where
+        eligibility order is no longer the precomputed popularity
+        order), or a backfill walk that blows its scan budget (a
+        rare-match rule — the dense pass bounds the cost and caches the
+        mask).  Fallbacks and pruned serves are counted in
+        pio_ur_serve_candidate_total."""
+        if sparse is None or len(sparse[0]) == 0:
+            _M_CAND.inc(1, outcome="fallback_no_candidates")
+            return None
+        cand, sc = sparse
+        n_items = len(model.item_dict)
+        # strict date parsing happens in the key builder, before any
+        # cache or mask work — malformed dates 400 exactly as the dense
+        # tail does
+        key = self._mask_rule_key(query)
+        mask_at = None
+        mask_c = None
+        if key is not None:
+            # peek, not get: this probe never populates, so counting it
+            # in the hit/miss telemetry would flatline the dense cache's
+            # hit ratio under pruned traffic
+            full = model.rule_mask_cache("host").peek(key)
+            if full is not None:
+                # a dense query (or tail switch) already composed this
+                # rule set: gather the per-generation cached full mask
+                def mask_at(ids, _full=full):
+                    return _full[ids]
+            else:
+                def mask_at(ids):
+                    return self._mask_from_key_host_sliced(model, key, ids)
+            mask_c = mask_at(cand)
+        black = self._blacklist_ids(model, query)
+        if lap is not None:
+            lap("mask")
+        k = min(bucket_width(2 * num, 16), n_items)
+        s = sc * mask_c if mask_c is not None else sc
+        pos = np.flatnonzero(s > 0)
+        # sort the blacklist ONCE: both the signal filter here and the
+        # backfill walk probe it via _sorted_member
+        sb = np.sort(np.asarray(black, np.int32)) if black else None
+        if sb is not None and len(pos):
+            pos = pos[~_sorted_member(cand[pos], sb)]
+        st = si = None
+        if len(pos):
+            vals, oi = host_topk_desc(s[pos], min(k, len(pos)))
+            st, si = vals, cand[pos][oi].astype(np.int32)
+        n_signal = min(len(st) if st is not None else 0, num)
+        bt = bi = None
+        if n_signal < num and self.params.backfill_type != "none":
+            if key is not None and not self._mask_key_is_binary(key):
+                # a boost bias scales backfill scores, so eligible-item
+                # order diverges from the precomputed popularity order —
+                # only the dense [I_p] top-k ranks that exactly
+                _M_CAND.inc(1, outcome="fallback_backfill_reorder")
+                return None
+            merged = self._backfill_merge(model, mask_at, sb, k)
+            if merged is None:
+                # the walk blew its scan budget (a rare-match rule over a
+                # big catalog): the dense tail bounds the cost at one
+                # [I_p] pass AND populates the rule-mask cache, so
+                # repeats of this rule set get the cached-mask gather
+                _M_CAND.inc(1, outcome="fallback_backfill_scan")
+                return None
+            bt, bi = merged
+        if lap is not None:
+            lap("topk")
+        _M_CAND.inc(1, outcome="pruned")
+        _M_CAND_FRAC.observe(len(cand) / max(n_items, 1))
+        empty_f = np.zeros(0, np.float32)
+        empty_i = np.zeros(0, np.int32)
+        res = self._assemble(
+            model, num, st is not None,
+            st if st is not None else empty_f,
+            si if si is not None else empty_i,
+            bt if bt is not None else empty_f,
+            bi if bi is not None else empty_i)
+        if lap is not None:
+            lap("assemble")
+        return res
+
+    @staticmethod
+    def _mask_key_is_binary(key: tuple) -> bool:
+        """True when the composed mask can only take values in {0, 1}:
+        every field bias is a hard filter (< 0), a zero-boost (0.0, which
+        excludes like a filter) or the identity boost (1.0) — dateRange
+        and currentDate factors are always 0/1.  Binary masks never
+        REORDER backfill scores (x * 1.0 == x in f32), so the pruned
+        tail's popularity-order merge stays exact."""
+        return all(bias < 0.0 or bias in (0.0, 1.0)
+                   for _name, _values, bias in key[0])
+
+    # ids a pruned-tail backfill walk may scan before giving up and
+    # falling back to the dense tail: bounds the per-query sliced
+    # predicate work to a CATALOG-INDEPENDENT constant when a rule
+    # matches almost nothing (the dense pass is O(I_p) once and its
+    # full mask is then cached for repeats, where the walk would
+    # re-evaluate the slice every query)
+    _BACKFILL_SCAN_BUDGET = 1 << 16
+
+    def _backfill_merge(self, model: URModel, mask_at, sb, k: int,
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Backfill picks for the pruned tail: walk popmodel's
+        precomputed (popularity desc, id asc) total order in doubling
+        chunks, dropping blacklisted (``sb``: pre-sorted id array or
+        None) and rule-masked-out ids, until k survive — never an [I_p]
+        temporary.  Only called under a binary mask, where survivor
+        order along the walk IS the dense tail's ``host_topk_desc(bf *
+        mask)`` order and survivor scores are exactly ``bf`` (the dense
+        tail's -inf rows are the ones dropped here, and _assemble skips
+        them there).  Returns None when the walk exceeds
+        _BACKFILL_SCAN_BUDGET scanned ids with survivors still owed and
+        catalog left to scan — the caller serves that query dense."""
+        order = model.host_pop_order()
+        bf = model.host_popularity()
+        n = len(order)
+        picks: List[np.ndarray] = []
+        taken = 0
+        start, chunk = 0, max(4 * k, 64)
+        while taken < k and start < n:
+            if start >= self._BACKFILL_SCAN_BUDGET:
+                return None
+            ids = order[start:start + chunk]
+            start += len(ids)
+            chunk = min(chunk * 2, 1 << 16)
+            keep = np.ones(len(ids), bool)
+            if sb is not None:
+                keep &= ~_sorted_member(ids, sb)
+            if mask_at is not None:
+                keep &= mask_at(ids) > 0
+            sel = ids[keep]
+            if len(sel):
+                picks.append(sel[: k - taken])
+                taken += len(picks[-1])
+        if not picks:
+            return np.zeros(0, np.float32), np.zeros(0, np.int32)
+        bi = np.concatenate(picks).astype(np.int32)
+        return bf[bi], bi
+
+    def _mask_from_key_host_sliced(self, model: URModel, key: tuple,
+                                   ids: np.ndarray) -> np.ndarray:
+        """Evaluate the canonical rule key's mask at ``ids`` only —
+        exactly ``_mask_from_key_host(...)[ids]`` without the [I_p]
+        build and without a cache entry (candidate slices are
+        query-specific; the shared per-(property, value) id indexes and
+        date-offset caches still back the factors).  Exactness is
+        structural: both paths run the SAME factor composition
+        (_compose_mask_host) and every factor is elementwise, so
+        evaluation commutes with the gather; only the accessors differ
+        (sorted-membership probe vs cached full bitset, ts gather vs
+        full ts)."""
+        zeros = np.zeros(len(ids), np.float32)
+        return self._compose_mask_host(
+            model, key,
+            # prop_value_index id lists are ascending by construction,
+            # so membership needs no per-call sort
+            value_match=lambda name, val: _sorted_member(
+                ids, model._value_mask_ids(name, val)).astype(np.float32),
+            date_ts=lambda ts: ts[ids],
+            zeros=lambda: zeros,
+            n=len(ids))
+
     def _query_hist(self, model: URModel, query: URQuery,
                     hist_override: Optional[Dict[str, np.ndarray]] = None,
                     ) -> Optional[Dict[str, np.ndarray]]:
@@ -1514,8 +1878,27 @@ class URAlgorithm(Algorithm):
             # program still amortizes dispatch and every row comes back in
             # ONE readback before the numpy tails run.
             if scorer == "host":
-                rows = [self._score_history_host(model, h) if h else None
-                        for h in hists]
+                sparses = [self._score_history_host(model, h) if h else None
+                           for h in hists]
+                if _serve_candidates() == "on":
+                    # candidate branch: each query's pruned tail runs
+                    # straight off its sparse row — micro-batched
+                    # queries keep one-pass assembly and the same
+                    # per-query dense fallback as serial predict
+                    out = []
+                    for r, q in enumerate(queries):
+                        nm = min(q.num, n_items)
+                        res = self._host_tail_pruned(model, q, sparses[r],
+                                                     nm)
+                        if res is None:
+                            res = self._host_tail(
+                                model, q,
+                                self._sparse_signal_dense(n_items,
+                                                          sparses[r]), nm)
+                        out.append(res)
+                    return out
+                rows = [self._sparse_signal_dense(n_items, s)
+                        for s in sparses]
             else:
                 total = self._score_batch_device(model, hists, bp, n_items)
                 rows_all = (None if total is None
@@ -1528,8 +1911,10 @@ class URAlgorithm(Algorithm):
             ]
         total = None
         if scorer == "host":
-            rows_np = [self._score_history_host(model, h) if h else None
-                       for h in hists]
+            rows_np = [
+                self._sparse_signal_dense(
+                    n_items, self._score_history_host(model, h))
+                if h else None for h in hists]
             if any(r is not None for r in rows_np):
                 total = jnp.asarray(np.stack(
                     [r if r is not None else np.zeros(n_items, np.float32)
@@ -1686,15 +2071,33 @@ class URAlgorithm(Algorithm):
 
     def _mask_from_key_host(self, model, fields, drk, now, avail, expire
                             ) -> np.ndarray:
+        return self._compose_mask_host(
+            model, (fields, drk, now, avail, expire),
+            value_match=model.host_value_mask,   # cached full f32 bitsets
+            date_ts=lambda ts: ts,
+            zeros=model.host_zeros,
+            n=len(model.item_dict))
+
+    def _compose_mask_host(self, model, key: tuple, value_match, date_ts,
+                           zeros, n: int) -> np.ndarray:
+        """The ONE host factor composition behind both the full mask and
+        the candidate slice — pruned≡dense exactness depends on both
+        paths multiplying the identical elementwise factors in the
+        identical order, so the composition exists exactly once and the
+        two callers only swap accessors: ``value_match(name, val)`` →
+        f32 0/1 match over the domain, ``date_ts(full_ts)`` → the
+        domain's slice of a date-offset array, ``zeros()`` → the
+        match-nothing result, ``n`` = domain length."""
+        fields, drk, now, avail, expire = key
         one = np.float32(1.0)
-        mask = np.ones(len(model.item_dict), np.float32)
+        mask = np.ones(n, np.float32)
         for name, values, bias in fields:
             match = None
             for val in values:
-                m = model.host_value_mask(name, val)
+                m = value_match(name, val)
                 match = m if match is None else np.maximum(match, m)
             if match is None:
-                match = model.host_zeros()
+                match = zeros()
             if bias < 0:
                 mask = mask * match              # hard filter
             else:
@@ -1703,8 +2106,9 @@ class URAlgorithm(Algorithm):
             name, after_s, before_s = drk
             d = model.date_offsets(name)
             if d is None:            # no item has the property: match nothing
-                return model.host_zeros()
+                return zeros()
             base, ts = d
+            ts = date_ts(ts)
             present = (ts >= 0)
             mask = mask * present.astype(np.float32)
             if after_s is not None:
@@ -1721,8 +2125,9 @@ class URAlgorithm(Algorithm):
                     continue
                 d = model.date_offsets(prop)
                 if d is None:
-                    return model.host_zeros()
+                    return zeros()
                 base, ts = d
+                ts = date_ts(ts)
                 b = self._date_bound(now, base)
                 mask = mask * (op(ts, b) & (ts >= 0)).astype(np.float32)
         return mask

@@ -61,6 +61,11 @@ REQUIRED_METRICS = frozenset({
     "pio_http_connections",
     "pio_serve_batch_size",
     "pio_events_ingested_total",
+    # candidate-pruned serving contract (PR 7): dashboards key on the
+    # pruned/fallback outcome mix and the candidate-fraction histogram
+    "pio_ur_serve_candidate_total",
+    "pio_ur_serve_candidate_frac",
+    "pio_ur_host_inverted_bytes",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

@@ -11,13 +11,19 @@ result — through BOTH serve tails (``PIO_UR_SERVE_TAIL=host`` vs
 under each tail, diffing results EXACTLY: same items, same float scores,
 same order.
 
+A candidate-pruned phase then replays the corpus through the sparse
+host tail (``PIO_UR_SERVE_CANDIDATES=on`` — posting-union candidates,
+sliced rule masks, popularity-order backfill merge) serial AND batched,
+diffing exact floats against the dense reference.
+
 Then the same corpus goes over HTTP against the event-loop front end —
 a live deployed query server — in BOTH wire modes: serial keep-alive
 (one request/response at a time) and HTTP/1.1 pipelined (the SDK's
-QueryPipeline, every query in flight at once), diffing the JSON
-responses exactly against the in-process reference.  Any divergence —
-tail math, micro-batching, request-loop parsing, response ordering
-under pipelining — fails the script.
+QueryPipeline, every query in flight at once), each replayed under the
+candidate-pruned AND the dense tail, diffing the JSON responses exactly
+against the in-process reference.  Any divergence — tail math,
+candidate pruning, micro-batching, request-loop parsing, response
+ordering under pipelining — fails the script.
 
 The host tail's contract is that it is a bit-exact twin of the device
 tail (elementwise f32 mask math matches XLA, host_topk_desc reproduces
@@ -159,33 +165,41 @@ def http_phase(engine, ep, query_cls, storage, reference, problems) -> None:
     port = httpd.server_address[1]
     bodies = corpus_bodies()
     try:
-        # serial keep-alive: one request/response at a time on one socket
-        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-        serial = []
-        for body in bodies:
-            conn.request("POST", "/queries.json", _json.dumps(body).encode(),
-                         {"Content-Type": "application/json"})
-            r = conn.getresponse()
-            payload = r.read()
-            if r.status != 200:
-                problems.append(
-                    f"http/serial HTTP {r.status}: {payload[:200]!r}")
-                return
-            serial.append(canon_http(_json.loads(payload)))
-        conn.close()
-        # pipelined: every query in flight at once on one socket; the
-        # event loop must answer strictly in order
-        with EngineClient(f"http://127.0.0.1:{port}").pipeline(
-                depth=len(bodies)) as p:
-            handles = [p.send_query(body) for body in bodies]
-        pipelined = [canon_http(h.result()) for h in handles]
-        for name, results in (("http/serial", serial),
-                              ("http/pipelined", pipelined)):
-            for qi, (got, want) in enumerate(zip(results, reference)):
-                if got != want:
+        # the deployed server is in-process, so the per-query env switch
+        # flips ITS tail too: each wire mode replays under the dense AND
+        # the candidate-pruned tail
+        for cand in ("off", "on"):
+            os.environ["PIO_UR_SERVE_CANDIDATES"] = cand
+            # serial keep-alive: one request/response at a time per socket
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            serial = []
+            for body in bodies:
+                conn.request("POST", "/queries.json",
+                             _json.dumps(body).encode(),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                payload = r.read()
+                if r.status != 200:
                     problems.append(
-                        f"query #{qi} differs on {name} vs in-process:\n"
-                        f"  got:  {got}\n  want: {want}")
+                        f"http/serial/cand_{cand} HTTP {r.status}: "
+                        f"{payload[:200]!r}")
+                    return
+                serial.append(canon_http(_json.loads(payload)))
+            conn.close()
+            # pipelined: every query in flight at once on one socket; the
+            # event loop must answer strictly in order
+            with EngineClient(f"http://127.0.0.1:{port}").pipeline(
+                    depth=len(bodies)) as p:
+                handles = [p.send_query(body) for body in bodies]
+            pipelined = [canon_http(h.result()) for h in handles]
+            for name, results in ((f"http/serial/cand_{cand}", serial),
+                                  (f"http/pipelined/cand_{cand}",
+                                   pipelined)):
+                for qi, (got, want) in enumerate(zip(results, reference)):
+                    if got != want:
+                        problems.append(
+                            f"query #{qi} differs on {name} vs "
+                            f"in-process:\n  got:  {got}\n  want: {want}")
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -219,12 +233,20 @@ def main() -> int:
     queries = corpus(URQuery, FieldRule)
 
     runs = {}
+    os.environ["PIO_UR_SERVE_CANDIDATES"] = "off"   # dense phase first
     for tail in ("host", "device"):
         os.environ["PIO_UR_SERVE_TAIL"] = tail
         runs[f"{tail}/serial"] = [canon(algo.predict(model, q))
                                   for q in queries]
         runs[f"{tail}/batch"] = [canon(r) for r in
                                  algo.serve_batch_predict(model, queries)]
+    # candidate-pruned phase: the sparse host tail must reproduce the
+    # dense reference exactly, serial and micro-batched
+    os.environ["PIO_UR_SERVE_TAIL"] = "host"
+    os.environ["PIO_UR_SERVE_CANDIDATES"] = "on"
+    runs["cand/serial"] = [canon(algo.predict(model, q)) for q in queries]
+    runs["cand/batch"] = [canon(r) for r in
+                          algo.serve_batch_predict(model, queries)]
     problems = []
     reference = runs["device/serial"]
     some_nonempty = any(reference)
@@ -251,8 +273,8 @@ def main() -> int:
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
-        print(f"ok: {len(queries)} queries × (4 serving paths + "
-              "http serial + http pipelined) identical "
+        print(f"ok: {len(queries)} queries × (6 serving paths + "
+              "http serial/pipelined × candidates on/off) identical "
               "(items, scores, order)")
     return 1 if problems else 0
 

@@ -1,0 +1,482 @@
+"""Candidate-pruned serving tests (PR 7): the pruned host tail must be
+an EXACT twin of the dense tail — same items, same float scores, same
+tie order — across every scorer × tail × batching × candidates cell,
+including the adversarial shapes that break naive pruning: duplicate
+score vectors (tie order), rules selecting entirely outside the
+candidate set, empty-postings event types, blacklists covering the
+popularity head, all-masked queries, num=0, and cold users (where the
+pruned path falls back to dense).  Plus the new observability surface:
+pio_ur_serve_candidate_{total,frac}, pio_ur_host_inverted_bytes, the
+per-name parallel inverted builds, and the env resolution rules."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.events.event import DataMap, Event
+from predictionio_tpu.models.universal_recommender import (
+    UniversalRecommenderEngine,
+    URQuery,
+)
+from predictionio_tpu.models.universal_recommender.engine import (
+    URAlgorithm,
+    URAlgorithmParams,
+    URDataSourceParams,
+    URModel,
+    _M_CAND,
+    _M_CAND_FRAC,
+    _M_INV_BYTES,
+    _serve_candidates,
+)
+from predictionio_tpu.storage import App
+from predictionio_tpu.store.columnar import CSRLookup, IdDict
+
+
+# -- fabricated models: full control over score/popularity pathologies ----
+
+
+def make_model(n_items=400, k=8, seed=0, popularity=None, const_llr=False,
+               blank_type=None):
+    """A URModel built directly (the bench's fabrication pattern):
+    random indicator tables with -1 padding over two event types sharing
+    the primary item space; ``const_llr`` makes every weight 1.0 so LLR
+    scoring degenerates into duplicate-heavy counts; ``blank_type``
+    forces one type's table to all -1 (empty postings)."""
+    rng = np.random.default_rng(seed)
+    item_dict = IdDict([f"i{j}" for j in range(n_items)])
+    user_dict = IdDict([f"u{j}" for j in range(20)])
+    idx, llr, dicts = {}, {}, {}
+    for name in ("ev0", "ev1"):
+        tbl = rng.integers(0, n_items, (n_items, k)).astype(np.int32)
+        tbl[:, -1] = -1
+        if name == blank_type:
+            tbl = np.full((n_items, k), -1, np.int32)
+        idx[name] = tbl
+        llr[name] = (np.ones((n_items, k), np.float32) if const_llr
+                     else np.sort(rng.random((n_items, k)).astype(
+                         np.float32) * 4, axis=1)[:, ::-1].copy())
+        dicts[name] = item_dict
+    if popularity is None:
+        # few distinct values: the backfill order is mostly ties
+        popularity = (np.round(rng.random(n_items).astype(np.float32) * 4)
+                      / 2).astype(np.float32)
+    props = {f"i{j}": {"category": f"c{j % 5}"}
+             for j in range(0, n_items, 3)}
+    return URModel(
+        primary_event="ev0", item_dict=item_dict, user_dict=user_dict,
+        indicator_idx=idx, indicator_llr=llr, event_item_dicts=dicts,
+        popularity=np.asarray(popularity, np.float32),
+        item_properties=props,
+        user_seen=CSRLookup.from_pairs(
+            np.zeros(0, np.int32), np.zeros(0, np.int32), len(user_dict)),
+    )
+
+
+def make_algo(**over):
+    params = dict(app_name="candapp", mesh_dp=1)
+    params.update(over)
+    return URAlgorithm(URAlgorithmParams(**params))
+
+
+def canon(result):
+    return [(s.item, float(s.score)) for s in result.item_scores]
+
+
+def hist_for(model, ids, types=("ev0", "ev1")):
+    return {t: np.asarray(sorted(set(ids)), np.int32) for t in types}
+
+
+def run_both(algo, model, query, hist, monkeypatch):
+    """(pruned, dense) canon results for one query under the host paths."""
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "on")
+    pruned = canon(algo.predict(model, query, hist_override=hist))
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    dense = canon(algo.predict(model, query, hist_override=hist))
+    return pruned, dense
+
+
+# -- trained-model corpus parity across every cell ------------------------
+
+
+@pytest.fixture()
+def rules_app(mem_storage):
+    app_id = mem_storage.apps.insert(App(0, "candapp"))
+    rng = np.random.default_rng(11)
+    events = []
+    e_items = [f"e{i}" for i in range(6)]
+    b_items = [f"b{i}" for i in range(6)]
+    for u in range(30):
+        mine = e_items if u < 15 else b_items
+        for it in mine:
+            if rng.random() < 0.7:
+                events.append(Event(
+                    event="purchase", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=it))
+            if rng.random() < 0.9:
+                events.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=it))
+    for n, it in enumerate(e_items):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({
+                "category": "electronics",
+                "availableDate": "2026-01-01T00:00:00",
+                "expireDate": f"2026-0{(n % 6) + 1}-15T00:00:00"})))
+    for it in b_items:
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=it,
+            properties=DataMap({"category": "books",
+                                "availableDate": "2026-02-01T00:00:00"})))
+    mem_storage.l_events.insert_batch(events, app_id)
+    return mem_storage
+
+
+@pytest.fixture()
+def trained(rules_app):
+    engine = UniversalRecommenderEngine.apply()
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="candapp", event_names=["purchase", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(
+            app_name="candapp", mesh_dp=1, max_correlators_per_item=8,
+            min_llr=0.0, available_date_name="availableDate",
+            expire_date_name="expireDate"))],
+    )
+    models = engine.train(ep)
+    return engine, ep, models
+
+
+def corpus():
+    q = URQuery.from_json
+    return [
+        q({"user": "u2", "num": 6}),
+        q({"user": "stranger-cold", "num": 5}),          # dense fallback
+        q({"item": "e1", "num": 4}),
+        q({"itemSet": ["e0", "e2"], "num": 5}),
+        q({"user": "u3", "num": 6,
+           "fields": [{"name": "category", "values": ["books"],
+                       "bias": -1}]}),
+        # boost + likely backfill shortfall: the reorder fallback
+        q({"user": "u3", "num": 12,
+           "fields": [{"name": "category", "values": ["electronics"],
+                       "bias": 3.0}]}),
+        q({"user": "u4", "num": 6, "blacklistItems": ["e0", "b0"]}),
+        q({"user": "u5", "num": 6,
+           "dateRange": {"name": "expireDate",
+                         "after": "2026-02-01T00:00:00"}}),
+        q({"user": "u6", "num": 8, "currentDate": "2026-03-01T00:00:00"}),
+        q({"user": "u7", "num": 6,
+           "fields": [{"name": "category", "values": ["no-such"],
+                       "bias": -1}]}),                   # all-masked
+        q({"user": "u20", "num": 0}),                    # num=0
+        q({"user": "ghost", "num": 4,
+           "fields": [{"name": "category", "values": ["books"],
+                       "bias": -1}]}),                   # backfill-only
+    ]
+
+
+@pytest.mark.parametrize("tail", ["host", "device"])
+@pytest.mark.parametrize("scorer", ["host", "device"])
+def test_corpus_parity_candidates_cells(trained, monkeypatch, scorer, tail):
+    """Within each scorer × tail cell: candidates on/auto/off × serial/
+    batch answer identically (exact floats, exact order).  On device
+    cells the resolver forces candidates off, so the assert doubles as
+    a guard that the knob cannot leak into device serving."""
+    engine, ep, models = trained
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", scorer)
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", tail)
+    queries = corpus()
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    reference = [canon(algo.predict(model, q)) for q in queries]
+    assert any(reference), "corpus produced only empty results"
+    for cand in ("on", "auto"):
+        monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", cand)
+        serial = [canon(algo.predict(model, q)) for q in queries]
+        batched = [canon(r) for r in algo.serve_batch_predict(model, queries)]
+        for qi, (s_got, b_got, want) in enumerate(
+                zip(serial, batched, reference)):
+            assert s_got == want, (scorer, tail, cand, "serial", qi)
+            assert b_got == want, (scorer, tail, cand, "batch", qi)
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    batched_off = [canon(r) for r in algo.serve_batch_predict(model, queries)]
+    assert batched_off == reference
+
+
+# -- adversarial fabricated shapes ----------------------------------------
+
+
+def test_duplicate_score_ties_exact_order(monkeypatch):
+    """Counts-mode scoring (use_llr_weights=False) yields integer scores
+    — duplicate-heavy vectors are argpartition's pathological case AND
+    the tie-order trap.  Pruned must reproduce the dense boundary ties
+    bit-for-bit, deep into the list (num ~ half the candidate set)."""
+    model = make_model(const_llr=True)
+    algo = make_algo()
+    hist = hist_for(model, range(0, 60))
+    for num in (5, 40, 120):
+        q = URQuery(user="u1", num=num)
+        pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+        assert pruned == dense and len(pruned) == num
+
+
+def test_duplicate_llr_weights_exact(monkeypatch):
+    """use_llr_weights with constant weights: every posting contributes
+    1.0 — weighted-bincount float sums must match the dense scatter."""
+    model = make_model(const_llr=True)
+    algo = make_algo(use_llr_weights=True)
+    hist = hist_for(model, range(10, 50))
+    q = URQuery(user="u1", num=30)
+    pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+    assert pruned == dense
+
+
+def test_constant_popularity_backfill_tie_order(monkeypatch):
+    """All-equal popularity: the backfill merge's walk order is PURE tie
+    order (id ascending) — any ordering bug shows immediately.  The
+    tiny history forces a deep backfill pad."""
+    model = make_model(popularity=np.full(400, 0.5, np.float32))
+    algo = make_algo()
+    hist = hist_for(model, [3], types=("ev0",))
+    q = URQuery(user="u1", num=50)
+    pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+    assert pruned == dense and len(pruned) == 50
+
+
+def test_rules_selecting_outside_candidate_set(monkeypatch):
+    """A hard filter whose items are DISJOINT from the candidate set:
+    the signal masks to nothing and every result comes from backfill
+    restricted to the rule's items — the pruned tail must find them via
+    the popularity walk, never by inventing candidates."""
+    model = make_model(n_items=300)
+    algo = make_algo()
+    # candidates drawn from postings of items 0..20; category c4 items
+    # (j % 5 == 4 over the sampled j % 3 == 0 grid) are scattered wide
+    hist = hist_for(model, range(0, 20))
+    q = URQuery.from_json({
+        "user": "u1", "num": 8,
+        "fields": [{"name": "category", "values": ["c4"], "bias": -1}]})
+    pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+    assert pruned == dense
+    assert pruned, "filter should still backfill from matching items"
+
+
+def test_boost_with_backfill_shortfall_falls_back(monkeypatch):
+    """A value boost (non-binary mask) plus a backfill shortfall cannot
+    merge from the popularity order — the pruned tail must fall back to
+    dense (counted) and stay exact."""
+    model = make_model(n_items=300)
+    algo = make_algo()
+    hist = hist_for(model, [1], types=("ev0",))
+    q = URQuery.from_json({
+        "user": "u1", "num": 40,
+        "fields": [{"name": "category", "values": ["c1"], "bias": 2.5}]})
+    before = _M_CAND.value(outcome="fallback_backfill_reorder")
+    pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+    assert pruned == dense
+    assert _M_CAND.value(outcome="fallback_backfill_reorder") > before
+
+
+def test_rare_match_backfill_scan_budget_falls_back(monkeypatch):
+    """A rule matching a thin slice of a big catalog would make the
+    pruned backfill walk re-evaluate the sliced predicate over most of
+    the popularity order on EVERY query (the pruned path never populates
+    the mask cache) — past _BACKFILL_SCAN_BUDGET scanned ids it must
+    fall back to dense (counted, and the dense pass caches the full
+    mask) while staying exact."""
+    model = make_model(n_items=3000)
+    algo = make_algo()
+    hist = hist_for(model, [1], types=("ev0",))
+    monkeypatch.setattr(URAlgorithm, "_BACKFILL_SCAN_BUDGET", 8)
+    q = URQuery.from_json({
+        "user": "u1", "num": 40,
+        "fields": [{"name": "category", "values": ["c1"], "bias": -1}]})
+    before = _M_CAND.value(outcome="fallback_backfill_scan")
+    pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+    assert pruned == dense and pruned
+    assert _M_CAND.value(outcome="fallback_backfill_scan") > before
+
+
+def test_empty_postings_event_type(monkeypatch):
+    """An event type whose table is all -1 contributes no candidates but
+    must not break the union; with EVERY type blank there are no
+    candidates at all and the query falls back to dense (counted)."""
+    one_blank = make_model(blank_type="ev1")
+    algo = make_algo()
+    hist = hist_for(one_blank, range(0, 30))
+    q = URQuery(user="u1", num=10)
+    pruned, dense = run_both(algo, one_blank, q, hist, monkeypatch)
+    assert pruned == dense and pruned
+
+    all_blank = make_model(blank_type="ev1")
+    all_blank.indicator_idx["ev0"] = np.full_like(
+        all_blank.indicator_idx["ev0"], -1)
+    before = _M_CAND.value(outcome="fallback_no_candidates")
+    pruned, dense = run_both(algo, all_blank, q, hist, monkeypatch)
+    assert pruned == dense
+    assert _M_CAND.value(outcome="fallback_no_candidates") > before
+
+
+def test_blacklist_covering_popularity_head_and_candidates(monkeypatch):
+    """Blacklist the whole popularity head (forces the merge to walk
+    deep) AND every candidate (forces backfill-only assembly)."""
+    model = make_model(n_items=300)
+    algo = make_algo()
+    hist = hist_for(model, [5], types=("ev0",))
+    sparse = algo._score_history_host(model, hist)
+    cand_items = [f"i{int(j)}" for j in sparse[0]]
+    head = [f"i{int(j)}" for j in model.host_pop_order()[:80]]
+    q = URQuery.from_json({"user": "u1", "num": 10,
+                           "blacklistItems": sorted(set(cand_items + head))})
+    pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+    assert pruned == dense and pruned
+
+
+def test_all_masked_and_num0(monkeypatch):
+    model = make_model()
+    algo = make_algo()
+    hist = hist_for(model, range(0, 10))
+    q_masked = URQuery.from_json({
+        "user": "u1", "num": 6,
+        "fields": [{"name": "category", "values": ["nope"], "bias": -1}]})
+    q_zero = URQuery(user="u1", num=0)
+    for q in (q_masked, q_zero):
+        pruned, dense = run_both(algo, model, q, hist, monkeypatch)
+        assert pruned == dense == []
+
+
+def test_candidate_metrics_observed(monkeypatch):
+    """A pruned serve increments outcome=pruned and lands a candidate
+    fraction observation bounded by the true candidate count."""
+    model = make_model()
+    algo = make_algo()
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "on")
+    hist = hist_for(model, range(0, 8))
+    sparse = algo._score_history_host(model, hist)
+    frac = len(sparse[0]) / len(model.item_dict)
+    _M_CAND_FRAC.clear_series()
+    before = _M_CAND.value(outcome="pruned")
+    algo.predict(model, URQuery(user="u1", num=5), hist_override=hist)
+    assert _M_CAND.value(outcome="pruned") == before + 1
+    snap = _M_CAND_FRAC._snapshot_series()
+    assert snap and abs(next(iter(snap.values()))["sum"] - frac) < 1e-9
+
+
+def test_sliced_mask_equals_full_mask_gather(trained, monkeypatch):
+    """_mask_from_key_host_sliced(ids) ≡ _mask_from_key_host()[ids] for
+    every rule shape in the corpus — the factor-by-factor exactness the
+    pruned tail's parity rests on."""
+    engine, ep, models = trained
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    rng = np.random.default_rng(5)
+    ids = np.unique(rng.integers(0, len(model.item_dict), 30)).astype(
+        np.int32)
+    for q in corpus():
+        key = algo._mask_rule_key(q)
+        if key is None:
+            continue
+        full = algo._mask_from_key_host(model, *key)
+        sliced = algo._mask_from_key_host_sliced(model, key, ids)
+        np.testing.assert_array_equal(full[ids], sliced, err_msg=str(key))
+
+
+def test_cached_full_mask_is_gathered(trained, monkeypatch):
+    """When a dense query already composed and cached the full mask, the
+    pruned path gathers from it instead of re-evaluating predicates."""
+    engine, ep, models = trained
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    q = URQuery.from_json({
+        "user": "u2", "num": 5,
+        "fields": [{"name": "category", "values": ["books"], "bias": -1}]})
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    dense = canon(algo.predict(model, q))       # populates the cache
+    assert len(model.rule_mask_cache("host")) == 1
+    calls = []
+    orig = algo._mask_from_key_host_sliced
+    monkeypatch.setattr(
+        algo, "_mask_from_key_host_sliced",
+        lambda *a, **kw: calls.append(1) or orig(*a, **kw))
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "on")
+    pruned = canon(algo.predict(model, q))
+    assert pruned == dense
+    assert calls == [], "cached full mask was not gathered"
+
+
+# -- env resolution, gauges, parallel warm --------------------------------
+
+
+def test_env_resolution(monkeypatch):
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.delenv("PIO_UR_SERVE_CANDIDATES", raising=False)
+    assert _serve_candidates() == "on"          # auto on host/host
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    assert _serve_candidates() == "off"
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "on")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "device")
+    assert _serve_candidates() == "off"         # no sparse set device-side
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "device")
+    assert _serve_candidates() == "off"
+
+
+def test_host_inverted_bytes_gauge(monkeypatch):
+    model = make_model()
+    model.host_inverted("ev0")
+    indptr, rows, w = model.host_inverted("ev0")
+    want = indptr.nbytes + rows.nbytes + w.nbytes
+    assert _M_INV_BYTES.value(event="ev0") == want
+
+
+def test_warm_propagates_parallel_build_failure(monkeypatch):
+    """A builder thread's failure must fail warm() itself (deploy-time),
+    not surface as a 500 on the first serving query for that type."""
+    model = make_model()
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    model.indicator_idx["ev1"] = None   # unbuildable second type
+    with pytest.raises(AttributeError):
+        model.warm()
+
+
+def test_warm_builds_all_types_in_parallel(monkeypatch):
+    """warm() under the host scorer builds EVERY event type's inversion
+    (concurrently — per-name locks) and, with candidates on, the
+    popularity order; concurrent warms stay exactly-once per type."""
+    model = make_model()
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "on")
+    results = []
+    barrier = threading.Barrier(4)
+
+    def warm():
+        barrier.wait()
+        model.warm()
+        results.append({n: model.host_inverted(n)[0]
+                        for n in model.indicator_idx})
+
+    threads = [threading.Thread(target=warm) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4
+    for name in model.indicator_idx:
+        assert all(r[name] is results[0][name] for r in results), \
+            f"{name} built more than once"
+    assert "_host_pop_order" in model.__dict__
+    order = model.host_pop_order()
+    assert sorted(order.tolist()) == list(range(len(model.item_dict)))

@@ -194,6 +194,9 @@ def test_rule_mask_cache_hits_and_canonicalization(trained_rules,
     model = models[0]
     monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
     monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    # dense-tail cache accounting is the subject; the candidate-pruned
+    # path probes without populating (tests/test_serve_candidates.py)
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
     f1 = {"name": "category", "values": ["books"], "bias": -1}
     f2 = {"name": "category", "values": ["electronics"], "bias": 2.0}
     qa = URQuery.from_json({"user": "u2", "num": 5, "fields": [f1, f2]})
@@ -218,6 +221,7 @@ def test_rule_mask_cache_invalidated_per_model_generation(trained_rules,
     model = models[0]
     monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
     monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
     q = URQuery.from_json({"user": "u2", "num": 5, "fields": [
         {"name": "category", "values": ["books"], "bias": -1}]})
     algo.predict(model, q)
@@ -236,6 +240,7 @@ def test_rule_mask_cache_eviction_bounded(trained_rules, monkeypatch):
     algo = URAlgorithm(ep.algorithm_params_list[0][1])
     monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
     monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
     monkeypatch.setenv("PIO_UR_RULE_MASK_CACHE", "2")
     model = pickle.loads(pickle.dumps(models[0]))   # fresh caches
     for bias in (2.0, 3.0, 4.0):
@@ -320,6 +325,7 @@ def test_rule_mask_key_quantizes_and_ignores_inert_current_date(
     model = models[0]
     monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
     monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
     sub_second = [
         URQuery.from_json({"user": "u2", "num": 5,
                            "currentDate": "2026-03-01T00:00:00.200"}),

@@ -815,7 +815,8 @@ def test_host_scorer_matches_device_scorer(trained, monkeypatch):
     hist = algo._user_history(model, "u2")
     monkeypatch.setenv("PIO_UR_SERVE_SCORER", "device")
     s_dev = np.asarray(algo._score_history(model, hist))
-    s_host = algo._score_history_host(model, hist)
+    s_host = algo._sparse_signal_dense(
+        len(model.item_dict), algo._score_history_host(model, hist))
     np.testing.assert_allclose(s_dev, s_host, rtol=1e-5, atol=1e-6)
 
 
@@ -832,11 +833,12 @@ def test_host_scorer_edge_cases(trained, monkeypatch):
     algo = URAlgorithm(ep.algorithm_params_list[0][1])
     monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
 
+    n_items = len(model.item_dict)
     assert algo._score_history_host(model, {}) is None
     some = next(iter(model.indicator_idx))
     # out-of-range ids are skipped, not crashed on
-    s = algo._score_history_host(
-        model, {some: np.asarray([10**6, -5], np.int32)})
+    s = algo._sparse_signal_dense(n_items, algo._score_history_host(
+        model, {some: np.asarray([10**6, -5], np.int32)}))
     assert s is None or not s.any()
 
     # an event type whose table is all -1 contributes nothing
@@ -844,7 +846,8 @@ def test_host_scorer_edge_cases(trained, monkeypatch):
     monkeypatch.setattr(model, "indicator_idx", blank)
     model.__dict__.pop("_host_inv", None)   # rebuild inversion
     hist = {some: np.asarray([0, 1], np.int32)}
-    s = algo._score_history_host(model, hist)
+    s = algo._sparse_signal_dense(
+        n_items, algo._score_history_host(model, hist))
     assert s is not None and not s.any()
 
 
