@@ -1995,6 +1995,273 @@ def bench_serve_scale(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_freshness(smoke: bool) -> dict:
+    """Streaming freshness: a REAL ``pio deploy --follow`` subprocess
+    (embedded follow-trainer hot-swapping the live model) measured on
+    three axes:
+
+    - **append→reflected latency** (p50/p99 over rounds): the bench
+      appends purchases of a BRAND-NEW item — invisible to any stale
+      model, since serving history comes from the live store but the
+      recommendable catalog comes from the model — and polls the live
+      /queries.json until the item appears for a correlated user.  The
+      p99 ≤ 10 s acceptance gate lands in ``freshness_p99_guard``.
+    - **exactness parity**: after the folds drain, a probe corpus over
+      HTTP must match a from-scratch ``engine.train`` over the same
+      events EXACTLY (items, float scores, order).
+    - **serve p95 regression**: interleaved A/B reps of sustained load
+      with the follower idle vs actively folding a steady append
+      stream; ``freshness_serve_p95_ratio`` ≤ 1.05 gates in
+      ``freshness_serve_guard``.
+    """
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.storage.locator import (
+        Storage, StorageConfig, set_storage,
+    )
+    from predictionio_tpu.workflow import core_workflow
+
+    if smoke:
+        n_users, n_items, rounds, clients, secs, reps = 120, 50, 3, 2, 0.6, 2
+    else:
+        n_users, n_items, rounds, clients, secs, reps = (
+            1_500, 400, 8, 2, 2.0, 3)
+    tmp = tempfile.mkdtemp(prefix="pio_bench_freshness")
+    out: dict = {
+        "freshness_p50_ms": 0.0, "freshness_p99_ms": 0.0,
+        "freshness_rounds": 0, "freshness_parity": "not_run",
+        "freshness_p99_guard": "not_run",
+        "freshness_serve_p95_idle_ms": 0.0,
+        "freshness_serve_p95_folding_ms": 0.0,
+        "freshness_serve_p95_ratio": 0.0,
+        "freshness_serve_guard": "not_run",
+    }
+    proc = None
+    try:
+        import numpy as np
+
+        storage = Storage(StorageConfig(
+            sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
+            repositories={r: "FS" for r in ("METADATA", "EVENTDATA",
+                                            "MODELDATA")}))
+        set_storage(storage)
+        rng = np.random.default_rng(11)
+        app_id = storage.apps.insert(App(0, "freshbench"))
+
+        def buys(users, items):
+            return [Event(event="buy", entity_type="user",
+                          entity_id=u, target_entity_type="item",
+                          target_entity_id=i) for u, i in zip(users, items)]
+
+        evs = []
+        for u in range(n_users):
+            for it in rng.integers(0, n_items, 5):
+                evs.append(Event(
+                    event="buy", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{it}"))
+        for s in range(0, len(evs), 20_000):
+            storage.l_events.insert_batch(evs[s:s + 20_000], app_id)
+        variant = {
+            "id": "bench-fresh",
+            "engineFactory": "predictionio_tpu.models."
+                             "universal_recommender."
+                             "UniversalRecommenderEngine",
+            "datasource": {"params": {"appName": "freshbench",
+                                      "eventNames": ["buy"]}},
+            "algorithms": [{"name": "ur", "params": {
+                "appName": "freshbench", "meshDp": 1,
+                "maxCorrelatorsPerItem": 20}}],
+        }
+        ur_json = f"{tmp}/fresh-engine.json"
+        with open(ur_json, "w") as f:
+            json.dump(variant, f)
+        from predictionio_tpu.models.universal_recommender import (
+            UniversalRecommenderEngine,
+        )
+
+        engine = UniversalRecommenderEngine.apply()
+        ep = engine.engine_params_from_variant(variant)
+        core_workflow.run_train(engine, ep, engine_id="bench-fresh",
+                                storage=storage)
+        env = {
+            **os.environ,
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": f"{tmp}/store",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            "PIO_JAX_PLATFORM": os.environ.get("PIO_JAX_PLATFORM", "cpu"),
+        }
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main", "deploy",
+             "--engine-json", ur_json, "--ip", "127.0.0.1",
+             "--port", str(port), "--follow", "0.1"],
+            env=env)
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(base + "/", timeout=2):
+                    break
+            except OSError:
+                time.sleep(0.3)
+
+        def stats():
+            with urllib.request.urlopen(base + "/stats.json",
+                                        timeout=10) as r:
+                return json.loads(r.read())
+
+        def drain(timeout=30.0):
+            """Wait until the embedded follower has folded everything."""
+            end = time.time() + timeout
+            while time.time() < end:
+                fr = stats().get("freshness", {}).get("follower", {})
+                if fr.get("lastOutcome") in ("idle", "disabled"):
+                    return True
+                time.sleep(0.1)
+            return False
+
+        drain()
+        # -- append→reflected latency rounds ----------------------------
+        lat = []
+        for r in range(rounds):
+            seed_item = f"i{(r * 17) % n_items}"
+            new_item = f"fresh_item_{r}"
+            probe_user = f"probe{r}"
+            # the probe user's history holds seed_item BEFORE the round,
+            # so reflection == the new co-occurring item appearing
+            storage.l_events.insert_batch(
+                buys([probe_user], [seed_item]), app_id)
+            drain()
+            t0 = time.time()
+            cobuyers = [f"cob{r}_{j}" for j in range(6)]
+            storage.l_events.insert_batch(
+                buys(cobuyers, [seed_item] * 6)
+                + buys(cobuyers, [new_item] * 6), app_id)
+            reflected = None
+            while time.time() - t0 < 30:
+                body = json.dumps({"user": probe_user, "num": 30}).encode()
+                req = urllib.request.Request(
+                    base + "/queries.json", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                if any(s["item"] == new_item for s in doc["itemScores"]):
+                    reflected = (time.time() - t0) * 1e3
+                    break
+                time.sleep(0.01)
+            if reflected is not None:
+                lat.append(reflected)
+        if lat:
+            out["freshness_rounds"] = len(lat)
+            out["freshness_p50_ms"] = float(np.percentile(lat, 50))
+            out["freshness_p99_ms"] = float(np.percentile(lat, 99))
+            out["freshness_p99_guard"] = (
+                "ok" if out["freshness_p99_ms"] <= 10_000 and
+                len(lat) == rounds
+                else f"FAIL p99={out['freshness_p99_ms']:.0f}ms "
+                     f"rounds={len(lat)}/{rounds}")
+        else:
+            out["freshness_p99_guard"] = "FAIL no round reflected"
+        # -- exactness parity vs a from-scratch retrain -----------------
+        drain()
+        from predictionio_tpu.models.universal_recommender import URQuery
+        from predictionio_tpu.models.universal_recommender.engine import (
+            URAlgorithm,
+        )
+        from predictionio_tpu.store.event_store import (
+            invalidate_staging_cache,
+        )
+
+        invalidate_staging_cache()
+        os.environ["PIO_UR_SERVE_SCORER"] = "host"
+        ref = engine.train(ep)[0]
+        algo = URAlgorithm(ep.algorithm_params_list[0][1])
+        probes = ([{"user": f"u{j * 31 % n_users}", "num": 10}
+                   for j in range(8)]
+                  + [{"user": f"probe{r}", "num": 10}
+                     for r in range(min(rounds, 3))]
+                  + [{"user": "never-seen", "num": 5}])
+        mismatches = 0
+        for bodyd in probes:
+            req = urllib.request.Request(
+                base + "/queries.json", data=json.dumps(bodyd).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                doc = json.loads(resp.read())
+            got = [(x["item"], float(x["score"]))
+                   for x in doc["itemScores"]]
+            want = [(s.item, float(s.score)) for s in algo.predict(
+                ref, URQuery.from_json(bodyd)).item_scores]
+            if got != want:
+                mismatches += 1
+        out["freshness_parity"] = (
+            "ok" if mismatches == 0
+            else f"FAIL {mismatches}/{len(probes)} probes diverged")
+        # -- serve p95 with the follower idle vs actively folding -------
+        load = [{"user": f"u{(j * 7) % n_users}", "num": 10}
+                for j in range(32)]
+        idle_p95, fold_p95 = [], []
+        stop_append = threading.Event()
+
+        def appender():
+            k = 0
+            while not stop_append.is_set():
+                storage.l_events.insert_batch(
+                    buys([f"load{k}_{j}" for j in range(20)],
+                         [f"i{(k + j) % n_items}" for j in range(20)]),
+                    app_id)
+                k += 1
+                stop_append.wait(0.25)
+
+        for rep in range(reps):
+            drain()
+            _, _, p95_i, _, _, _ = _measure_qps_latency(
+                port, load, secs, clients)
+            idle_p95.append(p95_i)
+            stop_append.clear()
+            t = threading.Thread(target=appender, daemon=True)
+            t.start()
+            time.sleep(0.2)     # the first fold is in flight
+            _, _, p95_f, _, _, _ = _measure_qps_latency(
+                port, load, secs, clients)
+            fold_p95.append(p95_f)
+            stop_append.set()
+            t.join(timeout=5)
+        out["freshness_serve_p95_idle_ms"] = float(np.median(idle_p95))
+        out["freshness_serve_p95_folding_ms"] = float(np.median(fold_p95))
+        ratio = (out["freshness_serve_p95_folding_ms"]
+                 / max(out["freshness_serve_p95_idle_ms"], 1e-9))
+        out["freshness_serve_p95_ratio"] = ratio
+        out["freshness_serve_guard"] = (
+            "ok" if ratio <= 1.05
+            else f"FAIL ratio={ratio:.3f} (>1.05)")
+        return out
+    finally:
+        if proc is not None:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stop", timeout=5).read()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        set_storage(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_scale(smoke: bool) -> dict:
     """North-star scale slice: the TILED CCO path (the strategy the
     1B-event story depends on — the full count matrix never materializes)
@@ -2309,7 +2576,7 @@ def main() -> int:
     ap.add_argument("--only",
                     choices=["ur", "p50", "als", "scan", "http", "scale", "ingest",
                              "ingest_scale", "serve100k", "serve_scale",
-                             "snapshot"],
+                             "snapshot", "freshness"],
                     default=None)
     ap.add_argument("--scale", action="store_true",
                     help="run only the 1B-scale tiled-path slice")
@@ -2344,6 +2611,7 @@ def main() -> int:
             "serve100k": lambda: bench_serve100k(args.smoke),
             "serve_scale": lambda: bench_serve_scale(args.smoke),
             "snapshot": lambda: bench_snapshot(args.smoke),
+            "freshness": lambda: bench_freshness(args.smoke),
         }[args.only]()
         print(json.dumps(out))
         return 0
@@ -2410,6 +2678,15 @@ def main() -> int:
         "serve_scale_monotone": "section_failed",
         "scale_serve_parity": "section_failed",
         "scale_serve_flatness": "section_failed",
+    })
+    freshness = _run_section("freshness", args.smoke, {
+        "freshness_p50_ms": 0.0, "freshness_p99_ms": 0.0,
+        "freshness_rounds": 0, "freshness_parity": "section_failed",
+        "freshness_p99_guard": "section_failed",
+        "freshness_serve_p95_idle_ms": 0.0,
+        "freshness_serve_p95_folding_ms": 0.0,
+        "freshness_serve_p95_ratio": 0.0,
+        "freshness_serve_guard": "section_failed",
     })
     snapshot = _run_section("snapshot", args.smoke, {
         "train_cold_snapshot_events_per_sec": 0.0,
@@ -2503,6 +2780,10 @@ def main() -> int:
             # delta-aware retrain, dictionary micro-guards
             **{k: (round(v, 1) if isinstance(v, float) else v)
                for k, v in snapshot.items()},
+            # streaming freshness: append→reflected latency through a
+            # live --follow deploy, exactness parity, serve-p95 guard
+            **{k: (round(v, 2) if isinstance(v, float) else v)
+               for k, v in freshness.items()},
             **({"section_failures": _SECTION_FAILURES}
                if _SECTION_FAILURES else {}),
         },

@@ -725,6 +725,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "(reference WorkflowParams stopAfterRead)")
     tr.add_argument("--stop-after-prepare", action="store_true",
                     help="run data source + preparator, then stop")
+    tr.add_argument("--follow", action="store_true",
+                    help="stay resident after training: tail the event "
+                         "store and publish an incrementally-folded model "
+                         "generation whenever new events arrive (pair "
+                         "deployments with --auto-reload to pick them up)")
+    tr.add_argument("--follow-interval", type=float, default=0.0,
+                    metavar="SECS",
+                    help="seconds between follow ticks (default "
+                         "PIO_FOLLOW_INTERVAL_S or 2)")
     tr.set_defaults(func=_cmd_train)
 
     dp = sub.add_parser("deploy")
@@ -740,6 +749,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poll EngineInstances every SECS seconds and "
                          "hot-swap when a retrain completes (reference "
                          "MasterActor behavior); 0 disables")
+    dp.add_argument("--follow", type=float, default=0.0, metavar="SECS",
+                    help="host an embedded follow-trainer: tail the event "
+                         "store every SECS seconds, fold new events into "
+                         "the live model and hot-swap it in-process — "
+                         "event-append→reflected-in-query in seconds, no "
+                         "full retrain (0 disables)")
     dp.add_argument("--workers", type=int, default=1,
                     help="prefork N processes all serving this port via "
                          "SO_REUSEPORT (CPU backends: scales query "

@@ -1122,6 +1122,36 @@ class URAlgorithm(Algorithm):
     # ≈ 320 MB worst-case, comfortable next to the resident model
     serve_batch_max = 16
 
+    @staticmethod
+    def per_type_tuning(params: URAlgorithmParams,
+                        event_names: Sequence[str],
+                        ) -> Dict[str, Tuple[int, float]]:
+        """Per-event-type (max_correlators, min_llr) overrides parsed from
+        ``indicator_params`` — shared by train() and the streaming fold
+        engine so both derive the identical tuning per type."""
+        per_type: Dict[str, Tuple[int, float]] = {}
+        for name, over in (params.indicator_params or {}).items():
+            # validate against the CONFIGURED types, not the data-dependent
+            # set (a type with zero events this window is still valid)
+            if name not in event_names:
+                raise ValueError(
+                    f"indicator_params names unknown event type {name!r}; "
+                    f"configured event_names: {list(event_names)}")
+            t_k = params.max_correlators_per_item
+            t_llr = params.min_llr
+            for key, val in over.items():
+                norm = key.replace("_", "").lower()   # minLLR/minLlr/min_llr
+                if norm == "maxcorrelatorsperitem":
+                    t_k = int(val)
+                elif norm == "minllr":
+                    t_llr = float(val)
+                else:
+                    raise ValueError(
+                        f"indicator_params[{name!r}]: unknown key {key!r} "
+                        "(expected maxCorrelatorsPerItem / minLLR)")
+            per_type[name] = (t_k, t_llr)
+        return per_type
+
     def train(self, td: URTrainingData) -> URModel:
         primary = td.event_names[0]
         p_user, p_item, p_item_dict, p_times = td.interactions[primary]
@@ -1150,27 +1180,7 @@ class URAlgorithm(Algorithm):
                 u, i = p_user, p_item  # identity → self-pair kernel reuse
             others.append((name, u, i, len(item_dict)))
             event_item_dicts[name] = item_dict
-        per_type = {}
-        for name, over in (self.params.indicator_params or {}).items():
-            # validate against the CONFIGURED types, not the data-dependent
-            # set (a type with zero events this window is still valid)
-            if name not in td.event_names:
-                raise ValueError(
-                    f"indicator_params names unknown event type {name!r}; "
-                    f"configured event_names: {td.event_names}")
-            t_k = self.params.max_correlators_per_item
-            t_llr = self.params.min_llr
-            for key, val in over.items():
-                norm = key.replace("_", "").lower()   # minLLR/minLlr/min_llr
-                if norm == "maxcorrelatorsperitem":
-                    t_k = int(val)
-                elif norm == "minllr":
-                    t_llr = float(val)
-                else:
-                    raise ValueError(
-                        f"indicator_params[{name!r}]: unknown key {key!r} "
-                        "(expected maxCorrelatorsPerItem / minLLR)")
-            per_type[name] = (t_k, t_llr)
+        per_type = self.per_type_tuning(self.params, td.event_names)
         common = dict(
             top_k=self.params.max_correlators_per_item,
             llr_threshold=self.params.min_llr,

@@ -534,3 +534,25 @@ class EngineClient:
         the client-side feed for the server's cross-request
         micro-batcher."""
         return QueryPipeline(self, depth=depth, timeout=self.timeout)
+
+    def freshness(self) -> Dict[str, Any]:
+        """The server's ``freshness`` document: live model generation,
+        last hot-swap time, and — when the deployment hosts an embedded
+        follow-trainer — its lag/outcome status.  Lets a client wait for
+        an appended event to become visible by polling ``generation``
+        instead of replaying queries.  Served in /stats.json and on
+        GET / (the fallback keeps the contract alive under
+        PIO_METRICS=off, where /stats.json answers 503)."""
+        try:
+            doc = self._conn.request("GET", "/stats.json")
+        except PIOError:
+            doc = self._conn.request("GET", "/")
+        return doc.get("freshness", {}) if isinstance(doc, dict) else {}
+
+    def model_generation(self) -> int:
+        """Shortcut: the live model's generation counter (0 when the
+        server predates the freshness contract)."""
+        try:
+            return int(self.freshness().get("generation") or 0)
+        except (PIOError, ValueError):
+            return 0

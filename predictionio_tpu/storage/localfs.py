@@ -1102,6 +1102,20 @@ class FSEvents(base.LEvents, base.PEvents):
         return _snap.scan_tail(d, watermark, self._tombstones(d), base=base,
                                heads=heads)
 
+    def scan_events_up_to(self, app_id: int, channel_id: Optional[int],
+                          watermark: Dict[str, int],
+                          heads: Optional[Dict] = None) -> Optional[Dict]:
+        """Bounded restart read for the follow-trainer: parse the log UP
+        TO ``watermark`` so a restarted follower reconstructs exactly
+        the event set its persisted watermark describes, then folds only
+        the unapplied suffix.  None = the watermark no longer matches
+        the live log (full restage)."""
+        from predictionio_tpu.storage import snapshot as _snap
+
+        d = self._chan_dir(app_id, channel_id)
+        return _snap.scan_bounded(d, watermark, self._tombstones(d),
+                                  heads=heads)
+
     def snapshot_status(self, app_id: int,
                         channel_id: Optional[int] = None) -> Optional[Dict]:
         from predictionio_tpu.storage import snapshot as _snap

@@ -448,6 +448,39 @@ def scan_tail(d: Path, watermark: Dict[str, int], tombstones: set,
             "watermark": new_mark, "heads": new_heads}
 
 
+def scan_bounded(d: Path, watermark: Dict[str, int],
+                 tombstones: set,
+                 heads: Optional[Dict[str, dict]] = None) -> Optional[dict]:
+    """Parse the log UP TO ``watermark`` (per-segment byte offsets) —
+    the follow-trainer's crash-restart read: reconstruct exactly the
+    event set a persisted watermark describes, so the restart re-folds
+    only the unapplied suffix instead of double-folding or re-training
+    blind.  Returns {"batch", "events"} or None when the watermark no
+    longer matches the live log (segment gone/shrank/recreated — caller
+    falls back to a full restage)."""
+    builder = ColumnarBuilder()
+    n = 0
+    for name in sorted(watermark):
+        seg = d / name
+        end = int(watermark[name])
+        try:
+            size = seg.stat().st_size
+        except OSError:
+            return None          # covered segment vanished
+        if size < end:
+            return None          # shrank under the watermark
+        if heads is not None and not _head_matches(seg, heads.get(name)):
+            return None          # recreated file reusing the name
+        if end > 0:
+            try:
+                n += _parse_range(seg, 0, end, tombstones, builder)
+            except (json.JSONDecodeError, UnicodeDecodeError, KeyError,
+                    TypeError, ValueError):
+                return None      # stale offset / foreign bytes
+    batch, _ids = builder.finish()
+    return {"batch": batch, "events": n}
+
+
 def scan_snapshot(d: Path, tombstones: set) -> Optional[dict]:
     """The snapshot-or-tail read: mmap the covered columns, parse only the
     uncovered tail, splice via the shared-dict concat fast path.

@@ -1,0 +1,13 @@
+"""Streaming model freshness: resident follow-training + hot-swap serving.
+
+PredictionIO's signature gap is event-append → *batch* retrain → redeploy
+(PAPER.md §0: real-time event server, Spark batch train).  This package
+closes it: :mod:`fold` maintains additive co-occurrence count state and
+re-derives only what a delta actually changed, and :mod:`follow` is the
+resident trainer (``pio train --follow`` daemon, or embedded in the query
+server via ``pio deploy --follow``) that tails the event store from the
+snapshot watermark and publishes fresh model generations via atomic
+hot-swap.
+"""
+
+from predictionio_tpu.streaming.follow import FollowTrainer  # noqa: F401

@@ -1,0 +1,711 @@
+"""Incremental CCO fold: delta events → updated URModel, exactly.
+
+A full UR retrain is (a) stage/parse the whole log, (b) translate to
+dense id spaces, (c) the O(U·I_p·I_t) co-occurrence count pass, (d) LLR +
+per-row top-k, (e) popularity/CSR/property epilogues.  PR 3's delta
+staging already made (a) incremental; this module makes (b)–(e)
+incremental too, by exploiting that CCO counts are ADDITIVE:
+
+- :class:`URFoldState` keeps, per event type, the deduped (user, item)
+  pair set, the dense int32 co-occurrence count matrix ``C`` and the LLR
+  marginals (distinct-user row/column counts).  A delta fold applies
+  ``C_new = C + Δpᵀ·A_old + P_newᵀ·Δa`` as a few vectorized scatter-adds
+  over the delta's cross-join — O(delta footprint), never O(U·I²).
+- LLR + top-k re-runs through the SAME jitted kernels training uses
+  (``ops.cco._llr_mask_scores`` / ``_llr_topk_dense`` / the shared
+  ``_finalize_topk`` epilogue), so every recomputed cell is bit-identical
+  to a from-scratch retrain's value — exactness by construction, not by
+  tolerance.  Only *affected* rows recompute: a delta that changes no
+  global LLR input (no new users, no new target-side pairs for the type)
+  re-LLRs just the touched primary rows; a marginal change (new user →
+  N, new target pairs → column counts) forces that type's full re-LLR,
+  because Dunning G² couples every cell to N and its column marginal.
+- The emitted model is a NEW ``URModel`` object per fold — PR 4/7's
+  generation-keyed serving caches (rule-mask LRU, value-mask/date LRUs,
+  ``host_pop_order``) invalidate by model identity, so hot-swap
+  correctness needs no extra plumbing.  Where cheap and provably safe,
+  derived serving state carries over instead of rebuilding: the
+  ``host_inverted`` CSR is row-patched when few indicator rows changed
+  (``_patch_inverted_csr`` — array-identical to a from-scratch
+  inversion), and the property indexes carry when no ``$set``-family
+  event arrived.
+
+State is bounded by ``PIO_FOLLOW_STATE_BYTES`` (default 1 GiB: count
+matrices plus the log-proportional parts — accumulated batch, pair
+sets, raw popularity inputs); past it :class:`FoldUnsupported` tells
+the follower to fall back to full (delta-staged) retrains per tick,
+which stay exact — the budget gates cost, never correctness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops.cco import _llr_mask_scores
+from predictionio_tpu.store.columnar import (
+    CSRLookup,
+    EventBatch,
+    IdDict,
+    fold_properties,
+)
+
+_LOW32 = np.int64((1 << 32) - 1)
+
+
+def state_budget_bytes() -> int:
+    """PIO_FOLLOW_STATE_BYTES caps the resident fold state — the count
+    matrices (I_p·I_t·4 per event type) PLUS the log-proportional parts
+    (accumulated columnar batch, pair sets, raw popularity inputs).
+    Past it the follower retrains instead of folding (exact either way;
+    the budget trades memory for fold latency)."""
+    try:
+        return max(int(os.environ.get("PIO_FOLLOW_STATE_BYTES",
+                                      str(1 << 30))), 1)
+    except ValueError:
+        return 1 << 30
+
+
+class FoldUnsupported(RuntimeError):
+    """The fold engine cannot (or should not) maintain incremental state
+    for this engine/shape — the follower falls back to retrain mode."""
+
+
+def _pair_key(u: np.ndarray, i: np.ndarray) -> np.ndarray:
+    """(user id, type-local item id) → one sortable int64 key."""
+    return (np.asarray(u, np.int64) << np.int64(32)) | np.asarray(i, np.int64)
+
+
+def _key_item(key: np.ndarray) -> np.ndarray:
+    return (key & _LOW32).astype(np.int64)
+
+
+def _key_user(key: np.ndarray) -> np.ndarray:
+    return (key >> np.int64(32)).astype(np.int64)
+
+
+def _in_sorted(values: np.ndarray, sorted_arr: np.ndarray) -> np.ndarray:
+    """Boolean membership of ``values`` in an ascending array."""
+    if len(sorted_arr) == 0 or len(values) == 0:
+        return np.zeros(len(values), bool)
+    pos = np.searchsorted(sorted_arr, values)
+    np.minimum(pos, len(sorted_arr) - 1, out=pos)
+    return sorted_arr[pos] == values
+
+
+def _cross_scatter(C: np.ndarray, pairs_sorted: np.ndarray,
+                   du: np.ndarray, di: np.ndarray,
+                   rows_from_delta: bool) -> np.ndarray:
+    """Scatter one side of the count update into ``C`` and return the
+    touched C-row ids.
+
+    For every delta pair (du[e], di[e]) and every partner item j in the
+    OTHER side's per-user segment of ``pairs_sorted`` (deduped composite
+    keys, (user, item)-ascending):
+
+    - rows_from_delta=True:  C[di[e], j] += 1   (Δpᵀ·A — delta items are
+      primary rows, partners are columns)
+    - rows_from_delta=False: C[j, di[e]] += 1   (Pᵀ·Δa — partners are
+      primary rows, delta items are columns)
+
+    One searchsorted pair bounds each user's partner segment; the flat
+    expansion mirrors ``models.common.gather_csr_rows`` (repeat/arange,
+    no per-pair Python loop).
+    """
+    if len(du) == 0 or len(pairs_sorted) == 0:
+        return np.zeros(0, np.int64)
+    starts = np.searchsorted(pairs_sorted,
+                             np.asarray(du, np.int64) << np.int64(32))
+    ends = np.searchsorted(pairs_sorted,
+                           (np.asarray(du, np.int64) + 1) << np.int64(32))
+    seg = ends - starts                       # partners per delta pair
+    total = int(seg.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    csum = np.cumsum(seg)
+    within = np.arange(total, dtype=np.int64) - np.repeat(csum - seg, seg)
+    partners = _key_item(pairs_sorted[np.repeat(starts, seg) + within])
+    own = np.repeat(np.asarray(di, np.int64), seg)
+    if rows_from_delta:
+        rows, cols = own, partners
+    else:
+        rows, cols = partners, own
+    np.add.at(C, (rows, cols), 1)
+    return np.unique(rows)
+
+
+@partial(jax.jit, static_argnames=("top_k", "pallas"))
+def _llr_topk_rows_jit(C_rows, rc_rows, cc, n_total, llr_threshold,
+                       self_cols, top_k: int, pallas: str = "off"):
+    """Row-sliced twin of ``ops.cco._llr_topk_dense``: the identical
+    elementwise score chain (so each cell's f32 value is bit-identical —
+    XLA elementwise math is element-value-deterministic regardless of
+    tensor shape), the identical -inf self-pair placement (``self_cols``
+    holds each row's GLOBAL primary id, -1 for non-primary types), the
+    identical ``lax.top_k`` tie order."""
+    scores = _llr_mask_scores(
+        C_rows.astype(jnp.float32), rc_rows.astype(jnp.float32),
+        cc.astype(jnp.float32), n_total, llr_threshold, pallas)
+    cols = jnp.arange(scores.shape[1], dtype=jnp.int32)[None, :]
+    is_self = (cols == self_cols[:, None]) & (self_cols[:, None] >= 0)
+    scores = jnp.where(is_self, -jnp.inf, scores)
+    s, i = jax.lax.top_k(scores, top_k)
+    return s, i.astype(jnp.int32)
+
+
+def _llr_topk_rows(C_rows: np.ndarray, rc_rows: np.ndarray,
+                   cc: np.ndarray, n_total: float, llr_threshold: float,
+                   self_rows: Optional[np.ndarray], top_k: int,
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host wrapper: pad the row count to the next power of two so the
+    jit compiles once per bucket, not per distinct slice size (padding
+    rows score -inf everywhere — zero counts — and are dropped)."""
+    n = C_rows.shape[0]
+    pad = 1 << max((n - 1).bit_length(), 0)
+    sc = np.full(pad, -1, np.int32)
+    if self_rows is not None:
+        sc[:n] = self_rows.astype(np.int32)
+    if pad > n:
+        C_rows = np.concatenate(
+            [C_rows, np.zeros((pad - n, C_rows.shape[1]), C_rows.dtype)])
+        rc_rows = np.concatenate(
+            [rc_rows, np.zeros(pad - n, rc_rows.dtype)])
+    s, i = _llr_topk_rows_jit(
+        jnp.asarray(C_rows), jnp.asarray(rc_rows), jnp.asarray(cc),
+        float(n_total), float(llr_threshold), jnp.asarray(sc),
+        top_k=top_k)
+    return np.asarray(s)[:n], np.asarray(i)[:n]
+
+
+def _patch_inverted_csr(old: Tuple[np.ndarray, np.ndarray, np.ndarray],
+                        changed_rows: np.ndarray,
+                        new_idx: np.ndarray, new_llr: np.ndarray,
+                        n_t: int, i_p: int,
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Row-patch a host_inverted CSR: drop every posting entry whose
+    primary row changed, insert the changed rows' new entries at their
+    (target, row) positions.  Output is ARRAY-IDENTICAL to rebuilding the
+    inversion from the new indicator table (the rebuild's stable sort
+    orders entries by (target, row); kept entries already follow that
+    order and inserts go to their exact slots), so patched and rebuilt
+    indexes serve byte-for-byte the same candidates."""
+    indptr, rows, w = old
+    tgt_of = np.repeat(np.arange(n_t, dtype=np.int64), np.diff(indptr))
+    keep = ~_in_sorted(rows.astype(np.int64), changed_rows)
+    k_t, k_r, k_w = tgt_of[keep], rows[keep], w[keep]
+    sub = new_idx[changed_rows]
+    valid = sub >= 0
+    n_r = np.repeat(changed_rows.astype(np.int64),
+                    sub.shape[1])[valid.ravel()]
+    n_tg = sub[valid].astype(np.int64)
+    n_w = new_llr[changed_rows][valid].astype(np.float32)
+    order = np.lexsort((n_r, n_tg))
+    n_tg, n_r, n_w = n_tg[order], n_r[order], n_w[order]
+    pos = np.searchsorted(k_t * i_p + k_r.astype(np.int64),
+                          n_tg * i_p + n_r)
+    rows2 = np.insert(k_r, pos, n_r.astype(np.int32)).astype(np.int32)
+    w2 = np.insert(k_w, pos, n_w).astype(np.float32)
+    counts = (np.bincount(k_t, minlength=n_t)
+              + np.bincount(n_tg, minlength=n_t))
+    indptr2 = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return indptr2, rows2, w2
+
+
+@dataclasses.dataclass
+class _TypeState:
+    """Per-event-type incremental state."""
+
+    codes: np.ndarray            # int64 sorted unique target-dict codes
+    item_dict: IdDict            # strings of ``codes`` (id = position)
+    local_of_target: np.ndarray  # target code → local item id (-1 unknown)
+    pairs: np.ndarray            # int64 sorted deduped (u<<32 | i) keys
+    C: np.ndarray                # int32 [I_p, I_t] co-occurrence counts
+    col_counts: np.ndarray       # int64 [I_t] distinct users per target
+    raw_items: List[np.ndarray]  # per-fold raw event items (local ids)
+    raw_times: List[np.ndarray]  # per-fold raw event epoch seconds
+    idx: Optional[np.ndarray] = None   # int32 [I_p, K] indicator ids
+    llr: Optional[np.ndarray] = None   # f32   [I_p, K] indicator scores
+
+    @property
+    def n_items(self) -> int:
+        return len(self.codes)
+
+
+class URFoldState:
+    """Resident incremental-training state for ONE Universal Recommender
+    algorithm.  ``fold(delta_batch)`` folds a columnar delta (sharing
+    this state's dictionaries — the scan_tail contract) and returns a
+    fresh :class:`URModel` whose responses are identical to
+    ``URAlgorithm.train`` over the full accumulated batch."""
+
+    def __init__(self, algo_params, ds_params):
+        from predictionio_tpu.models.universal_recommender.engine import (
+            URAlgorithm,
+        )
+
+        self.params = algo_params
+        self.ds_params = ds_params
+        self.event_names: List[str] = list(ds_params.event_names)
+        if not self.event_names:
+            raise FoldUnsupported("no event_names configured")
+        self.primary = self.event_names[0]
+        blacklist = self.params.blacklist_events or [self.primary]
+        unknown = [b for b in blacklist if b not in self.event_names]
+        if unknown:
+            raise ValueError(
+                f"blacklist_events {unknown} not in event_names "
+                f"{self.event_names}")
+        bf_names = self.params.backfill_event_names or [self.primary]
+        unknown_bf = [b for b in bf_names if b not in self.event_names]
+        if unknown_bf:
+            raise ValueError(
+                f"backfill_event_names {unknown_bf} not in event_names "
+                f"{self.event_names}")
+        if self.params.checkpoint:
+            raise FoldUnsupported(
+                "checkpointed training is a batch-durability feature; "
+                "the follower's unit of durability is the watermark")
+        self.per_type = URAlgorithm.per_type_tuning(algo_params,
+                                                    self.event_names)
+        self.user_dict = IdDict()
+        self.user_of_code = np.full(1, -1, np.int32)
+        self.row_counts = np.zeros(0, np.int64)
+        self.types: Dict[str, _TypeState] = {
+            name: _TypeState(
+                codes=np.zeros(0, np.int64), item_dict=IdDict(),
+                local_of_target=np.full(1, -1, np.int64),
+                pairs=np.zeros(0, np.int64),
+                C=np.zeros((0, 0), np.int32),
+                col_counts=np.zeros(0, np.int64),
+                raw_items=[], raw_times=[])
+            for name in self.event_names
+        }
+        self.batch: Optional[EventBatch] = None
+        self._props: Dict[str, dict] = {}
+        self._props_ever = False
+        self._primary_perm = np.zeros(0, np.int64)
+        self.generation = 0
+        self.model = None
+        self.last_fold_stats: Dict[str, dict] = {}
+
+    # -- public entry ---------------------------------------------------------
+
+    def fold(self, delta: EventBatch):
+        """Fold one columnar delta (built with ``base=self.batch`` so the
+        dictionaries are shared — the first call bootstraps from scratch)
+        and return the new URModel."""
+        if self.batch is None:
+            self.batch = delta
+        elif len(delta):
+            self.batch = EventBatch.concat([self.batch, delta])
+        self._apply(delta)
+        self._check_budget()
+        model = self._emit()
+        self.generation += 1
+        return model
+
+    @classmethod
+    def bootstrap(cls, algo_params, ds_params,
+                  batch: EventBatch) -> "URFoldState":
+        """Build state + first model from a full columnar batch."""
+        state = cls(algo_params, ds_params)
+        state.fold(batch)
+        return state
+
+    def state_bytes(self) -> int:
+        """Total resident bytes of the incremental state: count matrices
+        plus everything that GROWS with the log — the accumulated
+        columnar batch, pair sets, raw popularity inputs and indicator
+        tables.  This is what ``PIO_FOLLOW_STATE_BYTES`` bounds: a
+        long-lived follower at a steady event rate demotes to retrain
+        mode when its resident history outgrows the budget, instead of
+        leaking without limit."""
+        total = 0
+        for t in self.types.values():
+            total += int(t.C.nbytes) + int(t.pairs.nbytes)
+            total += int(t.col_counts.nbytes) + int(t.local_of_target.nbytes)
+            total += sum(int(a.nbytes) for a in t.raw_items)
+            total += sum(int(a.nbytes) for a in t.raw_times)
+            if t.idx is not None:
+                total += int(t.idx.nbytes) + int(t.llr.nbytes)
+        if self.batch is not None:
+            b = self.batch
+            for arr in (b.event_codes, b.entity_type_codes, b.entity_ids,
+                        b.target_ids, b.times_us, b.ratings):
+                total += int(arr.nbytes)
+        return total
+
+    # -- delta application ----------------------------------------------------
+
+    def _check_budget(self) -> None:
+        if self.state_bytes() > state_budget_bytes():
+            raise FoldUnsupported(
+                f"fold state {self.state_bytes()} B exceeds "
+                f"PIO_FOLLOW_STATE_BYTES={state_budget_bytes()}")
+
+    @staticmethod
+    def _grow_translate(arr: np.ndarray, n: int) -> np.ndarray:
+        if len(arr) >= n:
+            return arr
+        out = np.full(max(n, 1), -1, arr.dtype)
+        out[: len(arr)] = arr
+        return out
+
+    def _apply(self, delta: EventBatch) -> None:
+        """Mirror URDataSource.read_training incrementally over ``delta``
+        and fold the translated pairs into the count state."""
+        from predictionio_tpu.events.event import SPECIAL_EVENTS
+
+        self.last_fold_stats = {}
+        special = [delta.event_dict.id(n) for n in SPECIAL_EVENTS]
+        special = np.asarray([c for c in special if c is not None], np.int32)
+        props_changed = bool(len(delta)) and bool(
+            np.isin(delta.event_codes, special).any())
+        view = dataclasses.replace(delta, prop_columns=None)
+        per_type_raw: Dict[str, tuple] = {}
+        for name in self.event_names:
+            sel = view.select_events([name])
+            has_t = sel.target_ids >= 0
+            per_type_raw[name] = (sel.entity_ids[has_t],
+                                  sel.target_ids[has_t],
+                                  sel.times_us[has_t].astype(np.float64) / 1e6)
+        # users enroll exactly as read_training's per-type unique pass
+        # does; enrollment ORDER only assigns internal user ids, and
+        # responses are user-id-order independent (items carry the
+        # tie-breaking ids)
+        self.user_of_code = self._grow_translate(
+            self.user_of_code, len(delta.entity_dict))
+        n_users_before = len(self.user_dict)
+        for name in self.event_names:
+            e_codes = per_type_raw[name][0]
+            for c in np.unique(e_codes):
+                if self.user_of_code[c] < 0:
+                    self.user_of_code[c] = self.user_dict.add(
+                        delta.entity_dict.str(int(c)))
+        new_users = len(self.user_dict) != n_users_before
+        # item spaces: keep each type's sorted-unique target-code set —
+        # the same set read_training's np.unique produces over the full
+        # batch, so local item ids (and their tie order) match a
+        # from-scratch retrain exactly even when an OLD code first
+        # appears under a new type (mid-array insert + state remap)
+        reshaped: Dict[str, bool] = {}
+        for name in self.event_names:
+            reshaped[name] = self._extend_item_space(
+                name, per_type_raw[name][1], delta)
+        primary_reshaped = reshaped[self.primary]
+        if primary_reshaped:
+            self._reshape_primary_rows()
+        # translate + append raw events (popularity inputs)
+        deltas: Dict[str, np.ndarray] = {}
+        for name in self.event_names:
+            st = self.types[name]
+            e_codes, t_codes, times = per_type_raw[name]
+            u = self.user_of_code[e_codes].astype(np.int64)
+            i = st.local_of_target[t_codes]
+            if len(i):
+                st.raw_items.append(i.astype(np.int32))
+                st.raw_times.append(times)
+            keys = (np.unique(_pair_key(u, i)) if len(u)
+                    else np.zeros(0, np.int64))
+            if len(keys):
+                keys = keys[~_in_sorted(keys, st.pairs)]
+            deltas[name] = keys
+        # counts: C_new = C + Δpᵀ·A_old + P_newᵀ·Δa per type (for the
+        # primary, A ≡ P and the two terms cover (P+Δ)ᵀ(P+Δ) exactly —
+        # the ΔᵀΔ diagonal term rides P_newᵀΔ).  Step A must see every
+        # type's PRE-delta pair set; step C the POST-delta primary set.
+        p_st = self.types[self.primary]
+        dp = deltas[self.primary]
+        dp_u, dp_i = _key_user(dp), _key_item(dp)
+        touched: Dict[str, List[np.ndarray]] = {
+            n: [] for n in self.event_names}
+        for name in self.event_names:
+            st = self.types[name]
+            touched[name].append(_cross_scatter(
+                st.C, st.pairs, dp_u, dp_i, rows_from_delta=True))
+        if len(dp):
+            p_st.pairs = np.sort(np.concatenate([p_st.pairs, dp]))
+            self.row_counts += np.bincount(dp_i, minlength=p_st.n_items)
+        for name in self.event_names:
+            st = self.types[name]
+            da = deltas[name]
+            if len(da) == 0:
+                continue
+            touched[name].append(_cross_scatter(
+                st.C, p_st.pairs, _key_user(da), _key_item(da),
+                rows_from_delta=False))
+            st.col_counts += np.bincount(_key_item(da),
+                                         minlength=st.n_items)
+            if name != self.primary:
+                st.pairs = np.sort(np.concatenate([st.pairs, da]))
+        # re-LLR scope per type (exact): a changed N or column marginal
+        # couples every cell of that type; otherwise only rows whose C
+        # cells or row marginal changed can differ
+        rc_rows = np.unique(dp_i) if len(dp) else np.zeros(0, np.int64)
+        for name in self.event_names:
+            st = self.types[name]
+            if st.n_items == 0 or p_st.n_items == 0:
+                continue
+            if (new_users or len(deltas[name]) or reshaped[name]
+                    or primary_reshaped or st.idx is None):
+                self._rellr_type(name, rows=None)
+                continue
+            parts = [rc_rows] + touched[name]
+            rows = np.unique(np.concatenate(parts)) if parts else rc_rows
+            if len(rows) == 0:
+                self.last_fold_stats[name] = {"rows": 0, "mode": "skip"}
+                continue
+            self._rellr_type(name, rows=rows.astype(np.int64))
+        if props_changed or not self._props_ever:
+            # full-history recompute, not a delta merge: properties apply
+            # in (eventTime, row) order, so a delta $set carrying an
+            # EARLIER eventTime than an applied one must lose — an
+            # append-order merge would get that wrong.  Cost is bounded
+            # by PIO_FOLLOW_STATE_BYTES (breach demotes to retrain).
+            self._props = {
+                k: dict(v) for k, v in fold_properties(
+                    self.batch, self.ds_params.item_entity_type).items()}
+            self._props_ever = True
+        self._last_remap = {"primary": primary_reshaped,
+                            "types": dict(reshaped),
+                            "props": props_changed}
+
+    def _extend_item_space(self, name: str, t_codes: np.ndarray,
+                           delta: EventBatch) -> bool:
+        """Merge new target codes into the type's sorted code set;
+        returns True when the type's item-id space changed shape (grew
+        and/or existing ids shifted)."""
+        st = self.types[name]
+        st.local_of_target = self._grow_translate(
+            st.local_of_target, len(delta.target_dict))
+        if len(t_codes) == 0:
+            return False
+        uniq = np.unique(t_codes.astype(np.int64))
+        new = uniq[~_in_sorted(uniq, st.codes)]
+        if len(new) == 0:
+            return False
+        merged = np.union1d(st.codes, new)
+        perm = np.searchsorted(merged, st.codes)  # old local → new local
+        remapped = bool(len(st.codes)) and bool(
+            (perm != np.arange(len(st.codes))).any())
+        st.codes = merged
+        st.item_dict = IdDict(
+            [delta.target_dict.str(int(c)) for c in merged])
+        lot = np.full(len(st.local_of_target), -1, np.int64)
+        lot[merged] = np.arange(len(merged), dtype=np.int64)
+        st.local_of_target = lot
+        if remapped:
+            # existing local ids shifted: remap everything keyed on them
+            st.pairs = np.sort(
+                (st.pairs & ~_LOW32) | perm[_key_item(st.pairs)])
+            st.raw_items = [perm[a].astype(np.int32) for a in st.raw_items]
+        # grow/permute the column-indexed state
+        cc = np.zeros(len(merged), np.int64)
+        if len(perm):
+            cc[perm] = st.col_counts
+        st.col_counts = cc
+        C = np.zeros((st.C.shape[0], len(merged)), np.int32)
+        if len(perm) and st.C.size:
+            C[:, perm] = st.C
+        st.C = C
+        st.idx = st.llr = None   # shape changed: full re-LLR for the type
+        if name == self.primary:
+            self._primary_perm = perm
+        return True
+
+    def _reshape_primary_rows(self) -> None:
+        """The PRIMARY item space changed shape: every type's C rows, the
+        row marginals and indicator tables follow the new id order (the
+        old→new row permutation _extend_item_space just computed)."""
+        p_st = self.types[self.primary]
+        n_p = p_st.n_items
+        # primary pairs were already remapped; rebuild the row marginal
+        # from them (delta pairs merge afterwards, in _apply)
+        self.row_counts = (
+            np.bincount(_key_item(p_st.pairs), minlength=n_p)
+            .astype(np.int64) if len(p_st.pairs)
+            else np.zeros(n_p, np.int64))
+        perm = self._primary_perm
+        for name in self.event_names:
+            st = self.types[name]
+            C = np.zeros((n_p, st.C.shape[1]), np.int32)
+            if len(perm) and st.C.size:
+                C[perm, :] = st.C
+            st.C = C
+            st.idx = st.llr = None
+
+    def _rellr_type(self, name: str, rows: Optional[np.ndarray]) -> None:
+        """Recompute LLR + top-k for ``rows`` of one type (None = all),
+        through the exact kernels training uses."""
+        from predictionio_tpu.ops.cco import (
+            _DenseRunner,
+            _llr_topk_dense,
+            topk_impl,
+        )
+        from predictionio_tpu.ops.pallas_kernels import pallas_mode
+
+        st = self.types[name]
+        t_k, t_llr = self.per_type.get(
+            name, (self.params.max_correlators_per_item,
+                   self.params.min_llr))
+        excl = name == self.primary
+        n_t = st.n_items
+        n_total = float(len(self.user_dict))
+        # non-default kernel selections (pallas top-k / pallas LLR) only
+        # have full-matrix entry points — take the full path so the fold
+        # reproduces exactly what training would have computed
+        if rows is None or topk_impl() != "lax" or pallas_mode() != "off":
+            s, i = _llr_topk_dense(
+                jnp.asarray(st.C), jnp.asarray(self.row_counts),
+                jnp.asarray(st.col_counts), n_total, float(t_llr),
+                top_k=min(t_k, n_t), exclude_self=bool(excl),
+                pallas=pallas_mode(), topk=topk_impl())
+            scores, idx = _DenseRunner.collect((s, i, n_t, t_k))
+            st.idx = idx.astype(np.int32)
+            st.llr = np.where(np.isfinite(scores), scores,
+                              0.0).astype(np.float32)
+            self.last_fold_stats[name] = {"rows": st.C.shape[0],
+                                          "mode": "full"}
+            return
+        scores, idx = _llr_topk_rows(
+            st.C[rows], self.row_counts[rows], st.col_counts, n_total,
+            float(t_llr), rows if excl else None, min(t_k, n_t))
+        scores, idx = _DenseRunner.collect((scores, idx, n_t, t_k))
+        st.idx[rows] = idx.astype(np.int32)
+        st.llr[rows] = np.where(np.isfinite(scores), scores,
+                                0.0).astype(np.float32)
+        self.last_fold_stats[name] = {"rows": int(len(rows)),
+                                      "mode": "sliced"}
+
+    # -- model emission -------------------------------------------------------
+
+    def _emit(self):
+        """Build a fresh URModel from the state — the same construction
+        URAlgorithm.train performs from its results dict."""
+        from predictionio_tpu.models.universal_recommender.engine import (
+            URModel,
+        )
+        from predictionio_tpu.models.universal_recommender.popmodel import (
+            backfill_scores,
+            parse_duration,
+        )
+
+        p_st = self.types[self.primary]
+        n_items = p_st.n_items
+        n_users = len(self.user_dict)
+        if n_items == 0:
+            raise ValueError(f"no {self.primary!r} events to train on")
+        indicator_idx: Dict[str, np.ndarray] = {}
+        indicator_llr: Dict[str, np.ndarray] = {}
+        event_item_dicts: Dict[str, IdDict] = {}
+        for name in self.event_names:
+            st = self.types[name]
+            if name != self.primary and st.n_items == 0:
+                continue
+            event_item_dicts[name] = st.item_dict
+            indicator_idx[name] = st.idx.copy()
+            indicator_llr[name] = st.llr.copy()
+        user_seen = CSRLookup.from_pairs(
+            _key_user(p_st.pairs), _key_item(p_st.pairs), n_users)
+        bf_names = self.params.backfill_event_names or [self.primary]
+        bf_items, bf_times = [], []
+        for name in bf_names:
+            st = self.types[name]
+            items = (np.concatenate(st.raw_items) if st.raw_items
+                     else np.zeros(0, np.int32))
+            times = (np.concatenate(st.raw_times) if st.raw_times
+                     else np.zeros(0, np.float64))
+            if name == self.primary:
+                bf_items.append(items)
+                bf_times.append(times)
+            else:
+                translate = p_st.item_dict.lookup_many(
+                    st.item_dict.strings())
+                mapped = translate[items] if len(items) else items
+                keep = mapped >= 0
+                bf_items.append(mapped[keep])
+                bf_times.append(times[keep])
+        popularity = backfill_scores(
+            self.params.backfill_type,
+            np.concatenate(bf_items) if bf_items else np.zeros(0, np.int32),
+            np.concatenate(bf_times) if bf_times else np.zeros(0, np.float64),
+            n_items,
+            parse_duration(self.params.backfill_duration),
+        )
+        blacklist_events = self.params.blacklist_events or [self.primary]
+        user_seen_by_event: Dict[str, CSRLookup] = {}
+        for name in blacklist_events:
+            if name == self.primary or name not in event_item_dicts:
+                continue
+            st = self.types[name]
+            translate = p_st.item_dict.lookup_many(st.item_dict.strings())
+            u, i = _key_user(st.pairs), _key_item(st.pairs)
+            mapped = translate[i] if len(i) else i
+            keep = mapped >= 0
+            user_seen_by_event[name] = CSRLookup.from_pairs(
+                u[keep], mapped[keep], n_users)
+        prev = self.model
+        model = URModel(
+            primary_event=self.primary,
+            item_dict=p_st.item_dict,
+            user_dict=IdDict(self.user_dict.strings()),
+            indicator_idx=indicator_idx,
+            indicator_llr=indicator_llr,
+            event_item_dicts=event_item_dicts,
+            popularity=popularity,
+            item_properties=self._props,
+            user_seen=user_seen,
+            user_seen_by_event=user_seen_by_event,
+        )
+        self._carry_serving_state(model, prev)
+        self.model = model
+        return model
+
+    def _carry_serving_state(self, model, prev) -> None:
+        """Incremental serving-state handoff to the new generation, only
+        where provably identical to a from-scratch rebuild; everything
+        else stays generation-keyed (a fresh ``__dict__`` IS the
+        invalidation)."""
+        if prev is None:
+            return
+        remap = getattr(self, "_last_remap",
+                        {"primary": True, "types": {}, "props": True})
+        same_catalog = (not remap["primary"]
+                        and len(model.item_dict) == len(prev.item_dict))
+        if same_catalog and not remap["props"] \
+                and model.item_properties is prev.item_properties:
+            for attr in ("_prop_value_index", "_prop_date_array",
+                         "_known_prop_names", "_date_off"):
+                v = prev.__dict__.get(attr)
+                if v is not None:
+                    model.__dict__[attr] = v
+        if not same_catalog:
+            return
+        inv_prev = prev.__dict__.get("_host_inv") or {}
+        for name, old in inv_prev.items():
+            if name not in model.indicator_idx or remap["types"].get(name):
+                continue
+            new_idx = model.indicator_idx[name]
+            old_idx = prev.indicator_idx.get(name)
+            if old_idx is None or old_idx.shape != new_idx.shape:
+                continue
+            new_llr = model.indicator_llr[name]
+            diff = ((new_idx != old_idx)
+                    | (new_llr != prev.indicator_llr[name])).any(axis=1)
+            changed = np.flatnonzero(diff).astype(np.int64)
+            i_p = new_idx.shape[0]
+            n_t = max(len(model.event_item_dicts[name]), 1)
+            if len(changed) == 0:
+                patched = old
+            elif len(changed) * 4 <= i_p:
+                patched = _patch_inverted_csr(old, changed, new_idx,
+                                              new_llr, n_t, i_p)
+            else:
+                continue   # too many rows moved: lazy rebuild is cheaper
+            model.__dict__.setdefault("_host_inv", {})[name] = patched

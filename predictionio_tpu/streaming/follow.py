@@ -1,0 +1,607 @@
+"""Resident follow-trainer: tail the event store, fold, hot-swap.
+
+The :class:`FollowTrainer` is the daemon behind ``pio train --follow``
+and the embeddable updater behind ``pio deploy --follow SECS``.  Each
+tick it:
+
+1. tails the event store from its watermark (PR 3's ``scan_tail_from``
+   delta protocol — only bytes past the per-segment watermark parse);
+2. folds the delta into the live model (:mod:`streaming.fold` — additive
+   CCO counts, affected-row re-LLR) or, when folding is unsupported for
+   the engine/shape, re-trains through the normal (delta-staged) path;
+3. publishes the new model generation: a COMPLETED EngineInstance +
+   model blob in daemon mode (every ``--auto-reload`` deployment
+   converges within its poll interval), and/or an in-process atomic
+   hot-swap callback in embedded mode (the query server swaps its
+   predictor under its lock — sub-second append→reflected latency);
+4. persists its watermark (``follow.json`` next to the span journals),
+   so a SIGKILL'd daemon restarts by re-reading exactly the covered
+   prefix (``scan_events_up_to``) and folding only the unapplied suffix
+   — no double-fold, no blind full retrain.
+
+Consistency edges mirror ``_StagedCache``: any tombstone change or
+log-shape mismatch (segment vanished/shrank/recreated) forces a full
+restage; ``PIO_FOLLOW_MAX_LAG_EVENTS`` bounds how large a delta is
+folded incrementally before a restage is the better deal.  Kill switch:
+``PIO_FOLLOW=off`` idles the loop without tearing it down.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+from predictionio_tpu.obs import metrics as _obs_metrics
+from predictionio_tpu.obs import tracing as _tracing
+from predictionio_tpu.obs.metrics import LATENCY_BUCKETS
+from predictionio_tpu.storage.locator import Storage, get_storage
+from predictionio_tpu.streaming.fold import FoldUnsupported, URFoldState
+
+log = logging.getLogger("pio.follow")
+
+_REG = _obs_metrics.get_registry()
+_M_FOLDS = _REG.counter(
+    "pio_follow_folds_total",
+    "Follow-trainer ticks by outcome: fold (incremental), retrain "
+    "(full train through the delta-staged path), restage (tombstone/"
+    "log-shape change or max-lag breach forced a full rebuild), idle "
+    "(no new events), disabled (PIO_FOLLOW=off), error")
+_M_FOLD_S = _REG.histogram(
+    "pio_follow_fold_duration_seconds",
+    "Wall time of one follow tick that published a generation "
+    "(tail scan + fold/retrain + publish), by mode",
+    buckets=LATENCY_BUCKETS)
+_M_LAG = _REG.gauge(
+    "pio_follow_lag_events",
+    "Unapplied events behind the live log at the last tick "
+    "(0 after a successful fold — the freshness backlog)")
+_M_PUBLISH_TS = _REG.gauge(
+    "pio_follow_last_publish_timestamp_seconds",
+    "Unix time of the last published model generation")
+_M_GEN = _REG.gauge(
+    "pio_model_generation",
+    "Monotonic generation counter of the live model: bumped by every "
+    "hot-swap (follow fold, auto-reload, manual /reload) — serving "
+    "caches key on the model object this counts")
+
+
+def follow_interval_s() -> float:
+    """PIO_FOLLOW_INTERVAL_S: seconds between follow ticks (default 2)."""
+    try:
+        return max(float(os.environ.get("PIO_FOLLOW_INTERVAL_S", "2.0")),
+                   0.05)
+    except ValueError:
+        return 2.0
+
+
+def follow_max_lag_events() -> int:
+    """PIO_FOLLOW_MAX_LAG_EVENTS: a delta larger than this restages
+    instead of folding incrementally (default 1M — a backlog that big
+    means the follower was down; a fresh bootstrap amortizes better
+    than one giant fold)."""
+    try:
+        return max(int(os.environ.get("PIO_FOLLOW_MAX_LAG_EVENTS",
+                                      "1000000")), 1)
+    except ValueError:
+        return 1_000_000
+
+
+def follow_enabled() -> bool:
+    """PIO_FOLLOW=off idles a running follower without tearing it down."""
+    return os.environ.get("PIO_FOLLOW", "").lower() not in (
+        "off", "0", "false")
+
+
+def follow_state_path(storage: Storage, engine_id: str,
+                      variant: str) -> Optional[Path]:
+    """Where the follower persists its watermark — next to the span
+    journals under the METADATA localfs/sharedfs path; None (in-memory
+    only) for other backends."""
+    try:
+        src = storage.config.sources[storage.config.repositories["METADATA"]]
+    except (KeyError, AttributeError):
+        return None
+    if src.get("type") not in ("localfs", "sharedfs") or not src.get("path"):
+        return None
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in f"{engine_id}-{variant}")
+    return Path(src["path"]) / "follow" / f"{safe}.json"
+
+
+class FollowTrainer:
+    """Resident trainer: tail → fold → hot-swap, forever.
+
+    ``on_publish(models, info)`` is the embedded hot-swap hook (the
+    query server passes its ``swap_models``); ``persist=True`` records a
+    COMPLETED EngineInstance + model blob per generation so detached
+    deployments converge via ``--auto-reload``.
+    """
+
+    def __init__(self, engine, engine_params, engine_id: str,
+                 engine_version: str = "1", engine_variant: str = "default",
+                 engine_factory: str = "",
+                 storage: Optional[Storage] = None,
+                 interval: Optional[float] = None,
+                 on_publish: Optional[Callable] = None,
+                 persist: bool = True,
+                 max_lag: Optional[int] = None):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+        self.engine_factory = engine_factory or engine_id
+        self.storage = storage or get_storage()
+        self.interval = float(interval) if interval else follow_interval_s()
+        self.on_publish = on_publish
+        self.persist = persist
+        self.max_lag = max_lag
+        self.generation = 0
+        self.instance_id: Optional[str] = None
+        self.last_outcome = "init"
+        self.last_fold_events = 0
+        self.last_publish_at: Optional[float] = None
+        self.bootstrap_events = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._backoff = 0.0
+        # fold-mode state (None in retrain mode / before bootstrap)
+        self._fold: Optional[URFoldState] = None
+        self._wm: Dict[str, int] = {}
+        self._heads: Dict[str, dict] = {}
+        self._tombstones = frozenset()
+        self._retrain_count = -1
+        # a generation whose fold/restage/retrain succeeded but whose
+        # publish raised: (models, mode, duration_s) — retried first
+        # thing next tick (the in-memory watermark has already advanced,
+        # so a 0-event tick would otherwise idle on a stale live model)
+        self._pending: Optional[tuple] = None
+        self._resolve_mode()
+        self._state_path = follow_state_path(
+            self.storage, engine_id, engine_variant) if persist else None
+
+    # -- mode / storage plumbing ---------------------------------------------
+
+    def _resolve_mode(self) -> None:
+        """fold mode needs: one URAlgorithm, the identity preparator, a
+        UR data source, and a tailing (segment-file) event backend —
+        anything else follows by full retrain per tick (still exact,
+        still delta-staged through PR 3's cache)."""
+        from predictionio_tpu.models.universal_recommender.engine import (
+            URAlgorithm,
+            URDataSourceParams,
+            URPreparator,
+        )
+
+        self.mode = "retrain"
+        self._algo = None
+        _ds, prep, algos, _serving = self.engine.make_components(
+            self.engine_params)
+        ds_params = self.engine_params.data_source_params
+        self.app_name = getattr(ds_params, "app_name", None)
+        if self.app_name is None:
+            raise FoldUnsupported(
+                "follow-trainer needs a data source with an app_name")
+        backend = self.storage.l_events
+        self._backend = backend if hasattr(backend, "scan_tail_from") else None
+        if (len(algos) == 1 and type(algos[0]) is URAlgorithm
+                and type(prep) is URPreparator
+                and isinstance(ds_params, URDataSourceParams)
+                and self._backend is not None):
+            self.mode = "fold"
+            self._algo = algos[0]
+            self._ds_params = ds_params
+
+    def _app_channel(self):
+        app = self.storage.apps.get_by_name(self.app_name)
+        if app is None:
+            raise ValueError(f"app {self.app_name!r} does not exist")
+        return app.id, None
+
+    # -- watermark persistence ------------------------------------------------
+
+    def _persist_state(self) -> None:
+        if self._state_path is None:
+            return
+        from predictionio_tpu.storage.snapshot import _fsync_write
+
+        self._state_path.parent.mkdir(parents=True, exist_ok=True)
+        _fsync_write(self._state_path, json.dumps({
+            "version": 1,
+            "watermark": self._wm,
+            "heads": self._heads,
+            "generation": self.generation,
+            "instanceId": self.instance_id,
+            "bootstrapEvents": self.bootstrap_events,
+            "lastFoldEvents": self.last_fold_events,
+            "updatedAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+        }, indent=1, sort_keys=True))
+
+    def _load_state(self) -> Optional[dict]:
+        if self._state_path is None:
+            return None
+        try:
+            doc = json.loads(self._state_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        if not isinstance(doc, dict) or "watermark" not in doc:
+            return None
+        return doc
+
+    # -- bootstrap ------------------------------------------------------------
+
+    def bootstrap(self) -> bool:
+        """Make a model live: resume from a persisted watermark (daemon
+        restart — re-reads the covered prefix, folds only the suffix),
+        else full restage.  Returns True once a model exists."""
+        if self.mode != "fold":
+            return self._retrain_tick(force=True) in ("retrain", "idle")
+        prior = self._load_state()
+        if prior is not None and self._bootstrap_from_watermark(prior):
+            return True
+        return self._restage(publish=True)
+
+    def _bootstrap_from_watermark(self, prior: dict) -> bool:
+        app_id, chan = self._app_channel()
+        wm = {str(k): int(v) for k, v in prior["watermark"].items()}
+        heads = prior.get("heads") or {}
+        # tombstones read BEFORE the scan (same safe-side order as
+        # _restage/_tick_inner): one landing mid-scan then compares
+        # unequal next tick and restages, instead of being recorded as
+        # already-applied while its deleted events stay folded in
+        tombs = self._backend.tombstone_state(app_id, chan)
+        res = self._backend.scan_events_up_to(app_id, chan, wm, heads=heads)
+        if res is None:
+            log.info("follow restart: persisted watermark no longer "
+                     "matches the log — full restage")
+            return False
+        try:
+            self._fold = URFoldState.bootstrap(
+                self._algo.params, self._ds_params, res["batch"])
+        except (FoldUnsupported, ValueError) as e:
+            log.warning("follow restart: bootstrap from covered prefix "
+                        "failed (%s); full restage", e)
+            return False
+        self._wm, self._heads = wm, heads
+        self._tombstones = tombs
+        self.generation = int(prior.get("generation", 0))
+        self.instance_id = prior.get("instanceId")
+        self.bootstrap_events = int(res["events"])
+        log.info("follow restart: rebuilt state from %d covered events "
+                 "(generation %d); folding the unapplied suffix",
+                 res["events"], self.generation)
+        # the covered prefix equals the last PUBLISHED generation; the
+        # embedded host still needs its in-process copy swapped in
+        if self.on_publish is not None:
+            self.on_publish([self._fold.model], self._publish_info("restart"))
+        # fold whatever arrived past the watermark right now
+        self.tick()
+        return True
+
+    def _restage(self, publish: bool) -> bool:
+        """Full rebuild: read the whole log (snapshot-first) and
+        re-bootstrap the fold state."""
+        app_id, chan = self._app_channel()
+        tombs = self._backend.tombstone_state(app_id, chan)
+        res = self._backend.snapshot_scan(app_id, chan)
+        if res is None:
+            res = self._backend.scan_tail_from(app_id, chan, {}, base=None,
+                                               heads=None)
+        if res is None:
+            return False
+        try:
+            t0 = time.perf_counter()
+            self._fold = URFoldState.bootstrap(
+                self._algo.params, self._ds_params, res["batch"])
+        except ValueError as e:
+            # e.g. no primary events yet — but also config errors
+            # (blacklist/backfill name typos) that would recur forever:
+            # log every retry so the operator sees WHY nothing publishes
+            log.warning("follow restage could not bootstrap (%s); "
+                        "retrying next tick", e)
+            self._fold = None
+            return False
+        except FoldUnsupported as e:
+            log.warning("fold unsupported (%s); falling back to "
+                        "retrain mode", e)
+            self._fold = None
+            self.mode = "retrain"
+            return self._retrain_tick(force=True) == "retrain"
+        self._wm = dict(res["watermark"])
+        self._heads = dict(res.get("heads") or {})
+        self._tombstones = tombs
+        self.bootstrap_events = len(self._fold.batch)
+        self.last_fold_events = len(self._fold.batch)
+        if publish:
+            self._publish_guarded([self._fold.model], "restage",
+                                  time.perf_counter() - t0)
+        return True
+
+    # -- the tick -------------------------------------------------------------
+
+    def tick(self) -> str:
+        """One follow cycle; returns the outcome (also counted in
+        pio_follow_folds_total)."""
+        if not follow_enabled():
+            self.last_outcome = "disabled"
+            _M_FOLDS.inc(1, outcome="disabled")
+            return "disabled"
+        try:
+            outcome = self._tick_inner()
+        except Exception:
+            log.exception("follow tick failed")
+            self.last_outcome = "error"
+            _M_FOLDS.inc(1, outcome="error")
+            raise
+        self.last_outcome = outcome
+        _M_FOLDS.inc(1, outcome=outcome)
+        return outcome
+
+    def _tick_inner(self) -> str:
+        if self._pending is not None:
+            models, pmode, dur = self._pending
+            self._publish(models, pmode, dur)
+            self._pending = None
+            return pmode
+        if self.mode != "fold":
+            return self._retrain_tick()
+        if self._fold is None:
+            return "restage" if self._restage(publish=True) else "idle"
+        app_id, chan = self._app_channel()
+        t0 = time.perf_counter()
+        tombs = self._backend.tombstone_state(app_id, chan)
+        if tombs != self._tombstones:
+            # a tombstone arrived mid-follow: folded events may be dead —
+            # the incremental state cannot subtract, so rebuild from the
+            # live log (the same contract as _StagedCache)
+            log.info("follow: tombstone set changed — full restage")
+            self._fold = None
+            return "restage" if self._restage(publish=True) else "idle"
+        trace = _tracing.Trace(f"fold-{uuid.uuid4().hex[:12]}")
+        with trace.activate(), trace.span("follow_tail"):
+            tail = self._backend.scan_tail_from(
+                app_id, chan, self._wm, base=self._fold.batch,
+                heads=self._heads)
+        if tail is None:
+            log.info("follow: watermark no longer matches the log — "
+                     "full restage")
+            self._fold = None
+            return "restage" if self._restage(publish=True) else "idle"
+        _M_LAG.set(tail["events"])
+        if tail["events"] == 0:
+            self._wm, self._heads = tail["watermark"], tail["heads"]
+            return "idle"
+        max_lag = self.max_lag or follow_max_lag_events()
+        if tail["events"] > max_lag:
+            log.info("follow: %d unapplied events exceed "
+                     "PIO_FOLLOW_MAX_LAG_EVENTS=%d — full restage",
+                     tail["events"], max_lag)
+            self._fold = None
+            return "restage" if self._restage(publish=True) else "idle"
+        with trace.activate():
+            with trace.span("follow_fold", events=tail["events"]):
+                try:
+                    model = self._fold.fold(tail["batch"])
+                except FoldUnsupported as e:
+                    log.warning("fold unsupported mid-stream (%s); "
+                                "restaging in retrain mode", e)
+                    self._fold = None
+                    self.mode = "retrain"
+                    return self._retrain_tick(force=True)
+                except Exception:
+                    # fold() mutates incrementally (batch concat, pair
+                    # merges, raw popularity appends) — after a partial
+                    # apply the state cannot be trusted, and retrying
+                    # the same suffix on top of it would double-fold.
+                    # Drop it; the next cycle restages from the log.
+                    self._fold = None
+                    raise
+        self._wm, self._heads = tail["watermark"], tail["heads"]
+        self.last_fold_events = int(tail["events"])
+        self._publish_guarded([model], "fold", time.perf_counter() - t0,
+                              trace=trace)
+        _M_LAG.set(0)
+        return "fold"
+
+    def _retrain_tick(self, force: bool = False) -> str:
+        """Fallback path: full Engine.train per tick (delta-staged by
+        PR 3's cache), published exactly like a fold."""
+        t0 = time.perf_counter()
+        changed, commit = self._probe_store()
+        if not force and not changed:
+            commit()
+            return "idle"
+        models = self.engine.train(self.engine_params)
+        # commit the probe's positions only now: a transient train
+        # failure must leave the watermark behind so the next tick
+        # retries the same suffix instead of idling forever
+        commit()
+        self._publish_guarded(models, "retrain", time.perf_counter() - t0)
+        return "retrain"
+
+    def _probe_store(self):
+        """Cheap new-events probe for retrain mode: watermark tail scan
+        on segment-file backends, an event count elsewhere.  Returns
+        ``(changed, commit)`` — ``commit()`` applies the observed
+        positions and runs only after the tick's train succeeded (or on
+        the nothing-new path)."""
+        app_id, chan = self._app_channel()
+        if self._backend is not None:
+            tombs = self._backend.tombstone_state(app_id, chan)
+            tomb_changed = tombs != self._tombstones
+            tail = self._backend.scan_tail_from(app_id, chan, self._wm,
+                                                base=None,
+                                                heads=self._heads or None)
+            if tail is None:
+                def commit():
+                    self._tombstones = tombs
+                    self._wm, self._heads = {}, {}
+                return True, commit
+            _M_LAG.set(tail["events"])
+
+            # the commit captures tail positions even on a tombstone-only
+            # trigger: the retrain reads the whole log, so the next tick
+            # must not re-count the covered suffix as new work
+            def commit():
+                self._tombstones = tombs
+                self._wm, self._heads = tail["watermark"], tail["heads"]
+            return tomb_changed or tail["events"] > 0, commit
+        n = sum(1 for _ in self.storage.p_events.find(app_id))
+
+        def commit():
+            self._retrain_count = n
+        return n != self._retrain_count, commit
+
+    # -- publication ----------------------------------------------------------
+
+    def _publish_info(self, mode: str) -> dict:
+        return {
+            "mode": mode,
+            "generation": self.generation,
+            "engineInstanceId": self.instance_id,
+            "foldEvents": self.last_fold_events,
+            "publishedAt": self.last_publish_at,
+        }
+
+    def _publish_guarded(self, models, mode: str, duration_s: float,
+                         trace: Optional[_tracing.Trace] = None) -> None:
+        """Publish, retaining the generation in ``_pending`` so a
+        transient publish failure is retried first thing next tick
+        instead of stranding an already-folded generation unpublished."""
+        self._pending = (models, mode, duration_s)
+        self._publish(models, mode, duration_s, trace=trace)
+        self._pending = None
+
+    def _publish(self, models, mode: str, duration_s: float,
+                 trace: Optional[_tracing.Trace] = None) -> None:
+        """Atomic model publication: durable instance record (daemon) +
+        in-process hot-swap (embedded), then watermark persistence —
+        the watermark only advances AFTER the generation it describes is
+        published, so a crash between the two re-folds, never skips."""
+        from predictionio_tpu.controller.engine import (
+            serialize_engine_params,
+        )
+        from predictionio_tpu.storage.base import EngineInstance
+        from predictionio_tpu.workflow import persistence
+
+        if trace is None:
+            trace = _tracing.Trace(f"fold-{uuid.uuid4().hex[:12]}")
+        self.generation += 1
+        try:
+            with trace.activate(), trace.span(
+                    "model_swap", mode=mode, generation=self.generation,
+                    events=self.last_fold_events):
+                if self.persist:
+                    now = _dt.datetime.now(_dt.timezone.utc)
+                    params_json = serialize_engine_params(self.engine_params)
+                    instance = EngineInstance(
+                        id="", status="TRAINING", start_time=now,
+                        end_time=None,
+                        engine_id=self.engine_id,
+                        engine_version=self.engine_version,
+                        engine_variant=self.engine_variant,
+                        engine_factory=self.engine_factory,
+                        data_source_params=params_json["data_source_params"],
+                        preparator_params=params_json["preparator_params"],
+                        algorithms_params=params_json["algorithms_params"],
+                        serving_params=params_json["serving_params"])
+                    with trace.span("follow_publish"):
+                        iid = self.storage.engine_instances.insert(instance)
+                        try:
+                            persistence.save_models(self.storage, iid, models)
+                            instance.status = "COMPLETED"
+                            instance.end_time = _dt.datetime.now(
+                                _dt.timezone.utc)
+                            self.storage.engine_instances.update(instance)
+                        except BaseException:
+                            # best-effort: the retry inserts a fresh row;
+                            # this one must not linger forever-TRAINING
+                            try:
+                                instance.status = "ABORTED"
+                                instance.end_time = _dt.datetime.now(
+                                    _dt.timezone.utc)
+                                self.storage.engine_instances.update(instance)
+                            except Exception:
+                                pass
+                            raise
+                    self.instance_id = iid
+                if self.on_publish is not None:
+                    self.on_publish(models, self._publish_info(mode))
+        except BaseException:
+            # the retry re-runs _publish in full: un-count this attempt
+            # so generations advance by exactly one per published swap
+            self.generation -= 1
+            raise
+        self.last_publish_at = time.time()
+        if self.on_publish is None:
+            # daemon mode owns pio_model_generation; an embedded host's
+            # install path sets it from the SERVER generation (which
+            # also counts reloads) — two counters writing one gauge
+            # would break its monotonic contract
+            _M_GEN.set(self.generation)
+        _M_PUBLISH_TS.set(self.last_publish_at)
+        _M_FOLD_S.observe(duration_s, mode=mode)
+        self._persist_state()
+        rec = _tracing.get_recorder()
+        if rec.enabled:
+            rec.record(trace.to_doc(rec.tag, "model_swap"))
+        log.info("follow: published generation %d (%s, %d events, "
+                 "%.3fs)", self.generation, mode, self.last_fold_events,
+                 duration_s)
+
+    # -- loop / lifecycle -----------------------------------------------------
+
+    def run_forever(self) -> None:
+        """Blocking daemon loop with exponential error backoff and crash
+        restart from the persisted watermark."""
+        while not self._stop.is_set():
+            try:
+                if (self.mode == "fold" and self._fold is None
+                        and self.generation == 0):
+                    self.bootstrap()   # publishes + ticks when it lands
+                else:
+                    self.tick()
+                self._backoff = 0.0
+            except Exception:
+                log.exception("follow cycle failed; backing off")
+                self._backoff = min(
+                    max(self.interval, self._backoff * 2 or self.interval),
+                    60.0)
+            self._stop.wait(self.interval + self._backoff)
+
+    def start(self) -> threading.Thread:
+        """Run the loop on a daemon thread (the embedded mode)."""
+        t = threading.Thread(target=self.run_forever, daemon=True,
+                             name="pio-follow")
+        self._thread = t
+        t.start()
+        return t
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def status(self) -> dict:
+        """The /stats.json freshness payload."""
+        return {
+            "mode": self.mode,
+            "generation": self.generation,
+            "lastOutcome": self.last_outcome,
+            "lastFoldEvents": self.last_fold_events,
+            "lastPublishAt": (
+                _dt.datetime.fromtimestamp(
+                    self.last_publish_at,
+                    _dt.timezone.utc).isoformat()
+                if self.last_publish_at else None),
+            "engineInstanceId": self.instance_id,
+            "enabled": follow_enabled(),
+            "intervalSeconds": self.interval,
+        }

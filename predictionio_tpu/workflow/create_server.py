@@ -48,6 +48,11 @@ _M_SERVE_BATCH = obs_metrics.get_registry().histogram(
     "pio_serve_batch_size",
     "Queries coalesced per micro-batch device dispatch",
     buckets=SIZE_BUCKETS)
+_M_GENERATION = obs_metrics.get_registry().gauge(
+    "pio_model_generation",
+    "Monotonic generation counter of the live model: bumped by every "
+    "hot-swap (follow fold, auto-reload, manual /reload) — serving "
+    "caches key on the model object this counts")
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -276,8 +281,20 @@ class QueryServerState:
         self._lock = threading.Lock()
         self.instance = None
         self.predictor: Optional[Callable] = None
+        self.batcher = None
         self.query_count = 0
         self.started = _dt.datetime.now(_dt.timezone.utc)
+        # model-generation bookkeeping: every hot-swap (reload, auto-
+        # reload, embedded follower) installs a NEW model object and
+        # bumps this counter — the serving caches (rule masks, inverted
+        # CSR, pop order, value masks) all live on the model object, so
+        # the swap IS their invalidation
+        self.generation = 0
+        self.swapped_at: Optional[_dt.datetime] = None
+        self.follower = None          # embedded FollowTrainer, if any
+        self.follow_info: Optional[Dict] = None
+        self._build_seq = 0           # install-order tickets (see _install)
+        self._installed_seq = 0
         self.reload()
         # plugins start only once the state is fully initialized (they get
         # a live QueryServerState with engine/storage/predictor populated)
@@ -307,9 +324,13 @@ class QueryServerState:
             if latest is not None and (
                     current is None or latest.id != current.id):
                 try:
-                    self.reload()
-                    log.info("auto-reload: hot-swapped to instance %s",
-                             latest.id)
+                    if self.reload() is not None:
+                        log.info("auto-reload: hot-swapped to instance %s",
+                                 latest.id)
+                    else:
+                        log.info("auto-reload: instance %s dropped as "
+                                 "stale (a newer generation installed "
+                                 "first)", latest.id)
                 except Exception:
                     # the newer instance's models may still be mid-write;
                     # keep serving the current model and retry next tick
@@ -317,41 +338,99 @@ class QueryServerState:
                                   "current instance")
 
     def stop_auto_reload(self) -> None:
+        """Stop every background updater (auto-reload poller + embedded
+        follower) — wired into server shutdown."""
         self._auto_stop.set()
+        if self.follower is not None:
+            self.follower.stop(timeout=2.0)
 
-    def reload(self) -> str:
+    def reload(self) -> Optional[str]:
+        """Load + install the latest persisted instance.  Returns its id,
+        or None when the bundle was dropped as stale (a build that
+        started later — e.g. the embedded follower's — installed first;
+        the server is serving that newer generation, not this one)."""
+        instance, models = core_workflow.load_latest_models(
+            self.engine_id, self.engine_version, self.engine_variant,
+            self.storage)
+        if self._install(models, instance=instance):
+            return instance.id
+        return None
+
+    def swap_models(self, models, info: Optional[Dict] = None) -> None:
+        """Embedded-follower hot-swap: install already-built models
+        without a persistence round trip.  The swap is atomic under the
+        serving lock; in-flight queries finish on the old generation."""
+        self._install(models, follow_info=info)
+
+    def _install(self, models, instance=None,
+                 follow_info: Optional[Dict] = None) -> bool:
+        """The ONE model-installation path (reload, auto-reload, follower
+        swap): build + warm the serving bundle OUTSIDE the lock — a warm
+        can stage tens of MB to device — then swap the predictor,
+        batcher and generation in one lock hold.  Concurrent builders
+        (auto-reload poller + embedded follower) are ordered by a build
+        ticket taken at build START: a bundle whose build began before a
+        later build already installed is dropped, so a slow stale build
+        can never swap in over a newer generation.  Returns False when
+        the bundle was dropped as stale, True when it went live."""
         import jax
 
         with self._lock:
-            instance, models = core_workflow.load_latest_models(
-                self.engine_id, self.engine_version, self.engine_variant, self.storage
-            )
-            # Micro-batch concurrent queries when every algorithm supports
-            # serving-safe batch prediction.  PIO_SERVE_BATCH: on | off |
-            # auto (default).  Auto engages only on an accelerator
-            # backend: there a batch amortizes the per-dispatch/readback
-            # overhead that dominates concurrent serving (~70 ms/readback
-            # behind the axon tunnel), while on CPU the scoring math is so
-            # cheap that the batcher's coordination measurably LOSES
-            # (2.4k → 0.4k q/s at 32 clients — see PERF.md round 4).
-            conf = os.environ.get("PIO_SERVE_BATCH", "auto").lower()
-            enable = conf in ("1", "on", "true")
-            if not enable and conf == "auto":
-                # probe the backend ONLY for auto — "off" must never touch
-                # the accelerator (init can hang for minutes on a dead
-                # tunnel), and a broken backend must not kill deploy
-                try:
-                    enable = jax.default_backend() not in ("cpu",)
-                except RuntimeError:
-                    enable = False
-            self.predictor, bp = self.engine.serving_bundle(
-                self.engine_params, models)
-            self.batcher = (
-                _MicroBatcher(bp, self.predictor,
-                              max_batch=getattr(bp, "max_batch", None))
-                if enable and bp is not None else None)
-            self.instance = instance
-            return instance.id
+            self._build_seq += 1
+            ticket = self._build_seq
+
+        # Micro-batch concurrent queries when every algorithm supports
+        # serving-safe batch prediction.  PIO_SERVE_BATCH: on | off |
+        # auto (default).  Auto engages only on an accelerator
+        # backend: there a batch amortizes the per-dispatch/readback
+        # overhead that dominates concurrent serving (~70 ms/readback
+        # behind the axon tunnel), while on CPU the scoring math is so
+        # cheap that the batcher's coordination measurably LOSES
+        # (2.4k → 0.4k q/s at 32 clients — see PERF.md round 4).
+        conf = os.environ.get("PIO_SERVE_BATCH", "auto").lower()
+        enable = conf in ("1", "on", "true")
+        if not enable and conf == "auto":
+            # probe the backend ONLY for auto — "off" must never touch
+            # the accelerator (init can hang for minutes on a dead
+            # tunnel), and a broken backend must not kill deploy
+            try:
+                enable = jax.default_backend() not in ("cpu",)
+            except RuntimeError:
+                enable = False
+        predictor, bp = self.engine.serving_bundle(self.engine_params, models)
+        batcher = (
+            _MicroBatcher(bp, predictor,
+                          max_batch=getattr(bp, "max_batch", None))
+            if enable and bp is not None else None)
+        with self._lock:
+            if ticket <= self._installed_seq:
+                return False   # a build that started later already installed
+            self._installed_seq = ticket
+            self.predictor = predictor
+            self.batcher = batcher
+            if instance is not None:
+                self.instance = instance
+            self.generation += 1
+            self.swapped_at = _dt.datetime.now(_dt.timezone.utc)
+            if follow_info is not None:
+                self.follow_info = dict(follow_info)
+        _M_GENERATION.set(self.generation)
+        return True
+
+    def freshness(self) -> Dict:
+        """The /stats.json ``freshness`` key: how current the live model
+        is and who keeps it that way."""
+        doc: Dict[str, Any] = {
+            "generation": self.generation,
+            "swappedAt": (self.swapped_at.isoformat()
+                          if self.swapped_at else None),
+            "engineInstanceId": self.instance.id if self.instance else None,
+        }
+        if self.follower is not None:
+            doc["follower"] = self.follower.status()
+        elif self.follow_info is not None:
+            doc["follower"] = dict(self.follow_info)
+        return doc
 
     def parse_query(self, body: Dict) -> Any:
         if self.query_class is not None and hasattr(self.query_class, "from_json"):
@@ -407,6 +486,10 @@ class QueryServerState:
             "trainedAt": self.instance.start_time.isoformat() if self.instance else None,
             "queryCount": self.query_count,
             "startedAt": self.started.isoformat(),
+            "modelGeneration": self.generation,
+            # freshness is STATE, not a metric: it must stay readable
+            # under PIO_METRICS=off, where /stats.json answers 503
+            "freshness": self.freshness(),
         }
 
 
@@ -463,11 +546,14 @@ def make_handler(state: QueryServerState):
                 doc["engineId"] = state.engine_id
                 doc["queryCount"] = state.query_count
                 doc["startedAt"] = state.started.isoformat()
+                doc["freshness"] = state.freshness()
                 self.send_json(doc)
             elif path == "/reload":
                 try:
                     iid = state.reload()
-                    self.send_json({"reloaded": True, "engineInstanceId": iid})
+                    live = state.instance.id if state.instance else None
+                    self.send_json({"reloaded": iid is not None,
+                                    "engineInstanceId": iid or live})
                 except Exception as e:
                     self.send_error_json(500, f"reload failed: {e}")
             elif path == "/stop":
@@ -527,6 +613,7 @@ def deploy(
     auto_reload: float = 0.0,
     workers: int = 1,
     reuse_port: bool = False,
+    follow: float = 0.0,
 ):
     """Programmatic deploy; returns the HTTPServer (background=True) or blocks.
 
@@ -585,6 +672,27 @@ def deploy(
         storage=storage, feedback=feedback, feedback_app_name=feedback_app,
         plugins=plugins, auto_reload=auto_reload,
     )
+    if follow > 0:
+        # embedded follow-trainer: tail the event store every SECS and
+        # hot-swap the in-process model (no persistence round trip).
+        # Each prefork worker hosts its own follower — they all read the
+        # same store, so the group converges within one interval.
+        from predictionio_tpu.streaming.fold import FoldUnsupported
+        from predictionio_tpu.streaming.follow import FollowTrainer
+
+        try:
+            state.follower = FollowTrainer(
+                engine, engine_params, eid, engine_version, variant,
+                storage=state.storage, interval=follow,
+                on_publish=state.swap_models, persist=False)
+        except FoldUnsupported as e:
+            # e.g. a data source with no app_name: nothing to tail —
+            # serve without a follower rather than raising here, which
+            # would leak the already-started auto-reload poller/plugins
+            log.warning("--follow unsupported for this engine (%s); "
+                        "deploying without a follower", e)
+        else:
+            state.follower.start()
     child_procs: list = []
     # flight recorder: prefork children resolve the group's traces dir
     # from PIO_METRICS_DIR; single workers persist next to the storage
@@ -613,6 +721,7 @@ def deploy(
                 + (["--engine-id", engine_id] if engine_id else [])
                 + (["--feedback"] if feedback else [])
                 + (["--auto-reload", str(auto_reload)] if auto_reload else [])
+                + (["--follow", str(follow)] if follow else [])
             ),
             build_env=lambda w: {
                 "PIO_METRICS_TAG": f"w{w + 1}-{os.getpid()}",
@@ -654,6 +763,7 @@ def run_server_from_args(args) -> int:
             auto_reload=getattr(args, "auto_reload", 0.0) or 0.0,
             workers=getattr(args, "workers", 1) or 1,
             reuse_port=getattr(args, "reuse_port", False),
+            follow=getattr(args, "follow", 0.0) or 0.0,
         )
     except Exception as e:
         print(f"Error: {e}", file=sys.stderr)

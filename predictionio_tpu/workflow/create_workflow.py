@@ -143,6 +143,9 @@ def run_train_from_args(args) -> int:
                 print(f"prepare -> {_describe(pd)}")
             print("Stopped before training (debug flag).")
             return 0
+        if getattr(args, "follow", False):
+            return _run_follow(args, variant, engine, engine_params,
+                               engine_id)
         instance = core_workflow.run_train(
             engine,
             engine_params,
@@ -155,6 +158,30 @@ def run_train_from_args(args) -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     print(f"Training completed. Engine instance id: {instance.id}")
+    return 0
+
+
+def _run_follow(args, variant, engine, engine_params, engine_id: str) -> int:
+    """`pio train --follow` — the resident follow-trainer daemon: train
+    (or resume from the persisted watermark), then tail the event store
+    and publish an incrementally-folded COMPLETED engine instance per
+    batch of new events.  Deployments started with ``--auto-reload``
+    hot-swap to each generation within their poll interval."""
+    from predictionio_tpu.streaming.follow import FollowTrainer
+
+    trainer = FollowTrainer(
+        engine, engine_params, engine_id=engine_id,
+        engine_version=args.engine_version, engine_variant=args.variant,
+        engine_factory=variant["engineFactory"],
+        interval=getattr(args, "follow_interval", 0.0) or None,
+        persist=True)
+    print(f"Follow-trainer for {engine_id} resident "
+          f"(mode={trainer.mode}, interval={trainer.interval:g}s); "
+          "Ctrl-C stops.")
+    try:
+        trainer.run_forever()
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

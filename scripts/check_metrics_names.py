@@ -48,6 +48,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.workflow.core_workflow",
     "predictionio_tpu.workflow.create_server",
     "predictionio_tpu.models.universal_recommender.engine",
+    "predictionio_tpu.streaming.follow",
 ]
 
 
@@ -66,6 +67,14 @@ REQUIRED_METRICS = frozenset({
     "pio_ur_serve_candidate_total",
     "pio_ur_serve_candidate_frac",
     "pio_ur_host_inverted_bytes",
+    # streaming-freshness contract (PR 8): the follow-trainer's fold
+    # outcomes/lag and the hot-swap generation counter every serving
+    # cache invalidates on
+    "pio_follow_folds_total",
+    "pio_follow_fold_duration_seconds",
+    "pio_follow_lag_events",
+    "pio_follow_last_publish_timestamp_seconds",
+    "pio_model_generation",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

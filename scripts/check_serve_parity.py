@@ -205,6 +205,133 @@ def http_phase(engine, ep, query_cls, storage, reference, problems) -> None:
         httpd.server_close()
 
 
+def hotswap_phase(engine, ep, query_cls, storage, problems) -> None:
+    """Replay the rules corpus through a LIVE deploy while an embedded
+    follow-trainer swaps model generations mid-stream: every response
+    must be a valid 200 (zero 5xx — a query must never observe a
+    half-swapped model), and once the stream of appends has been folded
+    the deployed responses must match a from-scratch retrain over the
+    same events EXACTLY."""
+    import http.client
+    import json as _json
+    import threading
+    import time as _time
+
+    from predictionio_tpu.api.http_util import start_server
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow.create_server import (
+        QueryServerState, make_handler,
+    )
+
+    app = storage.apps.get_by_name("parityapp")
+    state = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
+                             "default", storage=storage)
+    follower = state.follower = FollowTrainer(
+        engine, ep, "parity-engine", storage=storage, interval=0.05,
+        on_publish=state.swap_models, persist=False)
+    follower.start()
+    httpd = start_server(make_handler(state), "127.0.0.1", 0,
+                         background=True)
+    port = httpd.server_address[1]
+    bodies = corpus_bodies()
+    gen_start = state.generation
+    errors_5xx = []
+    replay_errors = []
+    replay_count = [0]
+    stop = threading.Event()
+
+    def replay_loop():
+        # a transport error mid-swap (reset, half-response) is exactly
+        # the failure this phase exists to catch — it must FAIL the
+        # phase, not silently kill the replay thread and leave the
+        # zero-5xx assertion vacuously true
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            while not stop.is_set():
+                for body in bodies:
+                    conn.request("POST", "/queries.json",
+                                 _json.dumps(body).encode(),
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    replay_count[0] += 1
+                    if r.status >= 500:
+                        errors_5xx.append((r.status, payload[:200]))
+            conn.close()
+        except Exception as e:
+            replay_errors.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=replay_loop, daemon=True)
+    try:
+        t.start()
+        # appends forcing folds/swaps while the replay loop is live:
+        # fresh users co-purchasing with the electronics cluster
+        for k in range(6):
+            storage.l_events.insert_batch(
+                [Event(event="purchase", entity_type="user",
+                       entity_id=f"swapper{k}", target_entity_type="item",
+                       target_entity_id=f"e{j}") for j in (0, 1, 2)],
+                app.id)
+            _time.sleep(0.15)
+        deadline = _time.time() + 20
+        while _time.time() < deadline and (
+                state.generation <= gen_start
+                or follower.last_outcome not in ("fold", "idle")):
+            _time.sleep(0.05)
+        # drain: one more tick's worth so the LAST append is folded
+        while _time.time() < deadline and follower.last_outcome != "idle":
+            _time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        follower.stop()
+    swaps = state.generation - gen_start
+    if swaps < 1:
+        problems.append("hotswap: follower never swapped a generation "
+                        f"(outcome={follower.last_outcome})")
+    if errors_5xx:
+        problems.append(
+            f"hotswap: {len(errors_5xx)} 5xx responses during swaps "
+            f"(first: {errors_5xx[0]})")
+    if replay_errors:
+        problems.append(
+            f"hotswap: replay connection died mid-stream after "
+            f"{replay_count[0]} responses: {replay_errors[0]}")
+    # post-swap exactness: live responses == from-scratch retrain now
+    invalidate_staging_cache()
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    ref = engine.train(ep)[0]
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    for qi, body in enumerate(bodies + [{"user": "swapper0", "num": 6}]):
+        conn.request("POST", "/queries.json", _json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = r.read()
+        if r.status != 200:
+            problems.append(f"hotswap: post-swap query #{qi} HTTP "
+                            f"{r.status}: {payload[:200]!r}")
+            continue
+        got = canon_http(_json.loads(payload))
+        want = canon(algo.predict(ref, query_cls.from_json(body)))
+        if got != want:
+            problems.append(
+                f"hotswap: query #{qi} differs from the post-swap "
+                f"from-scratch model:\n  got:  {got}\n  want: {want}")
+    conn.close()
+    httpd.shutdown()
+    httpd.server_close()
+    if not problems:
+        print(f"hotswap phase: {swaps} mid-stream generation swaps, "
+              "zero 5xx, post-swap responses exactly match a "
+              "from-scratch retrain")
+
+
 def main() -> int:
     # pin the scorer so both tails consume the IDENTICAL signal array and
     # any diff is attributable to the tail under test
@@ -270,12 +397,17 @@ def main() -> int:
     if not problems:
         http_phase(engine, ep, URQuery, get_storage(),
                    runs["host/serial"], problems)
+    # hot-swap phase: the same corpus under live mid-stream generation
+    # swaps (embedded follow-trainer), then post-swap exactness
+    os.environ["PIO_UR_SERVE_CANDIDATES"] = "off"
+    if not problems:
+        hotswap_phase(engine, ep, URQuery, get_storage(), problems)
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
         print(f"ok: {len(queries)} queries × (6 serving paths + "
-              "http serial/pipelined × candidates on/off) identical "
-              "(items, scores, order)")
+              "http serial/pipelined × candidates on/off + live "
+              "hot-swap phase) identical (items, scores, order)")
     return 1 if problems else 0
 
 

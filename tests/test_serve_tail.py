@@ -485,6 +485,13 @@ def test_query_server_stats_json_503_when_metrics_off(tmp_path, rules_app,
             assert r.status == 200
         with urllib.request.urlopen(base + "/") as r:
             assert "pid" in _json.loads(r.read())
+        # freshness is state, not a metric: the SDK contract must
+        # survive the kill switch via the GET / fallback
+        from predictionio_tpu.sdk import EngineClient
+
+        qc = EngineClient(url=base)
+        assert qc.model_generation() >= 1
+        assert qc.freshness().get("generation") == qc.model_generation()
     finally:
         obs_metrics.set_enabled(True)
         if httpd is not None:

@@ -1,0 +1,704 @@
+"""Streaming freshness: fold exactness, hot-swap invalidation, daemon
+crash-restart, and the roundtrip script wrapper.
+
+The fold engine's contract is bit-exactness: after ANY fold sequence the
+resident model must answer every query identically to a from-scratch
+``engine.train`` over the same events.  These tests drive the real
+storage tail (scan_tail_from), real folds, and real hot-swaps through
+``QueryServerState.swap_models`` — no mocks on the exactness path.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _buy(u, i, event="purchase"):
+    from predictionio_tpu.events.event import Event
+
+    return Event(event=event, entity_type="user", entity_id=u,
+                 target_entity_type="item", target_entity_id=i)
+
+
+def _set_item(i, props):
+    from predictionio_tpu.events.event import DataMap, Event
+
+    return Event(event="$set", entity_type="item", entity_id=i,
+                 properties=DataMap(props))
+
+
+def _seed_events(n_users=12, n_items=8, seed=1, base_u=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for u in range(base_u, base_u + n_users):
+        for it in range(n_items):
+            if rng.random() < 0.45:
+                out.append(_buy(f"u{u}", f"i{it}"))
+            if rng.random() < 0.6:
+                out.append(_buy(f"u{u}", f"i{it}", event="view"))
+    return out
+
+
+def _ur_setup(fs_storage, app_name="sfapp", event_names=("purchase", "view"),
+              **algo_kw):
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.storage.base import App
+
+    app_id = fs_storage.apps.insert(App(0, app_name))
+    engine = UniversalRecommenderEngine.apply()
+    ap = URAlgorithmParams(app_name=app_name, mesh_dp=1,
+                           max_correlators_per_item=6, **algo_kw)
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name=app_name, event_names=list(event_names)),
+        algorithm_params_list=[("ur", ap)])
+    return app_id, engine, ap, ep
+
+
+def _canon(res):
+    return [(s.item, float(s.score)) for s in res.item_scores]
+
+
+def _fresh_ref(engine, ep):
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+
+    invalidate_staging_cache()
+    return engine.train(ep)[0]
+
+
+def _assert_model_equals_fresh(model, engine, ep, queries, algo):
+    """Model arrays AND responses must equal a from-scratch retrain."""
+    ref = _fresh_ref(engine, ep)
+    for name in ref.indicator_idx:
+        assert np.array_equal(ref.indicator_idx[name],
+                              model.indicator_idx[name]), name
+        assert np.array_equal(ref.indicator_llr[name],
+                              model.indicator_llr[name]), name
+        assert (ref.event_item_dicts[name].strings()
+                == model.event_item_dicts[name].strings()), name
+    assert np.array_equal(ref.popularity, model.popularity)
+    assert ref.item_properties == model.item_properties
+    for q in queries:
+        assert _canon(algo.predict(ref, q)) == _canon(algo.predict(model, q))
+
+
+@pytest.fixture()
+def host_serving(monkeypatch):
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+
+
+def _tail(storage, app_id, wm, base, heads):
+    return storage.l_events.scan_tail_from(app_id, None, wm, base=base,
+                                           heads=heads)
+
+
+# -- fold exactness ----------------------------------------------------------
+
+
+def test_fold_matches_train_across_folds(fs_storage, host_serving):
+    """Bootstrap + growth + remap + duplicate-only folds: after every
+    fold the model arrays and responses equal a from-scratch train."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    app_id, engine, ap, ep = _ur_setup(
+        fs_storage, use_llr_weights=True,
+        indicator_params={"view": {"maxCorrelatorsPerItem": 4}})
+    fs_storage.l_events.insert_batch(_seed_events(seed=1), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"i{k}", {"category": "red" if k < 4 else "blue"})
+         for k in range(8)], app_id)
+    algo = URAlgorithm(ap)
+    queries = ([URQuery(user=f"u{u}", num=6) for u in range(0, 12, 2)]
+               + [URQuery(user="nobody", num=4), URQuery(item="i1", num=5),
+                  URQuery(user="u1", num=6, fields=[
+                      {"name": "category", "values": ["red"], "bias": -1}])])
+    tail = _tail(fs_storage, app_id, {}, None, None)
+    state = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    wm, heads = tail["watermark"], tail["heads"]
+    _assert_model_equals_fresh(state.model, engine, ep, queries, algo)
+    deltas = [
+        _seed_events(n_users=4, seed=2, base_u=5),        # overlap + new
+        _seed_events(n_users=3, seed=3, base_u=50)        # new users
+        + [_buy("u50", "a_first_item"),                   # mid-array insert
+           _set_item("a_first_item", {"category": "red"})],
+        _seed_events(seed=1),                             # pure duplicates
+    ]
+    for k, evs in enumerate(deltas):
+        fs_storage.l_events.insert_batch(evs, app_id)
+        tail = _tail(fs_storage, app_id, wm, state.batch, heads)
+        assert tail is not None and tail["events"] > 0
+        model = state.fold(tail["batch"])
+        wm, heads = tail["watermark"], tail["heads"]
+        _assert_model_equals_fresh(model, engine, ep, queries, algo)
+    # the duplicate-only fold must have skipped every re-LLR
+    assert all(s["mode"] == "skip" for s in state.last_fold_stats.values())
+
+
+def test_fold_sliced_rows_path_is_exact(fs_storage, host_serving):
+    """A primary-only delta from an existing user re-LLRs ONLY the
+    touched rows of the non-primary type (its marginals are untouched),
+    and the sliced recompute is bit-identical to the full one."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_seed_events(seed=4), app_id)
+    tail = _tail(fs_storage, app_id, {}, None, None)
+    state = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    wm, heads = tail["watermark"], tail["heads"]
+    # one new purchase (u0, i7) where u0 hasn't bought i7: primary rows
+    # change; the view type sees only row-local changes
+    fs_storage.l_events.insert_batch([_buy("u0", "i7")], app_id)
+    tail = _tail(fs_storage, app_id, wm, state.batch, heads)
+    assert tail["events"] == 1
+    model = state.fold(tail["batch"])
+    assert state.last_fold_stats["view"]["mode"] == "sliced"
+    assert state.last_fold_stats["purchase"]["mode"] == "full"
+    algo = URAlgorithm(ap)
+    queries = [URQuery(user=f"u{u}", num=6) for u in range(12)]
+    _assert_model_equals_fresh(model, engine, ep, queries, algo)
+
+
+def test_scan_bounded_reconstructs_covered_prefix(fs_storage):
+    """scan_events_up_to parses exactly the events a watermark covers —
+    the daemon-restart read — and refuses a recreated segment."""
+    from predictionio_tpu.storage.base import App
+
+    app_id = fs_storage.apps.insert(App(0, "boundapp"))
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{k}", "i0") for k in range(5)], app_id)
+    tail = fs_storage.l_events.scan_tail_from(app_id, None, {}, base=None,
+                                              heads=None)
+    wm, heads = tail["watermark"], tail["heads"]
+    fs_storage.l_events.insert_batch(
+        [_buy(f"late{k}", "i0") for k in range(3)], app_id)
+    res = fs_storage.l_events.scan_events_up_to(app_id, None, wm,
+                                                heads=heads)
+    assert res is not None and res["events"] == 5
+    names = {res["batch"].entity_dict.str(int(c))
+             for c in res["batch"].entity_ids}
+    assert names == {f"u{k}" for k in range(5)}
+    # a recreated segment reusing a covered name must be rejected
+    seg = next(iter(wm))
+    d = fs_storage.l_events._chan_dir(app_id, None)
+    content = b'{"event":"purchase","entityType":"user","entityId":"x",' \
+              b'"targetEntityType":"item","targetEntityId":"i0",' \
+              b'"eventId":"zzz","eventTime":"2026-01-01T00:00:00Z"}\n'
+    (d / seg).write_bytes(content * 64)
+    assert fs_storage.l_events.scan_events_up_to(
+        app_id, None, wm, heads=heads) is None
+
+
+# -- hot-swap invalidation audit ---------------------------------------------
+# One test per generation-keyed serving structure: a swapped-in model
+# must never serve entries derived from the previous generation.
+
+
+def _follow_pair(fs_storage, app_id, engine, ap, ep):
+    """(state, follower) with the embedded swap wired, bootstrapped."""
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import QueryServerState
+
+    core_workflow.run_train(engine, ep, engine_id="swap-eng",
+                            storage=fs_storage)
+    state = QueryServerState(
+        engine, ep, UniversalRecommenderEngine.query_class, "swap-eng",
+        "1", "default", storage=fs_storage)
+    follower = state.follower = FollowTrainer(
+        engine, ep, "swap-eng", storage=fs_storage, interval=3600,
+        on_publish=state.swap_models, persist=False)
+    assert follower.mode == "fold"
+    assert follower.bootstrap()
+    return state, follower
+
+
+def test_swap_invalidates_rule_mask_cache(fs_storage, host_serving,
+                                          monkeypatch):
+    """Rule-mask LRU: a field filter composed under generation N must
+    not survive a swap that moved the property values."""
+    # pruned queries probe the dense mask cache without populating it —
+    # pin candidates off so the populated-precondition below is real
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    app_id, engine, ap, ep = _ur_setup(
+        fs_storage, available_date_name="", expire_date_name="")
+    fs_storage.l_events.insert_batch(_seed_events(seed=5), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"i{k}", {"category": "red"}) for k in range(8)], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    red = {"user": "u1", "num": 8,
+           "fields": [{"name": "category", "values": ["red"], "bias": -1}]}
+    before = state.predict(red)
+    assert before.item_scores, "fixture: red filter should match items"
+    old_model = follower._fold.model
+    old_cache = old_model.rule_mask_cache("host")
+    assert len(old_cache) > 0, "fixture: dense mask cache must populate"
+    # move every item to blue; the same red query must now match nothing
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"i{k}", {"category": "blue"}) for k in range(8)], app_id)
+    assert follower.tick() == "fold"
+    new_model = follower._fold.model
+    assert new_model is not old_model
+    assert new_model.rule_mask_cache("host") is not old_cache
+    after = state.predict(red)
+    assert after.item_scores == [], _canon(after)
+
+
+def test_swap_invalidates_inverted_csr(fs_storage, host_serving):
+    """host_inverted CSR: new co-occurrences must be servable from the
+    candidate-pruned path right after the swap (patched or rebuilt, the
+    postings must reflect the new generation)."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{u}", f"i{it}") for u in range(8) for it in range(4)
+         if (u + it) % 2], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    # warm the old inversion
+    state.predict({"user": "u1", "num": 4})
+    assert follower._fold.model.__dict__.get("_host_inv")
+    # i9 is brand new and co-purchased with i1 by several users
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{u}", "i9") for u in range(8) if u % 2]
+        + [_buy(f"u{u}", "i1") for u in range(8) if u % 2], app_id)
+    assert follower.tick() == "fold"
+    res = state.predict({"user": "fresh", "num": 4})  # cold: backfill only
+    # the real probe: a user whose history is i1 must now see i9
+    fs_storage.l_events.insert_batch([_buy("prober", "i1")], app_id)
+    assert follower.tick() == "fold"
+    res = state.predict({"user": "prober", "num": 6})
+    items = [s.item for s in res.item_scores if s.score > 0]
+    assert "i9" in items, _canon(res)
+
+
+def test_swap_invalidates_pop_order(fs_storage, host_serving, monkeypatch):
+    """host_pop_order: the pruned tail's backfill merge must walk the NEW
+    generation's popularity order after a swap."""
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "on")
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    # iPOP's buyers are DISJOINT from u1's co-occurrence neighborhood, so
+    # both iPOP and iNEW can only ever reach u1 via popularity backfill
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{u}", f"i{it}") for u in range(6) for it in (0, 1)]
+        + [_buy(f"w{k}", "iPOP") for k in range(3)], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    old_model = follower._fold.model
+    old_model.host_pop_order()          # warm the old order
+    # iNEW becomes by far the most popular item
+    fs_storage.l_events.insert_batch(
+        [_buy(f"pop{k}", "iNEW") for k in range(30)], app_id)
+    assert follower.tick() == "fold"
+    new_model = follower._fold.model
+    assert "_host_pop_order" not in new_model.__dict__ or not np.array_equal(
+        new_model.__dict__["_host_pop_order"],
+        old_model.__dict__["_host_pop_order"])
+    # a user with history gets backfill padding from the NEW order
+    res = state.predict({"user": "u1", "num": 10})
+    items = [s.item for s in res.item_scores]
+    assert "iNEW" in items, items
+    assert items.index("iNEW") < items.index("iPOP"), items
+
+
+def test_swap_invalidates_value_mask_cache(fs_storage, host_serving):
+    """Dense value-mask/date caches: a $set fold rebuilds the property
+    indexes; a props-untouched fold carries them over (provably
+    identical), and either way responses track the live generation."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=6, n_items=6),
+                                     app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item("i0", {"tier": "gold"})], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    gold = {"user": "u2", "num": 6,
+            "fields": [{"name": "tier", "values": ["gold"], "bias": -1}]}
+    before = {s.item for s in state.predict(gold).item_scores}
+    assert before <= {"i0"} and before, before
+    m1 = follower._fold.model
+    m1.host_value_mask("tier", "gold")          # warm the dense mask LRU
+    m1.prop_value_index("tier")
+    # props-untouched fold: the derived indexes carry over by identity
+    fs_storage.l_events.insert_batch([_buy("u0", "i1")], app_id)
+    assert follower.tick() == "fold"
+    m2 = follower._fold.model
+    assert m2.item_properties is m1.item_properties
+    assert m2.__dict__.get("_prop_value_index") is \
+        m1.__dict__.get("_prop_value_index")
+    # props-changing fold: gold moves to i3; the old mask must be gone
+    fs_storage.l_events.insert_batch(
+        [_set_item("i0", {"tier": "silver"}),
+         _set_item("i3", {"tier": "gold"})], app_id)
+    assert follower.tick() == "fold"
+    m3 = follower._fold.model
+    assert m3.item_properties is not m1.item_properties
+    assert "_prop_value_index" not in m3.__dict__
+    after = {s.item for s in state.predict(gold).item_scores}
+    assert after <= {"i3"}, after
+
+
+def test_patched_inverted_equals_rebuilt(fs_storage, host_serving):
+    """The incremental host_inverted row patch must be ARRAY-identical
+    to inverting the new indicator table from scratch."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=7, n_users=14),
+                                     app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    m1 = follower._fold.model
+    m1.host_inverted("purchase")   # warm so the fold has something to patch
+    # a duplicate-heavy delta touching ONE pair keeps the changed-row set
+    # small enough for the patch path
+    fs_storage.l_events.insert_batch([_buy("u0", "i7")], app_id)
+    assert follower.tick() == "fold"
+    m2 = follower._fold.model
+    patched = m2.__dict__.get("_host_inv", {}).get("purchase")
+    if patched is None:
+        pytest.skip("fold took the rebuild path (too many rows changed)")
+    rebuilt_model = follower._fold.model
+    rebuilt_model.__dict__.pop("_host_inv")
+    fresh = rebuilt_model.host_inverted("purchase")
+    for a, b in zip(patched, fresh):
+        assert np.array_equal(a, b)
+
+
+# -- follow-mode edges -------------------------------------------------------
+
+
+def test_tombstone_mid_follow_forces_restage(fs_storage, host_serving):
+    """A tombstone arriving mid-follow invalidates the additive state:
+    the next tick must fully restage, and the restaged model must equal
+    a from-scratch train (the dead event gone)."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=8), app_id)
+    dead_id = fs_storage.l_events.insert(_buy("deadguy", "i0"), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    assert follower.tick() == "idle"
+    assert fs_storage.l_events.delete(dead_id, app_id)
+    # a snapshot gives the restage AND the reference retrain the same
+    # (segment-order) staging source, so the comparison below can be
+    # array-exact — with a tombstone and no snapshot the reference falls
+    # to the row-object read path, whose batch ORDER (hence item-id
+    # assignment) legitimately differs
+    fs_storage.l_events.build_snapshot(app_id)
+    assert follower.tick() == "restage"
+    model = follower._fold.model
+    assert model.user_dict.id("deadguy") is None
+    algo = URAlgorithm(ap)
+    queries = [URQuery(user=f"u{u}", num=6) for u in range(0, 12, 3)]
+    _assert_model_equals_fresh(model, engine, ep, queries, algo)
+
+
+def test_max_lag_breach_restages(fs_storage, host_serving):
+    """A delta past PIO_FOLLOW_MAX_LAG_EVENTS rebuilds instead of
+    folding — and the rebuild is still exact."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=9), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    follower.max_lag = 2
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{k}", "i1") for k in range(20, 26)], app_id)
+    assert follower.tick() == "restage"
+    algo = URAlgorithm(ap)
+    _assert_model_equals_fresh(
+        follower._fold.model, engine, ep,
+        [URQuery(user="u21", num=5), URQuery(user="u1", num=5)], algo)
+
+
+def test_state_budget_falls_back_to_retrain(fs_storage, host_serving,
+                                            monkeypatch):
+    """PIO_FOLLOW_STATE_BYTES breach → FoldUnsupported → the follower
+    keeps publishing through full retrains."""
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import QueryServerState
+
+    monkeypatch.setenv("PIO_FOLLOW_STATE_BYTES", "1")
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=10), app_id)
+    core_workflow.run_train(engine, ep, engine_id="swap-eng",
+                            storage=fs_storage)
+    state = QueryServerState(
+        engine, ep, UniversalRecommenderEngine.query_class, "swap-eng",
+        "1", "default", storage=fs_storage)
+    follower = state.follower = FollowTrainer(
+        engine, ep, "swap-eng", storage=fs_storage, interval=3600,
+        on_publish=state.swap_models, persist=False)
+    assert follower.mode == "fold"       # resolves optimistically...
+    assert follower.bootstrap()
+    assert follower.mode == "retrain"    # ...and demotes on the budget
+    gen = state.generation
+    fs_storage.l_events.insert_batch([_buy("late", "i1")], app_id)
+    assert follower.tick() == "retrain"
+    assert state.generation == gen + 1
+
+
+def test_follow_kill_switch_and_metrics(fs_storage, host_serving,
+                                        monkeypatch):
+    """PIO_FOLLOW=off idles the loop; outcomes land in
+    pio_follow_folds_total and swaps bump pio_model_generation."""
+    from predictionio_tpu.obs.metrics import get_registry
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=11), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    reg = get_registry()
+    monkeypatch.setenv("PIO_FOLLOW", "off")
+    assert follower.tick() == "disabled"
+    monkeypatch.delenv("PIO_FOLLOW")
+    before = reg.counter("pio_follow_folds_total", "x").value(outcome="fold")
+    fs_storage.l_events.insert_batch([_buy("kk", "i2")], app_id)
+    assert follower.tick() == "fold"
+    assert reg.counter("pio_follow_folds_total",
+                       "x").value(outcome="fold") == before + 1
+    assert reg.gauge("pio_model_generation", "x").value() >= 2
+    fresh = state.freshness()
+    assert fresh["generation"] == state.generation
+    assert fresh["follower"]["lastOutcome"] == "fold"
+
+
+def test_transient_publish_failure_retries_next_tick(fs_storage,
+                                                     host_serving):
+    """A fold whose publish raises must NOT strand the generation: the
+    in-memory watermark has already advanced, so the next (0-event) tick
+    must retry the retained publish instead of idling on a stale live
+    model."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(
+        _seed_events(seed=13) + [_buy("pu", "i0")], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    gen0 = state.generation
+    # i9 is brand new, co-purchased with i0 (which probe user "pu" owns)
+    fs_storage.l_events.insert_batch(
+        [_buy(f"c{j}", t) for j in range(5) for t in ("i0", "i9")], app_id)
+    fgen0 = follower.generation
+    real = follower.on_publish
+    calls = {"n": 0}
+
+    def flaky(models, info):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient swap error")
+        return real(models, info)
+
+    follower.on_publish = flaky
+    with pytest.raises(OSError):
+        follower.tick()
+    assert follower.last_outcome == "error"
+    assert follower._pending is not None
+    # the failed attempt must not consume a generation number
+    assert follower.generation == fgen0
+    # no new events arrived: without the retry this tick would be "idle"
+    assert follower.tick() == "fold"
+    assert follower._pending is None
+    assert follower.generation == fgen0 + 1
+    assert state.generation > gen0
+    res = state.predict({"user": "pu", "num": 8})
+    assert "i9" in [s.item for s in res.item_scores]
+
+
+def test_fold_exception_drops_state_and_restages(fs_storage, host_serving,
+                                                 monkeypatch):
+    """A non-FoldUnsupported error escaping fold() may have partially
+    applied the delta — retrying the same suffix on that state would
+    double-fold.  The state must be dropped so the next cycle restages."""
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=17), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    gen0 = state.generation
+    fs_storage.l_events.insert_batch([_buy("zz", "i1")], app_id)
+    orig = URFoldState.fold
+
+    def boom(self, batch):
+        raise MemoryError("transient mid-apply failure")
+
+    monkeypatch.setattr(URFoldState, "fold", boom)
+    with pytest.raises(MemoryError):
+        follower.tick()
+    assert follower._fold is None
+    monkeypatch.setattr(URFoldState, "fold", orig)
+    assert follower.tick() == "restage"
+    assert state.generation > gen0
+    res = state.predict({"user": "zz", "num": 8})
+    assert res.item_scores, "restaged model must serve the new user"
+
+
+# -- daemon: SIGKILL + watermark restart -------------------------------------
+
+
+def _daemon_env(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "store"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+        "PYTHONPATH": str(REPO),
+    })
+    return env
+
+
+def _wait_follow_state(path: Path, timeout=90, min_gen=1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            doc = json.loads(path.read_text())
+            if doc.get("generation", 0) >= min_gen:
+                return doc
+        except (OSError, json.JSONDecodeError):
+            pass
+        time.sleep(0.2)
+    raise AssertionError(f"follow state never reached gen {min_gen}")
+
+
+def test_daemon_sigkill_restart_refolds_exact_suffix(tmp_path):
+    """`pio train --follow` daemon: SIGKILL mid-follow, events appended
+    while down, restart — the restart re-reads exactly the covered
+    prefix (bootstrapEvents == pre-kill count), folds exactly the
+    unapplied suffix (lastFoldEvents == appended count, no double-fold),
+    and the published model equals a from-scratch retrain."""
+    from predictionio_tpu.storage.locator import (
+        Storage, StorageConfig, set_storage,
+    )
+
+    variant = {
+        "id": "follow-ur",
+        "engineFactory": "predictionio_tpu.models.universal_recommender."
+                         "UniversalRecommenderEngine",
+        "datasource": {"params": {"appName": "DaemonApp",
+                                  "eventNames": ["purchase"]}},
+        "algorithms": [{"name": "ur", "params": {
+            "appName": "DaemonApp", "meshDp": 1,
+            "maxCorrelatorsPerItem": 5}}],
+    }
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(variant))
+    env = _daemon_env(tmp_path)
+
+    def storage():
+        cfg = StorageConfig(
+            sources={"FS": {"type": "localfs",
+                            "path": str(tmp_path / "store")}},
+            repositories={r: "FS" for r in ("METADATA", "EVENTDATA",
+                                            "MODELDATA")})
+        st = Storage(cfg)
+        set_storage(st)
+        return st
+
+    st = storage()
+    from predictionio_tpu.storage.base import App
+
+    app_id = st.apps.insert(App(0, "DaemonApp"))
+    n_initial = 0
+    evs = [_buy(f"u{u}", f"i{it}") for u in range(10) for it in range(5)
+           if (u + it) % 2]
+    n_initial = len(evs)
+    st.l_events.insert_batch(evs, app_id)
+
+    follow_state = (tmp_path / "store" / "follow"
+                    / "follow-ur-default.json")
+    cmd = [sys.executable, "-m", "predictionio_tpu.cli.main", "train",
+           "--engine-json", str(ej), "--follow", "--follow-interval", "0.2"]
+    proc = subprocess.Popen(cmd, env=env, cwd=str(tmp_path),
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        doc = _wait_follow_state(follow_state, min_gen=1)
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    gen_killed = doc["generation"]
+    # appended while the daemon is DOWN: the unapplied suffix
+    suffix = [_buy(f"v{k}", "i1") for k in range(4)] + [_buy("v0", "i2")]
+    st.l_events.insert_batch(suffix, app_id)
+    proc = subprocess.Popen(cmd, env=env, cwd=str(tmp_path),
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        doc = _wait_follow_state(follow_state, min_gen=gen_killed + 1)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    # exactly the suffix was re-folded (no double-fold, no blind retrain)
+    assert doc["bootstrapEvents"] == n_initial, doc
+    assert doc["lastFoldEvents"] == len(suffix), doc
+    # the published generation equals a from-scratch retrain
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine, URQuery,
+    )
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm, URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.workflow import core_workflow
+
+    ap = URAlgorithmParams(app_name="DaemonApp", mesh_dp=1,
+                           max_correlators_per_item=5)
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(app_name="DaemonApp",
+                                              event_names=["purchase"]),
+        algorithm_params_list=[("ur", ap)])
+    engine = UniversalRecommenderEngine.apply()
+    _instance, models = core_workflow.load_latest_models(
+        "follow-ur", "1", "default", st)
+    algo = URAlgorithm(ap)
+    ref = _fresh_ref(engine, ep)
+    for q in [URQuery(user="u1", num=5), URQuery(user="v0", num=5),
+              URQuery(user="v3", num=5)]:
+        assert _canon(algo.predict(models[0], q)) \
+            == _canon(algo.predict(ref, q))
+    set_storage(None)
+
+
+# -- script wrapper ----------------------------------------------------------
+
+
+def test_check_freshness_roundtrip_script():
+    """Tier-1 wrapper for scripts/check_freshness_roundtrip.py: live
+    deploy + embedded follower, append→fold→reflected rounds with exact
+    parity vs a from-scratch retrain and zero 5xx."""
+    r = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_freshness_roundtrip.py")],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
