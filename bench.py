@@ -1197,6 +1197,198 @@ def _fabricate_ur_serving_store(tmp: str, n_items: int, n_users: int,
     return storage, ur_json
 
 
+def bench_store_scale(smoke: bool) -> dict:
+    """Sharded event-store scaling (the PR-9 tentpole): ingest and the
+    cold-train merged scan at shards ∈ {1, 2, 4} through the storage
+    layer (replicas=1), plus the semi-sync replication barrier's ingest
+    cost at shards=2 (replicas=2, PIO_FSYNC=always vs the same shape
+    unreplicated).  Every cell recounts the on-disk shard union and
+    requires every eventId unique — the exactly-once integrity check —
+    and the scan cell requires the merged columnar batch to carry
+    exactly the ingested set."""
+    import shutil
+    import tempfile
+
+    from predictionio_tpu.storage.sharded import ShardedEvents
+
+    n = 20_000 if smoke else 300_000
+    batch = 1_000
+    out: dict = {"store_scale_events": n}
+    saved_fsync = os.environ.get("PIO_FSYNC")
+    try:
+        for shards in (1, 2, 4):
+            tmp = tempfile.mkdtemp(prefix=f"pio_store_s{shards}")
+            ev = None
+            try:
+                os.environ["PIO_FSYNC"] = "rotate"
+                ev = ShardedEvents(tmp, shards=shards, replicas=1)
+                reqs = [
+                    [{"event": "buy", "entityType": "user",
+                      "entityId": f"u{k % 5000}",
+                      "targetEntityType": "item",
+                      "targetEntityId": f"i{k % 20000}",
+                      "eventId": f"e{k}"}
+                     for k in range(s0, min(s0 + batch, n))]
+                    for s0 in range(0, n, batch)]
+                t0 = time.perf_counter()
+                for sub in reqs:
+                    res = ev.insert_json_batch(sub, 1)
+                    assert res[0]["status"] == 201, res[0]
+                wall = time.perf_counter() - t0
+                out[f"store_ingest_s{shards}_events_per_sec"] = n / wall
+                ids = [e.event_id for e in ev.scan(1)]
+                if len(ids) != n or len(set(ids)) != n:
+                    raise AssertionError(
+                        f"shards={shards}: integrity broke "
+                        f"({len(ids)} rows / {len(set(ids))} unique, "
+                        f"want {n})")
+                # cold-train scan: per-shard columnar snapshots, merged
+                ev.build_snapshot(1)
+                t0 = time.perf_counter()
+                batches = list(ev.find_batches(1))
+                wall = time.perf_counter() - t0
+                total = sum(len(b) for b in batches)
+                if total != n:
+                    raise AssertionError(
+                        f"shards={shards}: merged scan {total} != {n}")
+                out[f"store_scan_s{shards}_events_per_sec"] = n / wall
+                out[f"store_scale_integrity_s{shards}"] = "ok"
+            finally:
+                # close BEFORE rmtree even on failure, or leaked follower
+                # threads recreate the deleted tmp dir forever
+                if ev is not None:
+                    ev.close()
+                shutil.rmtree(tmp, ignore_errors=True)
+        # replication cost: identical shape with and without the barrier
+        n_r = max(2_000, n // 10)
+        for replicas in (1, 2):
+            tmp = tempfile.mkdtemp(prefix=f"pio_store_r{replicas}")
+            ev = None
+            try:
+                os.environ["PIO_FSYNC"] = "always"
+                ev = ShardedEvents(tmp, shards=2, replicas=replicas)
+                t0 = time.perf_counter()
+                for s0 in range(0, n_r, batch):
+                    ev.insert_json_batch(
+                        [{"event": "buy", "entityType": "user",
+                          "entityId": f"u{k}", "eventId": f"r{k}"}
+                         for k in range(s0, min(s0 + batch, n_r))], 1)
+                wall = time.perf_counter() - t0
+                out[f"store_ingest_repl{replicas}_events_per_sec"] = (
+                    n_r / wall)
+                ids = [e.event_id for e in ev.scan(1)]
+                if len(ids) != n_r or len(set(ids)) != n_r:
+                    raise AssertionError(
+                        f"replicas={replicas}: integrity broke")
+            finally:
+                if ev is not None:
+                    ev.close()
+                shutil.rmtree(tmp, ignore_errors=True)
+        out["store_repl_overhead_ratio"] = round(
+            out["store_ingest_repl1_events_per_sec"]
+            / max(out["store_ingest_repl2_events_per_sec"], 1e-9), 3)
+    finally:
+        if saved_fsync is None:
+            os.environ.pop("PIO_FSYNC", None)
+        else:
+            os.environ["PIO_FSYNC"] = saved_fsync
+    return out
+
+
+def bench_store_failover(smoke: bool) -> dict:
+    """The kill-a-primary drill as a measured bench phase: a real writer
+    process ingests through the semi-sync replication barrier and is
+    SIGKILLed mid-group-commit; every shard's primary node directory is
+    yanked; the phase times promotion → first successful post-failover
+    ack, verifies zero acked-event loss and zero duplicates, and waits
+    for the follower re-sync lag to drain to 0
+    (pio_store_replica_lag_events).  The full tear/partition harness
+    (scripts/check_store_failover.py) then runs as a pass/fail gate."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from predictionio_tpu.storage.sharded import ShardedEvents
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from check_store_failover import writer_script
+
+    out: dict = {}
+    n_ack = 60 if smoke else 200
+    tmp = tempfile.mkdtemp(prefix="pio_store_fo")
+    saved_fsync = os.environ.get("PIO_FSYNC")
+    ev = None
+    try:
+        os.environ["PIO_FSYNC"] = "always"
+        p = subprocess.Popen(
+            [sys.executable, "-c", writer_script(tmp, "fo", 10_000_000)],
+            stdout=subprocess.PIPE, text=True)
+        acked = []
+        for line in p.stdout:
+            acked.append(line.strip())
+            if len(acked) >= n_ack:
+                break
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=60)
+        for k in (0, 1):
+            pdir = os.path.join(tmp, f"shard_{k:02d}", "a")
+            if os.path.isdir(pdir):
+                shutil.move(pdir, pdir + ".lost")
+        t0 = time.perf_counter()
+        ev = ShardedEvents(tmp, shards=2, replicas=2)
+        got = [e.event_id for e in ev.scan(1)]      # promotes both shards
+        res = ev.insert_json_batch(
+            [{"event": "buy", "entityType": "user", "entityId": "post",
+              "eventId": "post-0"}], 1)
+        promo_ms = (time.perf_counter() - t0) * 1e3
+        lost = set(acked) - set(got)
+        dups = len(got) - len(set(got))
+        out["store_failover_acked_events"] = len(acked)
+        out["store_failover_lost_events"] = len(lost)
+        out["store_failover_duplicate_events"] = dups
+        out["store_failover_first_ack_after_promotion"] = (
+            "ok" if res[0].get("status") == 201 else f"FAILED: {res[0]}")
+        out["store_failover_promotion_to_first_ack_ms"] = round(promo_ms, 1)
+        t0 = time.perf_counter()
+        residual = -1
+        while time.perf_counter() - t0 < 30:
+            topo = ev.topology_status()
+            residual = sum(s["replicaLagEvents"] for s in topo["perShard"])
+            if residual == 0:
+                break
+            time.sleep(0.05)
+        out["store_failover_lag_drain_s"] = round(
+            time.perf_counter() - t0, 3)
+        out["store_failover_residual_lag_events"] = residual
+        out["store_failover_integrity"] = (
+            "ok" if not lost and not dups and residual == 0
+            else f"FAILED: lost={len(lost)} dups={dups} lag={residual}")
+    finally:
+        if ev is not None:
+            # close BEFORE rmtree even on failure, or leaked follower
+            # threads recreate the deleted tmp dir forever
+            ev.close()
+        if saved_fsync is None:
+            os.environ.pop("PIO_FSYNC", None)
+        else:
+            os.environ["PIO_FSYNC"] = saved_fsync
+        shutil.rmtree(tmp, ignore_errors=True)
+    # the full fault-injection harness (torn replica tails, mid-scan
+    # partition) as a gate
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "scripts", "check_store_failover.py")],
+        capture_output=True, text=True, timeout=600)
+    out["store_failover_drill"] = (
+        "ok" if r.returncode == 0 else "FAILED: " + r.stderr[-300:])
+    return out
+
+
 def bench_serve100k(smoke: bool) -> dict:
     """HTTP serving p50/p95 at the FULL 100k-item catalog (VERDICT r4
     weak #4: never recorded off-tunnel).  Training a 100k-item CCO model
@@ -2576,7 +2768,8 @@ def main() -> int:
     ap.add_argument("--only",
                     choices=["ur", "p50", "als", "scan", "http", "scale", "ingest",
                              "ingest_scale", "serve100k", "serve_scale",
-                             "snapshot", "freshness"],
+                             "snapshot", "freshness", "store_scale",
+                             "store_failover"],
                     default=None)
     ap.add_argument("--scale", action="store_true",
                     help="run only the 1B-scale tiled-path slice")
@@ -2612,6 +2805,8 @@ def main() -> int:
             "serve_scale": lambda: bench_serve_scale(args.smoke),
             "snapshot": lambda: bench_snapshot(args.smoke),
             "freshness": lambda: bench_freshness(args.smoke),
+            "store_scale": lambda: bench_store_scale(args.smoke),
+            "store_failover": lambda: bench_store_failover(args.smoke),
         }[args.only]()
         print(json.dumps(out))
         return 0
@@ -2687,6 +2882,27 @@ def main() -> int:
         "freshness_serve_p95_folding_ms": 0.0,
         "freshness_serve_p95_ratio": 0.0,
         "freshness_serve_guard": "section_failed",
+    })
+    store_scale = _run_section("store_scale", args.smoke, {
+        **{f"store_ingest_s{s}_events_per_sec": 0.0 for s in (1, 2, 4)},
+        **{f"store_scan_s{s}_events_per_sec": 0.0 for s in (1, 2, 4)},
+        **{f"store_scale_integrity_s{s}": "section_failed"
+           for s in (1, 2, 4)},
+        "store_ingest_repl1_events_per_sec": 0.0,
+        "store_ingest_repl2_events_per_sec": 0.0,
+        "store_repl_overhead_ratio": 0.0,
+        "store_scale_events": 0,
+    })
+    store_failover = _run_section("store_failover", args.smoke, {
+        "store_failover_acked_events": 0,
+        "store_failover_lost_events": -1,
+        "store_failover_duplicate_events": -1,
+        "store_failover_promotion_to_first_ack_ms": 0.0,
+        "store_failover_first_ack_after_promotion": "section_failed",
+        "store_failover_lag_drain_s": 0.0,
+        "store_failover_residual_lag_events": -1,
+        "store_failover_integrity": "section_failed",
+        "store_failover_drill": "section_failed",
     })
     snapshot = _run_section("snapshot", args.smoke, {
         "train_cold_snapshot_events_per_sec": 0.0,
@@ -2784,6 +3000,12 @@ def main() -> int:
             # live --follow deploy, exactness parity, serve-p95 guard
             **{k: (round(v, 2) if isinstance(v, float) else v)
                for k, v in freshness.items()},
+            # sharded/replicated event store: shard sweep with
+            # exactly-once integrity per cell + the kill-a-primary drill
+            **{k: (round(v, 1) if isinstance(v, float) else v)
+               for k, v in store_scale.items()},
+            **{k: (round(v, 2) if isinstance(v, float) else v)
+               for k, v in store_failover.items()},
             **({"section_failures": _SECTION_FAILURES}
                if _SECTION_FAILURES else {}),
         },

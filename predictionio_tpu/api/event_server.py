@@ -231,6 +231,16 @@ def make_handler(state: EventServerState):
                 snap = self._snapshot_coverage(ak.app_id)
                 if snap:
                     doc["snapshot"] = snap
+                # sharded/replicated store topology (shards, per-shard
+                # primary + epoch + replica lag) — only on backends that
+                # expose it
+                topo = getattr(state.storage.l_events,
+                               "topology_status", None)
+                if topo is not None:
+                    try:
+                        doc["storeTopology"] = topo()
+                    except OSError:
+                        pass
                 self.send_json(doc)
             elif path.startswith("/events/") and path.endswith(".json"):
                 event_id = path[len("/events/"):-len(".json")]

@@ -12,6 +12,7 @@ import http.client
 import itertools
 import json
 import os
+import random
 import threading
 import time
 import urllib.parse
@@ -40,6 +41,22 @@ class PIOError(Exception):
         self.request_id = request_id
 
 
+def _backoff_delays(window: float):
+    """Bounded exponential backoff with full jitter for reconnects: first
+    retry immediate (the dropped-idle-keep-alive case), then ~50 ms
+    doubling to a 1 s cap, randomized to 50–100% of the step so a client
+    fleet doesn't reconnect in lockstep, until ``window`` seconds have
+    elapsed.  Yields the sleep before each retry attempt (0 = retry now);
+    the caller stops iterating on success."""
+    deadline = time.monotonic() + max(0.0, window)
+    yield 0.0
+    delay = 0.05
+    while time.monotonic() < deadline:
+        yield min(delay, max(0.0, deadline - time.monotonic())) * (
+            0.5 + random.random() * 0.5)
+        delay = min(delay * 2, 1.0)
+
+
 class _Conn:
     """One persistent keep-alive connection per client instance.
 
@@ -48,10 +65,22 @@ class _Conn:
     server, while connection reuse measures ~4-10k/s.  Connections are
     PER-THREAD (threading.local), so a client shared across N worker
     threads issues N parallel keep-alive connections instead of
-    serializing on one socket.  Reconnects transparently once per request
-    only when the request provably never reached the server."""
+    serializing on one socket.
 
-    def __init__(self, base_url: str, timeout: float):
+    Retry contract: a request that provably never reached the server
+    (connection refused, or the send itself failed) is retried with
+    bounded exponential backoff + jitter for up to ``retry_window``
+    seconds — long enough to ride through an event-store failover
+    promotion window instead of erroring on the first refused connect.
+    A failure AFTER the send is NEVER retried for non-idempotent methods
+    (the server may have committed the event; re-sending would silently
+    duplicate it) — the backoff changes nothing about that at-least-once
+    contract, it only retries the provably-unprocessed cases.  Callers
+    that must retry post-send failures should supply client eventIds so
+    the retry is idempotent at read time."""
+
+    def __init__(self, base_url: str, timeout: float,
+                 retry_window: float = 8.0):
         u = urllib.parse.urlsplit(base_url)
         if u.scheme == "https":
             self._make = lambda: http.client.HTTPSConnection(
@@ -60,6 +89,7 @@ class _Conn:
             self._make = lambda: http.client.HTTPConnection(
                 u.hostname, u.port or 80, timeout=timeout)
         self.prefix = u.path.rstrip("/")
+        self.retry_window = retry_window
         self._tl = threading.local()
 
     def request(self, method: str, path_qs: str, body: Any = None) -> Any:
@@ -76,7 +106,8 @@ class _Conn:
             tl.conn.close()
             tl.conn = None
         tl.last_use = time.monotonic()
-        for attempt in (0, 1):
+        delays = _backoff_delays(self.retry_window)
+        while True:
             if getattr(tl, "conn", None) is None:
                 tl.conn = self._make()
             sent = False
@@ -94,8 +125,8 @@ class _Conn:
                 # CannotSendRequest forever)
                 tl.conn.close()
                 tl.conn = None
-                # retry once, but only when the request provably did
-                # not reach the server: connection refused, or the
+                # retry with backoff, but only when the request provably
+                # did not reach the server: connection refused, or the
                 # send itself failed (Content-Length framing means a
                 # partially-received request is never processed).
                 # A failure AFTER the send may mean the server already
@@ -105,12 +136,15 @@ class _Conn:
                     ConnectionRefusedError, ConnectionResetError,
                     BrokenPipeError, http.client.RemoteDisconnected,
                 )) and (not sent or method in ("GET", "DELETE"))
-                if attempt or not retriable:
+                sleep = next(delays, None) if retriable else None
+                if sleep is None:
                     # transport failures keep their type (callers and the
                     # retry contract depend on it); the request id rides
                     # along as an attribute for log joining
                     e.request_id = rid
                     raise
+                if sleep:
+                    time.sleep(sleep)
         if resp.status >= 400:
             try:
                 message = json.loads(payload).get("message", "")
@@ -209,20 +243,39 @@ class _Pipeline:
     _SEND_BUF = 32 * 1024
 
     def __init__(self, base_url: str, depth: int = 128,
-                 timeout: float = 10.0, qs: str = ""):
+                 timeout: float = 10.0, qs: str = "",
+                 retry_window: float = 8.0):
         import socket as _socket
 
         u = urllib.parse.urlsplit(base_url)
+
+        def connect(port):
+            # the pipeline's one TCP connect gets the same bounded
+            # backoff-with-jitter as the serial client: a refused connect
+            # during a failover promotion window is retried for up to
+            # ``retry_window`` seconds before surfacing.  (Nothing has
+            # been sent yet, so this never interacts with the
+            # no-retry-after-send / at-least-once contract below.)
+            delays = _backoff_delays(retry_window)
+            while True:
+                try:
+                    return _socket.create_connection(
+                        (u.hostname, port), timeout=timeout)
+                except ConnectionRefusedError:
+                    sleep = next(delays, None)
+                    if sleep is None:
+                        raise
+                    if sleep:
+                        time.sleep(sleep)
+
         if u.scheme == "https":
             import ssl
 
-            raw = _socket.create_connection(
-                (u.hostname, u.port or 443), timeout=timeout)
+            raw = connect(u.port or 443)
             self._sock = ssl.create_default_context().wrap_socket(
                 raw, server_hostname=u.hostname)
         else:
-            self._sock = _socket.create_connection(
-                (u.hostname, u.port or 80), timeout=timeout)
+            self._sock = connect(u.port or 80)
         self._sock.setsockopt(
             _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
@@ -402,7 +455,8 @@ class EventPipeline(_Pipeline):
     def __init__(self, client: "EventClient", depth: int = 128,
                  timeout: float = 10.0):
         super().__init__(client._base_url, depth=depth, timeout=timeout,
-                         qs=client._qs())
+                         qs=client._qs(),
+                         retry_window=client.retry_window)
 
     def create_event(
         self,
@@ -441,7 +495,8 @@ class QueryPipeline(_Pipeline):
 
     def __init__(self, client: "EngineClient", depth: int = 64,
                  timeout: float = 10.0):
-        super().__init__(client._base_url, depth=depth, timeout=timeout)
+        super().__init__(client._base_url, depth=depth, timeout=timeout,
+                         retry_window=client.retry_window)
 
     def send_query(self, query: Dict[str, Any]) -> AsyncResult:
         return self._send("POST", "/queries.json", query)
@@ -451,12 +506,16 @@ class EventClient:
     """Client for the Event Server (reference: EventClient in the SDKs)."""
 
     def __init__(self, access_key: str, url: str = "http://localhost:7070",
-                 channel: Optional[str] = None, timeout: float = 10.0):
+                 channel: Optional[str] = None, timeout: float = 10.0,
+                 retry_window: float = 8.0):
         self.access_key = access_key
         self.channel = channel
         self.timeout = timeout
+        # how long connection-refused requests back off before surfacing
+        # (failover promotion windows; 0 = fail fast after one retry)
+        self.retry_window = retry_window
         self._base_url = url
-        self._conn = _Conn(url, timeout)
+        self._conn = _Conn(url, timeout, retry_window=retry_window)
 
     def pipeline(self, depth: int = 128) -> EventPipeline:
         """Open a pipelined single-event ingestion session (see
@@ -520,10 +579,12 @@ class EventClient:
 class EngineClient:
     """Client for a deployed engine (reference: EngineClient in the SDKs)."""
 
-    def __init__(self, url: str = "http://localhost:8000", timeout: float = 10.0):
+    def __init__(self, url: str = "http://localhost:8000", timeout: float = 10.0,
+                 retry_window: float = 8.0):
         self.timeout = timeout
+        self.retry_window = retry_window
         self._base_url = url
-        self._conn = _Conn(url, timeout)
+        self._conn = _Conn(url, timeout, retry_window=retry_window)
 
     def send_query(self, query: Dict[str, Any]) -> Dict[str, Any]:
         return self._conn.request("POST", "/queries.json", query)

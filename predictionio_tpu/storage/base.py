@@ -362,6 +362,40 @@ def match_filters(
     return True
 
 
+class StoreCapabilityError(NotImplementedError):
+    """An event backend was asked for an optional capability it does not
+    provide (e.g. the ``scan_tail_from``/``scan_events_up_to`` delta-tail
+    protocol that ``pio deploy --follow`` and delta staging need).  Raised
+    with an actionable message naming the backend and the capability, so
+    the failure is a one-line diagnosis instead of an AttributeError deep
+    in a worker thread."""
+
+
+def delta_tail_supported(backend) -> bool:
+    """True when ``backend`` implements the delta-tail protocol
+    (``scan_tail_from`` + ``scan_events_up_to`` + ``tombstone_state``) —
+    the capability the follow-trainer's fold mode and the retained-batch
+    staging cache require.  localfs/sharedfs/sharded/memory do; a backend
+    that can't should leave the methods undefined and callers surface
+    :class:`StoreCapabilityError` (or degrade) with a clear message."""
+    return all(
+        callable(getattr(backend, name, None))
+        for name in ("scan_tail_from", "scan_events_up_to",
+                     "tombstone_state"))
+
+
+def require_delta_tail(backend, what: str) -> None:
+    """Raise :class:`StoreCapabilityError` with a clear, actionable
+    message when ``backend`` lacks the delta-tail protocol."""
+    if not delta_tail_supported(backend):
+        raise StoreCapabilityError(
+            f"{what} requires the event backend to support the delta-tail "
+            f"protocol (scan_tail_from/scan_events_up_to/tombstone_state), "
+            f"but {type(backend).__module__}.{type(backend).__name__} does "
+            "not provide it; use a localfs, sharedfs, sharded, or memory "
+            "event store, or implement the protocol on the backend")
+
+
 class PEvents(abc.ABC):
     """Bulk training-time reads (reference: PEvents.scala returns RDD[Event]).
 

@@ -874,6 +874,7 @@ class FSEvents(base.LEvents, base.PEvents):
                 batch = None
         if batch is not None:
             err: Optional[BaseException] = None
+            commit_info = None
             try:
                 with self._lock:
                     w = self._writers.get(key)
@@ -890,6 +891,7 @@ class FSEvents(base.LEvents, base.PEvents):
                     w.append(payload)
                     _M_GROUP.observe(len(batch))
                     _M_EVENTS.inc(payload.count("\n"))
+                    commit_info = self._commit_point(key, w)
                     # snapshot auto-trigger: only worth checking when this
                     # commit opened a new segment (rotations are rare; the
                     # default-0 get keeps a resumed writer's first commit
@@ -901,6 +903,16 @@ class FSEvents(base.LEvents, base.PEvents):
                 # a failed write (ENOSPC/EIO) must NACK every event in
                 # the group — none of them is durable
                 err = e
+            if err is None and commit_info is not None:
+                try:
+                    # replication barrier OUTSIDE the instance lock: a
+                    # slow follower must not block unrelated channels, and
+                    # a failed barrier NACKs the whole group exactly like
+                    # a failed write (nothing is acked that a promoted
+                    # follower would not have)
+                    self._post_commit(key, commit_info)
+                except BaseException as e:
+                    err = e
             with g.cond:
                 for i in batch:
                     if err is not None:
@@ -911,6 +923,20 @@ class FSEvents(base.LEvents, base.PEvents):
         err2 = item.get("err")
         if err2 is not None:
             raise err2
+
+    # -- replication hooks (storage.sharded overrides) -----------------------
+
+    def _commit_point(self, key: tuple, writer: _SegmentWriter):
+        """Called by the group-commit leader with the instance lock held,
+        right after the physical write: capture what this commit covered.
+        Replicated backends return (segment path, end offset); the base
+        backend has no barrier and returns None."""
+        return None
+
+    def _post_commit(self, key: tuple, info) -> None:
+        """Called by the leader AFTER the lock is released when
+        ``_commit_point`` returned non-None.  Raising here NACKs every
+        event in the group — the semi-sync replication barrier."""
 
     _COMPACT_INTENT = "compact-intent.json"
     _COMPACT_LOCK = "compact.lock"

@@ -88,11 +88,18 @@ def _sharedfs_source(path: str):
     return sharedfs.SharedFSSource(path)
 
 
+def _sharded_source(spec: Dict[str, str]):
+    from predictionio_tpu.storage import sharded
+
+    return sharded.ShardedSource(spec)
+
+
 _SOURCE_TYPES = {
     "memory": _MemorySource,
     "localfs": _LocalFSSource,
     "sql": sql.SQLSource,
     "sharedfs": _sharedfs_source,
+    "sharded": _sharded_source,
 }
 
 
@@ -116,6 +123,10 @@ class Storage:
                     )
                 if typ in ("localfs", "sharedfs"):
                     self._clients[name] = _SOURCE_TYPES[typ](spec.get("path", ".pio_store"))
+                elif typ == "sharded":
+                    # needs the whole spec: path + shards + replicas
+                    # (PIO_STORAGE_SOURCES_<NAME>_{SHARDS,REPLICAS})
+                    self._clients[name] = _SOURCE_TYPES[typ](spec)
                 elif typ == "sql":
                     # reference JDBC URL ≈ our path; default is an ephemeral db
                     self._clients[name] = _SOURCE_TYPES[typ](spec.get("path", ":memory:"))

@@ -179,10 +179,20 @@ class MemModels(base.Models):
 
 
 class MemEvents(base.LEvents, base.PEvents):
-    """Thread-safe in-memory event store keyed by (app_id, channel_id)."""
+    """Thread-safe in-memory event store keyed by (app_id, channel_id).
+
+    Implements the delta-tail protocol (``scan_tail_from`` /
+    ``scan_events_up_to`` / ``tombstone_state``) over the bucket's
+    insertion order, so ``pio deploy --follow`` and delta staging work on
+    a memory-backed store: the watermark is simply the consumed event
+    COUNT (``{"mem": n}``) plus a bucket generation fingerprint in
+    ``heads`` — deletes/removes/TTL-trims mutate in place, bump the
+    generation, and invalidate every outstanding watermark (callers full
+    restage, exactly like a compacted segment log)."""
 
     def __init__(self):
         self._events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        self._gens: Dict[Tuple[int, Optional[int]], int] = {}
         self._lock = threading.Lock()
 
     def _bucket(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
@@ -196,7 +206,9 @@ class MemEvents(base.LEvents, base.PEvents):
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self._lock:
-            return self._events.pop((app_id, channel_id), None) is not None
+            key = (app_id, channel_id)
+            self._gens[key] = self._gens.get(key, 0) + 1
+            return self._events.pop(key, None) is not None
 
     def compact(self, app_id: int, channel_id: Optional[int] = None,
                 before=None) -> Dict[str, int]:
@@ -212,11 +224,21 @@ class MemEvents(base.LEvents, base.PEvents):
             doomed = [k for k, e in bucket.items() if e.event_time < before]
             for k in doomed:
                 del bucket[k]
+            if doomed:
+                gkey = (app_id, channel_id)
+                self._gens[gkey] = self._gens.get(gkey, 0) + 1
             return {"kept": len(bucket), "expired": len(doomed), "segments": 0}
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         bucket = self._bucket(app_id, channel_id)
         with self._lock:
+            if event.event_id in bucket:
+                # in-place overwrite: neither the count watermark nor the
+                # bucket length moves, so bump the generation or
+                # outstanding delta-tail watermarks would keep validating
+                # against a silently changed prefix
+                key = (app_id, channel_id)
+                self._gens[key] = self._gens.get(key, 0) + 1
             bucket[event.event_id] = event
         return event.event_id
 
@@ -226,7 +248,91 @@ class MemEvents(base.LEvents, base.PEvents):
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
         bucket = self._bucket(app_id, channel_id)
         with self._lock:
-            return bucket.pop(event_id, None) is not None
+            ok = bucket.pop(event_id, None) is not None
+            if ok:
+                # in-place delete reorders nothing but shrinks the prefix
+                # every outstanding count-watermark describes: bump the
+                # generation so holders restage instead of double-reading
+                key = (app_id, channel_id)
+                self._gens[key] = self._gens.get(key, 0) + 1
+            return ok
+
+    # -- delta-tail protocol (count watermark + generation fingerprint) ------
+
+    def tombstone_state(self, app_id: int,
+                        channel_id: Optional[int] = None) -> frozenset:
+        """Deletes are in-place (no tombstone sidecar); the generation
+        fingerprint in the watermark heads is what invalidates staging
+        caches instead, so the tombstone set is always empty."""
+        return frozenset()
+
+    def _tail_state(self, app_id: int, channel_id: Optional[int]):
+        with self._lock:
+            bucket = self._events.get((app_id, channel_id), {})
+            return (list(bucket.values()),
+                    self._gens.get((app_id, channel_id), 0))
+
+    @staticmethod
+    def _columnar(events: List[Event], base=None):
+        """Events → (EventBatch WITH prop_columns, EventIdColumn), via the
+        same wire-dict builder the snapshot tail parser uses — fold-mode
+        consumers (URFoldState.bootstrap → fold_properties) require
+        property columns, which EventBatch.from_events does not carry.
+        With ``base`` (the scan_tail_from contract) codes are assigned in
+        the base batch's dictionaries, mutated in place, so the fold's
+        incremental code-indexed state stays valid across deltas."""
+        from predictionio_tpu.storage.snapshot import ColumnarBuilder
+
+        b = ColumnarBuilder(base=base)
+        for e in events:
+            b.add(e.to_json())
+        return b.finish()
+
+    @classmethod
+    def _tail_result(cls, events: List[Event], gen: int, total: int,
+                     base=None):
+        batch, ids = cls._columnar(events, base=base)
+        return {
+            "batch": batch,
+            "ids": ids,
+            "events": len(events),
+            "watermark": {"mem": total},
+            "heads": {"mem": {"gen": gen}},
+        }
+
+    def scan_tail_from(self, app_id: int, channel_id: Optional[int],
+                       watermark: Dict[str, int], base=None,
+                       heads: Optional[Dict] = None) -> Optional[Dict]:
+        """Events past the count watermark, or None (full restage) when
+        the bucket mutated in place (delete/remove/TTL) since the
+        watermark was taken."""
+        events, gen = self._tail_state(app_id, channel_id)
+        start = int(watermark.get("mem", 0))
+        if heads is not None:
+            want = (heads.get("mem") or {}).get("gen", 0)
+            if want != gen:
+                return None
+        if start > len(events):
+            return None          # bucket shrank under the watermark
+        return self._tail_result(events[start:], gen, len(events),
+                                 base=base)
+
+    def scan_events_up_to(self, app_id: int, channel_id: Optional[int],
+                          watermark: Dict[str, int],
+                          heads: Optional[Dict] = None) -> Optional[Dict]:
+        """The covered prefix a persisted watermark describes (the
+        follow-trainer's crash-restart read), or None when the bucket
+        mutated since."""
+        events, gen = self._tail_state(app_id, channel_id)
+        end = int(watermark.get("mem", 0))
+        if heads is not None:
+            want = (heads.get("mem") or {}).get("gen", 0)
+            if want != gen:
+                return None
+        if end > len(events):
+            return None
+        batch, _ = self._columnar(events[:end])
+        return {"batch": batch, "events": end}
 
     def find(
         self,

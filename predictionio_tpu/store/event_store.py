@@ -260,8 +260,12 @@ class PEventStore:
         # and event-strided processes would partition different spaces and
         # drop events globally.  (All hosts must also run the same image so
         # native_available() agrees; the scanner builds from source on use.)
+        # Every parent directory is checked: a sharded backend's segment
+        # union spans one channel dir PER SHARD, and a tombstone in any of
+        # them makes the whole native scan invalid.
         if any(t.stat().st_size > 0
-               for t in paths[0].parent.glob("tombstones*.txt")):
+               for parent in {p.parent for p in paths}
+               for t in parent.glob("tombstones*.txt")):
             return None  # tombstoned events are invisible to the scanner
         if local_shard:
             from predictionio_tpu.parallel import distributed as dist

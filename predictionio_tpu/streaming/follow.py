@@ -190,7 +190,27 @@ class FollowTrainer:
             raise FoldUnsupported(
                 "follow-trainer needs a data source with an app_name")
         backend = self.storage.l_events
-        self._backend = backend if hasattr(backend, "scan_tail_from") else None
+        from predictionio_tpu.storage.base import (
+            StoreCapabilityError,
+            delta_tail_supported,
+        )
+
+        if delta_tail_supported(backend):
+            self._backend = backend
+        else:
+            # degrade loudly, not obscurely: fold mode is impossible on a
+            # backend without the delta-tail protocol, so every tick will
+            # be a full retrain — name the backend and the missing
+            # capability once, up front (localfs/sharedfs/sharded/memory
+            # all implement it; see StoreCapabilityError)
+            self._backend = None
+            log.warning(
+                "event backend %s.%s does not support the delta-tail "
+                "protocol (scan_tail_from/scan_events_up_to/"
+                "tombstone_state): --follow degrades to full "
+                "retrain-per-tick (%s)",
+                type(backend).__module__, type(backend).__name__,
+                StoreCapabilityError.__name__)
         if (len(algos) == 1 and type(algos[0]) is URAlgorithm
                 and type(prep) is URPreparator
                 and isinstance(ds_params, URDataSourceParams)

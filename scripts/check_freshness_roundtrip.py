@@ -39,6 +39,16 @@ os.environ.setdefault("PIO_UR_SERVE_SCORER", "host")
 ROUNDS = 3
 WAIT_S = 20.0
 
+# --storage sharded [--shards N] runs the same roundtrip over the
+# sharded, replicated event store — the proof that delta staging and
+# `pio deploy --follow` work unchanged when events are hash-partitioned
+STORAGE_TYPE = "localfs"
+SHARDS = 2
+if "--storage" in sys.argv:
+    STORAGE_TYPE = sys.argv[sys.argv.index("--storage") + 1]
+if "--shards" in sys.argv:
+    SHARDS = int(sys.argv[sys.argv.index("--shards") + 1])
+
 
 def buy(u: str, i: str):
     from predictionio_tpu.events.event import Event
@@ -53,8 +63,11 @@ def build_store(path: str):
         Storage, StorageConfig, set_storage,
     )
 
+    src = {"type": STORAGE_TYPE, "path": path}
+    if STORAGE_TYPE == "sharded":
+        src["shards"] = str(SHARDS)
     storage = Storage(StorageConfig(
-        sources={"FS": {"type": "localfs", "path": path}},
+        sources={"FS": src},
         repositories={r: "FS" for r in ("METADATA", "EVENTDATA",
                                         "MODELDATA")}))
     set_storage(storage)

@@ -44,6 +44,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.api.event_server",
     "predictionio_tpu.api.dashboard",
     "predictionio_tpu.storage.localfs",
+    "predictionio_tpu.storage.sharded",
     "predictionio_tpu.storage.snapshot",
     "predictionio_tpu.workflow.core_workflow",
     "predictionio_tpu.workflow.create_server",
@@ -75,6 +76,11 @@ REQUIRED_METRICS = frozenset({
     "pio_follow_lag_events",
     "pio_follow_last_publish_timestamp_seconds",
     "pio_model_generation",
+    # sharded/replicated store contract (PR 9): the failover drill and
+    # replica-lag alerting key on these
+    "pio_store_shard_events_total",
+    "pio_store_replica_lag_events",
+    "pio_store_promotions_total",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

@@ -103,6 +103,67 @@ def check_channel(d: Path) -> list:
     return problems
 
 
+def _channel_ids(chan: Path) -> set:
+    """Live eventIds in a channel dir: complete lines of every segment,
+    minus the unioned tombstones (torn tails skipped, like the scans)."""
+    dead = set()
+    for t in chan.glob("tombstones*.txt"):
+        dead.update(t.read_text().split())
+    ids = set()
+    for seg in sorted(chan.glob("seg-*.jsonl")):
+        data = seg.read_bytes()
+        lines = data.split(b"\n")
+        if lines and not data.endswith(b"\n"):
+            lines = lines[:-1]          # torn tail: never acknowledged
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                eid = json.loads(line).get("eventId")
+            except json.JSONDecodeError:
+                continue
+            if eid and eid not in dead:
+                ids.add(eid)
+    return ids
+
+
+def check_sharded_root(root: Path) -> list:
+    """Sharded-store invariants: every shard's PRIMARY node is a normal
+    localfs tree (its snapshots are verified by check_channel like any
+    other), and the merged cross-shard eventId sets per (app, channel)
+    must be pairwise DISJOINT — an id in two shards means routing broke
+    or a failover duplicated data."""
+    problems = []
+    shards = sorted(p for p in root.glob("shard_*") if p.is_dir())
+    per_chan: dict = {}           # (app/chan relpath) -> {shard: ids}
+    for sd in shards:
+        try:
+            topo = json.loads((sd / "topology.json").read_text())
+            primary = topo.get("primary", "a")
+        except (OSError, json.JSONDecodeError):
+            primary = "a"
+        evroot = sd / primary / "events"
+        if not evroot.exists():
+            continue
+        for chan in sorted(evroot.glob("app_*/*")):
+            if not chan.is_dir():
+                continue
+            key = f"{chan.parent.name}/{chan.name}"
+            per_chan.setdefault(key, {})[sd.name] = _channel_ids(chan)
+    for key, by_shard in sorted(per_chan.items()):
+        owner: dict = {}
+        for shard_name, ids in sorted(by_shard.items()):
+            for eid in ids:
+                if eid in owner:
+                    problems.append(
+                        f"{root}: {key}: eventId {eid!r} present in BOTH "
+                        f"{owner[eid]} and {shard_name} (cross-shard "
+                        "duplicate)")
+                    break               # one example per shard pair
+                owner[eid] = shard_name
+    return problems
+
+
 def main(argv) -> int:
     if not argv:
         print("usage: check_snapshot_integrity.py <store_root>...",
@@ -115,6 +176,14 @@ def main(argv) -> int:
         for manifest in sorted(events.glob("app_*/*/snapshot/manifest.json")):
             checked += 1
             problems.extend(check_channel(manifest.parent.parent))
+        # sharded layout: per-shard per-node manifests + the cross-shard
+        # merged eventId disjointness sweep
+        for manifest in sorted(Path(root).glob(
+                "shard_*/*/events/app_*/*/snapshot/manifest.json")):
+            checked += 1
+            problems.extend(check_channel(manifest.parent.parent))
+        if any(Path(root).glob("shard_*")):
+            problems.extend(check_sharded_root(Path(root)))
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:

@@ -14,7 +14,8 @@ def ts(h):
     return dt.datetime(2026, 1, 1, h, tzinfo=dt.timezone.utc)
 
 
-@pytest.fixture(params=["memory", "localfs", "sql", "sqlfile", "sharedfs"])
+@pytest.fixture(params=["memory", "localfs", "sql", "sqlfile", "sharedfs",
+                        "sharded"])
 def storage(request, tmp_path):
     if request.param == "memory":
         src = {"type": "memory"}
@@ -24,13 +25,23 @@ def storage(request, tmp_path):
         src = {"type": "sql", "path": ":memory:"}
     elif request.param == "sharedfs":
         src = {"type": "sharedfs", "path": str(tmp_path / "shared")}
+    elif request.param == "sharded":
+        # 3 shards × 2 replicas: every generic storage test also runs
+        # through entity routing, fan-out merge, and the semi-sync
+        # replication barrier
+        src = {"type": "sharded", "path": str(tmp_path / "sharded"),
+               "shards": "3", "replicas": "2"}
     else:
         src = {"type": "sql", "path": str(tmp_path / "pio.db")}
     cfg = StorageConfig(
         sources={"S": src},
         repositories={"METADATA": "S", "EVENTDATA": "S", "MODELDATA": "S"},
     )
-    return Storage(cfg)
+    st = Storage(cfg)
+    yield st
+    ev = st.l_events
+    if hasattr(ev, "close"):
+        ev.close()      # stop replication follower threads
 
 
 def test_apps_crud(storage):
@@ -171,7 +182,12 @@ def test_pevents_find_batches(storage):
                         target_entity_type="item", target_entity_id=f"i{k % 4}",
                         event_time=ts(k % 23)), 3)
     batches = list(storage.p_events.find_batches(3, batch_size=4))
-    assert [len(b) for b in batches] == [4, 4, 2]
+    if hasattr(storage.p_events, "topology_status"):
+        # the sharded backend serves snapshot-first: one merged columnar
+        # batch per scan (same contract as localfs with a built snapshot)
+        assert sum(len(b) for b in batches) == 10
+    else:
+        assert [len(b) for b in batches] == [4, 4, 2]
     assert all(b.target_ids.min() >= 0 for b in batches)
 
 
@@ -614,3 +630,90 @@ def test_insert_after_crashed_commit_recovers_first(tmp_path):
     got = [e.entity_id for e in FSEvents(tmp_path)._iter_raw(1, None)]
     assert "POSTCRASH" in got
     assert len(got) == 6  # 5 compacted survivors + the new event
+
+
+# -- memory delta-tail protocol (PR 9 satellite) -----------------------------
+
+
+def _mem_events():
+    from predictionio_tpu.storage.memory import MemEvents
+
+    return MemEvents()
+
+
+def test_memory_delta_tail_roundtrip():
+    """MemEvents speaks the delta-tail protocol: a count watermark + a
+    generation fingerprint, so `pio deploy --follow` and delta staging
+    work on a memory-backed store."""
+    ev = _mem_events()
+    for k in range(6):
+        ev.insert(Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                        event_id=f"e{k}"), 1)
+    full = ev.scan_tail_from(1, None, {}, base=None, heads=None)
+    assert full["events"] == 6
+    assert full["watermark"] == {"mem": 6}
+    assert sorted(full["ids"].tolist()) == sorted(f"e{k}" for k in range(6))
+    # nothing new → empty tail with the same watermark
+    tail = ev.scan_tail_from(1, None, full["watermark"],
+                             heads=full["heads"])
+    assert tail["events"] == 0
+    # appends land in the tail only
+    ev.insert(Event(event="buy", entity_type="user", entity_id="u9",
+                    event_id="new1"), 1)
+    tail = ev.scan_tail_from(1, None, full["watermark"],
+                             heads=full["heads"])
+    assert tail["events"] == 1 and tail["ids"].tolist() == ["new1"]
+    assert tail["watermark"] == {"mem": 7}
+    # bounded restart read reconstructs exactly the covered prefix
+    bound = ev.scan_events_up_to(1, None, full["watermark"],
+                                 heads=full["heads"])
+    assert bound["events"] == 6
+    assert ev.tombstone_state(1) == frozenset()
+
+
+def test_memory_delta_tail_invalidated_by_mutation():
+    """In-place mutations (delete / remove / TTL trim) bump the bucket
+    generation: every outstanding watermark then reads None (full
+    restage), never a double-read or a stale suffix."""
+    ev = _mem_events()
+    for k in range(4):
+        ev.insert(Event(event="buy", entity_type="user", entity_id=f"u{k}",
+                        event_id=f"e{k}", event_time=ts(k + 1)), 1)
+    full = ev.scan_tail_from(1, None, {}, base=None, heads=None)
+    assert ev.delete("e1", 1)
+    assert ev.scan_tail_from(1, None, full["watermark"],
+                             heads=full["heads"]) is None
+    assert ev.scan_events_up_to(1, None, full["watermark"],
+                                heads=full["heads"]) is None
+    # restage reflects the delete and a TTL trim invalidates again
+    full2 = ev.scan_tail_from(1, None, {}, base=None, heads=None)
+    assert full2["events"] == 3
+    ev.compact(1, before=ts(3))
+    assert ev.scan_tail_from(1, None, full2["watermark"],
+                             heads=full2["heads"]) is None
+    # remove() clears the bucket AND invalidates
+    ev2 = _mem_events()
+    ev2.insert(Event(event="buy", entity_type="user", entity_id="u1"), 2)
+    f = ev2.scan_tail_from(2, None, {}, base=None, heads=None)
+    ev2.remove(2)
+    assert ev2.scan_tail_from(2, None, f["watermark"],
+                              heads=f["heads"]) is None
+
+
+def test_delta_tail_capability_helpers():
+    """The capability probe + the clear error for backends without the
+    delta-tail protocol."""
+    import pytest as _pytest
+
+    from predictionio_tpu.storage import base as _base
+    from predictionio_tpu.storage.localfs import FSEvents
+    from predictionio_tpu.storage.sql import SQLSource
+
+    assert _base.delta_tail_supported(_mem_events())
+    assert _base.delta_tail_supported(FSEvents("/tmp/_cap_probe"))
+    sql_events = SQLSource(":memory:").events
+    assert not _base.delta_tail_supported(sql_events)
+    with _pytest.raises(_base.StoreCapabilityError) as ei:
+        _base.require_delta_tail(sql_events, "pio deploy --follow")
+    assert "scan_tail_from" in str(ei.value)
+    assert "SQL" in type(sql_events).__name__ or "sql" in str(ei.value)

@@ -702,3 +702,15 @@ def test_check_freshness_roundtrip_script():
          str(REPO / "scripts" / "check_freshness_roundtrip.py")],
         capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_check_freshness_roundtrip_sharded():
+    """The same roundtrip over the sharded event store (shards=2):
+    `pio deploy --follow` and delta staging work unchanged when events
+    are hash-partitioned — the PR 9 acceptance gate."""
+    r = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_freshness_roundtrip.py"),
+         "--storage", "sharded", "--shards", "2"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
