@@ -2187,6 +2187,322 @@ def bench_serve_scale(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _freshness_catalog_sweep(smoke: bool) -> dict:
+    """ISSUE-11 headline proof: streaming freshness at MILLION-item
+    catalogs, items ∈ {100k, 300k, 1M}.  Each size builds a real event
+    log (one purchase per item so the whole catalog trains, 4-item user
+    histories so co-occurrence stays O(events)), trains the initial
+    model through the normal ``engine.train`` (the pure-COO sparse host
+    path makes this possible on CPU at 1M items — the dense count
+    matrix would be 4 TB), deploys it with an embedded ``--follow``
+    trainer, and measures:
+
+    - the follower STAYS IN FOLD MODE under the default 1 GiB
+      PIO_FOLLOW_STATE_BYTES at every size (the PR-8 dense state
+      demoted to retrain-per-tick past ~16k items:
+      ``freshness_scale_fold_guard``), with ``stateMode == sparse``;
+    - append→reflected p99 ≤ 10 s per size
+      (``freshness_scale_p99_guard``);
+    - ``pio_follow_state_bytes`` grows with the EVENT count, not
+      catalog²: largest/smallest state ratio bounded by 3× the event
+      ratio (``freshness_scale_state_guard`` — the catalog² ratio would
+      be 100×);
+    - post-drain HTTP responses are EXACTLY a from-scratch retrain's
+      (``freshness_scale_parity``), the retrain running after the
+      deploy exits so peak memory holds one model at a time.
+    """
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.storage.locator import (
+        Storage, StorageConfig, set_storage,
+    )
+    from predictionio_tpu.workflow import core_workflow
+
+    if smoke:
+        sizes, rounds, hist = (1_000, 4_000), 2, 4
+    else:
+        sizes, rounds, hist = (100_000, 300_000, 1_000_000), 3, 4
+    out: dict = {"freshness_scale_items": list(sizes),
+                 "freshness_scale_fold_guard": "not_run",
+                 "freshness_scale_p99_guard": "not_run",
+                 "freshness_scale_state_guard": "not_run",
+                 "freshness_scale_parity": "not_run"}
+    per_size: dict = {}
+    fold_ok, p99_ok, parity_ok = True, True, True
+    problems = []
+    for n_items in sizes:
+        tmp = tempfile.mkdtemp(prefix=f"pio_bench_fresh{n_items}")
+        proc = None
+        port = None
+        cell = {}
+        try:
+            storage = Storage(StorageConfig(
+                sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
+                repositories={r: "FS" for r in ("METADATA", "EVENTDATA",
+                                                "MODELDATA")}))
+            set_storage(storage)
+            app_id = storage.apps.insert(App(0, f"freshcat{n_items}"))
+            # user k//hist buys item k: every item in the catalog, each
+            # user a hist-item history → cross-join (nnz) is O(events)
+            evs = [Event(event="buy", entity_type="user",
+                         entity_id=f"u{k // hist}",
+                         target_entity_type="item",
+                         target_entity_id=f"i{k}")
+                   for k in range(n_items)]
+            for s in range(0, len(evs), 20_000):
+                storage.l_events.insert_batch(evs[s:s + 20_000], app_id)
+            n_inserted = len(evs)
+            variant = {
+                "id": f"bench-freshcat{n_items}",
+                "engineFactory": "predictionio_tpu.models."
+                                 "universal_recommender."
+                                 "UniversalRecommenderEngine",
+                "datasource": {"params": {"appName": f"freshcat{n_items}",
+                                          "eventNames": ["buy"]}},
+                "algorithms": [{"name": "ur", "params": {
+                    "appName": f"freshcat{n_items}", "meshDp": 1,
+                    "maxCorrelatorsPerItem": 8}}],
+            }
+            ur_json = f"{tmp}/engine.json"
+            with open(ur_json, "w") as f:
+                json.dump(variant, f)
+            from predictionio_tpu.models.universal_recommender import (
+                UniversalRecommenderEngine,
+            )
+
+            engine = UniversalRecommenderEngine.apply()
+            ep = engine.engine_params_from_variant(variant)
+            t_train0 = time.perf_counter()
+            core_workflow.run_train(
+                engine, ep, engine_id=f"bench-freshcat{n_items}",
+                storage=storage)
+            cell["train_s"] = round(time.perf_counter() - t_train0, 2)
+            env = {
+                **os.environ,
+                "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+                "PIO_STORAGE_SOURCES_FS_PATH": f"{tmp}/store",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+                "PIO_JAX_PLATFORM": os.environ.get("PIO_JAX_PLATFORM",
+                                                   "cpu"),
+            }
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.cli.main",
+                 "deploy", "--engine-json", ur_json, "--ip", "127.0.0.1",
+                 "--port", str(port), "--follow", "0.2"],
+                env=env)
+            base = f"http://127.0.0.1:{port}"
+            deadline = time.time() + 600
+            while True:
+                try:
+                    with urllib.request.urlopen(base + "/", timeout=2):
+                        break
+                except OSError:
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"deploy died at {n_items} items "
+                            f"(rc {proc.returncode})")
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"deploy not up in 600s at {n_items} items")
+                    time.sleep(0.5)
+
+            def follower_stats():
+                with urllib.request.urlopen(base + "/stats.json",
+                                            timeout=10) as r:
+                    return json.loads(r.read()).get(
+                        "freshness", {}).get("follower", {})
+
+            def drain(expected, timeout=600.0):
+                end = time.time() + timeout
+                while time.time() < end:
+                    fr = follower_stats()
+                    idle = fr.get("lastOutcome") in ("idle", "disabled")
+                    cov = fr.get("coveredEvents")
+                    if idle and cov is None:
+                        # retrain mode reports no covered count — return
+                        # immediately so the mode assertion fails fast
+                        # instead of burning the timeout per drain
+                        return fr
+                    if idle and cov >= expected:
+                        return fr
+                    time.sleep(0.25)
+                return None
+
+            fr = drain(n_inserted)
+            if fr is None:
+                problems.append(f"{n_items}: bootstrap never drained")
+                fold_ok = False
+                continue
+            lat = []
+            for r in range(rounds):
+                seed_item = f"i{(r * 97) % n_items}"
+                probe_user = f"probe{r}"
+                storage.l_events.insert_batch(
+                    [Event(event="buy", entity_type="user",
+                           entity_id=probe_user,
+                           target_entity_type="item",
+                           target_entity_id=seed_item)], app_id)
+                n_inserted += 1
+                drain(n_inserted)
+                new_item = f"fresh_item_{r}"
+                t0 = time.time()
+                adds = []
+                for j in range(6):
+                    for tgt in (seed_item, new_item):
+                        adds.append(Event(
+                            event="buy", entity_type="user",
+                            entity_id=f"cob{r}_{j}",
+                            target_entity_type="item",
+                            target_entity_id=tgt))
+                storage.l_events.insert_batch(adds, app_id)
+                n_inserted += len(adds)
+                reflected = None
+                while time.time() - t0 < 60:
+                    body = json.dumps({"user": probe_user,
+                                       "num": 30}).encode()
+                    req = urllib.request.Request(
+                        base + "/queries.json", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        doc = json.loads(resp.read())
+                    if any(x["item"] == new_item
+                           for x in doc["itemScores"]):
+                        reflected = (time.time() - t0) * 1e3
+                        break
+                    time.sleep(0.05)
+                if reflected is None:
+                    problems.append(f"{n_items}: round {r} never "
+                                    "reflected")
+                    p99_ok = False
+                else:
+                    lat.append(reflected)
+            fr = drain(n_inserted) or follower_stats()
+            cell["mode"] = fr.get("mode")
+            cell["state_mode"] = fr.get("stateMode")
+            cell["state_bytes"] = int(fr.get("stateBytes") or 0)
+            cell["covered_events"] = fr.get("coveredEvents")
+            cell["p50_ms"] = round(float(np.percentile(lat, 50)), 1) \
+                if lat else None
+            cell["p99_ms"] = round(float(np.percentile(lat, 99)), 1) \
+                if lat else None
+            if fr.get("mode") != "fold" or fr.get("stateMode") != "sparse":
+                fold_ok = False
+                problems.append(
+                    f"{n_items}: mode={fr.get('mode')}/"
+                    f"{fr.get('stateMode')} (expected fold/sparse)")
+            if not lat or max(lat) > 10_000 or len(lat) < rounds:
+                p99_ok = False
+            # collect parity probes BEFORE stopping the deploy
+            probe_bodies = (
+                [{"user": f"u{(j * 131) % max(n_items // hist, 1)}",
+                  "num": 10} for j in range(6)]
+                + [{"user": f"probe{r}", "num": 10}
+                   for r in range(rounds)]
+                + [{"user": "never-seen", "num": 5}])
+            got_http = []
+            for bodyd in probe_bodies:
+                req = urllib.request.Request(
+                    base + "/queries.json",
+                    data=json.dumps(bodyd).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    doc = json.loads(resp.read())
+                got_http.append([(x["item"], float(x["score"]))
+                                 for x in doc["itemScores"]])
+            # stop the deploy first: the reference retrain then holds
+            # the only full-size model in memory
+            try:
+                urllib.request.urlopen(f"{base}/stop", timeout=10).read()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            proc = None
+            from predictionio_tpu.models.universal_recommender import (
+                URQuery,
+            )
+            from predictionio_tpu.models.universal_recommender.engine import (
+                URAlgorithm,
+            )
+            from predictionio_tpu.store.event_store import (
+                invalidate_staging_cache,
+            )
+
+            invalidate_staging_cache()
+            os.environ["PIO_UR_SERVE_SCORER"] = "host"
+            ref = engine.train(ep)[0]
+            algo = URAlgorithm(ep.algorithm_params_list[0][1])
+            mismatches = 0
+            for bodyd, got in zip(probe_bodies, got_http):
+                want = [(sc.item, float(sc.score)) for sc in algo.predict(
+                    ref, URQuery.from_json(bodyd)).item_scores]
+                if got != want:
+                    mismatches += 1
+            if mismatches:
+                parity_ok = False
+                problems.append(f"{n_items}: {mismatches}/"
+                                f"{len(probe_bodies)} probes diverged "
+                                "from the from-scratch retrain")
+            del ref
+        except Exception as e:  # noqa: BLE001 - record, continue sweep
+            problems.append(f"{n_items}: {type(e).__name__}: {e}")
+            fold_ok = False
+        finally:
+            if proc is not None:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/stop", timeout=5).read()
+                except OSError:
+                    pass
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            set_storage(None)
+            shutil.rmtree(tmp, ignore_errors=True)
+            # record whatever the cell measured, even when an early
+            # failure path bailed out of the try (partial diagnostics
+            # beat a vanished size)
+            per_size[str(n_items)] = cell
+    out["freshness_scale_cells"] = per_size
+    sizes_done = [s for s in sizes if str(s) in per_size
+                  and per_size[str(s)].get("state_bytes")]
+    if len(sizes_done) >= 2:
+        b_lo = per_size[str(sizes_done[0])]["state_bytes"]
+        b_hi = per_size[str(sizes_done[-1])]["state_bytes"]
+        ev_ratio = sizes_done[-1] / sizes_done[0]
+        ratio = b_hi / max(b_lo, 1)
+        out["freshness_scale_state_ratio"] = round(ratio, 2)
+        out["freshness_scale_state_guard"] = (
+            "ok" if ratio <= 3 * ev_ratio
+            else f"FAIL state grew {ratio:.1f}x for {ev_ratio:.0f}x "
+                 f"events (catalog**2 would be {ev_ratio ** 2:.0f}x)")
+    out["freshness_scale_fold_guard"] = (
+        "ok" if fold_ok else "FAIL " + "; ".join(problems[:3]))
+    out["freshness_scale_p99_guard"] = (
+        "ok" if p99_ok and fold_ok
+        else "FAIL " + "; ".join(problems[:3]))
+    out["freshness_scale_parity"] = (
+        "ok" if parity_ok and fold_ok
+        else "FAIL " + "; ".join(problems[:3]))
+    return out
+
+
 def bench_freshness(smoke: bool) -> dict:
     """Streaming freshness: a REAL ``pio deploy --follow`` subprocess
     (embedded follow-trainer hot-swapping the live model) measured on
@@ -2313,17 +2629,31 @@ def bench_freshness(smoke: bool) -> dict:
                                         timeout=10) as r:
                 return json.loads(r.read())
 
-        def drain(timeout=30.0):
-            """Wait until the embedded follower has folded everything."""
+        n_inserted = len(evs)
+
+        def drain(timeout=30.0, expected=None):
+            """Wait until the embedded follower has folded everything.
+            ``expected`` (event count) makes the wait deterministic — a
+            bare "idle" can be a tick that ran BEFORE an append became
+            visible; without it, settle for idle + a stable
+            coveredEvents across two polls."""
             end = time.time() + timeout
+            last_cov = -1
             while time.time() < end:
                 fr = stats().get("freshness", {}).get("follower", {})
-                if fr.get("lastOutcome") in ("idle", "disabled"):
+                cov = fr.get("coveredEvents")
+                idle = fr.get("lastOutcome") in ("idle", "disabled")
+                if idle and cov is None:
                     return True
+                if idle and expected is not None and cov >= expected:
+                    return True
+                if idle and expected is None and cov == last_cov:
+                    return True
+                last_cov = cov
                 time.sleep(0.1)
             return False
 
-        drain()
+        drain(expected=n_inserted)
         # -- append→reflected latency rounds ----------------------------
         lat = []
         for r in range(rounds):
@@ -2334,12 +2664,14 @@ def bench_freshness(smoke: bool) -> dict:
             # so reflection == the new co-occurring item appearing
             storage.l_events.insert_batch(
                 buys([probe_user], [seed_item]), app_id)
-            drain()
+            n_inserted += 1
+            drain(expected=n_inserted)
             t0 = time.time()
             cobuyers = [f"cob{r}_{j}" for j in range(6)]
             storage.l_events.insert_batch(
                 buys(cobuyers, [seed_item] * 6)
                 + buys(cobuyers, [new_item] * 6), app_id)
+            n_inserted += 12
             reflected = None
             while time.time() - t0 < 30:
                 body = json.dumps({"user": probe_user, "num": 30}).encode()
@@ -2366,7 +2698,7 @@ def bench_freshness(smoke: bool) -> dict:
         else:
             out["freshness_p99_guard"] = "FAIL no round reflected"
         # -- exactness parity vs a from-scratch retrain -----------------
-        drain()
+        drain(expected=n_inserted)
         from predictionio_tpu.models.universal_recommender import URQuery
         from predictionio_tpu.models.universal_recommender.engine import (
             URAlgorithm,
@@ -2438,7 +2770,6 @@ def bench_freshness(smoke: bool) -> dict:
         out["freshness_serve_guard"] = (
             "ok" if ratio <= 1.05
             else f"FAIL ratio={ratio:.3f} (>1.05)")
-        return out
     finally:
         if proc is not None:
             try:
@@ -2452,6 +2783,10 @@ def bench_freshness(smoke: bool) -> dict:
                 proc.kill()
         set_storage(None)
         shutil.rmtree(tmp, ignore_errors=True)
+    # the catalog sweep runs after the small-shape deploy is down, so
+    # each size's deploy subprocess is the only model resident
+    out.update(_freshness_catalog_sweep(smoke))
+    return out
 
 
 def bench_scale(smoke: bool) -> dict:
@@ -2882,6 +3217,10 @@ def main() -> int:
         "freshness_serve_p95_folding_ms": 0.0,
         "freshness_serve_p95_ratio": 0.0,
         "freshness_serve_guard": "section_failed",
+        "freshness_scale_fold_guard": "section_failed",
+        "freshness_scale_p99_guard": "section_failed",
+        "freshness_scale_state_guard": "section_failed",
+        "freshness_scale_parity": "section_failed",
     })
     store_scale = _run_section("store_scale", args.smoke, {
         **{f"store_ingest_s{s}_events_per_sec": 0.0 for s in (1, 2, 4)},

@@ -799,6 +799,34 @@ def _cross_join_pairs(p: _SparseHostCSR, a: _SparseHostCSR) -> int:
     return int((p.deg[:n] * a.deg[:n]).sum())
 
 
+def _cross_join_flat_chunks(p: _SparseHostCSR, a: _SparseHostCSR):
+    """Yield the cross-join's flat cell indices (p_item·I_t + a_item,
+    int64) in chunks of ≤ ~_SPARSE_CHUNK_PAIRS pairs — the ONE
+    expansion loop behind every host count strategy.  Chunking over
+    primary entries keeps the ~5 pair-length temporaries bounded
+    (~8·chunk bytes each) instead of scaling with the full pair
+    budget."""
+    I_t = a.n_items
+    rep_all = a.deg[p.user]                   # partners per primary entry
+    csum_all = np.cumsum(rep_all)
+    lo = 0
+    while lo < len(p.user):
+        hi = int(np.searchsorted(
+            csum_all, (csum_all[lo - 1] if lo else 0) + _SPARSE_CHUNK_PAIRS,
+            side="left")) + 1
+        hi = min(max(hi, lo + 1), len(p.user))
+        rep = rep_all[lo:hi]
+        chunk = int(rep.sum())
+        if chunk:
+            p_rep = np.repeat(p.item[lo:hi], rep)
+            offs = np.repeat(a.start[p.user[lo:hi]], rep)
+            csum = np.cumsum(rep)
+            within = np.arange(chunk, dtype=np.int64) - np.repeat(
+                csum - rep, rep)
+            yield p_rep.astype(np.int64) * I_t + a.item[offs + within]
+        lo = hi
+
+
 def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR,
                    want_coo: bool = False,
                    total_pairs: Optional[int] = None):
@@ -836,41 +864,21 @@ def _sparse_counts(p: _SparseHostCSR, a: _SparseHostCSR,
         empty = np.empty(0, np.int64)
         return (C.reshape(I_p, I_t), empty) if want_coo \
             else C.reshape(I_p, I_t)
-    rep_all = a.deg[p.user]                   # partners per primary entry
-    csum_all = np.cumsum(rep_all)
-    # chunk the expansion over primary entries so the ~5 pair-length
-    # temporaries stay bounded (~8·chunk bytes each) instead of scaling
-    # with the full pair budget
-    lo = 0
-    while lo < len(p.user):
-        hi = int(np.searchsorted(
-            csum_all, (csum_all[lo - 1] if lo else 0) + _SPARSE_CHUNK_PAIRS,
-            side="left")) + 1
-        hi = min(max(hi, lo + 1), len(p.user))
-        rep = rep_all[lo:hi]
-        chunk = int(rep.sum())
-        if chunk:
-            p_rep = np.repeat(p.item[lo:hi], rep)
-            offs = np.repeat(a.start[p.user[lo:hi]], rep)
-            csum = np.cumsum(rep)
-            within = np.arange(chunk, dtype=np.int64) - np.repeat(
-                csum - rep, rep)
-            flat = p_rep.astype(np.int64) * I_t + a.item[offs + within]
-            if I_p * I_t <= _SPARSE_BINCOUNT_CELLS and chunk * 8 >= I_p * I_t:
-                # dense-ish chunk over a small matrix: an O(n + cells)
-                # bincount pass beats the sort-based unique.  Gated on
-                # BOTH sizes — with few pairs the per-chunk full-width
-                # histogram (+ astype + add over every cell) would be a
-                # constant-factor and 128 MB-peak regression exactly in
-                # the low-density regime this path serves.
-                C += np.bincount(flat, minlength=I_p * I_t).astype(np.int32)
-                touched = None   # identities lost; tail rescans (≤ gate)
-            else:
-                cells, counts = np.unique(flat, return_counts=True)
-                C[cells] += counts.astype(np.int32)
-                if touched is not None:
-                    touched.append(cells)
-        lo = hi
+    for flat in _cross_join_flat_chunks(p, a):
+        if I_p * I_t <= _SPARSE_BINCOUNT_CELLS and len(flat) * 8 >= I_p * I_t:
+            # dense-ish chunk over a small matrix: an O(n + cells)
+            # bincount pass beats the sort-based unique.  Gated on
+            # BOTH sizes — with few pairs the per-chunk full-width
+            # histogram (+ astype + add over every cell) would be a
+            # constant-factor and 128 MB-peak regression exactly in
+            # the low-density regime this path serves.
+            C += np.bincount(flat, minlength=I_p * I_t).astype(np.int32)
+            touched = None   # identities lost; tail rescans (≤ gate)
+        else:
+            cells, counts = np.unique(flat, return_counts=True)
+            C[cells] += counts.astype(np.int32)
+            if touched is not None:
+                touched.append(cells)
     if not want_coo:
         return C.reshape(I_p, I_t)
     if touched is None:
@@ -895,6 +903,46 @@ def _llr_cells(k11, rc_g, cc_g, n_total, llr_threshold):
     s = llr_score(k11, k12, k21, k22)
     s = jnp.where(k11 > 0, s, -jnp.inf)
     return jnp.where(s >= llr_threshold, s, -jnp.inf)
+
+
+def _llr_topk_cells(rows, cols, k11, rc_g, cc_g, n_total, llr_threshold,
+                    n_rows: int, width: int):
+    """Shared sparse selection tail: score pre-gathered nonzero cells
+    (``_llr_cells`` — the identical elementwise chain as the dense tail,
+    so each cell's f32 value is bit-identical) and select each row's top
+    ``width`` by (score desc, column asc) — exactly ``lax.top_k``'s
+    stable tie order — into ``[n_rows, width]`` outputs.  ``rows`` are
+    output-local row indices in ``[0, n_rows)``."""
+    out_s = np.full((n_rows, width), -np.inf, np.float32)
+    out_i = np.full((n_rows, width), -1, np.int32)
+    if len(rows):
+        # bucket the gather length to the next power of two (zero-padded
+        # k11 scores to -inf and is filtered below) so _llr_cells compiles
+        # once per bucket, not once per distinct nnz
+        nnz = len(rows)
+        pad = 1 << (nnz - 1).bit_length()
+        k11_p = np.zeros(pad, np.float32)
+        rc_p = np.ones(pad, np.float32)
+        cc_p = np.ones(pad, np.float32)
+        k11_p[:nnz] = k11
+        rc_p[:nnz] = rc_g
+        cc_p[:nnz] = cc_g
+        scores = np.asarray(_llr_cells(
+            k11_p, rc_p, cc_p,
+            jnp.float32(n_total), jnp.float32(llr_threshold)))[:nnz]
+        keep = scores > -np.inf
+        rows, cols, scores = rows[keep], cols[keep], scores[keep]
+    if len(rows):
+        # row-major, score desc within row, column asc on ties
+        order = np.lexsort((cols, -scores, rows))
+        rows, cols, scores = rows[order], cols[order], scores[order]
+        starts = np.concatenate([[0], np.flatnonzero(np.diff(rows)) + 1])
+        counts = np.diff(np.concatenate([starts, [len(rows)]]))
+        rank = np.arange(len(rows)) - np.repeat(starts, counts)
+        sel = rank < width
+        out_s[rows[sel], rank[sel]] = scores[sel]
+        out_i[rows[sel], rank[sel]] = cols[sel]
+    return out_s, out_i
 
 
 def _llr_topk_sparse_host(C, rc, cc, n_total, llr_threshold,
@@ -922,37 +970,70 @@ def _llr_topk_sparse_host(C, rc, cc, n_total, llr_threshold,
     if exclude_self:
         off_diag = rows != cols
         rows, cols = rows[off_diag], cols[off_diag]
-    width = min(top_k, I_t)
-    out_s = np.full((I_p, width), -np.inf, np.float32)
-    out_i = np.full((I_p, width), -1, np.int32)
-    if len(rows):
-        # bucket the gather length to the next power of two (zero-padded
-        # k11 scores to -inf and is filtered below) so _llr_cells compiles
-        # once per bucket, not once per distinct nnz
-        nnz = len(rows)
-        pad = 1 << (nnz - 1).bit_length()
-        k11 = np.zeros(pad, np.float32)
-        rc_g = np.ones(pad, np.float32)
-        cc_g = np.ones(pad, np.float32)
-        k11[:nnz] = C[rows, cols]
-        rc_g[:nnz] = rc[rows]
-        cc_g[:nnz] = cc[cols]
-        scores = np.asarray(_llr_cells(
-            k11, rc_g, cc_g,
-            jnp.float32(n_total), jnp.float32(llr_threshold)))[:nnz]
-        keep = scores > -np.inf
-        rows, cols, scores = rows[keep], cols[keep], scores[keep]
-    if len(rows):
-        # row-major, score desc within row, column asc on ties
-        order = np.lexsort((cols, -scores, rows))
-        rows, cols, scores = rows[order], cols[order], scores[order]
-        starts = np.concatenate([[0], np.flatnonzero(np.diff(rows)) + 1])
-        counts = np.diff(np.concatenate([starts, [len(rows)]]))
-        rank = np.arange(len(rows)) - np.repeat(starts, counts)
-        sel = rank < width
-        out_s[rows[sel], rank[sel]] = scores[sel]
-        out_i[rows[sel], rank[sel]] = cols[sel]
-    return out_s, out_i
+    return _llr_topk_cells(rows, cols, C[rows, cols], rc[rows], cc[cols],
+                           n_total, llr_threshold, I_p, min(top_k, I_t))
+
+
+def _llr_topk_sparse_rows(cell_rows, cell_cols, cell_counts, rc_rows, cc,
+                          n_total, llr_threshold, top_k: int,
+                          n_rows: int, n_cols: int,
+                          self_cols: Optional[np.ndarray] = None):
+    """Row-scoped twin of ``_llr_topk_sparse_host`` working straight from
+    COO cells — the fold engine's re-LLR tail, and the pure-COO training
+    tail's core.  ``cell_rows`` are LOCAL row indices in ``[0, n_rows)``
+    (a subset gather of the resident sparse count state), ``rc_rows``
+    the row marginals FOR THOSE ROWS, ``cc`` the full column marginal.
+    ``self_cols[r]`` is row r's GLOBAL column id to exclude (the
+    self-pair when the rows are a slice of the primary×primary type);
+    None disables the mask.  Output is bit-identical to slicing
+    ``_llr_topk_dense``'s result at the same rows: the scores come from
+    the same elementwise chain and the selection reproduces lax.top_k's
+    (score desc, column asc) order."""
+    rows = np.asarray(cell_rows, np.int64)
+    cols = np.asarray(cell_cols, np.int64)
+    counts = np.asarray(cell_counts)
+    if self_cols is not None and len(rows):
+        keep = cols != np.asarray(self_cols, np.int64)[rows]
+        rows, cols, counts = rows[keep], cols[keep], counts[keep]
+    rc_rows = np.asarray(rc_rows)
+    cc = np.asarray(cc)
+    return _llr_topk_cells(rows, cols, counts.astype(np.float32),
+                           rc_rows[rows], cc[cols], n_total, llr_threshold,
+                           n_rows, min(top_k, n_cols))
+
+
+def _sparse_counts_coo(p: _SparseHostCSR, a: _SparseHostCSR,
+                       total_pairs: Optional[int] = None):
+    """Pure-COO cooccurrence counts: (sorted unique flat cell indices,
+    int32 counts) WITHOUT ever materializing the dense [I_p, I_t] matrix
+    — the count path for catalogs whose I_p·I_t·4 blows _SPARSE_C_BYTES
+    (a two-type 1M-item catalog would need 4 TB dense; its nnz is
+    bounded by the cross-join).  Same expansion chunking as
+    _sparse_counts; per-chunk uniques merge at the end with one argsort
+    + segment-sum.  Returns None when the cross-join exceeds
+    _SPARSE_COO_PAIRS (the collection's own memory budget — past it the
+    caller must use a dense-capable strategy)."""
+    total = _cross_join_pairs(p, a) if total_pairs is None else total_pairs
+    if total > _SPARSE_COO_PAIRS:
+        return None
+    if total == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int32)
+    cells_parts: List[np.ndarray] = []
+    count_parts: List[np.ndarray] = []
+    for flat in _cross_join_flat_chunks(p, a):
+        cells, counts = np.unique(flat, return_counts=True)
+        cells_parts.append(cells)
+        count_parts.append(counts.astype(np.int32))
+    if len(cells_parts) == 1:
+        return cells_parts[0], count_parts[0]
+    cells = np.concatenate(cells_parts)
+    counts = np.concatenate(count_parts)
+    order = np.argsort(cells, kind="stable")
+    cells, counts = cells[order], counts[order]
+    new = np.concatenate(([True], cells[1:] != cells[:-1]))
+    starts = np.flatnonzero(new)
+    summed = np.add.reduceat(counts.astype(np.int64), starts)
+    return cells[starts], summed.astype(np.int32)
 
 
 def _sparse_tail() -> str:
@@ -976,7 +1057,14 @@ class _SparseHostRunner:
     elementwise scores, same tie order as the device tail), or the device
     _llr_topk_dense via PIO_CCO_SPARSE_TAIL=device.  Only the count
     production ever differs from the dense strategy: it never does.
-    dispatch returns None when budgets say 'use the device'."""
+    dispatch returns None when budgets say 'use the device'.
+
+    Two count representations: the dense host matrix (original path,
+    ≤ _SPARSE_C_BYTES) and a pure-COO path for catalogs whose dense
+    count matrix can never exist (1M×1M ≈ 4 TB) but whose nnz is small —
+    there counts AND the LLR/top-k tail run entirely from sorted COO
+    cells (``_sparse_counts_coo`` + ``_llr_topk_sparse_rows``), making
+    million-item CPU training O(nnz + I·K) instead of impossible."""
 
     def __init__(self, p_user, p_item, n_users: int, n_items_p: int,
                  n_total_users: Optional[int] = None):
@@ -984,6 +1072,25 @@ class _SparseHostRunner:
         self.n_total_users = n_total_users if n_total_users else n_users
         self.n_items_p = n_items_p
         self.p = _SparseHostCSR(p_user, p_item, n_items_p, n_users)
+
+    def _dispatch_coo(self, a: _SparseHostCSR, n_items_t: int, top_k: int,
+                      llr_threshold: float, exclude_self: bool,
+                      pairs: int):
+        """Dense-free dispatch: COO counts + row-scoped sparse tail.
+        None when the cross-join blows the COO collection budget."""
+        got = _sparse_counts_coo(self.p, a, total_pairs=pairs)
+        if got is None:
+            return None
+        cells, counts = got
+        rows, cols = np.divmod(cells, n_items_t)
+        self_cols = (np.arange(self.n_items_p, dtype=np.int64)
+                     if exclude_self else None)
+        s, i = _llr_topk_sparse_rows(
+            rows, cols, counts, self.p.col_counts, a.col_counts,
+            float(self.n_total_users), float(llr_threshold),
+            top_k=top_k, n_rows=self.n_items_p, n_cols=n_items_t,
+            self_cols=self_cols)
+        return s, i, n_items_t, top_k
 
     def dispatch(self, a_user, a_item, n_items_t: int, top_k: int,
                  llr_threshold: float, exclude_self: bool,
@@ -999,6 +1106,11 @@ class _SparseHostRunner:
             tail = "host" if pairs * 4 < self.n_items_p * n_items_t \
                 else "device"
         host_tail = tail == "host"
+        if host_tail and self.n_items_p * n_items_t * 4 > _SPARSE_C_BYTES:
+            # the dense count matrix cannot exist at this catalog size;
+            # the pure-COO path is the only O(nnz) strategy left
+            return self._dispatch_coo(a, n_items_t, top_k, llr_threshold,
+                                      exclude_self, pairs)
         got = _sparse_counts(self.p, a, want_coo=host_tail,
                              total_pairs=pairs)
         if got is None:

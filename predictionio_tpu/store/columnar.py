@@ -270,9 +270,12 @@ class EventBatch:
         already-shared) snapshot dictionaries.  Mixed inputs fall back to
         per-batch re-coding into fresh dicts, exactly as before.
 
-        ``prop_columns`` merge when every batch carries them AND any
-        per-key dictionaries shared between batches are shared *objects*
-        (same snapshot+tail contract); otherwise the result drops them."""
+        ``prop_columns`` merge when every batch carries them; a key whose
+        string dictionaries are shared *objects* across batches (the
+        snapshot+tail contract) merges code-for-code, and disagreeing
+        dictionaries are RE-CODED into a merged one (the sharded store's
+        cross-shard scans land here: each shard's snapshot owns its own
+        dicts) — only a batch with no prop columns at all drops them."""
         if len(batches) == 1:
             return batches[0]
         shared = all(
@@ -324,6 +327,9 @@ class EventBatch:
             np.concatenate(cols["ts"]) if cols["ts"] else np.empty(0, np.int64),
             np.concatenate(cols["rt"]) if cols["rt"] else np.empty(0, np.float32),
             event_dict, entity_type_dict, entity_dict, target_dict,
+            # rows keep their order either way, so the prop merge (row
+            # offsets only) is identical to the fast path's
+            prop_columns=cls._concat_props(batches),
         )
 
     @staticmethod
@@ -331,11 +337,16 @@ class EventBatch:
                       ) -> Optional[Dict[str, "PropColumn"]]:
         """Row-shifted merge of per-key property columns across batches.
 
-        Requires every batch to carry prop_columns, and any key present in
-        more than one batch to share its string dictionary OBJECT across
-        those batches (codes are then directly comparable).  Returns None
-        when the contract doesn't hold — callers treat that exactly like
-        the legacy "concat drops properties" behavior."""
+        Requires every batch to carry prop_columns.  A key whose string
+        dictionary is the same OBJECT across batches merges codes
+        directly (the snapshot+tail shared-dict contract — zero-copy);
+        disagreeing dictionaries (each shard's snapshot owns its own)
+        are RE-CODED into a merged dictionary — one pass over each
+        batch's dictionary strings plus one vectorized code gather, so
+        cross-shard merged scans keep their property columns instead of
+        dropping them (which used to force training onto the slow
+        row-object path).  Returns None only when some batch carries no
+        prop_columns at all."""
         if any(b.prop_columns is None for b in batches):
             return None
         offsets = np.cumsum([0] + [len(b) for b in batches])
@@ -350,8 +361,24 @@ class EventBatch:
                        for i, b in enumerate(batches)
                        if key in b.prop_columns]
             d = entries[0][1].dict
+            code_cols: List[np.ndarray] = []
             if any(c.dict is not d for _, c in entries[1:]):
-                return None
+                # disagreeing dictionaries: re-code into a merged dict
+                d = IdDict(entries[0][1].dict.strings())
+                for _, c in entries:
+                    if c.dict.strings() == d.strings():
+                        code_cols.append(np.asarray(c.codes, np.int32))
+                        continue
+                    n = len(c.dict)
+                    code_map = (np.fromiter(
+                        (d.add(s) for s in c.dict.strings()),
+                        np.int32, count=n) if n else np.empty(0, np.int32))
+                    code_cols.append(
+                        code_map[np.asarray(c.codes, np.int64)]
+                        if len(c.codes) else np.asarray(c.codes, np.int32))
+            else:
+                code_cols = [np.asarray(c.codes, np.int32)
+                             for _, c in entries]
             rows = np.concatenate([c.rows + off for off, c in entries])
             kind = np.concatenate([c.kind for _, c in entries])
             num = np.concatenate([c.num for _, c in entries])
@@ -361,7 +388,7 @@ class EventBatch:
                 [np.asarray([0], np.int64)]
                 + [c.str_offs[1:] + code_base[i]
                    for i, (_, c) in enumerate(entries)])
-            codes = (np.concatenate([c.codes for _, c in entries])
+            codes = (np.concatenate(code_cols)
                      if code_base[-1] else np.empty(0, np.int32))
             out[key] = PropColumn(rows, kind, num, str_offs, codes, d)
         return out

@@ -7,19 +7,30 @@ staging already made (a) incremental; this module makes (b)–(e)
 incremental too, by exploiting that CCO counts are ADDITIVE:
 
 - :class:`URFoldState` keeps, per event type, the deduped (user, item)
-  pair set, the dense int32 co-occurrence count matrix ``C`` and the LLR
-  marginals (distinct-user row/column counts).  A delta fold applies
-  ``C_new = C + Δpᵀ·A_old + P_newᵀ·Δa`` as a few vectorized scatter-adds
-  over the delta's cross-join — O(delta footprint), never O(U·I²).
-- LLR + top-k re-runs through the SAME jitted kernels training uses
-  (``ops.cco._llr_mask_scores`` / ``_llr_topk_dense`` / the shared
-  ``_finalize_topk`` epilogue), so every recomputed cell is bit-identical
-  to a from-scratch retrain's value — exactness by construction, not by
-  tolerance.  Only *affected* rows recompute: a delta that changes no
-  global LLR input (no new users, no new target-side pairs for the type)
-  re-LLRs just the touched primary rows; a marginal change (new user →
-  N, new target pairs → column counts) forces that type's full re-LLR,
-  because Dunning G² couples every cell to N and its column marginal.
+  pair set, the co-occurrence counts and the LLR marginals
+  (distinct-user row/column counts).  Counts are **sorted-COO by
+  default** (:class:`_SparseCounts`: one int64 ``(row<<32|col)`` key +
+  int32 count per nonzero cell — O(nnz), so a 1M-item catalog whose
+  dense matrix would be 4 TB fits in tens of MB); the legacy dense
+  int32 ``[I_p, I_t]`` matrices remain behind ``PIO_FOLLOW_STATE=dense``
+  as an escape hatch and as the bit-exactness oracle the property tests
+  compare against.  A delta fold applies ``C_new = C + Δpᵀ·A_old +
+  P_newᵀ·Δa`` as vectorized scatter-adds (dense) or one sorted merge
+  (sparse) over the delta's cross-join — O(delta footprint), never
+  O(U·I²).
+- LLR + top-k re-runs through the SAME scoring chain training uses
+  (``ops.cco._llr_mask_scores`` / ``_llr_cells`` — XLA elementwise math
+  is element-value-deterministic regardless of tensor shape), so every
+  recomputed cell is bit-identical to a from-scratch retrain's value —
+  exactness by construction, not by tolerance.  Sparse state routes
+  re-LLR through ``ops.cco._llr_topk_sparse_rows`` (the row-scoped
+  variant of the training host tail — same scores, same lax.top_k tie
+  order); dense state through the row-sliced ``_llr_topk_rows_jit``.
+  Only *affected* rows recompute: a delta that changes no global LLR
+  input (no new users, no new target-side pairs for the type) re-LLRs
+  just the touched primary rows; a marginal change (new user → N, new
+  target pairs → column counts) forces that type's full re-LLR, because
+  Dunning G² couples every cell to N and its column marginal.
 - The emitted model is a NEW ``URModel`` object per fold — PR 4/7's
   generation-keyed serving caches (rule-mask LRU, value-mask/date LRUs,
   ``host_pop_order``) invalidate by model identity, so hot-swap
@@ -30,11 +41,19 @@ incremental too, by exploiting that CCO counts are ADDITIVE:
   inversion), and the property indexes carry when no ``$set``-family
   event arrived.
 
-State is bounded by ``PIO_FOLLOW_STATE_BYTES`` (default 1 GiB: count
-matrices plus the log-proportional parts — accumulated batch, pair
-sets, raw popularity inputs); past it :class:`FoldUnsupported` tells
-the follower to fall back to full (delta-staged) retrains per tick,
-which stay exact — the budget gates cost, never correctness.
+State is bounded by ``PIO_FOLLOW_STATE_BYTES`` (default 1 GiB: counts
+plus the log-proportional parts — accumulated batch, pair sets, raw
+popularity inputs, indicator tables); past it :class:`FoldUnsupported`
+tells the follower to fall back to full (delta-staged) retrains per
+tick, which stay exact — the budget gates cost, never correctness.
+With sparse counts the resident total is ≈ f(events), not catalog², so
+the default budget holds fold mode at million-item catalogs.
+
+The state is also checkpointable (``checkpoint_arrays`` /
+``restore_checkpoint`` + the accumulated batch via
+``store.columnar.write_batch``): the follower persists it beside its
+watermark so a SIGKILL restart re-folds only the unapplied suffix
+instead of reparsing the covered prefix (see ``streaming.follow``).
 """
 
 from __future__ import annotations
@@ -72,9 +91,130 @@ def state_budget_bytes() -> int:
         return 1 << 30
 
 
+def fold_state_impl() -> str:
+    """``PIO_FOLLOW_STATE``: 'sparse' (default) keeps sorted-COO counts —
+    O(nnz) resident bytes, the representation that holds fold mode at
+    million-item catalogs; 'dense' keeps the legacy [I_p, I_t] int32
+    matrices (escape hatch + the oracle the sparse≡dense property tests
+    compare against)."""
+    conf = os.environ.get("PIO_FOLLOW_STATE", "auto").lower()
+    return "dense" if conf == "dense" else "sparse"
+
+
+def _dense_rellr_bytes() -> int:
+    """Small-catalog fast path: a sparse-state FULL re-LLR whose dense
+    [I_p, I_t] f32 matrix fits this budget (PIO_FOLLOW_DENSE_RELLR_BYTES,
+    default 4 MiB) materializes it transiently and runs the jitted dense
+    kernels — at tiny shapes (the sub-ms regime) the dense jit beats the
+    sparse gather+lexsort ~2×, and it is the exact path the dense state
+    (and PR 8) always took.  0 forces the sparse tail everywhere (the
+    property tests use it so the sparse kernels stay covered at small
+    shapes)."""
+    try:
+        return max(int(os.environ.get("PIO_FOLLOW_DENSE_RELLR_BYTES",
+                                      str(4 << 20))), 0)
+    except ValueError:
+        return 4 << 20
+
+
 class FoldUnsupported(RuntimeError):
     """The fold engine cannot (or should not) maintain incremental state
     for this engine/shape — the follower falls back to retrain mode."""
+
+
+class _SparseCounts:
+    """Sorted-COO co-occurrence counts: ``keys`` holds one int64
+    ``(row << 32) | col`` per nonzero cell, ascending; ``counts`` the
+    int32 count at that cell.  All mutations preserve the sort:
+
+    - increments merge via searchsorted + np.insert (new cells land at
+      their exact slots);
+    - row/col remaps apply a STRICTLY INCREASING permutation (the
+      old→new local-id map ``_extend_item_space`` computes is a
+      searchsorted into the union of two sorted sets, hence monotone),
+      so remapped keys stay ascending without a re-sort.
+    """
+
+    __slots__ = ("keys", "counts")
+
+    def __init__(self, keys: np.ndarray, counts: np.ndarray):
+        self.keys = np.asarray(keys, np.int64)
+        self.counts = np.asarray(counts, np.int32)
+
+    @classmethod
+    def empty(cls) -> "_SparseCounts":
+        return cls(np.zeros(0, np.int64), np.zeros(0, np.int32))
+
+    @classmethod
+    def from_dense(cls, C: np.ndarray) -> "_SparseCounts":
+        rows, cols = np.nonzero(C)
+        return cls(_pair_key(rows, cols), C[rows, cols].astype(np.int32))
+
+    @property
+    def nnz(self) -> int:
+        return len(self.keys)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.keys.nbytes) + int(self.counts.nbytes)
+
+    def add_pairs(self, rows: np.ndarray, cols: np.ndarray) -> None:
+        """counts[r, c] += multiplicity of (r, c) in the given pairs."""
+        if len(rows) == 0:
+            return
+        uniq, inc = np.unique(_pair_key(rows, cols), return_counts=True)
+        pos = np.searchsorted(self.keys, uniq)
+        hit = np.zeros(len(uniq), bool)
+        in_range = pos < len(self.keys)
+        hit[in_range] = self.keys[pos[in_range]] == uniq[in_range]
+        if hit.any():
+            self.counts[pos[hit]] += inc[hit].astype(np.int32)
+        miss = ~hit
+        if miss.any():
+            self.keys = np.insert(self.keys, pos[miss], uniq[miss])
+            self.counts = np.insert(self.counts, pos[miss],
+                                    inc[miss].astype(np.int32))
+
+    def all_cells(self):
+        """(rows, cols, counts) of every nonzero cell, (row, col)-asc."""
+        return (self.keys >> np.int64(32), self.keys & _LOW32, self.counts)
+
+    def row_cells(self, rows: np.ndarray):
+        """Gather the cells of a sorted unique row subset: returns
+        (local row index into ``rows``, col, count) — each row's cells
+        are one contiguous key segment, bounded by two searchsorteds
+        (the same repeat/arange expansion as ``_cross_scatter``)."""
+        rows = np.asarray(rows, np.int64)
+        starts = np.searchsorted(self.keys, rows << np.int64(32))
+        ends = np.searchsorted(self.keys, (rows + 1) << np.int64(32))
+        seg = ends - starts
+        total = int(seg.sum())
+        if total == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.int32))
+        csum = np.cumsum(seg)
+        within = np.arange(total, dtype=np.int64) - np.repeat(csum - seg, seg)
+        idx = np.repeat(starts, seg) + within
+        local = np.repeat(np.arange(len(rows), dtype=np.int64), seg)
+        return local, self.keys[idx] & _LOW32, self.counts[idx]
+
+    def remap_cols(self, perm: np.ndarray) -> None:
+        """col → perm[col] (perm strictly increasing: order preserved)."""
+        if self.nnz and len(perm):
+            self.keys = (self.keys & ~_LOW32) \
+                | np.asarray(perm, np.int64)[self.keys & _LOW32]
+
+    def remap_rows(self, perm: np.ndarray) -> None:
+        """row → perm[row] (perm strictly increasing: order preserved)."""
+        if self.nnz and len(perm):
+            self.keys = (np.asarray(perm, np.int64)[self.keys >> np.int64(32)]
+                         << np.int64(32)) | (self.keys & _LOW32)
+
+    def to_dense(self, n_rows: int, n_cols: int) -> np.ndarray:
+        C = np.zeros((n_rows, n_cols), np.int32)
+        if self.nnz:
+            C[self.keys >> np.int64(32), self.keys & _LOW32] = self.counts
+        return C
 
 
 def _pair_key(u: np.ndarray, i: np.ndarray) -> np.ndarray:
@@ -99,19 +239,18 @@ def _in_sorted(values: np.ndarray, sorted_arr: np.ndarray) -> np.ndarray:
     return sorted_arr[pos] == values
 
 
-def _cross_scatter(C: np.ndarray, pairs_sorted: np.ndarray,
-                   du: np.ndarray, di: np.ndarray,
-                   rows_from_delta: bool) -> np.ndarray:
-    """Scatter one side of the count update into ``C`` and return the
-    touched C-row ids.
+def _cross_partners(pairs_sorted: np.ndarray, du: np.ndarray,
+                    di: np.ndarray, rows_from_delta: bool):
+    """Expand one side of the count update into its (row, col) increment
+    pairs — shared by both count representations.
 
     For every delta pair (du[e], di[e]) and every partner item j in the
     OTHER side's per-user segment of ``pairs_sorted`` (deduped composite
     keys, (user, item)-ascending):
 
-    - rows_from_delta=True:  C[di[e], j] += 1   (Δpᵀ·A — delta items are
+    - rows_from_delta=True:  (di[e], j)   (Δpᵀ·A — delta items are
       primary rows, partners are columns)
-    - rows_from_delta=False: C[j, di[e]] += 1   (Pᵀ·Δa — partners are
+    - rows_from_delta=False: (j, di[e])   (Pᵀ·Δa — partners are
       primary rows, delta items are columns)
 
     One searchsorted pair bounds each user's partner segment; the flat
@@ -119,7 +258,7 @@ def _cross_scatter(C: np.ndarray, pairs_sorted: np.ndarray,
     no per-pair Python loop).
     """
     if len(du) == 0 or len(pairs_sorted) == 0:
-        return np.zeros(0, np.int64)
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
     starts = np.searchsorted(pairs_sorted,
                              np.asarray(du, np.int64) << np.int64(32))
     ends = np.searchsorted(pairs_sorted,
@@ -127,16 +266,30 @@ def _cross_scatter(C: np.ndarray, pairs_sorted: np.ndarray,
     seg = ends - starts                       # partners per delta pair
     total = int(seg.sum())
     if total == 0:
-        return np.zeros(0, np.int64)
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
     csum = np.cumsum(seg)
     within = np.arange(total, dtype=np.int64) - np.repeat(csum - seg, seg)
     partners = _key_item(pairs_sorted[np.repeat(starts, seg) + within])
     own = np.repeat(np.asarray(di, np.int64), seg)
     if rows_from_delta:
-        rows, cols = own, partners
+        return own, partners
+    return partners, own
+
+
+def _cross_scatter(counts, pairs_sorted: np.ndarray,
+                   du: np.ndarray, di: np.ndarray,
+                   rows_from_delta: bool) -> np.ndarray:
+    """Apply one side of the count update (see ``_cross_partners``) to
+    ``counts`` — a dense int32 matrix (scatter-add) or a
+    :class:`_SparseCounts` (sorted merge) — and return the touched
+    primary-row ids."""
+    rows, cols = _cross_partners(pairs_sorted, du, di, rows_from_delta)
+    if len(rows) == 0:
+        return np.zeros(0, np.int64)
+    if isinstance(counts, _SparseCounts):
+        counts.add_pairs(rows, cols)
     else:
-        rows, cols = partners, own
-    np.add.at(C, (rows, cols), 1)
+        np.add.at(counts, (rows, cols), 1)
     return np.unique(rows)
 
 
@@ -219,22 +372,28 @@ def _patch_inverted_csr(old: Tuple[np.ndarray, np.ndarray, np.ndarray],
 
 @dataclasses.dataclass
 class _TypeState:
-    """Per-event-type incremental state."""
+    """Per-event-type incremental state.  Exactly one of ``C`` (dense
+    impl) / ``sc`` (sparse impl) holds the co-occurrence counts."""
 
     codes: np.ndarray            # int64 sorted unique target-dict codes
     item_dict: IdDict            # strings of ``codes`` (id = position)
     local_of_target: np.ndarray  # target code → local item id (-1 unknown)
     pairs: np.ndarray            # int64 sorted deduped (u<<32 | i) keys
-    C: np.ndarray                # int32 [I_p, I_t] co-occurrence counts
     col_counts: np.ndarray       # int64 [I_t] distinct users per target
     raw_items: List[np.ndarray]  # per-fold raw event items (local ids)
     raw_times: List[np.ndarray]  # per-fold raw event epoch seconds
+    C: Optional[np.ndarray] = None       # int32 [I_p, I_t] counts (dense)
+    sc: Optional[_SparseCounts] = None   # sorted-COO counts (sparse)
     idx: Optional[np.ndarray] = None   # int32 [I_p, K] indicator ids
     llr: Optional[np.ndarray] = None   # f32   [I_p, K] indicator scores
 
     @property
     def n_items(self) -> int:
         return len(self.codes)
+
+    @property
+    def counts(self):
+        return self.sc if self.sc is not None else self.C
 
 
 class URFoldState:
@@ -273,6 +432,7 @@ class URFoldState:
                 "the follower's unit of durability is the watermark")
         self.per_type = URAlgorithm.per_type_tuning(algo_params,
                                                     self.event_names)
+        self.impl = fold_state_impl()
         self.user_dict = IdDict()
         self.user_of_code = np.full(1, -1, np.int32)
         self.row_counts = np.zeros(0, np.int64)
@@ -281,7 +441,10 @@ class URFoldState:
                 codes=np.zeros(0, np.int64), item_dict=IdDict(),
                 local_of_target=np.full(1, -1, np.int64),
                 pairs=np.zeros(0, np.int64),
-                C=np.zeros((0, 0), np.int32),
+                C=(np.zeros((0, 0), np.int32) if self.impl == "dense"
+                   else None),
+                sc=(_SparseCounts.empty() if self.impl == "sparse"
+                    else None),
                 col_counts=np.zeros(0, np.int64),
                 raw_items=[], raw_times=[])
             for name in self.event_names
@@ -318,17 +481,25 @@ class URFoldState:
         state.fold(batch)
         return state
 
+    @property
+    def state_mode(self) -> str:
+        """'sparse' | 'dense' — the resident count representation (the
+        pio_follow_state_mode gauge and /stats.json surface this)."""
+        return self.impl
+
     def state_bytes(self) -> int:
-        """Total resident bytes of the incremental state: count matrices
-        plus everything that GROWS with the log — the accumulated
-        columnar batch, pair sets, raw popularity inputs and indicator
-        tables.  This is what ``PIO_FOLLOW_STATE_BYTES`` bounds: a
-        long-lived follower at a steady event rate demotes to retrain
-        mode when its resident history outgrows the budget, instead of
-        leaking without limit."""
+        """Total resident bytes of the incremental state: the counts
+        (sorted-COO cells — O(nnz) — or the legacy dense matrices) plus
+        everything that GROWS with the log — the accumulated columnar
+        batch, pair sets, raw popularity inputs and indicator tables.
+        This is what ``PIO_FOLLOW_STATE_BYTES`` bounds: a long-lived
+        follower at a steady event rate demotes to retrain mode when its
+        resident history outgrows the budget, instead of leaking without
+        limit."""
         total = 0
         for t in self.types.values():
-            total += int(t.C.nbytes) + int(t.pairs.nbytes)
+            total += (t.sc.nbytes if t.sc is not None
+                      else int(t.C.nbytes)) + int(t.pairs.nbytes)
             total += int(t.col_counts.nbytes) + int(t.local_of_target.nbytes)
             total += sum(int(a.nbytes) for a in t.raw_items)
             total += sum(int(a.nbytes) for a in t.raw_times)
@@ -428,7 +599,7 @@ class URFoldState:
         for name in self.event_names:
             st = self.types[name]
             touched[name].append(_cross_scatter(
-                st.C, st.pairs, dp_u, dp_i, rows_from_delta=True))
+                st.counts, st.pairs, dp_u, dp_i, rows_from_delta=True))
         if len(dp):
             p_st.pairs = np.sort(np.concatenate([p_st.pairs, dp]))
             self.row_counts += np.bincount(dp_i, minlength=p_st.n_items)
@@ -438,7 +609,7 @@ class URFoldState:
             if len(da) == 0:
                 continue
             touched[name].append(_cross_scatter(
-                st.C, p_st.pairs, _key_user(da), _key_item(da),
+                st.counts, p_st.pairs, _key_user(da), _key_item(da),
                 rows_from_delta=False))
             st.col_counts += np.bincount(_key_item(da),
                                          minlength=st.n_items)
@@ -510,10 +681,17 @@ class URFoldState:
         if len(perm):
             cc[perm] = st.col_counts
         st.col_counts = cc
-        C = np.zeros((st.C.shape[0], len(merged)), np.int32)
-        if len(perm) and st.C.size:
-            C[:, perm] = st.C
-        st.C = C
+        if st.sc is not None:
+            # absent cells stay absent; existing cells' cols follow the
+            # (monotone) perm — no growth array needed, and pure growth
+            # at the end (identity perm) costs nothing
+            if remapped:
+                st.sc.remap_cols(perm)
+        else:
+            C = np.zeros((st.C.shape[0], len(merged)), np.int32)
+            if len(perm) and st.C.size:
+                C[:, perm] = st.C
+            st.C = C
         st.idx = st.llr = None   # shape changed: full re-LLR for the type
         if name == self.primary:
             self._primary_perm = perm
@@ -534,35 +712,96 @@ class URFoldState:
         perm = self._primary_perm
         for name in self.event_names:
             st = self.types[name]
-            C = np.zeros((n_p, st.C.shape[1]), np.int32)
-            if len(perm) and st.C.size:
-                C[perm, :] = st.C
-            st.C = C
+            if st.sc is not None:
+                st.sc.remap_rows(perm)
+            else:
+                C = np.zeros((n_p, st.C.shape[1]), np.int32)
+                if len(perm) and st.C.size:
+                    C[perm, :] = st.C
+                st.C = C
             st.idx = st.llr = None
 
     def _rellr_type(self, name: str, rows: Optional[np.ndarray]) -> None:
         """Recompute LLR + top-k for ``rows`` of one type (None = all),
-        through the exact kernels training uses."""
+        bit-identically to what training would compute: sparse state
+        routes through ``_llr_topk_sparse_rows`` (the row-scoped variant
+        of the training host tail — same ``_llr_cells`` elementwise
+        scores, same lax.top_k tie order), dense state through the same
+        jitted dense kernels as before."""
         from predictionio_tpu.ops.cco import (
             _DenseRunner,
             _llr_topk_dense,
+            _llr_topk_sparse_rows,
             topk_impl,
         )
         from predictionio_tpu.ops.pallas_kernels import pallas_mode
 
         st = self.types[name]
+        p_st = self.types[self.primary]
         t_k, t_llr = self.per_type.get(
             name, (self.params.max_correlators_per_item,
                    self.params.min_llr))
         excl = name == self.primary
         n_t = st.n_items
+        n_p = p_st.n_items
         n_total = float(len(self.user_dict))
+        default_kernels = topk_impl() == "lax" and pallas_mode() == "off"
+        small_dense = (default_kernels
+                       and n_p * n_t * 4 <= _dense_rellr_bytes())
+        if st.sc is not None and default_kernels and not small_dense:
+            # the sparse tail: score only the resident nonzero cells
+            # through the row-scoped variant of the training host tail
+            width = min(t_k, n_t)
+            if rows is None:
+                crows, ccols, ccnt = st.sc.all_cells()
+                rc_rows = self.row_counts
+                self_cols = (np.arange(n_p, dtype=np.int64) if excl
+                             else None)
+                n_rows = n_p
+            else:
+                crows, ccols, ccnt = st.sc.row_cells(rows)
+                rc_rows = self.row_counts[rows]
+                self_cols = rows if excl else None
+                n_rows = len(rows)
+            s, i = _llr_topk_sparse_rows(
+                crows, ccols, ccnt, rc_rows, st.col_counts, n_total,
+                float(t_llr), top_k=width, n_rows=n_rows, n_cols=n_t,
+                self_cols=self_cols)
+            scores, idx = _DenseRunner.collect((s, i, n_t, t_k))
+            if rows is None:
+                st.idx = idx.astype(np.int32)
+                st.llr = np.where(np.isfinite(scores), scores,
+                                  0.0).astype(np.float32)
+                self.last_fold_stats[name] = {"rows": n_p, "mode": "full"}
+            else:
+                st.idx[rows] = idx.astype(np.int32)
+                st.llr[rows] = np.where(np.isfinite(scores), scores,
+                                        0.0).astype(np.float32)
+                self.last_fold_stats[name] = {"rows": int(len(rows)),
+                                              "mode": "sliced"}
+            return
+        if st.sc is not None:
+            # dense kernels over a transient materialization: the tiny-
+            # catalog fast path (sub-ms regime, where the dense jit beats
+            # the sparse gather+lexsort ~2× — and exactly the code path
+            # the dense state and PR 8 always took), or a non-default
+            # kernel selection (pallas top-k / pallas LLR) whose only
+            # entry points are dense — there, unaffordable means the
+            # follower must retrain
+            if not small_dense and n_p * n_t * 4 > state_budget_bytes():
+                raise FoldUnsupported(
+                    f"non-default kernels ({topk_impl()}/{pallas_mode()}) "
+                    f"need a dense [{n_p}, {n_t}] count pass that exceeds "
+                    "PIO_FOLLOW_STATE_BYTES")
+            C_full = st.sc.to_dense(n_p, n_t)
+        else:
+            C_full = st.C
         # non-default kernel selections (pallas top-k / pallas LLR) only
         # have full-matrix entry points — take the full path so the fold
         # reproduces exactly what training would have computed
-        if rows is None or topk_impl() != "lax" or pallas_mode() != "off":
+        if rows is None or not default_kernels:
             s, i = _llr_topk_dense(
-                jnp.asarray(st.C), jnp.asarray(self.row_counts),
+                jnp.asarray(C_full), jnp.asarray(self.row_counts),
                 jnp.asarray(st.col_counts), n_total, float(t_llr),
                 top_k=min(t_k, n_t), exclude_self=bool(excl),
                 pallas=pallas_mode(), topk=topk_impl())
@@ -570,11 +809,11 @@ class URFoldState:
             st.idx = idx.astype(np.int32)
             st.llr = np.where(np.isfinite(scores), scores,
                               0.0).astype(np.float32)
-            self.last_fold_stats[name] = {"rows": st.C.shape[0],
+            self.last_fold_stats[name] = {"rows": C_full.shape[0],
                                           "mode": "full"}
             return
         scores, idx = _llr_topk_rows(
-            st.C[rows], self.row_counts[rows], st.col_counts, n_total,
+            C_full[rows], self.row_counts[rows], st.col_counts, n_total,
             float(t_llr), rows if excl else None, min(t_k, n_t))
         scores, idx = _DenseRunner.collect((scores, idx, n_t, t_k))
         st.idx[rows] = idx.astype(np.int32)
@@ -709,3 +948,132 @@ class URFoldState:
             else:
                 continue   # too many rows moved: lazy rebuild is cheaper
             model.__dict__.setdefault("_host_inv", {})[name] = patched
+
+    # -- checkpointing --------------------------------------------------------
+    #
+    # The numeric state serializes to one flat array dict (npz-able, no
+    # pickle) + a small JSON meta; the accumulated EventBatch persists
+    # separately through store.columnar.write_batch (which carries the
+    # dictionaries and property columns).  Strings are NOT duplicated:
+    # the user/item dictionaries reconstruct from the batch's dicts plus
+    # the stored code maps.  ``state_fingerprint`` (crc32 over pairs +
+    # marginals + code sets) makes bit-rot detectable: restore verifies
+    # it and the caller restages on mismatch.
+
+    def state_fingerprint(self) -> int:
+        import zlib
+
+        h = zlib.crc32(self.row_counts.tobytes())
+        for name in self.event_names:
+            st = self.types[name]
+            h = zlib.crc32(np.ascontiguousarray(st.pairs).tobytes(), h)
+            h = zlib.crc32(np.ascontiguousarray(st.col_counts).tobytes(), h)
+            h = zlib.crc32(np.ascontiguousarray(st.codes).tobytes(), h)
+        return int(h)
+
+    def checkpoint_arrays(self) -> Tuple[Dict[str, np.ndarray], dict]:
+        """(arrays, meta) capturing everything but the batch."""
+        arrays: Dict[str, np.ndarray] = {
+            "user_of_code": self.user_of_code,
+            "row_counts": self.row_counts,
+        }
+        meta = {
+            "version": 1,
+            "impl": self.impl,
+            "event_names": list(self.event_names),
+            "n_users": len(self.user_dict),
+            "props_ever": bool(self._props_ever),
+            "generation": int(self.generation),
+            "fingerprint": self.state_fingerprint(),
+        }
+        for k, name in enumerate(self.event_names):
+            st = self.types[name]
+            p = f"t{k}_"
+            arrays[p + "codes"] = st.codes
+            arrays[p + "local_of_target"] = st.local_of_target
+            arrays[p + "pairs"] = st.pairs
+            arrays[p + "col_counts"] = st.col_counts
+            arrays[p + "raw_items"] = (
+                np.concatenate(st.raw_items) if st.raw_items
+                else np.zeros(0, np.int32))
+            arrays[p + "raw_times"] = (
+                np.concatenate(st.raw_times) if st.raw_times
+                else np.zeros(0, np.float64))
+            if st.idx is not None:
+                arrays[p + "idx"] = st.idx
+                arrays[p + "llr"] = st.llr
+            if st.sc is not None:
+                arrays[p + "cell_keys"] = st.sc.keys
+                arrays[p + "cell_counts"] = st.sc.counts
+            else:
+                arrays[p + "dense_C"] = st.C
+        return arrays, meta
+
+    @classmethod
+    def restore_checkpoint(cls, algo_params, ds_params, batch,
+                           arrays, meta) -> "URFoldState":
+        """Rebuild a fold state from ``checkpoint_arrays`` output + the
+        persisted accumulated batch, verify the integrity fingerprint,
+        and emit the model it describes.  Raises ValueError on ANY
+        mismatch (version, config drift, corrupt arrays) — callers
+        restage from the log."""
+        if meta.get("version") != 1:
+            raise ValueError(f"unknown checkpoint version {meta.get('version')}")
+        state = cls(algo_params, ds_params)
+        if list(meta.get("event_names") or []) != state.event_names:
+            raise ValueError("checkpoint event_names do not match the "
+                             "current engine params")
+        state.batch = batch
+        state.user_of_code = np.array(arrays["user_of_code"], np.int32)
+        state.row_counts = np.array(arrays["row_counts"], np.int64)
+        # the user dictionary reconstructs by inverting user_of_code
+        # over the batch's entity dictionary (enrollment order is the
+        # value order of the map)
+        n_users = int(meta["n_users"])
+        order = np.full(n_users, -1, np.int64)
+        valid = np.flatnonzero(state.user_of_code >= 0)
+        order[state.user_of_code[valid]] = valid
+        if n_users and (order < 0).any():
+            raise ValueError("checkpoint user map is not a bijection")
+        state.user_dict = IdDict(
+            [batch.entity_dict.str(int(c)) for c in order])
+        state.impl = str(meta.get("impl") or "sparse")
+        for k, name in enumerate(state.event_names):
+            st = state.types[name]
+            p = f"t{k}_"
+            st.codes = np.array(arrays[p + "codes"], np.int64)
+            st.item_dict = IdDict(
+                [batch.target_dict.str(int(c)) for c in st.codes])
+            st.local_of_target = np.array(arrays[p + "local_of_target"],
+                                          np.int64)
+            st.pairs = np.array(arrays[p + "pairs"], np.int64)
+            st.col_counts = np.array(arrays[p + "col_counts"], np.int64)
+            ri = np.array(arrays[p + "raw_items"], np.int32)
+            rt = np.array(arrays[p + "raw_times"], np.float64)
+            if len(ri) != len(rt):
+                raise ValueError("checkpoint raw popularity arrays torn")
+            st.raw_items = [ri] if len(ri) else []
+            st.raw_times = [rt] if len(rt) else []
+            if p + "idx" in arrays:
+                st.idx = np.array(arrays[p + "idx"], np.int32)
+                st.llr = np.array(arrays[p + "llr"], np.float32)
+            if p + "cell_keys" in arrays:
+                st.sc = _SparseCounts(np.array(arrays[p + "cell_keys"]),
+                                      np.array(arrays[p + "cell_counts"]))
+                st.C = None
+            elif p + "dense_C" in arrays:
+                st.C = np.array(arrays[p + "dense_C"], np.int32)
+                st.sc = None
+            else:
+                raise ValueError(f"checkpoint carries no counts for {name}")
+        if state.state_fingerprint() != int(meta["fingerprint"]):
+            raise ValueError("checkpoint integrity fingerprint mismatch")
+        if meta.get("props_ever"):
+            state._props = {
+                k2: dict(v) for k2, v in fold_properties(
+                    batch, ds_params.item_entity_type).items()}
+            state._props_ever = True
+        state.generation = int(meta.get("generation", 0))
+        state.model = None
+        state.model = state._emit()
+        return state

@@ -70,6 +70,16 @@ _M_GEN = _REG.gauge(
     "Monotonic generation counter of the live model: bumped by every "
     "hot-swap (follow fold, auto-reload, manual /reload) — serving "
     "caches key on the model object this counts")
+_M_STATE_BYTES = _REG.gauge(
+    "pio_follow_state_bytes",
+    "Resident fold-state bytes (sorted-COO counts + accumulated batch "
+    "+ pair sets + popularity inputs + indicator tables) — what "
+    "PIO_FOLLOW_STATE_BYTES bounds; 0 in retrain mode.  With the "
+    "sparse state this grows with the EVENT count, not catalog**2")
+_M_STATE_MODE = _REG.gauge(
+    "pio_follow_state_mode",
+    "Fold-state representation in use: 1 on the active mode label "
+    "(sparse | dense | retrain), 0 on the others")
 
 
 def follow_interval_s() -> float:
@@ -97,6 +107,18 @@ def follow_enabled() -> bool:
     """PIO_FOLLOW=off idles a running follower without tearing it down."""
     return os.environ.get("PIO_FOLLOW", "").lower() not in (
         "off", "0", "false")
+
+
+def follow_checkpoint_interval_s() -> float:
+    """PIO_FOLLOW_CHECKPOINT_S: minimum seconds between fold-state
+    checkpoints (default 60; <= 0 disables checkpointing).  A restart
+    re-folds from the newest checkpoint's watermark, so the interval
+    bounds the restart's re-fold work — the covered-prefix reparse only
+    happens when no valid checkpoint exists."""
+    try:
+        return float(os.environ.get("PIO_FOLLOW_CHECKPOINT_S", "60"))
+    except ValueError:
+        return 60.0
 
 
 def follow_state_path(storage: Storage, engine_id: str,
@@ -163,6 +185,10 @@ class FollowTrainer:
         # thing next tick (the in-memory watermark has already advanced,
         # so a 0-event tick would otherwise idle on a stale live model)
         self._pending: Optional[tuple] = None
+        self._last_ckpt_at = 0.0
+        self._ckpt_cost_s = 0.0
+        self._state_bytes = 0
+        self._state_mode = "retrain"
         self._resolve_mode()
         self._state_path = follow_state_path(
             self.storage, engine_id, engine_variant) if persist else None
@@ -255,18 +281,206 @@ class FollowTrainer:
             return None
         return doc
 
+    # -- fold-state checkpoint ------------------------------------------------
+    #
+    # Two files next to follow.json: <name>.ckpt.batch (the accumulated
+    # columnar batch, via store.columnar.write_batch — dictionaries +
+    # property columns included) and <name>.ckpt.npz (the numeric fold
+    # state + JSON meta).  Write order batch-then-npz with a shared
+    # ckpt_id makes the npz the commit point: a crash between the two
+    # renames leaves an id mismatch and the loader falls back to the
+    # covered-prefix reparse.  Integrity of the arrays themselves is a
+    # crc32 fingerprint over pairs/marginals (URFoldState verifies on
+    # restore); config drift is a fingerprint over the serialized
+    # engine params.
+
+    def _ckpt_paths(self):
+        if self._state_path is None:
+            return None, None
+        stem = self._state_path.with_suffix("")
+        return (stem.parent / (stem.name + ".ckpt.npz"),
+                stem.parent / (stem.name + ".ckpt.batch"))
+
+    def _params_fingerprint(self) -> int:
+        import zlib
+
+        from predictionio_tpu.controller.engine import (
+            serialize_engine_params,
+        )
+
+        blob = json.dumps(serialize_engine_params(self.engine_params),
+                          sort_keys=True, default=str)
+        return int(zlib.crc32(blob.encode()))
+
+    def _maybe_checkpoint(self) -> None:
+        interval = follow_checkpoint_interval_s()
+        if (interval <= 0 or self.mode != "fold" or self._fold is None
+                or self._state_path is None):
+            return
+        # the write is synchronous in the tick path (a background writer
+        # would race the in-place indicator-table mutations the next
+        # fold performs), so bound its duty cycle: never spend more than
+        # ~10% of wall time checkpointing — a state near the 1 GiB
+        # budget self-throttles instead of stalling a fold every
+        # interval for the full write duration
+        effective = max(interval, 10.0 * self._ckpt_cost_s)
+        if time.monotonic() - self._last_ckpt_at < effective \
+                and self._last_ckpt_at:
+            return
+        try:
+            t0 = time.perf_counter()
+            self._write_checkpoint()
+            self._ckpt_cost_s = time.perf_counter() - t0
+            self._last_ckpt_at = time.monotonic()
+        except Exception:
+            # a failed checkpoint must never fail the publish that
+            # triggered it — the fallback (covered-prefix reparse) stays
+            log.exception("fold-state checkpoint failed; restart will "
+                          "reparse the covered prefix")
+
+    def _write_checkpoint(self) -> None:
+        import numpy as np
+
+        from predictionio_tpu.store.columnar import write_batch
+
+        npz_path, batch_path = self._ckpt_paths()
+        state = self._fold
+        arrays, meta = state.checkpoint_arrays()
+        ckpt_id = uuid.uuid4().hex
+        meta.update({
+            "ckptId": ckpt_id,
+            "paramsFingerprint": self._params_fingerprint(),
+            "watermark": dict(self._wm),
+            "heads": dict(self._heads),
+            "tombstones": sorted(self._tombstones),
+            "followGeneration": self.generation,
+            "instanceId": self.instance_id,
+        })
+        npz_path.parent.mkdir(parents=True, exist_ok=True)
+        bt = batch_path.with_name(batch_path.name + ".tmp")
+        write_batch(bt, state.batch, meta={"ckptId": ckpt_id})
+        os.replace(bt, batch_path)
+        nt = npz_path.with_name(npz_path.name + ".tmp")
+        arrays = dict(arrays)
+        arrays["meta_json"] = np.frombuffer(
+            json.dumps(meta).encode(), np.uint8).copy()
+        with open(nt, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(nt, npz_path)
+        log.info("fold-state checkpoint: %d events, %d B state",
+                 len(state.batch), state.state_bytes())
+
+    def _load_checkpoint(self):
+        """(state, watermark, heads, tombstones, meta) or None — every
+        validation failure logs its reason and falls back."""
+        import numpy as np
+
+        from predictionio_tpu.store.columnar import read_batch
+        from predictionio_tpu.streaming.fold import URFoldState
+
+        npz_path, batch_path = self._ckpt_paths()
+        if npz_path is None or not npz_path.exists() \
+                or not batch_path.exists():
+            return None
+        try:
+            with np.load(npz_path) as npz:
+                arrays = {k: npz[k] for k in npz.files}
+            meta = json.loads(bytes(arrays.pop("meta_json")))
+            if meta.get("paramsFingerprint") != self._params_fingerprint():
+                log.info("fold-state checkpoint: engine params changed — "
+                         "ignoring checkpoint")
+                return None
+            from predictionio_tpu.streaming.fold import fold_state_impl
+
+            conf = os.environ.get("PIO_FOLLOW_STATE", "").lower()
+            if conf in ("sparse", "dense") \
+                    and fold_state_impl() != meta.get("impl"):
+                # an EXPLICIT representation override (the documented
+                # escape hatch) must win over the persisted state — the
+                # restage rebuilds in the requested representation
+                log.info("fold-state checkpoint: PIO_FOLLOW_STATE=%s "
+                         "overrides the checkpoint's %s representation — "
+                         "ignoring checkpoint", conf, meta.get("impl"))
+                return None
+            # tombstone check BEFORE the expensive restore (reading the
+            # batch + a full model emit can be seconds at 1M items —
+            # pointless work if a delete while down invalidates it all)
+            app_id, chan = self._app_channel()
+            live_tombs = self._backend.tombstone_state(app_id, chan)
+            if live_tombs != frozenset(meta.get("tombstones") or []):
+                log.info("follow restart: tombstones changed while down "
+                         "— checkpoint unusable, falling back to the "
+                         "watermark reparse")
+                return None
+            batch, _ids, bmeta = read_batch(batch_path, mmap=False)
+            if bmeta.get("ckptId") != meta.get("ckptId"):
+                log.info("fold-state checkpoint: batch/state id mismatch "
+                         "(torn checkpoint) — ignoring")
+                return None
+            state = URFoldState.restore_checkpoint(
+                self._algo.params, self._ds_params, batch, arrays, meta)
+        except Exception as e:
+            # any corruption shape (torn zip, bad dtype, config drift)
+            # must degrade to the non-checkpoint restart, never crash it
+            log.warning("fold-state checkpoint unusable (%s) — restart "
+                        "falls back to the covered-prefix reparse", e)
+            return None
+        wm = {str(k): int(v) for k, v in (meta.get("watermark") or
+                                          {}).items()}
+        heads = dict(meta.get("heads") or {})
+        tombs = frozenset(meta.get("tombstones") or [])
+        return state, wm, heads, tombs, meta
+
     # -- bootstrap ------------------------------------------------------------
 
     def bootstrap(self) -> bool:
-        """Make a model live: resume from a persisted watermark (daemon
-        restart — re-reads the covered prefix, folds only the suffix),
-        else full restage.  Returns True once a model exists."""
+        """Make a model live: resume from a fold-state checkpoint (no
+        covered-prefix reparse at all), else from a persisted watermark
+        (daemon restart — re-reads the covered prefix, folds only the
+        suffix), else full restage.  Returns True once a model exists."""
         if self.mode != "fold":
             return self._retrain_tick(force=True) in ("retrain", "idle")
         prior = self._load_state()
+        if self._bootstrap_from_checkpoint(prior):
+            return True
         if prior is not None and self._bootstrap_from_watermark(prior):
             return True
         return self._restage(publish=True)
+
+    def _bootstrap_from_checkpoint(self, prior: Optional[dict]) -> bool:
+        """Resume from the persisted fold state: restore the arrays,
+        verify tombstones didn't move while down, re-publish the
+        restored generation to an embedded host, and fold ONLY the
+        events past the checkpoint's watermark — the covered prefix is
+        never reparsed."""
+        loaded = self._load_checkpoint()
+        if loaded is None:
+            return False
+        state, wm, heads, tombs, meta = loaded
+        self._fold = state
+        self._wm, self._heads = wm, heads
+        self._tombstones = tombs
+        self.generation = int((prior or {}).get(
+            "generation", meta.get("followGeneration", 0)))
+        self.instance_id = (prior or {}).get(
+            "instanceId", meta.get("instanceId"))
+        self.bootstrap_events = len(state.batch)
+        log.info("follow restart: restored fold state from checkpoint "
+                 "(%d covered events, %d B, generation %d) — folding "
+                 "only the unapplied suffix", len(state.batch),
+                 state.state_bytes(), self.generation)
+        # the checkpoint equals an already-published generation; an
+        # embedded host still needs its in-process copy swapped in
+        if self.on_publish is not None:
+            self.on_publish([state.model], self._publish_info("restart"))
+        self._update_state_metrics()
+        # fold whatever arrived past the checkpoint watermark right now
+        # (tick also re-runs the tombstone / log-shape / max-lag edges
+        # and restages if the watermark no longer matches the log)
+        self.tick()
+        return True
 
     def _bootstrap_from_watermark(self, prior: dict) -> bool:
         app_id, chan = self._app_channel()
@@ -297,6 +511,7 @@ class FollowTrainer:
         log.info("follow restart: rebuilt state from %d covered events "
                  "(generation %d); folding the unapplied suffix",
                  res["events"], self.generation)
+        self._update_state_metrics()
         # the covered prefix equals the last PUBLISHED generation; the
         # embedded host still needs its in-process copy swapped in
         if self.on_publish is not None:
@@ -339,6 +554,7 @@ class FollowTrainer:
         self._tombstones = tombs
         self.bootstrap_events = len(self._fold.batch)
         self.last_fold_events = len(self._fold.batch)
+        self._last_ckpt_at = 0.0   # a fresh state deserves a prompt ckpt
         if publish:
             self._publish_guarded([self._fold.model], "restage",
                                   time.perf_counter() - t0)
@@ -359,10 +575,25 @@ class FollowTrainer:
             log.exception("follow tick failed")
             self.last_outcome = "error"
             _M_FOLDS.inc(1, outcome="error")
+            self._update_state_metrics()
             raise
         self.last_outcome = outcome
         _M_FOLDS.inc(1, outcome=outcome)
+        self._update_state_metrics()
         return outcome
+
+    def _update_state_metrics(self) -> None:
+        """Refresh the fold-state gauges (bytes + representation mode)
+        and their status() mirror — cheap (an nbytes sum)."""
+        if self.mode == "fold" and self._fold is not None:
+            self._state_bytes = self._fold.state_bytes()
+            self._state_mode = self._fold.state_mode
+        else:
+            self._state_bytes = 0
+            self._state_mode = "retrain"
+        _M_STATE_BYTES.set(self._state_bytes)
+        for m in ("sparse", "dense", "retrain"):
+            _M_STATE_MODE.set(1 if m == self._state_mode else 0, mode=m)
 
     def _tick_inner(self) -> str:
         if self._pending is not None:
@@ -488,6 +719,8 @@ class FollowTrainer:
             "engineInstanceId": self.instance_id,
             "foldEvents": self.last_fold_events,
             "publishedAt": self.last_publish_at,
+            "stateBytes": self._state_bytes,
+            "stateMode": self._state_mode,
         }
 
     def _publish_guarded(self, models, mode: str, duration_s: float,
@@ -569,6 +802,7 @@ class FollowTrainer:
         _M_PUBLISH_TS.set(self.last_publish_at)
         _M_FOLD_S.observe(duration_s, mode=mode)
         self._persist_state()
+        self._maybe_checkpoint()
         rec = _tracing.get_recorder()
         if rec.enabled:
             rec.record(trace.to_doc(rec.tag, "model_swap"))
@@ -611,11 +845,22 @@ class FollowTrainer:
 
     def status(self) -> dict:
         """The /stats.json freshness payload."""
+        # snapshot once: a concurrent tick can demote (self._fold = None)
+        # between a check and a dereference on the HTTP thread
+        fold = self._fold
         return {
             "mode": self.mode,
             "generation": self.generation,
             "lastOutcome": self.last_outcome,
             "lastFoldEvents": self.last_fold_events,
+            "stateBytes": self._state_bytes,
+            "stateMode": self._state_mode,
+            # total events the resident fold state covers — the
+            # deterministic drain signal for scripts/benches (an
+            # "idle" outcome alone can be a tick that ran BEFORE an
+            # append became visible); None in retrain mode
+            "coveredEvents": (len(fold.batch)
+                              if fold is not None else None),
             "lastPublishAt": (
                 _dt.datetime.fromtimestamp(
                     self.last_publish_at,

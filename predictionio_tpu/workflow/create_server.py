@@ -430,6 +430,13 @@ class QueryServerState:
             doc["follower"] = self.follower.status()
         elif self.follow_info is not None:
             doc["follower"] = dict(self.follow_info)
+        # top-level mirror of the fold-state footprint (also a gauge:
+        # pio_follow_state_bytes) so dashboards and the freshness bench
+        # read one stable key regardless of follower topology
+        fr = doc.get("follower")
+        if isinstance(fr, dict):
+            doc["stateBytes"] = fr.get("stateBytes")
+            doc["stateMode"] = fr.get("stateMode")
         return doc
 
     def parse_query(self, body: Dict) -> Any:

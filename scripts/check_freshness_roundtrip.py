@@ -5,21 +5,43 @@ Builds a localfs store, trains a small UR model, deploys it behind the
 event-loop front end with an EMBEDDED follow-trainer (the
 ``pio deploy --follow`` path), then over several rounds:
 
-1. appends events through the storage layer (a brand-new user's
-   purchases — invisible to any stale model);
+1. appends events through the storage layer: co-buyers purchase a seed
+   item the probe user already owns PLUS a BRAND-NEW item — invisible
+   to any stale model, since the recommendable catalog comes from the
+   model (serving history comes from the live store, so an own-purchase
+   probe would reflect even without a fold — the new-item probe cannot);
 2. waits for the follower to fold them (polls the HTTP /stats.json
-   ``freshness.generation`` counter — the SDK's contract);
-3. asserts the live HTTP /queries.json response REFLECTS the append
-   (the new user gets personalized signal scores, not just backfill)
+   ``freshness`` key — generation, covered events — the SDK contract)
    and records the append→reflected wall latency;
-4. asserts exact parity: the deployed model's responses for a fixed
+3. asserts exact parity: the deployed model's responses for a fixed
    probe corpus are identical — same items, same float scores, same
    order — to a from-scratch ``engine.train`` over the same events.
+
+Draining is DETERMINISTIC: the script tracks how many events it
+inserted and waits until ``freshness.follower.coveredEvents`` reaches
+that count with an idle outcome — a bare "idle" can be a tick that ran
+before an append became visible (a race this script used to lose under
+CPU contention).
 
 Any 5xx anywhere, a fold that never lands, or a single float of
 divergence fails the script.  Exit 0 = clean.  Run standalone
 (``python scripts/check_freshness_roundtrip.py``) or via the tier-1
 suite (tests/test_streaming_follow.py wraps it).
+
+Modes:
+
+- default: 12-user / 8-item shape, 3 rounds.
+- ``--storage sharded [--shards N]``: the same roundtrip over the
+  sharded, replicated event store — the proof that delta staging and
+  ``pio deploy --follow`` work unchanged when events are
+  hash-partitioned.
+- ``--large``: the large-catalog smoke (PR 11 tentpole gate): a
+  4000-item catalog under a deliberately small
+  PIO_FOLLOW_STATE_BYTES=32MiB budget.  The legacy dense fold state
+  (4000² × 4 B = 64 MiB per event type) would demote to
+  retrain-per-tick; the sorted-COO sparse state must stay in fold mode
+  (asserted via ``freshness.follower.stateMode == "sparse"`` and
+  ``mode == "fold"``), reflect an append, and keep exact parity.
 """
 
 from __future__ import annotations
@@ -39,15 +61,22 @@ os.environ.setdefault("PIO_UR_SERVE_SCORER", "host")
 ROUNDS = 3
 WAIT_S = 20.0
 
-# --storage sharded [--shards N] runs the same roundtrip over the
-# sharded, replicated event store — the proof that delta staging and
-# `pio deploy --follow` work unchanged when events are hash-partitioned
 STORAGE_TYPE = "localfs"
 SHARDS = 2
+LARGE = "--large" in sys.argv
 if "--storage" in sys.argv:
     STORAGE_TYPE = sys.argv[sys.argv.index("--storage") + 1]
 if "--shards" in sys.argv:
     SHARDS = int(sys.argv[sys.argv.index("--shards") + 1])
+
+# the large smoke pins the budget low enough that the DENSE state could
+# not hold this catalog (I² × 4 B = 64 MiB > 32 MiB) while the sparse
+# state (O(nnz)) fits with room to spare
+LARGE_ITEMS = 4000
+LARGE_BUDGET = 32 << 20
+if LARGE:
+    ROUNDS = 2
+    os.environ["PIO_FOLLOW_STATE_BYTES"] = str(LARGE_BUDGET)
 
 
 def buy(u: str, i: str):
@@ -55,6 +84,19 @@ def buy(u: str, i: str):
 
     return Event(event="purchase", entity_type="user", entity_id=u,
                  target_entity_type="item", target_entity_id=i)
+
+
+def seed_events():
+    if LARGE:
+        # one purchase per item puts all LARGE_ITEMS in the catalog;
+        # u0..u99 each own a 40-item slice, so cross-joins stay tiny
+        evs = [buy(f"u{k % 100}", f"i{k}") for k in range(LARGE_ITEMS)]
+        # a correlated cluster for the probe rounds
+        evs += [buy(f"u{u}", f"i{it}") for u in range(12)
+                for it in range(8) if (u * it + u) % 3]
+        return evs
+    return [buy(f"u{u}", f"i{it}")
+            for u in range(12) for it in range(8) if (u * it + u) % 3]
 
 
 def build_store(path: str):
@@ -72,10 +114,10 @@ def build_store(path: str):
                                         "MODELDATA")}))
     set_storage(storage)
     app_id = storage.apps.insert(App(0, "freshapp"))
-    events = [buy(f"u{u}", f"i{it}")
-              for u in range(12) for it in range(8) if (u * it + u) % 3]
-    storage.l_events.insert_batch(events, app_id)
-    return storage, app_id
+    events = seed_events()
+    for s in range(0, len(events), 5000):
+        storage.l_events.insert_batch(events[s:s + 5000], app_id)
+    return storage, app_id, len(events)
 
 
 def canon(doc: dict):
@@ -105,7 +147,7 @@ def main() -> int:
     httpd = None
     follower = None
     try:
-        storage, app_id = build_store(tmp)
+        storage, app_id, n_events = build_store(tmp)
         engine = UniversalRecommenderEngine.apply()
         ap = URAlgorithmParams(app_name="freshapp", mesh_dp=1,
                                max_correlators_per_item=8)
@@ -141,14 +183,21 @@ def main() -> int:
                                 f"{payload[:200]!r}")
             return r.status, json.loads(payload)
 
+        def follower_stats():
+            _, stats = http_json("GET", "/stats.json")
+            return stats.get("freshness", {}).get("follower", {})
+
         def drain(timeout: float = WAIT_S) -> bool:
-            """Wait for the follower to fold everything pending (a tick
-            that found nothing new)."""
+            """Wait until the follower's resident state covers EVERY
+            event this script inserted AND the last tick found nothing
+            new — deterministic, unlike a bare lastOutcome poll."""
             end = time.time() + timeout
             while time.time() < end:
-                _, stats = http_json("GET", "/stats.json")
-                fr = stats.get("freshness", {}).get("follower", {})
-                if fr.get("lastOutcome") in ("idle", "disabled"):
+                fr = follower_stats()
+                covered = fr.get("coveredEvents")
+                caught_up = covered is None or covered >= n_events
+                if caught_up and fr.get("lastOutcome") in ("idle",
+                                                           "disabled"):
                     return True
                 time.sleep(0.02)
             return False
@@ -158,23 +207,49 @@ def main() -> int:
         if not drain():
             problems.append("follower never drained after bootstrap "
                             f"(outcome={follower.last_outcome})")
+        if LARGE:
+            fr = follower_stats()
+            if fr.get("mode") != "fold":
+                problems.append(
+                    f"large-catalog: follower demoted to {fr.get('mode')} "
+                    f"under PIO_FOLLOW_STATE_BYTES={LARGE_BUDGET} — the "
+                    "sparse state must hold fold mode here")
+            if fr.get("stateMode") != "sparse":
+                problems.append(
+                    f"large-catalog: stateMode={fr.get('stateMode')}, "
+                    "expected sparse")
+            sb = fr.get("stateBytes") or 0
+            dense_equiv = LARGE_ITEMS * LARGE_ITEMS * 4
+            if not 0 < sb <= LARGE_BUDGET:
+                problems.append(
+                    f"large-catalog: stateBytes={sb} outside "
+                    f"(0, {LARGE_BUDGET}]")
+            if dense_equiv <= LARGE_BUDGET:
+                problems.append("large-catalog smoke misconfigured: the "
+                                "dense state would also fit the budget")
         for rnd in range(ROUNDS):
-            fresh_user = f"fresh{rnd}"
+            seed_item = "i1"
+            new_item = f"fresh_item_{rnd}"
+            probe_user = f"probe{rnd}"
+            # the probe user's history holds seed_item BEFORE the round,
+            # so reflection == the brand-new co-occurring item appearing
+            # in their response — impossible on any stale model, whose
+            # catalog cannot contain new_item
+            storage.l_events.insert_batch([buy(probe_user, seed_item)],
+                                          app_id)
+            n_events += 1
+            drain()
             t0 = time.time()
+            cobuyers = [f"cob{rnd}_{j}" for j in range(6)]
             storage.l_events.insert_batch(
-                [buy(fresh_user, "i1"), buy(fresh_user, "i2")], app_id)
+                [buy(u, seed_item) for u in cobuyers]
+                + [buy(u, new_item) for u in cobuyers], app_id)
+            n_events += 12
             reflected = None
             while time.time() - t0 < WAIT_S:
                 st, doc = http_json("POST", "/queries.json",
-                                    {"user": fresh_user, "num": 5})
-                # reflection == the fresh user's own purchase (i1, top
-                # of every stale model's backfill) DISAPPEARING from
-                # their response via the own-purchase blacklist — a
-                # model that hasn't folded this append cannot produce
-                # that.  (A positive score or a generation bump can't
-                # tell: backfill scores are positive for unknown users,
-                # and the bootstrap publish can race the first append.)
-                if st == 200 and all(r["item"] != "i1"
+                                    {"user": probe_user, "num": 30})
+                if st == 200 and any(r["item"] == new_item
                                      for r in doc["itemScores"]):
                     reflected = time.time() - t0
                     break
@@ -185,14 +260,16 @@ def main() -> int:
                     f"(follower outcome={follower.last_outcome})")
                 break
             latencies.append(reflected)
-            # the i1-blacklist proof covers the append's first event;
-            # drain so the parity model covers the whole batch before
-            # comparing vs a from-scratch retrain over the same events
-            drain()
+            # the new-item proof covers the append's visibility; drain so
+            # the parity model covers the whole batch before comparing
+            # vs a from-scratch retrain over the same events
+            if not drain():
+                problems.append(f"round {rnd}: drain after append timed "
+                                "out")
             invalidate_staging_cache()
             ref = engine.train(ep)[0]
             probes = ([{"user": f"u{u}", "num": 6} for u in range(0, 12, 3)]
-                      + [{"user": fresh_user, "num": 5},
+                      + [{"user": probe_user, "num": 5},
                          {"user": "nobody", "num": 4},
                          {"item": "i2", "num": 5}])
             for body in probes:
@@ -211,9 +288,13 @@ def main() -> int:
         conn.close()
         if not problems:
             lat = ", ".join(f"{v * 1e3:.0f}ms" for v in latencies)
+            extra = ""
+            if LARGE:
+                extra = (f", {LARGE_ITEMS}-item catalog held fold mode "
+                         f"sparse under a {LARGE_BUDGET >> 20} MiB budget")
             print(f"ok: {ROUNDS} append→fold→reflected rounds "
                   f"(latencies {lat}), responses exactly equal a "
-                  "from-scratch retrain each round, zero 5xx")
+                  f"from-scratch retrain each round, zero 5xx{extra}")
     finally:
         if follower is not None:
             follower.stop()

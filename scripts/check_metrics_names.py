@@ -76,6 +76,10 @@ REQUIRED_METRICS = frozenset({
     "pio_follow_lag_events",
     "pio_follow_last_publish_timestamp_seconds",
     "pio_model_generation",
+    # sparse fold state (PR 11): capacity alerting keys on the resident
+    # state footprint and the sparse|dense|retrain mode flag
+    "pio_follow_state_bytes",
+    "pio_follow_state_mode",
     # sharded/replicated store contract (PR 9): the failover drill and
     # replica-lag alerting key on these
     "pio_store_shard_events_total",

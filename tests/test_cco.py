@@ -495,3 +495,101 @@ def test_sparse_counts_coo_bincount_downgrade():
     C, flat = cco_ops._sparse_counts(p, p, want_coo=True)
     np.testing.assert_array_equal(flat, np.flatnonzero(C))
     assert len(flat) > 0
+
+
+def test_pure_coo_counts_match_dense():
+    """_sparse_counts_coo (no dense matrix anywhere) must reproduce the
+    dense host counts cell for cell, across the chunked merge."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_ip, n_it = 400, 300, 250
+    pu, pi = random_interactions(n_users, n_ip, 5000, 101)
+    au, ai = random_interactions(n_users, n_it, 6000, 102)
+    p = cco_ops._SparseHostCSR(pu, pi, n_ip, n_users)
+    a = cco_ops._SparseHostCSR(au, ai, n_it, n_users)
+    cells, counts = cco_ops._sparse_counts_coo(p, a)
+    C_ref = cco_ops._sparse_counts(p, a)
+    C = np.zeros((n_ip, n_it), np.int32)
+    C[cells // n_it, cells % n_it] = counts
+    np.testing.assert_array_equal(C, C_ref)
+    assert np.all(np.diff(cells) > 0)
+
+
+def test_pure_coo_counts_chunked_merge():
+    """The end-of-scan merge across expansion chunks (argsort +
+    segment-sum) must aggregate duplicate cells exactly — forced by
+    shrinking the chunk budget so every user lands in its own chunk."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_items = 200, 60
+    pu, pi = random_interactions(n_users, n_items, 3000, 103)
+    p = cco_ops._SparseHostCSR(pu, pi, n_items, n_users)
+    saved = cco_ops._SPARSE_CHUNK_PAIRS
+    try:
+        cco_ops._SPARSE_CHUNK_PAIRS = 16   # many tiny chunks
+        cells, counts = cco_ops._sparse_counts_coo(p, p)
+    finally:
+        cco_ops._SPARSE_CHUNK_PAIRS = saved
+    C_ref = cco_ops._sparse_counts(p, p)
+    C = np.zeros((n_items, n_items), np.int32)
+    C[cells // n_items, cells % n_items] = counts
+    np.testing.assert_array_equal(C, C_ref)
+
+
+def test_huge_catalog_coo_dispatch_matches_dense(monkeypatch):
+    """When the dense host count matrix is over budget the runner must
+    take the pure-COO dispatch (counts + row-scoped sparse tail, no
+    [I_p, I_t] array anywhere) and return bit-identical results —
+    forced by shrinking _SPARSE_C_BYTES under the same shape."""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    n_users, n_items = 300, 120
+    u, i = random_interactions(n_users, n_items, 2500, 104)
+    monkeypatch.setenv("PIO_CCO_SPARSE", "1")
+    monkeypatch.setenv("PIO_CCO_SPARSE_TAIL", "host")
+
+    def run():
+        r = cco_ops._SparseHostRunner(u, i, n_users, n_items)
+        d = r.dispatch(u, i, n_items, 6, 0.5, True, self_pair=True)
+        assert d is not None
+        return r.collect(d)
+
+    s_ref, i_ref = run()
+    saved = cco_ops._SPARSE_C_BYTES
+    try:
+        cco_ops._SPARSE_C_BYTES = 1024    # dense C "cannot exist"
+        s_coo, i_coo = run()
+    finally:
+        cco_ops._SPARSE_C_BYTES = saved
+    np.testing.assert_array_equal(s_ref, s_coo)
+    np.testing.assert_array_equal(i_ref, i_coo)
+
+
+def test_llr_topk_sparse_rows_matches_host_tail_slices():
+    """The fold engine's row-scoped sparse tail must equal the TRAINING
+    host tail's rows at an arbitrary row subset — same ``_llr_cells``
+    compiled program, so bit-identity is structural — including
+    self-pair masking at the subset's GLOBAL row ids.  (The host tail's
+    own parity with the device tail is pinned separately on real count
+    data; two DIFFERENT XLA compilations of the same elementwise chain
+    can disagree by 1 ULP on adversarial inputs, so this test compares
+    within the one program the fold actually shares with training.)"""
+    from predictionio_tpu.ops import cco as cco_ops
+
+    rng = np.random.default_rng(105)
+    n_p, n_t, n_users = 90, 70, 500
+    C = (rng.random((n_p, n_t)) < 0.1).astype(np.int32) * \
+        rng.integers(1, 9, (n_p, n_t)).astype(np.int32)
+    rc = C.sum(axis=1).astype(np.int64) + rng.integers(0, 5, n_p)
+    cc = C.sum(axis=0).astype(np.int64) + rng.integers(0, 5, n_t)
+    # full-matrix host tail with the diagonal masked, as training runs it
+    s_host, i_host = cco_ops._llr_topk_sparse_host(
+        C, rc, cc, float(n_users), 0.25, top_k=5, exclude_self=True)
+    rows = np.asarray(sorted(rng.choice(n_p, 17, replace=False)), np.int64)
+    sub = C[rows]
+    lr, lc = np.nonzero(sub)
+    s_sp, i_sp = cco_ops._llr_topk_sparse_rows(
+        lr, lc, sub[lr, lc], rc[rows], cc, float(n_users), 0.25,
+        top_k=5, n_rows=len(rows), n_cols=n_t, self_cols=rows)
+    np.testing.assert_array_equal(s_sp, s_host[rows])
+    np.testing.assert_array_equal(i_sp, i_host[rows])

@@ -1,0 +1,271 @@
+"""Sparse fold state: sparse≡dense bit-exactness, the budget boundary,
+and the fold-state checkpoint.
+
+The PR-11 tentpole replaces the fold engine's dense [I_p, I_t] count
+matrices with sorted-COO cells.  Its contract is that the representation
+is INVISIBLE: for any delta sequence — item-space growth, mid-array code
+inserts, duplicate-only deltas, marginal-changing new users — the sparse
+state's emitted models are bit-identical to the dense state's, and both
+to a from-scratch train.  These tests drive both representations over
+the same storage tails (no mocks on the exactness path) and pin the
+budget-demotion boundary the sparse state moves.
+"""
+
+import numpy as np
+import pytest
+
+from test_streaming_follow import (  # shared fixtures/helpers
+    _buy,
+    _seed_events,
+    _set_item,
+    _tail,
+    _ur_setup,
+    host_serving,  # noqa: F401  (fixture re-export)
+)
+
+
+def _two_states(ap, ds, batch, monkeypatch):
+    """Bootstrap one sparse and one dense URFoldState from ONE batch
+    object (shared dicts, so one storage tail feeds both)."""
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    monkeypatch.setenv("PIO_FOLLOW_STATE", "sparse")
+    sparse = URFoldState.bootstrap(ap, ds, batch)
+    monkeypatch.setenv("PIO_FOLLOW_STATE", "dense")
+    dense = URFoldState.bootstrap(ap, ds, batch)
+    monkeypatch.delenv("PIO_FOLLOW_STATE")
+    assert sparse.state_mode == "sparse" and dense.state_mode == "dense"
+    return sparse, dense
+
+
+def _assert_models_equal(ma, mb, ctx=""):
+    assert ma.item_dict.strings() == mb.item_dict.strings(), ctx
+    assert ma.user_dict.strings() == mb.user_dict.strings(), ctx
+    assert set(ma.indicator_idx) == set(mb.indicator_idx), ctx
+    for name in ma.indicator_idx:
+        assert np.array_equal(ma.indicator_idx[name],
+                              mb.indicator_idx[name]), (ctx, name)
+        assert np.array_equal(ma.indicator_llr[name],
+                              mb.indicator_llr[name]), (ctx, name)
+        assert (ma.event_item_dicts[name].strings()
+                == mb.event_item_dicts[name].strings()), (ctx, name)
+    assert np.array_equal(ma.popularity, mb.popularity), ctx
+    assert np.array_equal(ma.user_seen.indptr, mb.user_seen.indptr), ctx
+    assert np.array_equal(ma.user_seen.values, mb.user_seen.values), ctx
+    assert ma.item_properties == mb.item_properties, ctx
+
+
+@pytest.mark.parametrize("dense_rellr", ["0", "default"])
+def test_sparse_equals_dense_randomized(fs_storage, host_serving,
+                                        monkeypatch, dense_rellr):
+    """Randomized delta property test: across folds mixing item-space
+    growth, duplicates, new users (marginal changes), property $sets and
+    single-pair sliced re-LLRs, the sparse and dense states emit
+    bit-identical models — and the final model equals a from-scratch
+    train.  Runs twice: with the small-catalog dense-kernel routing off
+    (PIO_FOLLOW_DENSE_RELLR_BYTES=0 — every re-LLR takes the SPARSE
+    tail, the at-scale path) and at its default (the tiny-shape fast
+    path)."""
+    if dense_rellr != "default":
+        monkeypatch.setenv("PIO_FOLLOW_DENSE_RELLR_BYTES", dense_rellr)
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    app_id, engine, ap, ep = _ur_setup(
+        fs_storage, indicator_params={"view": {"maxCorrelatorsPerItem": 4}})
+    rng = np.random.default_rng(23)
+    fs_storage.l_events.insert_batch(_seed_events(seed=31), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"i{k}", {"category": "red" if k < 4 else "blue"})
+         for k in range(8)], app_id)
+    tail = _tail(fs_storage, app_id, {}, None, None)
+    sparse, dense = _two_states(ap, ep.data_source_params, tail["batch"],
+                                monkeypatch)
+    _assert_models_equal(sparse.model, dense.model, "bootstrap")
+    wm, heads = tail["watermark"], tail["heads"]
+    for rnd in range(6):
+        evs = []
+        # duplicates of existing events
+        evs += [_buy(f"u{int(u)}", f"i{int(it)}")
+                for u in rng.integers(0, 12, 3)
+                for it in rng.integers(0, 8, 2)]
+        if rnd % 2:
+            # marginal change: brand-new users, sometimes new items
+            base = 100 + rnd * 10
+            evs += [_buy(f"u{base + int(u)}", f"i{int(it)}")
+                    for u in range(2) for it in rng.integers(0, 10, 3)]
+        if rnd == 2:
+            # items seen ONLY as views earlier get purchased now: their
+            # target codes predate every purchase code → mid-array
+            # insert + full state remap
+            evs += [_buy("u1", f"i{k}", event="view") for k in (20, 21)]
+        if rnd == 3:
+            evs += [_buy("u2", "i20"), _buy("u3", "i21")]
+        if rnd == 4:
+            evs += [_set_item("i2", {"category": "green"})]
+        if rnd == 5:
+            # single primary pair from an existing user → sliced re-LLR
+            evs = [_buy("u0", "i6")]
+        fs_storage.l_events.insert_batch(evs, app_id)
+        tail = _tail(fs_storage, app_id, wm, sparse.batch, heads)
+        assert tail is not None and tail["events"] > 0
+        ms = sparse.fold(tail["batch"])
+        md = dense.fold(tail["batch"])
+        wm, heads = tail["watermark"], tail["heads"]
+        _assert_models_equal(ms, md, f"round {rnd}")
+        assert sparse.last_fold_stats == dense.last_fold_stats, rnd
+    # the sliced path really ran somewhere in round 5
+    assert any(s["mode"] == "sliced"
+               for s in sparse.last_fold_stats.values())
+    # both equal a from-scratch retrain at the end
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+
+    invalidate_staging_cache()
+    ref = engine.train(ep)[0]
+    _assert_models_equal(ms, ref, "vs train")
+    algo = URAlgorithm(ap)
+    for q in [URQuery(user="u1", num=6), URQuery(user="u101", num=5),
+              URQuery(user="nobody", num=4)]:
+        got = [(s.item, float(s.score))
+               for s in algo.predict(ms, q).item_scores]
+        want = [(s.item, float(s.score))
+                for s in algo.predict(ref, q).item_scores]
+        assert got == want, q
+
+
+def test_sparse_counts_unit():
+    """_SparseCounts merge/gather/remap against a dense reference."""
+    from predictionio_tpu.streaming.fold import _SparseCounts
+
+    rng = np.random.default_rng(7)
+    C = np.zeros((37, 23), np.int32)
+    sc = _SparseCounts.empty()
+    for _ in range(8):
+        rows = rng.integers(0, 37, 50).astype(np.int64)
+        cols = rng.integers(0, 23, 50).astype(np.int64)
+        np.add.at(C, (rows, cols), 1)
+        sc.add_pairs(rows, cols)
+        assert np.array_equal(sc.to_dense(37, 23), C)
+        assert np.all(np.diff(sc.keys) > 0)      # sorted, unique
+    # row-subset gather
+    rows = np.asarray(sorted(rng.choice(37, 9, replace=False)), np.int64)
+    local, cols, counts = sc.row_cells(rows)
+    got = np.zeros((9, 23), np.int32)
+    got[local, cols] = counts
+    assert np.array_equal(got, C[rows])
+    # strictly-increasing col remap (23 → 30 cols, monotone injection)
+    perm = np.sort(rng.choice(30, 23, replace=False)).astype(np.int64)
+    sc.remap_cols(perm)
+    C2 = np.zeros((37, 30), np.int32)
+    C2[:, perm] = C
+    assert np.array_equal(sc.to_dense(37, 30), C2)
+    assert np.all(np.diff(sc.keys) > 0)
+    # strictly-increasing row remap
+    rperm = np.sort(rng.choice(45, 37, replace=False)).astype(np.int64)
+    sc.remap_rows(rperm)
+    C3 = np.zeros((45, 30), np.int32)
+    C3[rperm, :] = C2
+    assert np.array_equal(sc.to_dense(45, 30), C3)
+    assert np.all(np.diff(sc.keys) > 0)
+    # from_dense roundtrip
+    assert np.array_equal(_SparseCounts.from_dense(C3).to_dense(45, 30), C3)
+
+
+def test_budget_boundary_pins_demotion_threshold(fs_storage, host_serving,
+                                                 monkeypatch):
+    """The sparse state's demotion threshold is its O(nnz) footprint: a
+    budget the DENSE state cannot fit (I²·4 alone exceeds it) holds the
+    sparse state in fold mode, and a budget one byte under the sparse
+    footprint demotes."""
+    from predictionio_tpu.streaming.fold import (
+        FoldUnsupported, URFoldState,
+    )
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage,
+                                       event_names=("purchase",))
+    # ~600 distinct items: dense C = 600²·4 ≈ 1.44 MB; sparse nnz stays
+    # tiny (each user owns a 6-item slice)
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{k % 100}", f"i{k}") for k in range(600)], app_id)
+    tail = _tail(fs_storage, app_id, {}, None, None)
+
+    monkeypatch.setenv("PIO_FOLLOW_STATE", "sparse")
+    state = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    sparse_bytes = state.state_bytes()
+    n_items = len(state.model.item_dict)
+    dense_equiv = n_items * n_items * 4
+    assert sparse_bytes < dense_equiv, (sparse_bytes, dense_equiv)
+
+    # a budget between the two: sparse folds, dense demotes
+    budget = max(sparse_bytes * 2, sparse_bytes + 4096)
+    assert budget < dense_equiv
+    monkeypatch.setenv("PIO_FOLLOW_STATE_BYTES", str(budget))
+    fs_storage.l_events.insert_batch([_buy("u0", "i1")], app_id)
+    tail2 = _tail(fs_storage, app_id, tail["watermark"], state.batch,
+                  tail["heads"])
+    state.fold(tail2["batch"])          # sparse: within budget
+
+    monkeypatch.setenv("PIO_FOLLOW_STATE", "dense")
+    with pytest.raises(FoldUnsupported):
+        URFoldState.bootstrap(ap, ep.data_source_params,
+                              _tail(fs_storage, app_id, {}, None,
+                                    None)["batch"])
+
+    # one byte under the sparse footprint demotes the sparse state too
+    monkeypatch.setenv("PIO_FOLLOW_STATE", "sparse")
+    monkeypatch.setenv("PIO_FOLLOW_STATE_BYTES",
+                       str(state.state_bytes() - 1))
+    fs_storage.l_events.insert_batch([_buy("u0", "i2")], app_id)
+    tail3 = _tail(fs_storage, app_id, tail2["watermark"], state.batch,
+                  tail2["heads"])
+    with pytest.raises(FoldUnsupported):
+        state.fold(tail3["batch"])
+
+
+def test_checkpoint_roundtrip_bit_exact(fs_storage, host_serving):
+    """checkpoint_arrays → restore_checkpoint reproduces the state: the
+    restored model is bit-identical, and folding the same delta into
+    the original and the restored state stays bit-identical."""
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_seed_events(seed=41), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item("i1", {"category": "red"})], app_id)
+    tail = _tail(fs_storage, app_id, {}, None, None)
+    state = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    arrays, meta = state.checkpoint_arrays()
+    restored = URFoldState.restore_checkpoint(
+        ap, ep.data_source_params, state.batch, arrays, meta)
+    _assert_models_equal(state.model, restored.model, "restore")
+    # fold the same suffix into both
+    fs_storage.l_events.insert_batch(
+        [_buy("newguy", "i3"), _buy("u1", "i5")], app_id)
+    t2 = _tail(fs_storage, app_id, tail["watermark"], state.batch,
+               tail["heads"])
+    m1 = state.fold(t2["batch"])
+    m2 = restored.fold(t2["batch"])
+    _assert_models_equal(m1, m2, "post-restore fold")
+
+
+def test_checkpoint_fingerprint_rejects_corruption(fs_storage,
+                                                   host_serving):
+    """A flipped bit in the persisted pair set must fail the integrity
+    fingerprint (ValueError → the follower restages)."""
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage,
+                                       event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=43), app_id)
+    tail = _tail(fs_storage, app_id, {}, None, None)
+    state = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    arrays, meta = state.checkpoint_arrays()
+    bad = dict(arrays)
+    pairs = np.array(bad["t0_pairs"])
+    pairs[0] ^= 1
+    bad["t0_pairs"] = pairs
+    with pytest.raises(ValueError, match="fingerprint"):
+        URFoldState.restore_checkpoint(ap, ep.data_source_params,
+                                       state.batch, bad, meta)

@@ -563,6 +563,110 @@ def test_fold_exception_drops_state_and_restages(fs_storage, host_serving,
     assert res.item_scores, "restaged model must serve the new user"
 
 
+# -- fold-state checkpoint ---------------------------------------------------
+
+
+def _persisted_follower(fs_storage, engine, ep, engine_id="ckpt-eng"):
+    from predictionio_tpu.streaming.follow import FollowTrainer
+
+    return FollowTrainer(engine, ep, engine_id, storage=fs_storage,
+                         interval=3600, persist=True)
+
+
+def test_checkpoint_restart_skips_covered_prefix(fs_storage, host_serving,
+                                                 monkeypatch):
+    """A restart with a valid fold-state checkpoint restores the arrays
+    and folds ONLY the unapplied suffix — the covered prefix is never
+    reparsed (the watermark fallback is patched to prove it's not
+    reached), and the published model equals a from-scratch train."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+    from predictionio_tpu.streaming.follow import FollowTrainer
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=51), app_id)
+    t1 = _persisted_follower(fs_storage, engine, ep)
+    assert t1.mode == "fold"
+    assert t1.bootstrap()           # publishes + writes the checkpoint
+    covered = len(t1._fold.batch)
+    npz_path, batch_path = t1._ckpt_paths()
+    assert npz_path.exists() and batch_path.exists()
+    # "SIGKILL": drop the object; events arrive while down
+    suffix = [_buy(f"v{k}", "i1") for k in range(4)] + [_buy("v0", "i2")]
+    fs_storage.l_events.insert_batch(suffix, app_id)
+
+    def boom(self, prior):
+        raise AssertionError("covered-prefix reparse ran despite a "
+                             "valid checkpoint")
+
+    monkeypatch.setattr(FollowTrainer, "_bootstrap_from_watermark", boom)
+    t2 = _persisted_follower(fs_storage, engine, ep)
+    assert t2.bootstrap()
+    assert t2.bootstrap_events == covered
+    assert t2.last_fold_events == len(suffix)
+    assert t2.last_outcome == "fold"
+    algo = URAlgorithm(ap)
+    _assert_model_equals_fresh(
+        t2._fold.model, engine, ep,
+        [URQuery(user="u1", num=5), URQuery(user="v0", num=5)], algo)
+
+
+def test_checkpoint_env_override_wins(fs_storage, host_serving,
+                                      monkeypatch):
+    """An EXPLICIT PIO_FOLLOW_STATE that disagrees with the persisted
+    representation invalidates the checkpoint — the escape hatch must
+    actually switch representations on restart, not be silently
+    overridden by the restored state."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=53), app_id)
+    t1 = _persisted_follower(fs_storage, engine, ep)
+    assert t1.bootstrap()
+    assert t1._fold.state_mode == "sparse"
+    monkeypatch.setenv("PIO_FOLLOW_STATE", "dense")
+    t2 = _persisted_follower(fs_storage, engine, ep)
+    assert t2._load_checkpoint() is None     # explicit override refuses
+    assert t2.bootstrap()                    # ...and the restage lands
+    assert t2._fold.state_mode == "dense"
+
+
+def test_checkpoint_invalid_falls_back(fs_storage, host_serving):
+    """A torn/corrupt checkpoint (truncated npz) and a tombstone change
+    while down both fall back to the non-checkpoint restart paths."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=52), app_id)
+    dead = fs_storage.l_events.insert(_buy("deadguy", "i0"), app_id)
+    t1 = _persisted_follower(fs_storage, engine, ep)
+    assert t1.bootstrap()
+    # tombstone while "down": the checkpoint must refuse
+    assert fs_storage.l_events.delete(dead, app_id)
+    t2 = _persisted_follower(fs_storage, engine, ep)
+    assert t2._bootstrap_from_checkpoint(t2._load_state()) is False
+    # corruption: truncate the npz → loader rejects, full bootstrap
+    # still lands through the fallback paths
+    npz_path, _ = t1._ckpt_paths()
+    npz_path.write_bytes(npz_path.read_bytes()[:64])
+    t3 = _persisted_follower(fs_storage, engine, ep)
+    assert t3._load_checkpoint() is None
+    assert t3.bootstrap()
+    assert t3._fold is not None
+    assert t3._fold.model.user_dict.id("deadguy") is None
+
+
+def test_check_freshness_roundtrip_large_catalog():
+    """PR-11 tentpole gate: a 4000-item catalog under a 32 MiB budget —
+    the dense fold state (64 MiB of counts) would demote to retrain;
+    the sparse state must stay in fold mode, reflect appends, and keep
+    exact parity (scripts/check_freshness_roundtrip.py --large)."""
+    r = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_freshness_roundtrip.py"),
+         "--large"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 # -- daemon: SIGKILL + watermark restart -------------------------------------
 
 
