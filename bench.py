@@ -1243,6 +1243,8 @@ def bench_store_scale(smoke: bool) -> dict:
                         f"({len(ids)} rows / {len(set(ids))} unique, "
                         f"want {n})")
                 # cold-train scan: per-shard columnar snapshots, merged
+                # (same methodology as the PR-9 baseline: one cold
+                # find_batches after the build)
                 ev.build_snapshot(1)
                 t0 = time.perf_counter()
                 batches = list(ev.find_batches(1))
@@ -1252,6 +1254,27 @@ def bench_store_scale(smoke: bool) -> dict:
                     raise AssertionError(
                         f"shards={shards}: merged scan {total} != {n}")
                 out[f"store_scan_s{shards}_events_per_sec"] = n / wall
+                # scan-pipeline extras: pool width + per-shard wall
+                # (the straggler view) of the LAST merged scan, and the
+                # live fan-out path measured explicitly (the merged
+                # cross-shard snapshot normally short-circuits it)
+                from predictionio_tpu.storage.sharded import (
+                    _M_SCAN_SHARD_S, _M_SCAN_WORKERS,
+                )
+                out[f"store_scan_s{shards}_workers"] = int(
+                    _M_SCAN_WORKERS.value())
+                t0 = time.perf_counter()
+                res = ev._fanout_snapshot_scan(1)
+                wall = time.perf_counter() - t0
+                if res is None or res["events"] != n:
+                    raise AssertionError(
+                        f"shards={shards}: fan-out scan "
+                        f"{res and res['events']} != {n}")
+                out[f"store_scan_fanout_s{shards}_events_per_sec"] = (
+                    n / wall)
+                for k in range(shards):
+                    out[f"store_scan_s{shards}_shard{k}_seconds"] = round(
+                        _M_SCAN_SHARD_S.value(shard=str(k)), 6)
                 out[f"store_scale_integrity_s{shards}"] = "ok"
             finally:
                 # close BEFORE rmtree even on failure, or leaked follower
@@ -1259,6 +1282,17 @@ def bench_store_scale(smoke: bool) -> dict:
                 if ev is not None:
                     ev.close()
                 shutil.rmtree(tmp, ignore_errors=True)
+        # scan_parallel_recovery guard (PR 12 tentpole): the merged cold
+        # scan at shards=4 must hold >=0.5x the shards=1 figure on the
+        # same box — the pre-pipeline serial loop held ~0.17x
+        ratio = (out["store_scan_s4_events_per_sec"]
+                 / max(out["store_scan_s1_events_per_sec"], 1e-9))
+        out["store_scan_parallel_recovery_ratio"] = round(ratio, 3)
+        if ratio < 0.5:
+            raise AssertionError(
+                f"scan_parallel_recovery: shards=4 merged cold scan holds "
+                f"only {ratio:.2f}x of shards=1 (guard: >=0.5x)")
+        out["store_scale_scan_parallel_recovery"] = "ok"
         # replication cost: identical shape with and without the barrier
         n_r = max(2_000, n // 10)
         for replicas in (1, 2):
@@ -3231,6 +3265,8 @@ def main() -> int:
         "store_ingest_repl2_events_per_sec": 0.0,
         "store_repl_overhead_ratio": 0.0,
         "store_scale_events": 0,
+        "store_scan_parallel_recovery_ratio": 0.0,
+        "store_scale_scan_parallel_recovery": "section_failed",
     })
     store_failover = _run_section("store_failover", args.smoke, {
         "store_failover_acked_events": 0,

@@ -47,12 +47,15 @@ delta staging and PR 8's follow-trainer run unchanged on a sharded store.
 from __future__ import annotations
 
 import datetime as _dt
+import heapq
 import json
 import logging
 import os
 import threading
 import time
 import zlib
+from concurrent.futures import ThreadPoolExecutor
+from itertools import islice
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -63,7 +66,7 @@ from predictionio_tpu.storage.snapshot import (
     _fsync_write,
     _last_newline_boundary,
 )
-from predictionio_tpu.store.columnar import EventBatch, EventIdColumn
+from predictionio_tpu.store.columnar import BatchMerger, EventBatch
 
 log = logging.getLogger("pio.sharded")
 
@@ -92,6 +95,18 @@ _M_PROMOTIONS = _REG.counter(
     "Shard failovers — replica promoted to primary, by shard and reason")
 _M_SHARDS = _REG.gauge(
     "pio_store_shards", "Configured shard count of the sharded event store")
+_M_SCAN_SHARD_S = _REG.gauge(
+    "pio_store_scan_shard_duration_seconds",
+    "Per-shard scan+parse wall seconds of the last cross-shard merged "
+    "scan, by shard — the scan pipeline's straggler view")
+_M_SCAN_WORKERS = _REG.gauge(
+    "pio_store_scan_workers",
+    "Thread-pool width used by the last cross-shard merged scan "
+    "(1 = the serial legacy path, the parallel pipeline's parity oracle)")
+_M_SCAN_RATE = _REG.gauge(
+    "pio_store_scan_merged_events_per_sec",
+    "Merged events/second over the last cross-shard merged cold scan "
+    "(per-shard fan-out + k-way merge, wall clock)")
 
 
 def shard_of(entity_type: str, entity_id: str, n: int) -> int:
@@ -126,6 +141,21 @@ def _poll_s() -> float:
         return float(os.environ.get("PIO_STORE_REPL_POLL_S", "0.05"))
     except ValueError:
         return 0.05
+
+
+def _scan_workers(n_shards: int) -> int:
+    """PIO_SCAN_WORKERS: thread-pool width for cross-shard merged scans
+    (``snapshot_scan`` / ``scan_tail_from`` / ``scan_events_up_to`` and
+    everything riding them — ``find_batches``, delta staging, the
+    ``--follow`` bootstrap).  Default ≈ cores, capped at the shard
+    count; ``1`` forces the serial legacy path (the parity oracle)."""
+    try:
+        w = int(os.environ.get("PIO_SCAN_WORKERS", "0") or "0")
+    except ValueError:
+        w = 0
+    if w <= 0:
+        w = os.cpu_count() or 1
+    return max(1, min(w, n_shards))
 
 
 class _Fenced(OSError):
@@ -703,11 +733,32 @@ class ShardedEvents(base.LEvents, base.PEvents):
             _Shard(self._root / f"shard_{k:02d}", k, self.replicas, tag)
             for k in range(self.n_shards)
         ]
+        self._pool_lock = threading.Lock()
+        self._scan_pool: Optional[ThreadPoolExecutor] = None
+        self._scan_pool_size = 0
         _M_SHARDS.set(self.n_shards)
 
     def close(self) -> None:
+        with self._pool_lock:
+            if self._scan_pool is not None:
+                self._scan_pool.shutdown(wait=False, cancel_futures=True)
+                self._scan_pool = None
         for sh in self._shards:
             sh.close()
+
+    def _pool(self, workers: int) -> ThreadPoolExecutor:
+        """Persistent scan pool (resized when PIO_SCAN_WORKERS changes):
+        the follow-trainer's delta scan runs every tick, so per-scan
+        thread spawn/join would tax exactly the path this pipeline
+        accelerates."""
+        with self._pool_lock:
+            if self._scan_pool is None or self._scan_pool_size != workers:
+                if self._scan_pool is not None:
+                    self._scan_pool.shutdown(wait=False)
+                self._scan_pool = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="pio-scan")
+                self._scan_pool_size = workers
+            return self._scan_pool
 
     # -- routing / failover --------------------------------------------------
 
@@ -785,6 +836,14 @@ class ShardedEvents(base.LEvents, base.PEvents):
                     pass
             if shard.follower is not None:
                 shard.follower.kick()
+        # the merged cross-shard snapshot under the virtual channel dir
+        # describes data that no longer exists (validation would reject
+        # it anyway — this just reclaims the disk)
+        import shutil
+
+        d = self._chan_dir(app_id, channel_id)
+        if d.exists():
+            shutil.rmtree(d, ignore_errors=True)
         return removed
 
     def insert(self, event: Event, app_id: int,
@@ -884,14 +943,20 @@ class ShardedEvents(base.LEvents, base.PEvents):
             yield from self._on_shard(
                 shard, lambda ev: list(ev.find(app_id, **kw)))
             return
-        merged: List[Event] = []
-        for shard in self._shards:
-            merged.extend(self._on_shard(
-                shard, lambda ev: list(ev.find(app_id, **kw))))
-        merged.sort(key=lambda e: (e.event_time, e.creation_time),
-                    reverse=reversed_order)
+        # k-way merge instead of materialize-all + global re-sort: each
+        # shard's find already yields time order AND honors the limit
+        # (its top-`limit` is a superset of its share of the global
+        # top-`limit`), so the merged stream holds at most
+        # shards × limit events and stops at `limit` — a small-limit
+        # query no longer pays an O(total events) sort
+        parts = [self._on_shard(shard,
+                                lambda ev: list(ev.find(app_id, **kw)))
+                 for shard in self._shards]
+        merged = heapq.merge(
+            *parts, key=lambda e: (e.event_time, e.creation_time),
+            reverse=reversed_order)
         if limit is not None and limit >= 0:
-            merged = merged[:limit]
+            merged = islice(merged, limit)
         yield from merged
 
     # -- PEvents -------------------------------------------------------------
@@ -953,8 +1018,12 @@ class ShardedEvents(base.LEvents, base.PEvents):
         return frozenset(dead)
 
     def _chan_dir(self, app_id: int, channel_id: Optional[int]) -> Path:
-        """Virtual channel identity (staging-cache key only — no files
-        live here; per-shard data is under shard_*/<node>/events/...)."""
+        """Store-level channel identity: the staging-cache key, and home
+        of the MERGED cross-shard snapshot (``<dir>/snapshot/``).  The
+        event log itself lives per shard under
+        shard_*/<node>/events/...; this dir holds only the derived
+        merged columnar file + manifest (rebuildable at any time via
+        ``build_snapshot``)."""
         chan = (localfs.DEFAULT_CHANNEL if channel_id is None
                 else f"channel_{channel_id}")
         return self._root / "events" / f"app_{app_id}" / chan
@@ -971,7 +1040,192 @@ class ShardedEvents(base.LEvents, base.PEvents):
             agg["events"] += res.get("events", 0)
             agg["segments"] += res.get("segments", 0)
             agg["build_s"] = max(agg["build_s"], res.get("build_s", 0.0))
+        agg["merged"] = self._build_merged_snapshot(app_id, channel_id)
         return agg
+
+    # -- merged cross-shard snapshot -----------------------------------------
+    #
+    # The per-shard snapshots make each SHARD's read mmap-cheap, but a
+    # merged cold scan still paid N× the fixed read/validate cost plus a
+    # full k-way re-code per scan.  Folding the k-way merge result into
+    # ONE columnar file at the store root (under the virtual channel dir
+    # — the same two-phase manifest protocol as storage.snapshot) makes
+    # the cross-shard cold scan literally a single-shard read again:
+    # mmap the merged file, validate each shard's covered byte ranges +
+    # head fingerprints, parse only per-shard tails.  Any validation
+    # failure (compaction, recreated segments, receded tombstones, shard
+    # count change, torn file) falls back to the live parallel fan-out
+    # merge, which is always correct.
+
+    def _build_merged_snapshot(self, app_id: int,
+                               channel_id: Optional[int]) -> bool:
+        from predictionio_tpu.storage import snapshot as _snap
+        from predictionio_tpu.store.columnar import write_batch
+
+        if not _snap.enabled() or self.n_shards < 2:
+            return False
+        # tombstones read BEFORE the scan: a delete landing mid-build is
+        # then absent from ``tombstones_applied`` and the next scan's
+        # new-dead mask drops it — the reverse order could record a
+        # tombstone as applied that the batch never masked
+        tombs = self.tombstone_state(app_id, channel_id)
+        res = self._fanout_snapshot_scan(app_id, channel_id)
+        if res is None or res.get("ids") is None:
+            return False
+        d = self._chan_dir(app_id, channel_id)
+        snap_dir = d / _snap.SNAP_DIR
+        snap_dir.mkdir(parents=True, exist_ok=True)
+        import fcntl
+        import uuid
+
+        lockf = open(snap_dir / _snap.LOCK, "a")
+        try:
+            try:
+                fcntl.flock(lockf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return False     # another process's merged build in flight
+            for stale in snap_dir.glob("*.tmp*"):
+                stale.unlink(missing_ok=True)
+            name = f"snap-merged-{uuid.uuid4().hex[:8]}.pioc"
+            tmp = snap_dir / (name + f".tmp{os.getpid()}")
+            write_batch(tmp, res["batch"], res["ids"],
+                        meta={"merged": True, "events": res["events"]})
+            tmp.rename(snap_dir / name)
+            manifest = {
+                "version": 1,
+                "merged": True,
+                "shards": self.n_shards,
+                "snapshot": name,
+                "covered": res["watermark"],
+                "heads": res["heads"],
+                "events": res["events"],
+                "tombstones_applied": sorted(tombs),
+                "built_at": _dt.datetime.now(
+                    _dt.timezone.utc).isoformat(),
+            }
+            _fsync_write(snap_dir / _snap.MANIFEST, json.dumps(
+                manifest, indent=1, sort_keys=True))
+            for p in snap_dir.glob("snap-*.pioc"):
+                if p.name != name:
+                    p.unlink(missing_ok=True)
+            return True
+        finally:
+            lockf.close()
+
+    def _merged_snapshot_scan(self, app_id: int,
+                              channel_id: Optional[int]) -> Optional[Dict]:
+        """Serve the merged cross-shard snapshot if it still describes
+        the live store: one mmap read + per-shard covered-range/head
+        validation + tail-only parses.  None = no or stale merged snapshot
+        (caller falls back to the live fan-out merge)."""
+        from predictionio_tpu.storage import snapshot as _snap
+        from predictionio_tpu.store.columnar import read_batch
+
+        if not _snap.enabled():
+            return None
+        d = self._chan_dir(app_id, channel_id)
+        m = _snap.load_manifest(d)
+        if m is None or not m.get("merged") \
+                or m.get("shards") != self.n_shards:
+            return None
+        split = self._split_marks(m["covered"], m.get("heads", {}))
+        if split is None:
+            return None
+        per_wm, per_heads = split
+        tombs = self.tombstone_state(app_id, channel_id)
+        applied = set(m.get("tombstones_applied", ()))
+        if applied - tombs:
+            return None          # tombstones receded: log was rewritten
+        snap_dir = d / _snap.SNAP_DIR
+        try:
+            batch, ids, _meta = read_batch(snap_dir / m["snapshot"])
+        except FileNotFoundError:
+            return None
+        except (ValueError, OSError):
+            _snap._quarantine(snap_dir, m["snapshot"])
+            return None
+        if ids is None:
+            return None
+        batch, ids = _snap.drop_tombstoned(batch, ids, tombs - applied)
+        snap_events = len(batch)
+        # per-shard tails past the merged watermark: serial, encoding
+        # straight into the merged batch's dictionaries (tails are the
+        # hot-append suffix — usually empty or tiny)
+        tails: List[Dict] = []
+        wm: Dict[str, int] = {}
+        heads: Dict[str, dict] = {}
+        tail_events = 0
+        for k, shard in enumerate(self._shards):
+            res = self._on_shard(
+                shard,
+                lambda ev, k=k: ev.scan_tail_from(
+                    app_id, channel_id, per_wm[k], base=batch,
+                    heads=per_heads[k]))
+            if res is None:
+                return None      # a shard's log moved under the manifest
+            tail_events += res["events"]
+            if res["events"]:
+                tails.append(res)
+            for name, off in res["watermark"].items():
+                wm[f"{k}|{name}"] = off
+            for name, h in (res.get("heads") or {}).items():
+                heads[f"{k}|{name}"] = h
+        if tails:
+            batch = EventBatch.concat(
+                [batch] + [t["batch"] for t in tails])
+            if all(t.get("ids") is not None for t in tails):
+                from predictionio_tpu.store.columnar import EventIdColumn
+                ids = EventIdColumn.concat(
+                    [ids] + [t["ids"] for t in tails])
+            else:
+                ids = None
+        _snap.record_staged(snap_events, "snapshot")
+        _snap.record_staged(tail_events, "tail")
+        return {"batch": batch, "ids": ids, "events": len(batch),
+                "snap_events": snap_events, "tail_events": tail_events,
+                "watermark": wm, "heads": heads}
+
+    def _scan_fanout(self, fn) -> Iterator[tuple]:
+        """Run ``fn(k, shard)`` for every shard on a bounded thread pool
+        (``PIO_SCAN_WORKERS`` wide) and yield ``(k, result)`` IN SHARD
+        ORDER as each result becomes consumable — the consumer (the
+        k-way merge) stages completed shards while later shards are
+        still parsing.  Failover runs inside the worker (``fn`` wraps
+        ``_on_shard``), so a shard partitioned mid-fan-out promotes and
+        re-reads on its own thread without disturbing its siblings; a
+        shard whose failover fails raises, exactly like the serial
+        loop.  At ``workers <= 1`` the shards run inline — the
+        bit-exactness oracle the parity tests compare against.
+        Per-shard wall time lands on
+        ``pio_store_scan_shard_duration_seconds{shard}``."""
+        workers = _scan_workers(self.n_shards)
+        _M_SCAN_WORKERS.set(workers)
+
+        def timed(k, shard):
+            t0 = time.perf_counter()
+            res = fn(k, shard)
+            return res, time.perf_counter() - t0
+
+        if workers <= 1:
+            for k, shard in enumerate(self._shards):
+                res, dt = timed(k, shard)
+                _M_SCAN_SHARD_S.set(dt, shard=str(k))
+                yield k, res
+            return
+        pool = self._pool(workers)
+        futs = [pool.submit(timed, k, shard)
+                for k, shard in enumerate(self._shards)]
+        try:
+            for k, fut in enumerate(futs):
+                res, dt = fut.result()
+                _M_SCAN_SHARD_S.set(dt, shard=str(k))
+                yield k, res
+        finally:
+            # a consumer that bails early (miss → None, or an exception)
+            # must not leave stray shard reads running into a store that
+            # may be closing
+            for f in futs:
+                f.cancel()
 
     def snapshot_scan(self, app_id: int,
                       channel_id: Optional[int] = None) -> Optional[Dict]:
@@ -980,20 +1234,51 @@ class ShardedEvents(base.LEvents, base.PEvents):
         built columnar snapshot fall back to a full parse of their own
         log — the result always carries a shard-namespaced watermark, so
         delta staging and the follow-trainer work on a sharded store with
-        or without per-shard snapshot builds."""
-        acc: Optional[EventBatch] = None
-        ids_parts: List = []
-        wm: Dict[str, int] = {}
-        heads: Dict[str, dict] = {}
-        snap_events = tail_events = 0
-        for k, shard in enumerate(self._shards):
-            def read(ev, acc=acc):
+        or without per-shard snapshot builds.
+
+        Read strategy, fastest first: (1) the merged cross-shard
+        snapshot — one mmap read at single-shard cost, validated per
+        shard, tails parsed per shard; (2) the parallel fan-out
+        pipeline — per-shard reads on the ``PIO_SCAN_WORKERS`` thread
+        pool merged through ONE k-way :class:`BatchMerger` pass (each
+        column re-coded at most once) instead of the old serial loop
+        with pairwise ``EventBatch.concat`` accumulation (O(shards²)
+        copying).  On the fan-out path, row order (shard 0 first, then
+        shard 1, ...), merged dictionaries, property columns and
+        tombstone filtering are bit-exact vs the ``PIO_SCAN_WORKERS=1``
+        serial path."""
+        t0 = time.perf_counter()
+        res = self._merged_snapshot_scan(app_id, channel_id)
+        if res is not None:
+            wall = time.perf_counter() - t0
+            if wall > 0:
+                _M_SCAN_RATE.set(res["events"] / wall)
+            return res
+        return self._fanout_snapshot_scan(app_id, channel_id)
+
+    def _fanout_snapshot_scan(self, app_id: int,
+                              channel_id: Optional[int] = None
+                              ) -> Optional[Dict]:
+        """The live parallel fan-out + k-way merge (strategy 2)."""
+        t0 = time.perf_counter()
+
+        def read(k, shard):
+            def go(ev):
                 res = ev.snapshot_scan(app_id, channel_id)
                 if res is None:
                     res = ev.scan_tail_from(app_id, channel_id, {},
-                                            base=acc, heads=None)
+                                            base=None, heads=None)
                 return res
-            res = self._on_shard(shard, read)
+            return self._on_shard(shard, go)
+
+        # single-shard stores pass the sole part through untouched — the
+        # k-way merge would only re-code what is already one batch
+        merger = BatchMerger() if self.n_shards > 1 else None
+        sole: Optional[Dict] = None
+        wm: Dict[str, int] = {}
+        heads: Dict[str, dict] = {}
+        snap_events = tail_events = parts = 0
+        for k, res in self._scan_fanout(read):
             if res is None:
                 return None
             for name, off in res["watermark"].items():
@@ -1002,14 +1287,21 @@ class ShardedEvents(base.LEvents, base.PEvents):
                 heads[f"{k}|{name}"] = h
             snap_events += res.get("snap_events", 0)
             tail_events += res.get("tail_events", res.get("events", 0))
-            ids_parts.append(res.get("ids"))
-            part = res["batch"]
-            acc = part if acc is None else EventBatch.concat([acc, part])
-        if acc is None:
+            if merger is None:
+                sole = res
+            else:
+                merger.add(res["batch"], res.get("ids"))
+            parts += 1
+        if not parts:
             return None
-        ids = (EventIdColumn.concat([p for p in ids_parts])
-               if all(p is not None for p in ids_parts) else None)
-        return {"batch": acc, "ids": ids, "events": len(acc),
+        if merger is None:
+            batch, ids = sole["batch"], sole.get("ids")
+        else:
+            batch, ids = merger.finish()
+        wall = time.perf_counter() - t0
+        if wall > 0:
+            _M_SCAN_RATE.set(len(batch) / wall)
+        return {"batch": batch, "ids": ids, "events": len(batch),
                 "snap_events": snap_events, "tail_events": tail_events,
                 "watermark": wm, "heads": heads}
 
@@ -1036,30 +1328,52 @@ class ShardedEvents(base.LEvents, base.PEvents):
         if split is None:
             return None
         per_wm, per_heads = split
-        tails: List[EventBatch] = []
-        ids_parts: List = []
-        new_wm: Dict[str, int] = {}
-        new_heads: Dict[str, dict] = {}
-        total = 0
-        for k, shard in enumerate(self._shards):
-            res = self._on_shard(
+
+        single = self.n_shards == 1
+
+        def read(k, shard):
+            # base=None per shard (multi-shard): a worker-thread builder
+            # must never encode into the (shared, mutable) base
+            # dictionaries; the k-way merge below re-codes each
+            # completed part INTO the base dicts serially, in shard
+            # order — same final dict state, same codes, no cross-thread
+            # mutation.  A single-shard store is inherently serial, so
+            # its one builder encodes straight into the base as before.
+            return self._on_shard(
                 shard,
                 lambda ev, k=k: ev.scan_tail_from(
-                    app_id, channel_id, per_wm[k], base=base,
+                    app_id, channel_id, per_wm[k],
+                    base=base if single else None,
                     heads=per_heads[k] if heads is not None else None))
+
+        merger = BatchMerger(base=base) if not single else None
+        sole: Optional[Dict] = None
+        new_wm: Dict[str, int] = {}
+        new_heads: Dict[str, dict] = {}
+        total = parts = 0
+        for k, res in self._scan_fanout(read):
             if res is None:
                 return None
             total += res["events"]
-            tails.append(res["batch"])
-            ids_parts.append(res.get("ids"))
             for name, off in res["watermark"].items():
                 new_wm[f"{k}|{name}"] = off
             for name, h in (res.get("heads") or {}).items():
                 new_heads[f"{k}|{name}"] = h
-        batch = EventBatch.concat(tails) if tails else None
-        ids = (EventIdColumn.concat(ids_parts)
-               if ids_parts and all(p is not None for p in ids_parts)
-               else None)
+            if merger is None:
+                sole = res
+            else:
+                merger.add(res["batch"], res.get("ids"))
+            parts += 1
+        if not parts:
+            return None
+        if merger is None:
+            batch, ids = sole["batch"], sole.get("ids")
+        else:
+            # with base given the merged tail carries the base's
+            # dictionary OBJECTS, so the caller's concat([base, tail])
+            # takes the shared-dict fast path — the delta-staging
+            # contract
+            batch, ids = merger.finish()
         return {"batch": batch, "ids": ids, "events": total,
                 "watermark": new_wm, "heads": new_heads}
 
@@ -1070,20 +1384,30 @@ class ShardedEvents(base.LEvents, base.PEvents):
         if split is None:
             return None
         per_wm, per_heads = split
-        parts: List[EventBatch] = []
-        total = 0
-        for k, shard in enumerate(self._shards):
-            res = self._on_shard(
+
+        def read(k, shard):
+            return self._on_shard(
                 shard,
                 lambda ev, k=k: ev.scan_events_up_to(
                     app_id, channel_id, per_wm[k],
                     heads=per_heads[k] if heads is not None else None))
+
+        merger = BatchMerger() if self.n_shards > 1 else None
+        sole: Optional[Dict] = None
+        total = parts = 0
+        for _k, res in self._scan_fanout(read):
             if res is None:
                 return None
             total += res["events"]
-            parts.append(res["batch"])
-        return {"batch": EventBatch.concat(parts) if parts else None,
-                "events": total}
+            if merger is None:
+                sole = res
+            else:
+                merger.add(res["batch"])
+            parts += 1
+        if not parts:
+            return None
+        batch = sole["batch"] if merger is None else merger.finish()[0]
+        return {"batch": batch, "events": total}
 
     def snapshot_status(self, app_id: int,
                         channel_id: Optional[int] = None) -> Optional[Dict]:

@@ -432,6 +432,17 @@ def scan_tail(d: Path, watermark: Dict[str, int], tombstones: set,
             return None          # shrank under the watermark: invalid
         if heads is not None and not _head_matches(seg, heads.get(seg.name)):
             return None          # same name, different content generation
+        if size == start:
+            # nothing appended: the verified head still describes exactly
+            # `start` consumed bytes — skip the boundary scan and the
+            # fingerprint re-read (a cross-shard scan pays this loop once
+            # per shard, so the idle-segment case must stay cheap)
+            new_mark[seg.name] = start
+            head = (heads.get(seg.name) if heads is not None
+                    else _segment_head(seg, start))
+            if head is not None:
+                new_heads[seg.name] = head
+            continue
         end = _last_newline_boundary(seg, size)
         new_mark[seg.name] = max(end, start)
         head = _segment_head(seg, new_mark[seg.name])
@@ -481,6 +492,24 @@ def scan_bounded(d: Path, watermark: Dict[str, int],
     return {"batch": batch, "events": n}
 
 
+def drop_tombstoned(batch: EventBatch, ids: EventIdColumn,
+                    new_dead: set) -> tuple:
+    """Mask rows whose event id was tombstoned AFTER a snapshot was
+    built → (batch, ids).  Shared by the per-channel snapshot read and
+    the sharded store's merged cross-shard snapshot."""
+    if not new_dead:
+        return batch, ids
+    mask = np.ones(len(batch), bool)
+    for eid in new_dead:
+        r = ids.index_of(eid)
+        if r >= 0:
+            mask[r] = False
+    if not mask.all():
+        batch = batch.subset(mask)
+        ids = ids.subset(mask)
+    return batch, ids
+
+
 def scan_snapshot(d: Path, tombstones: set) -> Optional[dict]:
     """The snapshot-or-tail read: mmap the covered columns, parse only the
     uncovered tail, splice via the shared-dict concat fast path.
@@ -522,16 +551,7 @@ def scan_snapshot(d: Path, tombstones: set) -> Optional[dict]:
         return None
     if ids is None:
         return None
-    new_dead = tombstones - applied
-    if new_dead:
-        mask = np.ones(len(batch), bool)
-        for eid in new_dead:
-            r = ids.index_of(eid)
-            if r >= 0:
-                mask[r] = False
-        if not mask.all():
-            batch = batch.subset(mask)
-            ids = ids.subset(mask)
+    batch, ids = drop_tombstoned(batch, ids, tombstones - applied)
     snap_events = len(batch)
     tail = scan_tail(d, covered, tombstones, base=batch, heads=heads)
     if tail is None:
@@ -656,6 +676,14 @@ def record_miss() -> None:
 
 def record_delta(n: int) -> None:
     _M_STAGED.inc(n, mode="delta")
+
+
+def record_staged(n: int, mode: str) -> None:
+    """Staged-event accounting for backends that serve columnar batches
+    without routing through scan_snapshot (the sharded store's merged
+    cross-shard snapshot)."""
+    if n:
+        _M_STAGED.inc(n, mode=mode)
 
 
 def staged_counts() -> Dict[str, float]:

@@ -275,7 +275,11 @@ class EventBatch:
         snapshot+tail contract) merges code-for-code, and disagreeing
         dictionaries are RE-CODED into a merged one (the sharded store's
         cross-shard scans land here: each shard's snapshot owns its own
-        dicts) — only a batch with no prop columns at all drops them."""
+        dicts) — only a batch with no prop columns at all drops them.
+
+        The mixed-dictionary path delegates to :class:`BatchMerger` —
+        one k-way merge with preallocated output columns, each input
+        column re-coded at most once regardless of batch count."""
         if len(batches) == 1:
             return batches[0]
         shared = all(
@@ -297,40 +301,11 @@ class EventBatch:
                 b0.target_dict,
                 prop_columns=cls._concat_props(batches),
             )
-        event_dict, entity_type_dict = IdDict(), IdDict()
-        entity_dict, target_dict = IdDict(), IdDict()
-        cols: Dict[str, List[np.ndarray]] = {k: [] for k in ("ev", "et", "ei", "ti", "ts", "rt")}
+        merger = BatchMerger()
         for b in batches:
-            ev_map = np.fromiter((event_dict.add(s) for s in b.event_dict.strings()), np.int32,
-                                 count=len(b.event_dict)) if len(b.event_dict) else np.empty(0, np.int32)
-            et_map = np.fromiter((entity_type_dict.add(s) for s in b.entity_type_dict.strings()), np.int32,
-                                 count=len(b.entity_type_dict)) if len(b.entity_type_dict) else np.empty(0, np.int32)
-            ei_map = np.fromiter((entity_dict.add(s) for s in b.entity_dict.strings()), np.int32,
-                                 count=len(b.entity_dict)) if len(b.entity_dict) else np.empty(0, np.int32)
-            ti_map = np.fromiter((target_dict.add(s) for s in b.target_dict.strings()), np.int32,
-                                 count=len(b.target_dict)) if len(b.target_dict) else np.empty(0, np.int32)
-            cols["ev"].append(ev_map[b.event_codes] if len(b) else b.event_codes)
-            cols["et"].append(et_map[b.entity_type_codes] if len(b) else b.entity_type_codes)
-            cols["ei"].append(ei_map[b.entity_ids] if len(b) else b.entity_ids)
-            has_t = b.target_ids >= 0
-            ti = np.full(len(b), -1, np.int32)
-            if len(b) and len(ti_map):
-                ti[has_t] = ti_map[b.target_ids[has_t]]
-            cols["ti"].append(ti)
-            cols["ts"].append(b.times_us)
-            cols["rt"].append(b.ratings)
-        return cls(
-            np.concatenate(cols["ev"]) if cols["ev"] else np.empty(0, np.int32),
-            np.concatenate(cols["et"]) if cols["et"] else np.empty(0, np.int32),
-            np.concatenate(cols["ei"]) if cols["ei"] else np.empty(0, np.int32),
-            np.concatenate(cols["ti"]) if cols["ti"] else np.empty(0, np.int32),
-            np.concatenate(cols["ts"]) if cols["ts"] else np.empty(0, np.int64),
-            np.concatenate(cols["rt"]) if cols["rt"] else np.empty(0, np.float32),
-            event_dict, entity_type_dict, entity_dict, target_dict,
-            # rows keep their order either way, so the prop merge (row
-            # offsets only) is identical to the fast path's
-            prop_columns=cls._concat_props(batches),
-        )
+            merger.add(b)
+        merged, _ids = merger.finish()
+        return merged
 
     @staticmethod
     def _concat_props(batches: Sequence["EventBatch"]
@@ -494,6 +469,210 @@ class EventIdColumn:
         return EventIdColumn(np.asarray(self.blob)[gather], offs)
 
 
+class BatchMerger:
+    """Incremental k-way merge of batch parts (+ optional id columns).
+
+    Replaces pairwise ``EventBatch.concat([acc, part])`` accumulation —
+    O(parts²) copying, with the accumulator's ever-growing dictionaries
+    re-scanned at every step — with ONE k-way merge split into two
+    phases:
+
+    - :meth:`add` (phase A, called once per part IN PART ORDER) merges
+      the part's string dictionaries into the target dictionaries and
+      records the per-part code maps.  This is the Python-loop-bound
+      work, and it runs per part as the part becomes available — the
+      sharded store's parallel scan pipeline calls it for completed
+      shards while later shards are still parsing.
+    - :meth:`finish` (phase B) allocates every output column exactly
+      once and gathers each part into its slice (``np.take(map, codes,
+      out=slice)``) — no intermediate per-part copies, each column
+      re-coded at most once.
+
+    With ``base`` given, codes are assigned IN the base batch's
+    dictionaries (mutating them in place, per-key property dictionaries
+    included — the same contract as ``ColumnarBuilder(base=...)``), so
+    the merged result concatenates with the base via the shared-dict
+    fast path: the sharded store's delta staging depends on this to
+    splice a cross-shard tail into a retained batch with zero
+    re-coding of the retained part.
+
+    Row order is the order of ``add`` calls — the cross-shard row-order
+    contract (shard 0's rows, then shard 1's, ...) — and dictionary
+    codes are assigned in first-appearance order across parts, exactly
+    what sequential pairwise accumulation produced, so the merged batch
+    is bit-exact vs the legacy path, codes included.
+    """
+
+    def __init__(self, base: Optional[EventBatch] = None):
+        if base is not None:
+            self.event_dict = base.event_dict
+            self.entity_type_dict = base.entity_type_dict
+            self.entity_dict = base.entity_dict
+            self.target_dict = base.target_dict
+            self._base_props = base.prop_columns or {}
+        else:
+            self.event_dict = IdDict()
+            self.entity_type_dict = IdDict()
+            self.entity_dict = IdDict()
+            self.target_dict = IdDict()
+            self._base_props = {}
+        # per part: (batch, ids, ev_map, et_map, ei_map, ti_map);
+        # a None map means the part already speaks the target dict
+        self._parts: List[tuple] = []
+        # key -> {"dict": target IdDict, "entries": [(row_off, col, map)]}
+        self._props: Dict[str, dict] = {}
+        self._props_ok = True
+        self._ids_ok = True
+        self._rows = 0
+
+    @staticmethod
+    def _code_map(target: IdDict, part_dict: IdDict) -> Optional[np.ndarray]:
+        """Merge ``part_dict`` into ``target``; None = identity (the
+        part's codes are already valid in the target).  The first part
+        into an empty target bulk-installs its strings (a dictcomp, ~3×
+        a per-string add loop) and needs no gather at all."""
+        if part_dict is target:
+            return None
+        if not len(target):
+            strings = part_dict.strings()
+            target._to_str = strings
+            target._to_id = {s: i for i, s in enumerate(strings)}
+            return None
+        n = len(part_dict)
+        if not n:
+            return np.empty(0, np.int32)
+        # two C-level passes beat a per-string add loop on the miss-heavy
+        # cross-shard case (disjoint entity vocabularies): filter misses,
+        # bulk-install them, then map every string through one lookup
+        strings = part_dict.strings()
+        to_id = target._to_id
+        miss = [s for s in strings if s not in to_id]
+        if miss:
+            start = len(target._to_str)
+            to_id.update(zip(miss, range(start, start + len(miss))))
+            target._to_str.extend(miss)
+        return np.fromiter(map(to_id.__getitem__, strings), np.int32,
+                           count=n)
+
+    def add(self, batch: EventBatch,
+            ids: Optional["EventIdColumn"] = None) -> None:
+        """Phase A for one part: dictionary merge + code maps."""
+        self._parts.append((
+            batch, ids,
+            self._code_map(self.event_dict, batch.event_dict),
+            self._code_map(self.entity_type_dict, batch.entity_type_dict),
+            self._code_map(self.entity_dict, batch.entity_dict),
+            self._code_map(self.target_dict, batch.target_dict),
+        ))
+        if ids is None:
+            self._ids_ok = False
+        if batch.prop_columns is None:
+            self._props_ok = False
+        elif self._props_ok:
+            for key, col in batch.prop_columns.items():
+                st = self._props.get(key)
+                if st is None:
+                    base_col = self._base_props.get(key)
+                    st = self._props[key] = {
+                        "dict": (base_col.dict if base_col is not None
+                                 else IdDict()),
+                        "entries": [],
+                    }
+                st["entries"].append(
+                    (self._rows, col, self._code_map(st["dict"], col.dict)))
+        self._rows += len(batch)
+
+    def _finish_props(self) -> Optional[Dict[str, PropColumn]]:
+        if not self._props_ok:
+            return None
+        out: Dict[str, PropColumn] = {}
+        for key, st in self._props.items():
+            entries = st["entries"]
+            n = sum(len(c) for _, c, _ in entries)
+            total = sum(len(c.codes) for _, c, _ in entries)
+            rows = np.empty(n, np.int64)
+            kind = np.empty(n, np.int8)
+            num = np.empty(n, np.float64)
+            str_offs = np.empty(n + 1, np.int64)
+            str_offs[0] = 0
+            codes = np.empty(total, np.int32)
+            ep = cp = 0
+            for row_off, col, cmap in entries:
+                m, k = len(col), len(col.codes)
+                np.add(col.rows, row_off, out=rows[ep:ep + m])
+                kind[ep:ep + m] = col.kind
+                num[ep:ep + m] = col.num
+                np.add(col.str_offs[1:], cp,
+                       out=str_offs[ep + 1:ep + m + 1])
+                if k:
+                    if cmap is None:
+                        codes[cp:cp + k] = col.codes
+                    else:
+                        np.take(cmap, np.asarray(col.codes),
+                                out=codes[cp:cp + k])
+                ep += m
+                cp += k
+            out[key] = PropColumn(rows, kind, num, str_offs, codes,
+                                  st["dict"])
+        return out
+
+    def _finish_ids(self) -> Optional["EventIdColumn"]:
+        if not self._ids_ok:
+            return None
+        total = sum(int(ids.offs[-1]) for _, ids, *_ in self._parts)
+        blob = np.empty(total, np.uint8)
+        offs = np.empty(self._rows + 1, np.int64)
+        offs[0] = 0
+        rp = bp = 0
+        for _b, ids, *_ in self._parts:
+            m, k = len(ids), int(ids.offs[-1])
+            np.add(ids.offs[1:], bp, out=offs[rp + 1:rp + m + 1])
+            blob[bp:bp + k] = ids.blob
+            rp += m
+            bp += k
+        return EventIdColumn(blob, offs)
+
+    def finish(self) -> Tuple[EventBatch, Optional["EventIdColumn"]]:
+        """Phase B: preallocate + gather → (batch, ids-or-None)."""
+        n = self._rows
+        ev = np.empty(n, np.int32)
+        et = np.empty(n, np.int32)
+        ei = np.empty(n, np.int32)
+        ti = np.empty(n, np.int32)
+        ts = np.empty(n, np.int64)
+        rt = np.empty(n, np.float32)
+        at = 0
+        for b, _ids, ev_map, et_map, ei_map, ti_map in self._parts:
+            m = len(b)
+            if m:
+                for out_col, codes, cmap in (
+                    (ev, b.event_codes, ev_map),
+                    (et, b.entity_type_codes, et_map),
+                    (ei, b.entity_ids, ei_map),
+                ):
+                    if cmap is None:
+                        out_col[at:at + m] = codes
+                    else:
+                        np.take(cmap, np.asarray(codes),
+                                out=out_col[at:at + m])
+                sl = ti[at:at + m]
+                if ti_map is None:
+                    sl[:] = b.target_ids
+                else:
+                    # -1 sentinel rides the gather: code -1 hits the
+                    # appended last slot, which holds -1
+                    ti_ext = np.append(ti_map, np.int32(-1))
+                    np.take(ti_ext, np.asarray(b.target_ids), out=sl)
+                ts[at:at + m] = b.times_us
+                rt[at:at + m] = b.ratings
+            at += m
+        batch = EventBatch(
+            ev, et, ei, ti, ts, rt,
+            self.event_dict, self.entity_type_dict, self.entity_dict,
+            self.target_dict, prop_columns=self._finish_props())
+        return batch, self._finish_ids()
+
+
 # -- persisted columnar container (snapshot files) ---------------------------
 #
 # Layout (all little-endian):
@@ -598,8 +777,17 @@ def read_batch(path, mmap: bool = True
     read-only.  Raises ValueError on a torn/corrupt file — callers
     quarantine and rebuild."""
     import json as _json
+    import mmap as _mmap
 
-    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    # raw mmap + frombuffer instead of np.memmap: identical lazy views,
+    # minus np.memmap's realpath() walk (≈1 ms of lstat calls per open —
+    # material when a cross-shard scan opens one file per shard)
+    with open(path, "rb") as _f:
+        try:
+            _raw = _mmap.mmap(_f.fileno(), 0, access=_mmap.ACCESS_READ)
+        except ValueError as e:       # empty file — torn write
+            raise ValueError(f"{path}: not a columnar snapshot: {e}") from None
+    mm = np.frombuffer(_raw, dtype=np.uint8)
     if mm.shape[0] < 16 or bytes(mm[:8]) != _COLUMNAR_MAGIC:
         raise ValueError(f"{path}: not a columnar snapshot (bad magic)")
     hlen = int.from_bytes(bytes(mm[8:16]), "little")

@@ -69,7 +69,18 @@ class _StagedCache:
                      channel_id: Optional[int]) -> Optional[EventBatch]:
         """Serve the full columnar batch for (app, channel) from the
         retained entry + delta, else from the backend's snapshot_scan
-        (populating the entry), else None."""
+        (populating the entry), else None.
+
+        The delta-splice path (cheap: only past-watermark bytes parse)
+        runs under the cache lock so a retained entry mutates
+        atomically.  The COLD restage — the expensive cross-shard
+        parallel scan-and-stage pipeline on a sharded backend — runs
+        OUTSIDE the lock: one channel's cold scan no longer serializes
+        every other trainer in the process, and the sharded backend's
+        per-shard encode/stage begins for completed shards while later
+        shards are still parsing.  Two threads cold-staging the same
+        key concurrently both scan and install (idempotent,
+        last-writer-wins)."""
         from predictionio_tpu.storage import snapshot as _snap
 
         key = str(backend._chan_dir(app_id, channel_id)) if hasattr(
@@ -94,12 +105,13 @@ class _StagedCache:
                         _snap.record_hit()
                         return ent["batch"]
                 self._entries.pop(key, None)   # stale: full restage below
-            tomb = (backend.tombstone_state(app_id, channel_id)
-                    if hasattr(backend, "tombstone_state") else frozenset())
-            res = backend.snapshot_scan(app_id, channel_id)
-            if res is None:
-                return None
-            if use_cache:
+        tomb = (backend.tombstone_state(app_id, channel_id)
+                if hasattr(backend, "tombstone_state") else frozenset())
+        res = backend.snapshot_scan(app_id, channel_id)
+        if res is None:
+            return None
+        if use_cache:
+            with self._lock:
                 self._entries[key] = {
                     "batch": res["batch"],
                     "watermark": res["watermark"],
@@ -109,7 +121,7 @@ class _StagedCache:
                 self._entries.move_to_end(key)
                 while len(self._entries) > self.MAX_ENTRIES:
                     self._entries.popitem(last=False)
-            return res["batch"]
+        return res["batch"]
 
     def invalidate(self) -> None:
         with self._lock:

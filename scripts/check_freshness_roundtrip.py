@@ -68,6 +68,11 @@ if "--storage" in sys.argv:
     STORAGE_TYPE = sys.argv[sys.argv.index("--storage") + 1]
 if "--shards" in sys.argv:
     SHARDS = int(sys.argv[sys.argv.index("--shards") + 1])
+if STORAGE_TYPE == "sharded" and SHARDS > 1:
+    # the parallel-path assertion at the end reads the workers gauge;
+    # pin the pool width (capped at the shard count anyway) so a
+    # single-core CI host doesn't legitimately default to 1 and fail
+    os.environ.setdefault("PIO_SCAN_WORKERS", "2")
 
 # the large smoke pins the budget low enough that the DENSE state could
 # not hold this catalog (I² × 4 B = 64 MiB > 32 MiB) while the sparse
@@ -286,6 +291,18 @@ def main() -> int:
                         f"from-scratch retrain:\n  got:  {got}\n"
                         f"  want: {want}")
         conn.close()
+        if STORAGE_TYPE == "sharded" and SHARDS > 1:
+            # the roundtrip must have exercised the PARALLEL cross-shard
+            # scan pipeline, not a silent serial fallback: every merged
+            # scan records its pool width on the workers gauge
+            from predictionio_tpu.storage.sharded import _M_SCAN_WORKERS
+
+            w = _M_SCAN_WORKERS.value()
+            if w <= 1:
+                problems.append(
+                    f"sharded roundtrip ran with scan workers={w:g} — the "
+                    "parallel cross-shard scan pipeline was not exercised "
+                    "(PIO_SCAN_WORKERS forced to 1, or a 1-core fallback)")
         if not problems:
             lat = ", ".join(f"{v * 1e3:.0f}ms" for v in latencies)
             extra = ""

@@ -85,6 +85,12 @@ REQUIRED_METRICS = frozenset({
     "pio_store_shard_events_total",
     "pio_store_replica_lag_events",
     "pio_store_promotions_total",
+    # parallel cross-shard scan pipeline (PR 12): the bench's recovery
+    # guard and the freshness roundtrip's parallel-path assertion key on
+    # the worker gauge; per-shard durations feed the straggler view
+    "pio_store_scan_shard_duration_seconds",
+    "pio_store_scan_workers",
+    "pio_store_scan_merged_events_per_sec",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

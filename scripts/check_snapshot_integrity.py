@@ -31,8 +31,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SAMPLE_ROWS = 500
 
 
-def check_channel(d: Path) -> list:
-    """Problems for one channel dir with a snapshot (empty = clean)."""
+def _parse_covered(seg: Path, end: int, applied: set, truth: list) -> None:
+    with open(seg, "rb") as f:
+        data = f.read(end)
+    for line in data.split(b"\n"):
+        if not line.strip():
+            continue
+        ev = json.loads(line)
+        if ev.get("eventId") in applied:
+            continue
+        truth.append(ev)
+
+
+def _shard_primary(shard_dir: Path) -> str:
+    try:
+        topo = json.loads((shard_dir / "topology.json").read_text())
+        return topo.get("primary", "a")
+    except (OSError, json.JSONDecodeError):
+        return "a"
+
+
+def check_channel(d: Path, store_root: Path = None) -> list:
+    """Problems for one channel dir with a snapshot (empty = clean).
+
+    A MERGED cross-shard manifest (the sharded store's root-level
+    snapshot; covered keys are ``"<shard>|<segment>"``) re-derives its
+    ground truth from every shard's primary node in shard order — the
+    merged file's row-order contract — and needs ``store_root`` to
+    resolve the shard directories."""
     from predictionio_tpu.store.columnar import read_batch
     from predictionio_tpu.storage.snapshot import load_manifest
 
@@ -48,22 +74,37 @@ def check_channel(d: Path) -> list:
         return [f"{d}: snapshot has no id column"]
     applied = set(m.get("tombstones_applied", ()))
     truth = []   # wire dicts in builder order (sorted covered segments)
-    for name in sorted(m["covered"]):
-        end = m["covered"][name]
-        seg = d / name
-        if not seg.exists():
-            problems.append(f"{d}: covered segment {name} missing "
-                            "(stale manifest — snapshot would be bypassed)")
-            continue
-        with open(seg, "rb") as f:
-            data = f.read(end)
-        for line in data.split(b"\n"):
-            if not line.strip():
+    if m.get("merged"):
+        if store_root is None:
+            return [f"{d}: merged manifest outside a sharded store root"]
+        per_shard: dict = {}
+        for key, end in m["covered"].items():
+            k, sep, name = key.partition("|")
+            if not sep or not k.isdigit():
+                problems.append(f"{d}: malformed merged covered key {key!r}")
                 continue
-            ev = json.loads(line)
-            if ev.get("eventId") in applied:
+            per_shard.setdefault(int(k), {})[name] = end
+        for k in sorted(per_shard):
+            sd = store_root / f"shard_{k:02d}"
+            chan = (sd / _shard_primary(sd) / "events"
+                    / d.parent.name / d.name)
+            for name in sorted(per_shard[k]):
+                seg = chan / name
+                if not seg.exists():
+                    problems.append(
+                        f"{d}: covered segment {k}|{name} missing "
+                        "(stale manifest — snapshot would be bypassed)")
+                    continue
+                _parse_covered(seg, per_shard[k][name], applied, truth)
+    else:
+        for name in sorted(m["covered"]):
+            seg = d / name
+            if not seg.exists():
+                problems.append(f"{d}: covered segment {name} missing "
+                                "(stale manifest — snapshot would be "
+                                "bypassed)")
                 continue
-            truth.append(ev)
+            _parse_covered(seg, m["covered"][name], applied, truth)
     if len(truth) != m.get("events"):
         problems.append(
             f"{d}: JSONL recount {len(truth)} != manifest watermark "
@@ -80,7 +121,18 @@ def check_channel(d: Path) -> list:
             f"{d}: eventId set diff (missing {missing}, extra {extra})")
     from predictionio_tpu.events.event import parse_time
 
+    # merged manifests verify sample rows by id alignment (multi-writer
+    # segment-name interleaving can make the cross-shard parse order
+    # differ from the build-time order without being wrong); per-shard
+    # manifests keep the strict prefix-order check
+    row_of = None
+    if m.get("merged"):
+        row_of = {eid: j for j, eid in enumerate(ids.tolist())}
     for j, ev in enumerate(truth[:SAMPLE_ROWS]):
+        if row_of is not None:
+            j = row_of.get(ev.get("eventId"), -1)
+            if j < 0:
+                continue      # already reported by the id-set diff
         if j >= len(batch):
             break
         got = (
@@ -175,7 +227,8 @@ def main(argv) -> int:
         events = Path(root) / "events"
         for manifest in sorted(events.glob("app_*/*/snapshot/manifest.json")):
             checked += 1
-            problems.extend(check_channel(manifest.parent.parent))
+            problems.extend(check_channel(manifest.parent.parent,
+                                          store_root=Path(root)))
         # sharded layout: per-shard per-node manifests + the cross-shard
         # merged eventId disjointness sweep
         for manifest in sorted(Path(root).glob(

@@ -1,0 +1,338 @@
+"""Parallel cross-shard scan-and-stage pipeline (PR 12 tentpole).
+
+The contracts under test:
+
+- **parallel ≡ sequential**: the fan-out merge at ``PIO_SCAN_WORKERS>1``
+  is bit-exact (row order, decoded values, property columns, id column,
+  watermarks) vs the ``PIO_SCAN_WORKERS=1`` forced-serial oracle, on
+  randomized multi-shard corpora with DISAGREEING per-shard property
+  dictionaries and tombstones.
+- **merged cross-shard snapshot**: ``build_snapshot`` persists the
+  k-way merge; scans serve it at single-shard cost, stay correct across
+  appends (tail splice), late tombstones (id-column mask), and fall
+  back to the live fan-out when the manifest goes stale.
+- **delta staging**: a parallel ``scan_tail_from`` with ``base`` merges
+  INTO the base dictionaries (the shared-dict splice contract).
+- **failover**: a shard partitioned mid-parallel-fan-out promotes and
+  re-reads — every surviving event exactly once.
+- **find**: the k-way heap-merge honors global time order and pushes
+  ``limit`` down to each shard.
+"""
+
+import datetime as dt
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.storage import localfs
+from predictionio_tpu.storage.sharded import (
+    ShardedEvents,
+    _scan_workers,
+    _M_SCAN_WORKERS,
+)
+from predictionio_tpu.store.columnar import BatchMerger, EventBatch
+
+
+def _wire(k, rng):
+    """One wire event; property value domains differ per entity so the
+    per-shard property dictionaries disagree."""
+    d = {
+        "event": ("buy", "view", "$set")[k % 3],
+        "entityType": "user" if k % 3 != 2 else "item",
+        "entityId": f"u{k % 13}" if k % 3 != 2 else f"i{k % 7}",
+        "eventId": f"e{k}",
+        "eventTime": (dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+                      + dt.timedelta(seconds=k)).isoformat(),
+    }
+    if k % 3 != 2:
+        d["targetEntityType"] = "item"
+        d["targetEntityId"] = f"i{k % 29}"
+    if k % 4:
+        d["properties"] = {
+            "rating": int(rng.integers(0, 6)),
+            "color": f"c{rng.integers(0, 9)}",
+            "tags": [f"t{rng.integers(0, 5)}" for _ in range(k % 3)],
+        }
+    return d
+
+
+def canon(batch, ids=None):
+    """Decoded row tuples — the code-independent view both paths must
+    agree on, row order included."""
+    idl = ids.tolist() if ids is not None else [None] * len(batch)
+    rows = []
+    for j in range(len(batch)):
+        props = {}
+        if batch.prop_columns is not None:
+            for key, col in batch.prop_columns.items():
+                pos = int(np.searchsorted(col.rows, j))
+                if pos < len(col) and col.rows[pos] == j:
+                    props[key] = col.value_at(pos)
+        t = int(batch.target_ids[j])
+        r = float(batch.ratings[j])
+        rows.append((
+            idl[j],
+            batch.event_dict.str(int(batch.event_codes[j])),
+            batch.entity_type_dict.str(int(batch.entity_type_codes[j])),
+            batch.entity_dict.str(int(batch.entity_ids[j])),
+            batch.target_dict.str(t) if t >= 0 else None,
+            int(batch.times_us[j]),
+            None if np.isnan(r) else r,
+            tuple(sorted(props.items())),
+        ))
+    return rows
+
+
+@pytest.fixture()
+def store3(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FSYNC", "rotate")
+    ev = ShardedEvents(tmp_path / "s", shards=3, replicas=1)
+    rng = np.random.default_rng(12)
+    items = [_wire(k, rng) for k in range(240)]
+    res = ev.insert_json_batch(items, 1)
+    assert all(r["status"] == 201 for r in res)
+    for k in (3, 17, 101, 200):       # tombstones, spread across shards
+        assert ev.delete(f"e{k}", 1)
+    yield ev
+    ev.close()
+
+
+def _drop_merged(ev):
+    """Force the live fan-out path (hide the merged snapshot)."""
+    shutil.rmtree(ev._chan_dir(1, None), ignore_errors=True)
+
+
+def test_parallel_matches_serial_oracle(store3, monkeypatch):
+    """Fan-out merge at workers=4 is bit-exact vs the workers=1 oracle:
+    same rows in the same order, same decoded props (disagreeing
+    per-shard dictionaries re-coded identically), same id column, same
+    namespaced watermark — with some shards snapshot-backed and one on
+    the full-parse fallback."""
+    store3.build_snapshot(1)
+    _drop_merged(store3)
+    # one shard loses its snapshot → exercises the mixed
+    # snapshot/full-parse fan-out
+    shutil.rmtree(store3._shards[2].node_root("a") / "events" / "app_1"
+                  / "_default" / "snapshot")
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "4")
+    par = store3._fanout_snapshot_scan(1)
+    assert int(_M_SCAN_WORKERS.value()) == 3       # capped at shards
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "1")
+    ser = store3._fanout_snapshot_scan(1)
+    assert par["events"] == ser["events"] == 236
+    assert par["watermark"] == ser["watermark"]
+    assert par["heads"] == ser["heads"]
+    assert canon(par["batch"], par["ids"]) == canon(ser["batch"],
+                                                    ser["ids"])
+    # bit-exact down to the dictionary codes
+    for col in ("event_codes", "entity_type_codes", "entity_ids",
+                "target_ids", "times_us"):
+        assert np.array_equal(getattr(par["batch"], col),
+                              getattr(ser["batch"], col)), col
+    assert np.array_equal(par["ids"].blob, ser["ids"].blob)
+    assert np.array_equal(par["ids"].offs, ser["ids"].offs)
+
+
+def test_merged_snapshot_serves_and_tracks_staleness(store3, monkeypatch):
+    """The persisted merged snapshot returns the same event set as the
+    live fan-out, splices appended tails, masks late tombstones via the
+    id column, and never resurrects a deleted event."""
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "4")
+    store3.build_snapshot(1)
+    merged = store3.snapshot_scan(1)
+    assert merged["snap_events"] == 236 and merged["tail_events"] == 0
+    live = store3._fanout_snapshot_scan(1)
+    assert sorted(canon(merged["batch"], merged["ids"])) == \
+        sorted(canon(live["batch"], live["ids"]))
+    assert merged["watermark"] == live["watermark"]
+    # append → tail splice on the merged path
+    store3.insert_json_batch(
+        [{"event": "buy", "entityType": "user", "entityId": f"u{j}",
+          "targetEntityType": "item", "targetEntityId": "iNEW",
+          "eventId": f"n{j}", "properties": {"color": "brand-new"}}
+         for j in range(9)], 1)
+    res = store3.snapshot_scan(1)
+    assert res["snap_events"] == 236 and res["tail_events"] == 9
+    ids = {r[0] for r in canon(res["batch"], res["ids"])}
+    assert "n8" in ids and "e3" not in ids
+    # late tombstone → id-column mask, not a resurrect
+    assert store3.delete("e30", 1)
+    res = store3.snapshot_scan(1)
+    assert res["events"] == 244
+    ids = {r[0] for r in canon(res["batch"], res["ids"])}
+    assert "e30" not in ids
+    # a recreated segment (data-delete) invalidates the merged manifest:
+    # the scan falls back and still answers correctly
+    chan = (store3._shards[0].node_root("a") / "events" / "app_1"
+            / "_default")
+    seg = sorted(chan.glob("seg-*.jsonl"))[0]
+    lines = seg.read_bytes()
+    seg.write_bytes(b'{"event":"buy","entityType":"user","entityId":"uZ",'
+                    b'"eventId":"zz0","eventTime":"2026-01-01T00:00:00Z"}\n')
+    res2 = store3.snapshot_scan(1)
+    assert res2 is not None
+    ids2 = {r[0] for r in canon(res2["batch"],
+                                res2.get("ids"))}
+    assert "zz0" in ids2
+    seg.write_bytes(lines)    # restore for fixture teardown sanity
+
+
+def test_scan_tail_from_merges_into_base_dicts(store3, monkeypatch):
+    """Parallel delta staging keeps the shared-dict splice contract:
+    the merged tail carries the base's dictionary OBJECTS, so
+    concat([base, tail]) takes the zero-re-code fast path; the result
+    decodes identically to the workers=1 oracle."""
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "4")
+    store3.build_snapshot(1)
+    snap = store3.snapshot_scan(1)
+    base = snap["batch"]
+    store3.insert_json_batch(
+        [{"event": "buy", "entityType": "user", "entityId": f"u{j % 13}",
+          "targetEntityType": "item", "targetEntityId": f"iT{j}",
+          "eventId": f"t{j}", "properties": {"color": f"cT{j % 4}"}}
+         for j in range(20)], 1)
+    tail = store3.scan_tail_from(1, None, snap["watermark"], base=base,
+                                 heads=snap["heads"])
+    assert tail["events"] == 20
+    for d in ("event_dict", "entity_type_dict", "entity_dict",
+              "target_dict"):
+        assert getattr(tail["batch"], d) is getattr(base, d), d
+    assert tail["batch"].prop_columns["color"].dict \
+        is base.prop_columns["color"].dict
+    spliced = EventBatch.concat([base, tail["batch"]])
+    assert spliced.event_dict is base.event_dict      # fast path took
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "1")
+    ser = store3.scan_tail_from(1, None, snap["watermark"], base=None,
+                                heads=snap["heads"])
+    assert canon(tail["batch"], tail["ids"]) == canon(ser["batch"],
+                                                      ser["ids"])
+    assert tail["watermark"] == ser["watermark"]
+    # scan_events_up_to parity over the new watermark
+    up_p = store3.scan_events_up_to(1, None, tail["watermark"],
+                                    heads=tail["heads"])
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "4")
+    up_s = store3.scan_events_up_to(1, None, tail["watermark"],
+                                    heads=tail["heads"])
+    assert up_p["events"] == up_s["events"] == len(spliced)
+    assert canon(up_p["batch"]) == canon(up_s["batch"])
+
+
+def test_partition_mid_fanout_promotes_and_dedups(tmp_path, monkeypatch):
+    """A primary yanked while its shard's worker is mid-fan-out: the
+    worker promotes the replica and re-reads — the merged result holds
+    every acked event exactly once, identical to the serial oracle run
+    on the promoted topology."""
+    monkeypatch.setenv("PIO_FSYNC", "always")
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "2")
+    ev = ShardedEvents(tmp_path / "s", shards=2, replicas=2)
+    try:
+        res = ev.insert_json_batch(
+            [{"event": "buy", "entityType": "user", "entityId": f"u{k}",
+              "eventId": f"e{k}"} for k in range(40)], 1)
+        assert all(r["status"] == 201 for r in res)   # acked ⇒ replicated
+        fired = {}
+        orig = localfs.FSEvents.scan_tail_from
+
+        def boom(self, *a, **kw):
+            root = getattr(self, "_node_root", None)
+            if (not fired and root is not None and root.name == "a"
+                    and root.parent.name == "shard_00"):
+                fired["yank"] = True
+                lost = root.parent / "a.lost"
+                shutil.move(str(root), str(lost))
+                raise OSError("injected partition mid-fan-out")
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(localfs.FSEvents, "scan_tail_from", boom)
+        res = ev._fanout_snapshot_scan(1)
+        assert fired, "injection never triggered"
+        got = [r[0] for r in canon(res["batch"], res["ids"])]
+        assert sorted(got) == sorted(f"e{k}" for k in range(40))
+        assert len(got) == len(set(got)) == 40        # exactly once
+        assert ev._shards[0].topology()["epoch"] >= 1  # promoted
+        monkeypatch.setattr(localfs.FSEvents, "scan_tail_from", orig)
+        monkeypatch.setenv("PIO_SCAN_WORKERS", "1")
+        ser = ev._fanout_snapshot_scan(1)
+        assert canon(res["batch"], res["ids"]) == canon(ser["batch"],
+                                                        ser["ids"])
+    finally:
+        ev.close()
+
+
+def test_find_heap_merge_order_and_limit_pushdown(tmp_path, monkeypatch):
+    """Merged find: global (eventTime, creationTime) order across
+    shards, limit honored, and the limit pushed down to each shard
+    instead of materializing every event."""
+    monkeypatch.setenv("PIO_FSYNC", "rotate")
+    ev = ShardedEvents(tmp_path / "s", shards=3, replicas=1)
+    try:
+        items = [{"event": "buy", "entityType": "user",
+                  "entityId": f"u{k}", "eventId": f"e{k}",
+                  "eventTime": (dt.datetime(2026, 2, 1,
+                                            tzinfo=dt.timezone.utc)
+                                + dt.timedelta(seconds=k)).isoformat()}
+                 for k in range(60)]
+        ev.insert_json_batch(items, 1)
+        seen_limits = []
+        orig = localfs.FSEvents.find
+
+        def spy(self, app_id, **kw):
+            seen_limits.append(kw.get("limit"))
+            return orig(self, app_id, **kw)
+
+        monkeypatch.setattr(localfs.FSEvents, "find", spy)
+        got = [e.event_id for e in ev.find(1, limit=7)]
+        assert got == [f"e{k}" for k in range(7)]
+        assert seen_limits == [7, 7, 7]               # pushed down
+        rev = [e.event_id for e in ev.find(1, limit=5,
+                                           reversed_order=True)]
+        assert rev == [f"e{k}" for k in range(59, 54, -1)]
+        everything = [e.event_id for e in ev.find(1)]
+        assert everything == [f"e{k}" for k in range(60)]
+    finally:
+        ev.close()
+
+
+def test_scan_workers_env_parsing(monkeypatch):
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "3")
+    assert _scan_workers(8) == 3
+    assert _scan_workers(2) == 2          # capped at shard count
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "not-a-number")
+    assert _scan_workers(1) == 1
+    monkeypatch.setenv("PIO_SCAN_WORKERS", "0")
+    assert _scan_workers(64) == min(64, os.cpu_count() or 1)
+    monkeypatch.delenv("PIO_SCAN_WORKERS")
+    assert _scan_workers(64) >= 1
+
+
+def test_batch_merger_matches_pairwise_concat():
+    """Property-based spot check: one k-way BatchMerger pass equals the
+    semantics of pairwise EventBatch.concat on batches with disjoint
+    AND overlapping dictionaries."""
+    from predictionio_tpu.events.event import Event
+
+    rng = np.random.default_rng(5)
+
+    def mk(lo, hi, n):
+        evs = [Event(event=f"ev{int(rng.integers(0, 3))}",
+                     entity_type="user",
+                     entity_id=f"u{int(rng.integers(lo, hi))}",
+                     target_entity_type="item",
+                     target_entity_id=(f"i{int(rng.integers(lo, hi))}"
+                                       if rng.random() > 0.3 else None),
+                     properties={"rating": float(int(rng.integers(0, 5)))}
+                     if rng.random() > 0.5 else {})
+                for _ in range(n)]
+        return EventBatch.from_events(evs)
+
+    parts = [mk(0, 9, 17), mk(5, 14, 11), mk(100, 109, 23)]
+    pairwise = parts[0]
+    for p in parts[1:]:
+        pairwise = EventBatch.concat([pairwise, p])
+    merger = BatchMerger()
+    for p in parts:
+        merger.add(p)
+    kway, _ids = merger.finish()
+    assert canon(kway) == canon(pairwise)
