@@ -127,6 +127,26 @@ def _topk_low(n: int) -> np.ndarray:
     return low
 
 
+def topk_order_keys(s: np.ndarray) -> np.ndarray:
+    """The composite int64 key per element of a float32 score vector
+    whose DESCENDING order is exactly ``host_topk_desc`` /
+    ``lax.top_k``'s total order — (value desc, index asc), every key
+    distinct: the float's monotone int32 image in the high word, a
+    descending index in the low word.  Factored out of
+    ``host_topk_desc`` so incremental order maintenance (the fold
+    engine's ``host_pop_order`` merge) ranks by the SAME key the full
+    sort would."""
+    f = s.astype(np.float32)                 # fresh buffer we may clobber
+    i = f.view(np.int32)
+    m = i >> 31
+    np.bitwise_and(m, np.int32(0x7FFFFFFF), out=m)
+    np.bitwise_xor(i, m, out=i)                  # monotone float→int map
+    kk = i.astype(np.int64)
+    np.left_shift(kk, 32, out=kk)
+    np.add(kk, _topk_low(s.shape[0]), out=kk)
+    return kk
+
+
 def host_topk_desc(s: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k of a 1-D float32 score vector reproducing ``jax.lax.top_k``
     EXACTLY: values descending, equal values broken by LOWER index first —
@@ -150,14 +170,7 @@ def host_topk_desc(s: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
     k = min(int(k), n)
     if k <= 0:
         return s[:0].astype(np.float32), np.zeros(0, np.int32)
-    f = s.astype(np.float32)                 # fresh buffer we may clobber
-    i = f.view(np.int32)
-    m = i >> 31
-    np.bitwise_and(m, np.int32(0x7FFFFFFF), out=m)
-    np.bitwise_xor(i, m, out=i)                  # monotone float→int map
-    kk = i.astype(np.int64)
-    np.left_shift(kk, 32, out=kk)
-    np.add(kk, _topk_low(n), out=kk)
+    kk = topk_order_keys(s)
     if k >= n:
         order = np.argsort(kk)[::-1]
     else:

@@ -905,33 +905,41 @@ def _llr_cells(k11, rc_g, cc_g, n_total, llr_threshold):
     return jnp.where(s >= llr_threshold, s, -jnp.inf)
 
 
-def _llr_topk_cells(rows, cols, k11, rc_g, cc_g, n_total, llr_threshold,
-                    n_rows: int, width: int):
-    """Shared sparse selection tail: score pre-gathered nonzero cells
-    (``_llr_cells`` — the identical elementwise chain as the dense tail,
-    so each cell's f32 value is bit-identical) and select each row's top
+def _score_llr_cells(k11, rc_g, cc_g, n_total, llr_threshold) -> np.ndarray:
+    """One vectorized ``_llr_cells`` pass over pre-gathered cells,
+    bucketed to the next power of two (zero-padded k11 scores to -inf
+    and is sliced off) so the jit compiles once per bucket, not once per
+    distinct nnz.  Returns the float32 score per input cell (-inf =
+    masked).  This is the ONE scoring entry for every sparse tail — the
+    fold engine's pruned re-LLR scores all cells through the same padded
+    program the unpruned selection uses, which is what makes pruning
+    bit-exact rather than merely close."""
+    nnz = len(k11)
+    if nnz == 0:
+        return np.zeros(0, np.float32)
+    pad = 1 << (nnz - 1).bit_length()
+    k11_p = np.zeros(pad, np.float32)
+    rc_p = np.ones(pad, np.float32)
+    cc_p = np.ones(pad, np.float32)
+    k11_p[:nnz] = k11
+    rc_p[:nnz] = rc_g
+    cc_p[:nnz] = cc_g
+    return np.asarray(_llr_cells(
+        k11_p, rc_p, cc_p,
+        jnp.float32(n_total), jnp.float32(llr_threshold)))[:nnz]
+
+
+def _select_topk_cells(rows, cols, scores, n_rows: int, width: int):
+    """Selection half of ``_llr_topk_cells``: given FINITE-scored cells
+    (``rows`` output-local in ``[0, n_rows)``), select each row's top
     ``width`` by (score desc, column asc) — exactly ``lax.top_k``'s
-    stable tie order — into ``[n_rows, width]`` outputs.  ``rows`` are
-    output-local row indices in ``[0, n_rows)``."""
+    stable tie order — into ``[n_rows, width]`` outputs.  Selection is
+    independent per row, so callers may partition the cells at row
+    boundaries and run chunks concurrently: the per-chunk results are
+    identical to one global pass (the fold engine's re-LLR does exactly
+    that across a small worker pool)."""
     out_s = np.full((n_rows, width), -np.inf, np.float32)
     out_i = np.full((n_rows, width), -1, np.int32)
-    if len(rows):
-        # bucket the gather length to the next power of two (zero-padded
-        # k11 scores to -inf and is filtered below) so _llr_cells compiles
-        # once per bucket, not once per distinct nnz
-        nnz = len(rows)
-        pad = 1 << (nnz - 1).bit_length()
-        k11_p = np.zeros(pad, np.float32)
-        rc_p = np.ones(pad, np.float32)
-        cc_p = np.ones(pad, np.float32)
-        k11_p[:nnz] = k11
-        rc_p[:nnz] = rc_g
-        cc_p[:nnz] = cc_g
-        scores = np.asarray(_llr_cells(
-            k11_p, rc_p, cc_p,
-            jnp.float32(n_total), jnp.float32(llr_threshold)))[:nnz]
-        keep = scores > -np.inf
-        rows, cols, scores = rows[keep], cols[keep], scores[keep]
     if len(rows):
         # row-major, score desc within row, column asc on ties
         order = np.lexsort((cols, -scores, rows))
@@ -943,6 +951,22 @@ def _llr_topk_cells(rows, cols, k11, rc_g, cc_g, n_total, llr_threshold,
         out_s[rows[sel], rank[sel]] = scores[sel]
         out_i[rows[sel], rank[sel]] = cols[sel]
     return out_s, out_i
+
+
+def _llr_topk_cells(rows, cols, k11, rc_g, cc_g, n_total, llr_threshold,
+                    n_rows: int, width: int):
+    """Shared sparse selection tail: score pre-gathered nonzero cells
+    (``_score_llr_cells`` → ``_llr_cells`` — the identical elementwise
+    chain as the dense tail, so each cell's f32 value is bit-identical)
+    and select each row's top ``width`` (``_select_topk_cells``).
+    ``rows`` are output-local row indices in ``[0, n_rows)``."""
+    if len(rows):
+        scores = _score_llr_cells(k11, rc_g, cc_g, n_total, llr_threshold)
+        keep = scores > -np.inf
+        rows, cols, scores = rows[keep], cols[keep], scores[keep]
+    else:
+        scores = np.zeros(0, np.float32)
+    return _select_topk_cells(rows, cols, scores, n_rows, width)
 
 
 def _llr_topk_sparse_host(C, rc, cc, n_total, llr_threshold,

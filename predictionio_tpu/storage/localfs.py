@@ -662,6 +662,16 @@ class _EntityIndex:
                 offset += ln
             self._consumed[path] = consumed + end + 1
 
+    def warm(self) -> None:
+        """Consume every segment byte into the postings now.  The index
+        otherwise builds on the FIRST per-entity lookup — at a
+        million-event log that is seconds of JSON parsing landing inside
+        the first serving query's latency (and, during a follow deploy,
+        contending with the bootstrap fold).  Deploy warms it off-thread
+        instead; later lookups tail only the appended bytes."""
+        with self._lock:
+            self._refresh()
+
     def events(self, entity_type: str, entity_id: str, tombstones: set) -> List[Event]:
         for _attempt in range(2):
             with self._lock:
@@ -744,6 +754,14 @@ class FSEvents(base.LEvents, base.PEvents):
             if key not in self._indexes:
                 self._indexes[key] = _EntityIndex(self._chan_dir(app_id, channel_id))
             return self._indexes[key]
+
+    def warm_entity_index(self, app_id: int,
+                          channel_id: Optional[int] = None) -> None:
+        """Pre-build the per-entity serving index (see
+        ``_EntityIndex.warm``) so the FIRST ``find_by_entity`` after a
+        deploy doesn't pay the whole log's JSON parse inline — the query
+        server calls this off-thread at startup."""
+        self._entity_index(app_id, channel_id).warm()
 
     # -- layout --------------------------------------------------------------
 

@@ -959,6 +959,15 @@ class ShardedEvents(base.LEvents, base.PEvents):
             merged = islice(merged, limit)
         yield from merged
 
+    def warm_entity_index(self, app_id: int,
+                          channel_id: Optional[int] = None) -> None:
+        """Pre-build every shard's per-entity serving index (each shard
+        is a full localfs store — see FSEvents.warm_entity_index)."""
+        for shard in self._shards:
+            self._on_shard(
+                shard,
+                lambda ev: ev.warm_entity_index(app_id, channel_id))
+
     # -- PEvents -------------------------------------------------------------
 
     def scan(self, app_id: int, channel_id: Optional[int] = None,

@@ -57,6 +57,16 @@ class IdDict:
     def strings(self) -> List[str]:
         return list(self._to_str)
 
+    def clone(self) -> "IdDict":
+        """O(n) C-level copy (dict/list copy constructors) — the
+        copy-on-write step when a dictionary is shared with an emitted
+        model: ~10× cheaper than re-adding every string through
+        ``__init__`` at million-entry sizes."""
+        out = IdDict()
+        out._to_id = dict(self._to_id)
+        out._to_str = list(self._to_str)
+        return out
+
     def encode(self, values: Sequence[str]) -> np.ndarray:
         # hot loop: one list-comp over a local-aliased dict .get — hits
         # never touch a method frame, only misses pay the add() call
@@ -113,6 +123,22 @@ class CSRLookup:
         indptr = np.zeros(n_rows + 1, np.int64)
         np.cumsum(counts, out=indptr[1:])
         return cls(indptr, values.astype(np.int32))
+
+    @classmethod
+    def from_sorted_pairs(cls, rows: np.ndarray, values: np.ndarray,
+                          n_rows: int) -> "CSRLookup":
+        """``from_pairs`` for pairs that are ALREADY (row, value)-
+        lexicographically sorted and deduplicated (e.g. the fold state's
+        resident ``(user<<32|item)`` key sets) — skips the O(n log n)
+        flat sort and is array-identical to ``from_pairs`` on such input
+        (tested).  Caller contract, not checked: violating the sort or
+        uniqueness silently builds a wrong lookup."""
+        rows = np.asarray(rows, np.int64)
+        counts = (np.bincount(rows, minlength=n_rows) if len(rows)
+                  else np.zeros(n_rows, np.int64))
+        indptr = np.zeros(n_rows + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return cls(indptr, np.asarray(values, np.int32))
 
     @classmethod
     def empty(cls, n_rows: int = 0) -> "CSRLookup":

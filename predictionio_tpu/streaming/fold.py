@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -67,6 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.ops.cco import _llr_mask_scores
 from predictionio_tpu.store.columnar import (
     CSRLookup,
@@ -76,6 +78,125 @@ from predictionio_tpu.store.columnar import (
 )
 
 _LOW32 = np.int64((1 << 32) - 1)
+
+_REG = _obs_metrics.get_registry()
+_M_RELLR_ROWS = _REG.counter(
+    "pio_follow_rellr_rows_total",
+    "Primary rows handled by a full (marginal-coupled) re-LLR pass, by "
+    "outcome: certified (the selection-stability certificate proved the "
+    "row's stored top-k keeps membership AND order under the new scores "
+    "— its k stored scores refresh in O(k), no per-row sort) vs "
+    "selected (routed through the per-row top-k re-selection)")
+_M_EMIT = _REG.counter(
+    "pio_follow_emit_total",
+    "Derived-serving-state emissions by component (inverted | pop_order "
+    "| popularity | user_seen | seen_by_event | props) and path: "
+    "carried (previous "
+    "generation's object reused, provably identical), patched "
+    "(incremental splice/merge/weight-regather), rebuilt (from scratch)")
+
+
+def rellr_prune_enabled() -> bool:
+    """``PIO_FOLLOW_RELLR_PRUNE=off`` disables the selection-stability
+    certificate — every full re-LLR re-selects every row (the PR-8/11
+    behavior, kept as the exactness oracle the pruning property tests
+    compare against)."""
+    return os.environ.get("PIO_FOLLOW_RELLR_PRUNE", "").lower() not in (
+        "off", "0", "false")
+
+
+def rellr_workers() -> int:
+    """``PIO_FOLLOW_RELLR_WORKERS``: worker threads for the chunked
+    per-row top-k re-selection (the lexsort is the dominant full-re-LLR
+    term and is embarrassingly row-parallel — numpy's sorts release the
+    GIL on large arrays).  Default min(4, cores); 1 = inline."""
+    try:
+        w = int(os.environ.get("PIO_FOLLOW_RELLR_WORKERS", "0"))
+    except ValueError:
+        w = 0
+    if w <= 0:
+        w = min(4, os.cpu_count() or 1)
+    return max(w, 1)
+
+
+# below this many cells the pool's handoff overhead exceeds the sort
+_RELLR_CHUNK_MIN_CELLS = 262_144
+
+
+def _select_topk_chunked(rows: np.ndarray, cols: np.ndarray,
+                         scores: np.ndarray, n_rows: int, width: int):
+    """``ops.cco._select_topk_cells`` partitioned at row boundaries
+    across a small thread pool (``PIO_FOLLOW_RELLR_WORKERS``).  Selection
+    is independent per row, so the chunked outputs are identical to one
+    global pass; ``rows`` must be sorted ascending (cell order)."""
+    from predictionio_tpu.ops.cco import _select_topk_cells
+
+    workers = rellr_workers()
+    if workers <= 1 or len(rows) < _RELLR_CHUNK_MIN_CELLS or n_rows < 2:
+        return _select_topk_cells(rows, cols, scores, n_rows, width)
+    import concurrent.futures as _cf
+
+    out_s = np.full((n_rows, width), -np.inf, np.float32)
+    out_i = np.full((n_rows, width), -1, np.int32)
+    n_chunks = min(workers * 2, n_rows)
+    # split at row boundaries near equal CELL counts (not equal row
+    # counts — cell skew is what unbalances the sorts)
+    marks = (np.arange(1, n_chunks) * (len(rows) / n_chunks)).astype(np.int64)
+    edges, prev = [0], 0
+    for m in marks:
+        r = int(rows[min(int(m), len(rows) - 1)])
+        if r > prev:
+            edges.append(r)
+            prev = r
+    edges.append(n_rows)
+
+    def work(r0: int, r1: int) -> None:
+        lo = np.searchsorted(rows, r0, side="left")
+        hi = np.searchsorted(rows, r1, side="left")
+        s, i = _select_topk_cells(rows[lo:hi] - r0, cols[lo:hi],
+                                  scores[lo:hi], r1 - r0, width)
+        out_s[r0:r1] = s
+        out_i[r0:r1] = i
+
+    with _cf.ThreadPoolExecutor(max_workers=workers) as ex:
+        list(ex.map(lambda b: work(*b), zip(edges[:-1], edges[1:])))
+    return out_s, out_i
+
+
+def _merge_pop_order(old_order: np.ndarray, new_pop: np.ndarray,
+                     changed_ids: np.ndarray) -> np.ndarray:
+    """Incrementally maintain ``URModel.host_pop_order``: remove the
+    changed ids from the previous generation's order (unchanged members
+    keep their relative order — their keys didn't move), rank the
+    changed ids by the SAME composite key ``host_topk_desc`` sorts by,
+    and splice them in.  Array-identical to
+    ``host_topk_desc(new_pop, n)[1]`` whenever ``changed_ids`` contains
+    every id whose popularity differs from the old generation's plus
+    every NEW id (supersets are fine — an unchanged member re-inserts at
+    exactly its old slot, keys being distinct per id)."""
+    from predictionio_tpu.models.common import topk_order_keys
+
+    changed = np.asarray(changed_ids, np.int64)
+    if len(changed) == 0:
+        return old_order
+    keys = topk_order_keys(np.asarray(new_pop, np.float32))
+    keep = ~_in_sorted(old_order.astype(np.int64), changed)
+    base = old_order[keep].astype(np.int32, copy=False)
+    corder = changed[np.argsort(-keys[changed])].astype(np.int32)
+    pos = np.searchsorted(-keys[base.astype(np.int64)],
+                          -keys[corder.astype(np.int64)])
+    return np.insert(base, pos, corder)
+
+
+def _inverted_perm(idx: np.ndarray) -> np.ndarray:
+    """The row-major flat positions of ``idx``'s valid cells in
+    host_inverted CSR order (stable sort by target): the rebuild's
+    weight array is exactly ``llr.ravel()[perm]``, so a generation whose
+    CSR STRUCTURE is unchanged (same idx) refreshes its weights with one
+    gather instead of re-inverting."""
+    valid = idx >= 0
+    flat = np.flatnonzero(valid.ravel())
+    return flat[np.argsort(idx.ravel()[flat], kind="stable")]
 
 
 def state_budget_bytes() -> int:
@@ -336,38 +457,56 @@ def _llr_topk_rows(C_rows: np.ndarray, rc_rows: np.ndarray,
     return np.asarray(s)[:n], np.asarray(i)[:n]
 
 
-def _patch_inverted_csr(old: Tuple[np.ndarray, np.ndarray, np.ndarray],
-                        changed_rows: np.ndarray,
-                        new_idx: np.ndarray, new_llr: np.ndarray,
+def _patch_inverted_csr(old_indptr: np.ndarray, old_rows: np.ndarray,
+                        old_perm: np.ndarray, changed_rows: np.ndarray,
+                        old_idx: np.ndarray, new_idx: np.ndarray,
                         n_t: int, i_p: int,
                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Row-patch a host_inverted CSR: drop every posting entry whose
-    primary row changed, insert the changed rows' new entries at their
-    (target, row) positions.  Output is ARRAY-IDENTICAL to rebuilding the
-    inversion from the new indicator table (the rebuild's stable sort
-    orders entries by (target, row); kept entries already follow that
-    order and inserts go to their exact slots), so patched and rebuilt
-    indexes serve byte-for-byte the same candidates."""
-    indptr, rows, w = old
-    tgt_of = np.repeat(np.arange(n_t, dtype=np.int64), np.diff(indptr))
-    keep = ~_in_sorted(rows.astype(np.int64), changed_rows)
-    k_t, k_r, k_w = tgt_of[keep], rows[keep], w[keep]
+    """Row-patch a host_inverted CSR's STRUCTURE: drop every posting
+    entry whose primary row changed, insert the changed rows' new
+    entries at their (target, row) positions, and splice the weight
+    permutation (``_inverted_perm``) the same way — the caller gathers
+    weights as ``new_llr.ravel()[perm]``, so the weights of UNCHANGED
+    rows still refresh (an N bump moves every LLR value without moving
+    any structure).  ``indptr`` updates as an indptr-delta splice: old
+    prefix sums plus the prefix sums of (inserted − removed) per target
+    — O(n_t + changed·K), never a full posting recount — and extends
+    for target-space growth (new targets at the end) and primary-row
+    growth (``changed_rows`` may exceed ``old_idx``'s rows), so pure
+    catalog growth patches instead of rebuilding.  Output is
+    ARRAY-IDENTICAL to rebuilding the inversion from the new indicator
+    table (the rebuild's stable sort orders entries by (target, row);
+    kept entries already follow that order and inserts go to their
+    exact slots)."""
+    k = new_idx.shape[1]
+    changed_rows = np.asarray(changed_rows, np.int64)
+    if len(old_indptr) < n_t + 1:
+        old_indptr = np.concatenate([
+            old_indptr,
+            np.full(n_t + 1 - len(old_indptr), old_indptr[-1], np.int64)])
+    tgt_of = np.repeat(np.arange(n_t, dtype=np.int64), np.diff(old_indptr))
+    keep = ~_in_sorted(old_rows.astype(np.int64), changed_rows)
+    k_t, k_r, k_p = tgt_of[keep], old_rows[keep], old_perm[keep]
+    changed_old = changed_rows[changed_rows < old_idx.shape[0]]
+    rem = old_idx[changed_old]
+    rem_t = rem[rem >= 0].astype(np.int64)
     sub = new_idx[changed_rows]
     valid = sub >= 0
-    n_r = np.repeat(changed_rows.astype(np.int64),
-                    sub.shape[1])[valid.ravel()]
+    n_r = np.repeat(changed_rows, k)[valid.ravel()]
     n_tg = sub[valid].astype(np.int64)
-    n_w = new_llr[changed_rows][valid].astype(np.float32)
+    n_flat = (changed_rows[:, None] * k
+              + np.arange(k, dtype=np.int64)).ravel()[valid.ravel()]
     order = np.lexsort((n_r, n_tg))
-    n_tg, n_r, n_w = n_tg[order], n_r[order], n_w[order]
+    n_tg, n_r, n_flat = n_tg[order], n_r[order], n_flat[order]
     pos = np.searchsorted(k_t * i_p + k_r.astype(np.int64),
                           n_tg * i_p + n_r)
     rows2 = np.insert(k_r, pos, n_r.astype(np.int32)).astype(np.int32)
-    w2 = np.insert(k_w, pos, n_w).astype(np.float32)
-    counts = (np.bincount(k_t, minlength=n_t)
-              + np.bincount(n_tg, minlength=n_t))
-    indptr2 = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
-    return indptr2, rows2, w2
+    perm2 = np.insert(k_p, pos, n_flat)
+    delta = (np.bincount(n_tg, minlength=n_t)
+             - np.bincount(rem_t, minlength=n_t))
+    indptr2 = (old_indptr
+               + np.concatenate([[0], np.cumsum(delta)])).astype(np.int64)
+    return indptr2, rows2, perm2
 
 
 @dataclasses.dataclass
@@ -386,6 +525,21 @@ class _TypeState:
     sc: Optional[_SparseCounts] = None   # sorted-COO counts (sparse)
     idx: Optional[np.ndarray] = None   # int32 [I_p, K] indicator ids
     llr: Optional[np.ndarray] = None   # f32   [I_p, K] indicator scores
+    # copy-on-write marks: an emitted model shares idx/llr and item_dict
+    # by reference (the emit may run on the publisher thread); any
+    # in-place mutation must clone first
+    shared_tables: bool = False
+    shared_dict: bool = False
+
+    def mutable_tables(self) -> None:
+        """COW guard before an IN-PLACE idx/llr write (sliced re-LLR,
+        certified-score refresh): the emitted model may share these
+        arrays."""
+        if self.shared_tables:
+            if self.idx is not None:
+                self.idx = self.idx.copy()
+                self.llr = self.llr.copy()
+            self.shared_tables = False
 
     @property
     def n_items(self) -> int:
@@ -394,6 +548,25 @@ class _TypeState:
     @property
     def counts(self):
         return self.sc if self.sc is not None else self.C
+
+
+@dataclasses.dataclass
+class _EmitSnapshot:
+    """Consistent emission view captured by ``URFoldState.fold_apply``:
+    structure references (replaced-on-change) plus COW-marked shared
+    arrays/dictionaries, so ``emit_snapshot`` — and the serving-bundle
+    warm behind it — can run on the follower's publisher thread while
+    the next delta applies on the fold loop."""
+
+    generation: int
+    n_users: int
+    user_dict: IdDict
+    types: Dict[str, dict]
+    props: Dict[str, dict]
+    pop_f32: Optional[np.ndarray]
+    pop_changed: Optional[np.ndarray]
+    remap: dict
+    hints: Dict[str, dict]
 
 
 class URFoldState:
@@ -456,6 +629,36 @@ class URFoldState:
         self.generation = 0
         self.model = None
         self.last_fold_stats: Dict[str, dict] = {}
+        self.last_rellr_stats: Dict[str, dict] = {}
+        self.last_phase_s: Dict[str, float] = {}
+        self._rellr_s = 0.0
+        self._user_dict_shared = False
+        self._emit_hints: Dict[str, dict] = {}
+        self._reshape_identity: Dict[str, bool] = {}
+        # incremental popularity: running int64 per-item event counts +
+        # observed time range, valid while the backfill window covers
+        # every event (the default 3650-day window practically always
+        # does); outside the supported config the emit recomputes from
+        # the raw lists exactly as before
+        bf_names = list(self.params.backfill_event_names or [self.primary])
+        self._pop_incremental = (self.params.backfill_type == "popular"
+                                 and bf_names == [self.primary])
+        self._pop_duration = 0.0
+        if self._pop_incremental:
+            from predictionio_tpu.models.universal_recommender.popmodel \
+                import parse_duration
+            try:
+                self._pop_duration = parse_duration(
+                    self.params.backfill_duration)
+            except (ValueError, TypeError):
+                self._pop_incremental = False
+        self._pop: Optional[list] = None     # [counts, t_min, t_max]
+        self._pop_changed_now: Optional[np.ndarray] = None
+        # emit-side caches (touched only by emit_snapshot, which runs
+        # serialized — at most one emit at a time, in snapshot order)
+        self._user_seen_cache: Optional[tuple] = None
+        self._seen_by_ev_cache: Dict[str, tuple] = {}
+        self._inv_cache: Dict[str, dict] = {}
 
     # -- public entry ---------------------------------------------------------
 
@@ -463,15 +666,32 @@ class URFoldState:
         """Fold one columnar delta (built with ``base=self.batch`` so the
         dictionaries are shared — the first call bootstraps from scratch)
         and return the new URModel."""
+        return self.emit_snapshot(self.fold_apply(delta))
+
+    def fold_apply(self, delta: EventBatch) -> "_EmitSnapshot":
+        """Apply one columnar delta to the resident state and return an
+        emission snapshot — everything :meth:`emit_snapshot` needs,
+        captured by reference for replace-on-change structures and
+        marked copy-on-write for the in-place-mutated ones.  The split
+        lets the follower run the emit (and the serving-bundle warm
+        behind it) on its publisher thread while the NEXT delta applies
+        on the fold loop — ticks pipeline instead of serializing
+        fold+emit+warm."""
+        t0 = time.perf_counter()
+        self._rellr_s = 0.0
         if self.batch is None:
             self.batch = delta
         elif len(delta):
             self.batch = EventBatch.concat([self.batch, delta])
         self._apply(delta)
         self._check_budget()
-        model = self._emit()
+        self.last_phase_s = {
+            "apply": max(time.perf_counter() - t0 - self._rellr_s, 0.0),
+            "rellr": self._rellr_s,
+        }
+        snap = self._snapshot()
         self.generation += 1
-        return model
+        return snap
 
     @classmethod
     def bootstrap(cls, algo_params, ds_params,
@@ -505,6 +725,12 @@ class URFoldState:
             total += sum(int(a.nbytes) for a in t.raw_times)
             if t.idx is not None:
                 total += int(t.idx.nbytes) + int(t.llr.nbytes)
+        if self._pop is not None:
+            total += int(self._pop[0].nbytes)
+        # list(): the publisher thread's emit may be (re)installing cache
+        # entries concurrently with this read-only walk
+        for inv in list(self._inv_cache.values()):
+            total += int(inv["perm"].nbytes)
         if self.batch is not None:
             b = self.batch
             for arr in (b.event_codes, b.entity_type_codes, b.entity_ids,
@@ -534,6 +760,10 @@ class URFoldState:
         from predictionio_tpu.events.event import SPECIAL_EVENTS
 
         self.last_fold_stats = {}
+        self.last_rellr_stats = {}
+        self._emit_hints = {}
+        self._reshape_identity = {}
+        self._pop_changed_now = None
         special = [delta.event_dict.id(n) for n in SPECIAL_EVENTS]
         special = np.asarray([c for c in special if c is not None], np.int32)
         props_changed = bool(len(delta)) and bool(
@@ -557,6 +787,10 @@ class URFoldState:
             e_codes = per_type_raw[name][0]
             for c in np.unique(e_codes):
                 if self.user_of_code[c] < 0:
+                    if self._user_dict_shared:
+                        # COW: the emitted model shares this dictionary
+                        self.user_dict = self.user_dict.clone()
+                        self._user_dict_shared = False
                     self.user_of_code[c] = self.user_dict.add(
                         delta.entity_dict.str(int(c)))
         new_users = len(self.user_dict) != n_users_before
@@ -582,6 +816,23 @@ class URFoldState:
             if len(i):
                 st.raw_items.append(i.astype(np.int32))
                 st.raw_times.append(times)
+            if name == self.primary and self._pop_incremental:
+                n_p_now = st.n_items
+                if self._pop is None:
+                    self._pop = [np.zeros(max(n_p_now, 1), np.int64),
+                                 np.inf, -np.inf]
+                cnts = self._pop[0]
+                if len(cnts) < n_p_now:   # growth the reshape didn't see
+                    grown = np.zeros(n_p_now, np.int64)
+                    grown[:len(cnts)] = cnts
+                    self._pop[0] = cnts = grown
+                if len(i):
+                    cnts += np.bincount(i, minlength=len(cnts))
+                    self._pop[1] = min(self._pop[1], float(times.min()))
+                    self._pop[2] = max(self._pop[2], float(times.max()))
+                    self._pop_changed_now = np.unique(i).astype(np.int64)
+                else:
+                    self._pop_changed_now = np.zeros(0, np.int64)
             keys = (np.unique(_pair_key(u, i)) if len(u)
                     else np.zeros(0, np.int64))
             if len(keys):
@@ -631,6 +882,8 @@ class URFoldState:
             rows = np.unique(np.concatenate(parts)) if parts else rc_rows
             if len(rows) == 0:
                 self.last_fold_stats[name] = {"rows": 0, "mode": "skip"}
+                self._emit_hints[name] = {
+                    "idx_rows": np.zeros(0, np.int64), "llr_changed": False}
                 continue
             self._rellr_type(name, rows=rows.astype(np.int64))
         if props_changed or not self._props_ever:
@@ -643,9 +896,14 @@ class URFoldState:
                 k: dict(v) for k, v in fold_properties(
                     self.batch, self.ds_params.item_entity_type).items()}
             self._props_ever = True
-        self._last_remap = {"primary": primary_reshaped,
-                            "types": dict(reshaped),
-                            "props": props_changed}
+        self._last_remap = {
+            "primary": primary_reshaped,
+            "primary_identity": self._reshape_identity.get(
+                self.primary, True),
+            "types": dict(reshaped),
+            "type_identity": dict(self._reshape_identity),
+            "props": props_changed,
+        }
 
     def _extend_item_space(self, name: str, t_codes: np.ndarray,
                            delta: EventBatch) -> bool:
@@ -665,9 +923,23 @@ class URFoldState:
         perm = np.searchsorted(merged, st.codes)  # old local → new local
         remapped = bool(len(st.codes)) and bool(
             (perm != np.arange(len(st.codes))).any())
+        n_old = len(st.codes)
         st.codes = merged
-        st.item_dict = IdDict(
-            [delta.target_dict.str(int(c)) for c in merged])
+        self._reshape_identity[name] = not remapped
+        if remapped or n_old == 0:
+            st.item_dict = IdDict(
+                [delta.target_dict.str(int(c)) for c in merged])
+            st.shared_dict = False
+        else:
+            # pure end growth (every new code sorts after every old one):
+            # existing local ids are stable, so the dictionary APPENDS
+            # instead of rebuilding — O(new items), not O(catalog) —
+            # with a COW clone when an emitted model shares it
+            if st.shared_dict:
+                st.item_dict = st.item_dict.clone()
+                st.shared_dict = False
+            for c in merged[n_old:]:
+                st.item_dict.add(delta.target_dict.str(int(c)))
         lot = np.full(len(st.local_of_target), -1, np.int64)
         lot[merged] = np.arange(len(merged), dtype=np.int64)
         st.local_of_target = lot
@@ -692,7 +964,14 @@ class URFoldState:
             if len(perm) and st.C.size:
                 C[:, perm] = st.C
             st.C = C
-        st.idx = st.llr = None   # shape changed: full re-LLR for the type
+        if remapped:
+            # mid-array insert: stored indicator COLUMN ids shifted —
+            # the full re-LLR rebuilds the tables from scratch
+            st.idx = st.llr = None
+        # else: pure end growth keeps every stored column id valid; the
+        # marginal-triggered full re-LLR re-certifies each row against
+        # the new columns (a new column can only ENTER a row's top-k
+        # through the certificate's re-selection route)
         if name == self.primary:
             self._primary_perm = perm
         return True
@@ -710,6 +989,12 @@ class URFoldState:
             .astype(np.int64) if len(p_st.pairs)
             else np.zeros(n_p, np.int64))
         perm = self._primary_perm
+        identity = self._reshape_identity.get(self.primary, True)
+        if self._pop is not None:
+            cnts = np.zeros(n_p, np.int64)
+            if len(perm):
+                cnts[perm] = self._pop[0][:len(perm)]
+            self._pop[0] = cnts
         for name in self.event_names:
             st = self.types[name]
             if st.sc is not None:
@@ -719,15 +1004,38 @@ class URFoldState:
                 if len(perm) and st.C.size:
                     C[perm, :] = st.C
                 st.C = C
-            st.idx = st.llr = None
+            if identity and st.idx is not None and st.idx.shape[0] <= n_p:
+                # pure end growth of the primary space: existing rows
+                # keep their ids — extend the indicator tables with
+                # empty rows (the new rows re-select through their own
+                # delta pairs) instead of discarding every stored
+                # selection
+                pad = n_p - st.idx.shape[0]
+                if pad:
+                    st.idx = np.concatenate([st.idx, np.full(
+                        (pad, st.idx.shape[1]), -1, np.int32)])
+                    st.llr = np.concatenate([st.llr, np.zeros(
+                        (pad, st.llr.shape[1]), np.float32)])
+                    st.shared_tables = False
+            else:
+                st.idx = st.llr = None
 
     def _rellr_type(self, name: str, rows: Optional[np.ndarray]) -> None:
         """Recompute LLR + top-k for ``rows`` of one type (None = all),
         bit-identically to what training would compute: sparse state
-        routes through ``_llr_topk_sparse_rows`` (the row-scoped variant
-        of the training host tail — same ``_llr_cells`` elementwise
-        scores, same lax.top_k tie order), dense state through the same
-        jitted dense kernels as before."""
+        routes through the cell-scoring + selection tail shared with the
+        training host path (same ``_llr_cells`` elementwise scores, same
+        lax.top_k tie order) — full passes PRUNED by the selection-
+        stability certificate (:meth:`_rellr_full_sparse`) — dense state
+        through the same jitted dense kernels as before."""
+        t0 = time.perf_counter()
+        try:
+            self._rellr_type_inner(name, rows)
+        finally:
+            self._rellr_s += time.perf_counter() - t0
+
+    def _rellr_type_inner(self, name: str,
+                          rows: Optional[np.ndarray]) -> None:
         from predictionio_tpu.ops.cco import (
             _DenseRunner,
             _llr_topk_dense,
@@ -753,32 +1061,26 @@ class URFoldState:
             # through the row-scoped variant of the training host tail
             width = min(t_k, n_t)
             if rows is None:
-                crows, ccols, ccnt = st.sc.all_cells()
-                rc_rows = self.row_counts
-                self_cols = (np.arange(n_p, dtype=np.int64) if excl
-                             else None)
-                n_rows = n_p
-            else:
-                crows, ccols, ccnt = st.sc.row_cells(rows)
-                rc_rows = self.row_counts[rows]
-                self_cols = rows if excl else None
-                n_rows = len(rows)
+                self._rellr_full_sparse(name, st, width, t_k,
+                                        float(t_llr), excl, n_p, n_t,
+                                        n_total)
+                return
+            crows, ccols, ccnt = st.sc.row_cells(rows)
+            rc_rows = self.row_counts[rows]
+            self_cols = rows if excl else None
             s, i = _llr_topk_sparse_rows(
                 crows, ccols, ccnt, rc_rows, st.col_counts, n_total,
-                float(t_llr), top_k=width, n_rows=n_rows, n_cols=n_t,
+                float(t_llr), top_k=width, n_rows=len(rows), n_cols=n_t,
                 self_cols=self_cols)
             scores, idx = _DenseRunner.collect((s, i, n_t, t_k))
-            if rows is None:
-                st.idx = idx.astype(np.int32)
-                st.llr = np.where(np.isfinite(scores), scores,
-                                  0.0).astype(np.float32)
-                self.last_fold_stats[name] = {"rows": n_p, "mode": "full"}
-            else:
-                st.idx[rows] = idx.astype(np.int32)
-                st.llr[rows] = np.where(np.isfinite(scores), scores,
-                                        0.0).astype(np.float32)
-                self.last_fold_stats[name] = {"rows": int(len(rows)),
-                                              "mode": "sliced"}
+            st.mutable_tables()
+            st.idx[rows] = idx.astype(np.int32)
+            st.llr[rows] = np.where(np.isfinite(scores), scores,
+                                    0.0).astype(np.float32)
+            self.last_fold_stats[name] = {"rows": int(len(rows)),
+                                          "mode": "sliced"}
+            self._emit_hints[name] = {"idx_rows": rows,
+                                      "llr_changed": True}
             return
         if st.sc is not None:
             # dense kernels over a transient materialization: the tiny-
@@ -809,24 +1111,252 @@ class URFoldState:
             st.idx = idx.astype(np.int32)
             st.llr = np.where(np.isfinite(scores), scores,
                               0.0).astype(np.float32)
+            st.shared_tables = False
             self.last_fold_stats[name] = {"rows": C_full.shape[0],
                                           "mode": "full"}
+            self._emit_hints[name] = {"idx_rows": None,
+                                      "llr_changed": True}
             return
         scores, idx = _llr_topk_rows(
             C_full[rows], self.row_counts[rows], st.col_counts, n_total,
             float(t_llr), rows if excl else None, min(t_k, n_t))
         scores, idx = _DenseRunner.collect((scores, idx, n_t, t_k))
+        st.mutable_tables()
         st.idx[rows] = idx.astype(np.int32)
         st.llr[rows] = np.where(np.isfinite(scores), scores,
                                 0.0).astype(np.float32)
         self.last_fold_stats[name] = {"rows": int(len(rows)),
                                       "mode": "sliced"}
+        self._emit_hints[name] = {"idx_rows": rows, "llr_changed": True}
+
+    def _rellr_full_sparse(self, name: str, st: _TypeState, width: int,
+                           t_k: int, t_llr: float, excl: bool,
+                           n_p: int, n_t: int, n_total: float) -> None:
+        """Full (marginal-coupled) re-LLR of one type over the sparse
+        state, PRUNED: ONE vectorized G² score pass over every resident
+        nonzero cell — the same power-of-two-padded ``_llr_cells``
+        program the unpruned tail runs, so every emitted score is
+        bit-exact — followed by per-row top-k re-selection only where
+        the selection could have moved.
+
+        The per-row certificate is exact, not a bound, because it
+        compares the NEW scores directly: a row keeps its stored
+        selection iff (a) membership holds — with a full selection its
+        weakest selected cell strictly beats its best non-selected cell
+        (score TIES route to re-selection: the column tie-break could
+        flip membership); with a deficit selection (< ``width`` stored)
+        no non-selected cell scores finite and no selected cell fell to
+        -inf — and (b) the stored order is still (score desc, col asc)-
+        sorted under the new scores.  Certified rows provably keep
+        membership AND order, so they refresh their k stored scores by
+        one gather (O(k)) and skip the lexsort entirely; the rest
+        re-select through ``_select_topk_cells``, chunked across
+        ``PIO_FOLLOW_RELLR_WORKERS``.  ``PIO_FOLLOW_RELLR_PRUNE=off``
+        forces every row down the re-selection route (the exactness
+        oracle)."""
+        from predictionio_tpu.ops.cco import _DenseRunner, _score_llr_cells
+
+        crows, ccols, ccnt = st.sc.all_cells()
+        if excl and len(crows):
+            off = ccols != crows
+            crows, ccols, ccnt = crows[off], ccols[off], ccnt[off]
+        scores = _score_llr_cells(
+            ccnt.astype(np.float32),
+            self.row_counts[crows].astype(np.float32),
+            st.col_counts[ccols].astype(np.float32), n_total, t_llr)
+        old_idx = st.idx if (rellr_prune_enabled() and st.idx is not None
+                             and st.llr is not None
+                             and st.idx.shape == (n_p, t_k)) else None
+        self.last_fold_stats[name] = {"rows": n_p, "mode": "full"}
+        if old_idx is None:
+            keep = scores > -np.inf
+            s, i = _select_topk_chunked(
+                crows[keep], ccols[keep], scores[keep], n_p, width)
+            sc2, idx2 = _DenseRunner.collect((s, i, n_t, t_k))
+            st.idx = idx2.astype(np.int32)
+            st.llr = np.where(np.isfinite(sc2), sc2,
+                              0.0).astype(np.float32)
+            st.shared_tables = False
+            if n_p:
+                _M_RELLR_ROWS.inc(n_p, outcome="selected")
+            self.last_rellr_stats[name] = {"certified": 0,
+                                           "selected": int(n_p)}
+            self._emit_hints[name] = {"idx_rows": None,
+                                      "llr_changed": True}
+            return
+        # -- certification ------------------------------------------------
+        valid = old_idx >= 0
+        sel_count = valid.sum(axis=1)
+        span = np.int64(n_t + 1)
+        cell_flat = crows * span + ccols
+        # ONE searchsorted: locate every stored cell among the COO cells
+        # (they must exist — counts never decrease; a miss = corrupt
+        # state degrades to -inf, which fails certification and
+        # re-selects the row from the actual cells).  The located
+        # positions both refresh the stored scores AND mark the cells as
+        # selected — no second membership pass.
+        vr, vj = np.nonzero(valid)
+        vc = old_idx[vr, vj].astype(np.int64)
+        new_sel = np.full((n_p, t_k), -np.inf, np.float32)
+        is_sel = np.zeros(len(cell_flat), bool)
+        if len(vr) and len(cell_flat):
+            key = vr.astype(np.int64) * span + vc
+            pos = np.searchsorted(cell_flat, key)
+            np.minimum(pos, len(cell_flat) - 1, out=pos)
+            hit = cell_flat[pos] == key
+            is_sel[pos[hit]] = True
+            new_sel[vr[hit], vj[hit]] = scores[pos[hit]]
+        # per-row best non-selected contender (segment max; cells are
+        # (row, col)-sorted so each row is one contiguous run)
+        max_nonsel = np.full(n_p, -np.inf, np.float32)
+        starts = np.zeros(0, np.int64)
+        if len(crows):
+            non_scores = np.where(is_sel, np.float32(-np.inf), scores)
+            starts = np.concatenate(
+                [[0], np.flatnonzero(np.diff(crows)) + 1])
+            max_nonsel[crows[starts]] = np.maximum.reduceat(
+                non_scores, starts)
+        min_sel = np.where(valid, new_sel, np.inf).min(axis=1)
+        # a SCORE tie at the membership boundary is still exactly
+        # decidable: under (score desc, col asc) the tied selected cells
+        # win iff their largest column is below the tied contenders'
+        # smallest column (common in uniform-count catalogs, where whole
+        # rows share one score — without this, every such row would
+        # re-sort on every N bump)
+        nonsel_tie_min = np.full(n_p, int(span), np.int64)
+        if len(crows):
+            tie_cols = np.where(~is_sel & (scores == max_nonsel[crows]),
+                                ccols, span)
+            nonsel_tie_min[crows[starts]] = np.minimum.reduceat(
+                tie_cols, starts)
+        sel_tie_max = np.where(
+            valid & (new_sel == min_sel[:, None]), old_idx,
+            -1).max(axis=1).astype(np.int64) if t_k else \
+            np.full(n_p, -1, np.int64)
+        tie_ok = (min_sel > -np.inf) & (sel_tie_max < nonsel_tie_min)
+        member_ok = np.where(
+            sel_count == width,
+            (min_sel > max_nonsel)
+            | ((min_sel == max_nonsel) & tie_ok),
+            (max_nonsel == -np.inf) & (min_sel > -np.inf))
+        if t_k > 1:
+            s0, s1 = new_sel[:, :-1], new_sel[:, 1:]
+            i0 = old_idx[:, :-1].astype(np.int64)
+            i1 = old_idx[:, 1:].astype(np.int64)
+            # padding forms a suffix, so valid[:, 1:] marks exactly the
+            # adjacent pairs that are BOTH valid
+            pair_ok = ((s0 > s1) | ((s0 == s1) & (i0 < i1))
+                       | ~valid[:, 1:])
+            certified = member_ok & pair_ok.all(axis=1)
+        else:
+            certified = member_ok
+        uncert = np.flatnonzero(~certified).astype(np.int64)
+        idx_new = old_idx.copy()
+        llr_new = np.zeros((n_p, t_k), np.float32)
+        cert2d = certified[:, None] & valid
+        llr_new[cert2d] = new_sel[cert2d]
+        if len(uncert):
+            keep = scores > -np.inf
+            kr, kc, ks = crows[keep], ccols[keep], scores[keep]
+            lo = np.searchsorted(kr, uncert, side="left")
+            hi = np.searchsorted(kr, uncert, side="right")
+            seg = hi - lo
+            total = int(seg.sum())
+            if total:
+                csum = np.cumsum(seg)
+                within = np.arange(total, dtype=np.int64) \
+                    - np.repeat(csum - seg, seg)
+                gidx = np.repeat(lo, seg) + within
+                local = np.repeat(
+                    np.arange(len(uncert), dtype=np.int64), seg)
+                s_u, i_u = _select_topk_chunked(
+                    local, kc[gidx], ks[gidx], len(uncert), width)
+            else:
+                s_u = np.full((len(uncert), width), -np.inf, np.float32)
+                i_u = np.full((len(uncert), width), -1, np.int32)
+            sc2, idx2 = _DenseRunner.collect((s_u, i_u, n_t, t_k))
+            idx_new[uncert] = idx2.astype(np.int32)
+            llr_new[uncert] = np.where(np.isfinite(sc2), sc2,
+                                       0.0).astype(np.float32)
+        st.idx, st.llr = idx_new, llr_new
+        st.shared_tables = False
+        n_cert = int(n_p - len(uncert))
+        if n_cert:
+            _M_RELLR_ROWS.inc(n_cert, outcome="certified")
+        if len(uncert):
+            _M_RELLR_ROWS.inc(int(len(uncert)), outcome="selected")
+        self.last_rellr_stats[name] = {"certified": n_cert,
+                                       "selected": int(len(uncert))}
+        self._emit_hints[name] = {"idx_rows": uncert, "llr_changed": True}
 
     # -- model emission -------------------------------------------------------
 
+    def _snapshot(self) -> "_EmitSnapshot":
+        """Capture a consistent emission view of the state: references
+        for structures that are REPLACED on change (pairs, dicts, props,
+        per-fold raw arrays), copies for the in-place-mutated popularity
+        counts, and copy-on-write marks on the indicator tables and
+        dictionaries the emitted model will share.  After this call the
+        fold loop may apply the next delta while the emit runs."""
+        pop_f32, pop_changed = self._pop_view()
+        types: Dict[str, dict] = {}
+        for name in self.event_names:
+            st = self.types[name]
+            types[name] = {
+                "idx": st.idx, "llr": st.llr, "pairs": st.pairs,
+                "item_dict": st.item_dict, "n_items": st.n_items,
+                "raw_items": list(st.raw_items),
+                "raw_times": list(st.raw_times),
+            }
+            st.shared_tables = True
+            st.shared_dict = True
+        self._user_dict_shared = True
+        return _EmitSnapshot(
+            generation=self.generation + 1,
+            n_users=len(self.user_dict),
+            user_dict=self.user_dict,
+            types=types,
+            props=self._props,
+            pop_f32=pop_f32,
+            pop_changed=pop_changed,
+            remap=dict(getattr(self, "_last_remap", None)
+                       or {"primary": True, "primary_identity": False,
+                           "types": {}, "type_identity": {},
+                           "props": True}),
+            hints=dict(self._emit_hints),
+        )
+
+    def _pop_view(self):
+        """(popularity f32, changed ids) when the incremental counts
+        are valid — the counts convert to EXACTLY what backfill_scores
+        computes, provided no event has fallen out of the (end-anchored)
+        window: end = max_t + 1e-6 shifts with every append, so validity
+        is min_t >= end - duration, the same float64 arithmetic the full
+        recompute applies.  (None, None) otherwise → full recompute."""
+        if not self._pop_incremental or self._pop is None:
+            return None, None
+        cnts, t_min, t_max = self._pop
+        if np.isfinite(t_max) \
+                and t_min < (float(t_max) + 1e-6) - float(self._pop_duration):
+            return None, None
+        return cnts.astype(np.float32), \
+            (self._pop_changed_now if self._pop_changed_now is not None
+             else None)
+
     def _emit(self):
-        """Build a fresh URModel from the state — the same construction
-        URAlgorithm.train performs from its results dict."""
+        """Build a fresh URModel from the current state (snapshot taken
+        inline) — the restore/bootstrap entry; the follower's pipelined
+        path uses fold_apply + emit_snapshot instead."""
+        return self.emit_snapshot(self._snapshot())
+
+    def emit_snapshot(self, snap: "_EmitSnapshot"):
+        """Build the URModel one snapshot describes — array-identical to
+        the construction ``URAlgorithm.train`` performs — reusing
+        derived serving state across generations wherever provably
+        identical.  Runs off the fold loop when the follower pipelines
+        (streaming.follow's publisher thread); emits are serialized and
+        in snapshot order, so the prev-generation chain (``self.model``)
+        stays consistent."""
         from predictionio_tpu.models.universal_recommender.engine import (
             URModel,
         )
@@ -835,119 +1365,217 @@ class URFoldState:
             parse_duration,
         )
 
-        p_st = self.types[self.primary]
-        n_items = p_st.n_items
-        n_users = len(self.user_dict)
+        t0 = time.perf_counter()
+        p = snap.types[self.primary]
+        n_items = p["n_items"]
+        n_users = snap.n_users
         if n_items == 0:
             raise ValueError(f"no {self.primary!r} events to train on")
         indicator_idx: Dict[str, np.ndarray] = {}
         indicator_llr: Dict[str, np.ndarray] = {}
         event_item_dicts: Dict[str, IdDict] = {}
         for name in self.event_names:
-            st = self.types[name]
-            if name != self.primary and st.n_items == 0:
+            t = snap.types[name]
+            if name != self.primary and t["n_items"] == 0:
                 continue
-            event_item_dicts[name] = st.item_dict
-            indicator_idx[name] = st.idx.copy()
-            indicator_llr[name] = st.llr.copy()
-        user_seen = CSRLookup.from_pairs(
-            _key_user(p_st.pairs), _key_item(p_st.pairs), n_users)
+            event_item_dicts[name] = t["item_dict"]
+            indicator_idx[name] = t["idx"]
+            indicator_llr[name] = t["llr"]
+        # user → seen primary items: the resident pair set is already
+        # (user, item)-sorted and deduped, so a changed generation
+        # rebuilds in O(pairs) with NO sort; an untouched one carries
+        # the previous CSR object outright
+        pairs = p["pairs"]
+        us_cache = self._user_seen_cache
+        if us_cache is not None and us_cache[0] is pairs \
+                and us_cache[1] == n_users:
+            user_seen = us_cache[2]
+            _M_EMIT.inc(1, component="user_seen", path="carried")
+        else:
+            user_seen = CSRLookup.from_sorted_pairs(
+                _key_user(pairs), _key_item(pairs), n_users)
+            self._user_seen_cache = (pairs, n_users, user_seen)
+            _M_EMIT.inc(1, component="user_seen", path="rebuilt")
         bf_names = self.params.backfill_event_names or [self.primary]
-        bf_items, bf_times = [], []
-        for name in bf_names:
-            st = self.types[name]
-            items = (np.concatenate(st.raw_items) if st.raw_items
-                     else np.zeros(0, np.int32))
-            times = (np.concatenate(st.raw_times) if st.raw_times
-                     else np.zeros(0, np.float64))
-            if name == self.primary:
-                bf_items.append(items)
-                bf_times.append(times)
-            else:
-                translate = p_st.item_dict.lookup_many(
-                    st.item_dict.strings())
-                mapped = translate[items] if len(items) else items
-                keep = mapped >= 0
-                bf_items.append(mapped[keep])
-                bf_times.append(times[keep])
-        popularity = backfill_scores(
-            self.params.backfill_type,
-            np.concatenate(bf_items) if bf_items else np.zeros(0, np.int32),
-            np.concatenate(bf_times) if bf_times else np.zeros(0, np.float64),
-            n_items,
-            parse_duration(self.params.backfill_duration),
-        )
+        if snap.pop_f32 is not None:
+            popularity = snap.pop_f32
+            _M_EMIT.inc(1, component="popularity", path="patched")
+        else:
+            _M_EMIT.inc(1, component="popularity", path="rebuilt")
+            bf_items, bf_times = [], []
+            for name in bf_names:
+                t = snap.types[name]
+                items = (np.concatenate(t["raw_items"]) if t["raw_items"]
+                         else np.zeros(0, np.int32))
+                times = (np.concatenate(t["raw_times"]) if t["raw_times"]
+                         else np.zeros(0, np.float64))
+                if name == self.primary:
+                    bf_items.append(items)
+                    bf_times.append(times)
+                else:
+                    translate = p["item_dict"].lookup_many(
+                        t["item_dict"].strings())
+                    mapped = translate[items] if len(items) else items
+                    keep = mapped >= 0
+                    bf_items.append(mapped[keep])
+                    bf_times.append(times[keep])
+            popularity = backfill_scores(
+                self.params.backfill_type,
+                np.concatenate(bf_items) if bf_items
+                else np.zeros(0, np.int32),
+                np.concatenate(bf_times) if bf_times
+                else np.zeros(0, np.float64),
+                n_items,
+                parse_duration(self.params.backfill_duration),
+            )
         blacklist_events = self.params.blacklist_events or [self.primary]
         user_seen_by_event: Dict[str, CSRLookup] = {}
         for name in blacklist_events:
             if name == self.primary or name not in event_item_dicts:
                 continue
-            st = self.types[name]
-            translate = p_st.item_dict.lookup_many(st.item_dict.strings())
-            u, i = _key_user(st.pairs), _key_item(st.pairs)
+            t = snap.types[name]
+            cache = self._seen_by_ev_cache.get(name)
+            if cache is not None and cache[0] is t["pairs"] \
+                    and cache[1] is p["item_dict"] \
+                    and cache[2] is t["item_dict"] and cache[3] == n_users:
+                user_seen_by_event[name] = cache[4]
+                _M_EMIT.inc(1, component="seen_by_event", path="carried")
+                continue
+            translate = p["item_dict"].lookup_many(
+                t["item_dict"].strings())
+            u, i = _key_user(t["pairs"]), _key_item(t["pairs"])
             mapped = translate[i] if len(i) else i
             keep = mapped >= 0
-            user_seen_by_event[name] = CSRLookup.from_pairs(
-                u[keep], mapped[keep], n_users)
+            csr = CSRLookup.from_pairs(u[keep], mapped[keep], n_users)
+            user_seen_by_event[name] = csr
+            self._seen_by_ev_cache[name] = (
+                t["pairs"], p["item_dict"], t["item_dict"], n_users, csr)
+            _M_EMIT.inc(1, component="seen_by_event", path="rebuilt")
         prev = self.model
         model = URModel(
             primary_event=self.primary,
-            item_dict=p_st.item_dict,
-            user_dict=IdDict(self.user_dict.strings()),
+            item_dict=p["item_dict"],
+            user_dict=snap.user_dict,
             indicator_idx=indicator_idx,
             indicator_llr=indicator_llr,
             event_item_dicts=event_item_dicts,
             popularity=popularity,
-            item_properties=self._props,
+            item_properties=snap.props,
             user_seen=user_seen,
             user_seen_by_event=user_seen_by_event,
         )
-        self._carry_serving_state(model, prev)
+        self._carry_serving_state(model, prev, snap)
         self.model = model
+        self.last_emit_s = time.perf_counter() - t0
         return model
 
-    def _carry_serving_state(self, model, prev) -> None:
+    def _carry_serving_state(self, model, prev,
+                             snap: "_EmitSnapshot") -> None:
         """Incremental serving-state handoff to the new generation, only
         where provably identical to a from-scratch rebuild; everything
         else stays generation-keyed (a fresh ``__dict__`` IS the
-        invalidation)."""
+        invalidation).  Pure end growth of the catalog (identity perms)
+        patches rather than invalidates: the host_inverted CSR splices
+        the changed rows (and regathers ALL weights through the cached
+        inversion permutation — an N bump moves every LLR value without
+        moving structure), and host_pop_order merges (changed ∪ new)
+        ids into the previous order by the exact host_topk_desc key."""
         if prev is None:
             return
-        remap = getattr(self, "_last_remap",
-                        {"primary": True, "types": {}, "props": True})
+        remap = snap.remap
         same_catalog = (not remap["primary"]
                         and len(model.item_dict) == len(prev.item_dict))
+        grown_ok = same_catalog or (remap["primary"]
+                                    and remap.get("primary_identity"))
         if same_catalog and not remap["props"] \
                 and model.item_properties is prev.item_properties:
+            carried = False
             for attr in ("_prop_value_index", "_prop_date_array",
                          "_known_prop_names", "_date_off"):
                 v = prev.__dict__.get(attr)
                 if v is not None:
                     model.__dict__[attr] = v
-        if not same_catalog:
+                    carried = True
+            if carried:
+                _M_EMIT.inc(1, component="props", path="carried")
+        if not grown_ok:
             return
+        # -- host_pop_order: incremental merge of (changed ∪ new) ids ----
+        old_order = prev.__dict__.get("_host_pop_order")
+        if old_order is not None and snap.pop_changed is not None:
+            n_new, n_old = len(model.item_dict), len(prev.item_dict)
+            changed = snap.pop_changed
+            if n_new > n_old:
+                changed = np.union1d(
+                    changed, np.arange(n_old, n_new, dtype=np.int64))
+            model.__dict__["_host_pop_order"] = _merge_pop_order(
+                old_order, np.asarray(model.popularity, np.float32),
+                changed)
+            _M_EMIT.inc(1, component="pop_order",
+                        path="patched" if len(changed) else "carried")
+        # -- host_inverted CSR: carry / weight-regather / row-patch ------
         inv_prev = prev.__dict__.get("_host_inv") or {}
         for name, old in inv_prev.items():
-            if name not in model.indicator_idx or remap["types"].get(name):
+            if name not in model.indicator_idx:
                 continue
+            if remap["types"].get(name) \
+                    and not remap["type_identity"].get(name):
+                self._inv_cache.pop(name, None)
+                continue   # column ids shifted: rebuild from scratch
             new_idx = model.indicator_idx[name]
             old_idx = prev.indicator_idx.get(name)
-            if old_idx is None or old_idx.shape != new_idx.shape:
+            if old_idx is None or old_idx.ndim != 2 or new_idx.ndim != 2 \
+                    or old_idx.shape[1] != new_idx.shape[1] \
+                    or old_idx.shape[0] > new_idx.shape[0]:
+                self._inv_cache.pop(name, None)
                 continue
             new_llr = model.indicator_llr[name]
-            diff = ((new_idx != old_idx)
-                    | (new_llr != prev.indicator_llr[name])).any(axis=1)
-            changed = np.flatnonzero(diff).astype(np.int64)
             i_p = new_idx.shape[0]
             n_t = max(len(model.event_item_dicts[name]), 1)
-            if len(changed) == 0:
-                patched = old
-            elif len(changed) * 4 <= i_p:
-                patched = _patch_inverted_csr(old, changed, new_idx,
-                                              new_llr, n_t, i_p)
+            hint = snap.hints.get(name)
+            if hint is not None and hint["idx_rows"] is not None:
+                changed = np.asarray(hint["idx_rows"], np.int64)
+                llr_changed = bool(hint["llr_changed"])
             else:
-                continue   # too many rows moved: lazy rebuild is cheaper
-            model.__dict__.setdefault("_host_inv", {})[name] = patched
+                # no hint (restored state / non-default kernels): full
+                # structural diff, row-extended for catalog growth
+                rows_eq = min(old_idx.shape[0], i_p)
+                diff = (new_idx[:rows_eq] != old_idx[:rows_eq]).any(axis=1)
+                changed = np.flatnonzero(diff).astype(np.int64)
+                llr_changed = True
+            if old_idx.shape[0] < i_p:
+                changed = np.union1d(
+                    changed,
+                    np.arange(old_idx.shape[0], i_p, dtype=np.int64))
+            if len(changed) * 2 > i_p:
+                self._inv_cache.pop(name, None)
+                continue   # most rows moved: a from-scratch inversion
+                # (in the warm, off the fold loop) is the better deal
+            cache = self._inv_cache.get(name)
+            if cache is not None and cache["for_idx"] is old_idx:
+                perm = cache["perm"]
+            else:
+                perm = _inverted_perm(old_idx)
+            if len(changed) == 0:
+                if not llr_changed:
+                    model.__dict__.setdefault("_host_inv", {})[name] = old
+                    self._inv_cache[name] = {"for_idx": new_idx,
+                                             "perm": perm}
+                    _M_EMIT.inc(1, component="inverted", path="carried")
+                    continue
+                indptr, rows = old[0], old[1]
+                if len(indptr) < n_t + 1:
+                    indptr = np.concatenate([indptr, np.full(
+                        n_t + 1 - len(indptr), indptr[-1], np.int64)])
+            else:
+                indptr, rows, perm = _patch_inverted_csr(
+                    old[0], old[1], perm, changed, old_idx, new_idx,
+                    n_t, i_p)
+            w = new_llr.ravel()[perm].astype(np.float32, copy=False)
+            model.__dict__.setdefault("_host_inv", {})[name] = \
+                (indptr, rows, w)
+            self._inv_cache[name] = {"for_idx": new_idx, "perm": perm}
+            _M_EMIT.inc(1, component="inverted", path="patched")
 
     # -- checkpointing --------------------------------------------------------
     #
@@ -1074,6 +1702,22 @@ class URFoldState:
                     batch, ds_params.item_entity_type).items()}
             state._props_ever = True
         state.generation = int(meta.get("generation", 0))
+        if state._pop_incremental:
+            # the running popularity counts are derived state — rebuild
+            # from the restored raw lists so post-restore folds keep the
+            # incremental path (counts-then-astype equals the full
+            # recompute exactly)
+            p_st2 = state.types[state.primary]
+            items = (np.concatenate(p_st2.raw_items) if p_st2.raw_items
+                     else np.zeros(0, np.int32))
+            times = (np.concatenate(p_st2.raw_times) if p_st2.raw_times
+                     else np.zeros(0, np.float64))
+            state._pop = [
+                np.bincount(items, minlength=max(p_st2.n_items, 1))
+                .astype(np.int64),
+                float(times.min()) if len(times) else np.inf,
+                float(times.max()) if len(times) else -np.inf,
+            ]
         state.model = None
         state.model = state._emit()
         return state

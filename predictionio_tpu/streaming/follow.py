@@ -55,8 +55,10 @@ _M_FOLDS = _REG.counter(
     "(no new events), disabled (PIO_FOLLOW=off), error")
 _M_FOLD_S = _REG.histogram(
     "pio_follow_fold_duration_seconds",
-    "Wall time of one follow tick that published a generation "
-    "(tail scan + fold/retrain + publish), by mode",
+    "Wall time of one follow tick that published a generation, by "
+    "mode: tail scan + fold/retrain + publish when synchronous; with "
+    "the pipelined publisher, tail scan + fold only (emit/warm/publish "
+    "run off-loop — see pio_follow_fold_phase_duration_seconds)",
     buckets=LATENCY_BUCKETS)
 _M_LAG = _REG.gauge(
     "pio_follow_lag_events",
@@ -80,6 +82,26 @@ _M_STATE_MODE = _REG.gauge(
     "pio_follow_state_mode",
     "Fold-state representation in use: 1 on the active mode label "
     "(sparse | dense | retrain), 0 on the others")
+_M_PHASE_S = _REG.histogram(
+    "pio_follow_fold_phase_duration_seconds",
+    "Wall time of one fold tick's phases: apply (delta application + "
+    "marginals), rellr (LLR + top-k recompute incl. the pruned "
+    "certificate), emit (URModel construction + incremental serving-"
+    "state carry), warm (embedded serving-bundle build + warm + swap), "
+    "publish (durable instance/model persistence + watermark).  With "
+    "the pipelined publisher, emit/warm/publish overlap the NEXT "
+    "tick's apply/rellr",
+    buckets=LATENCY_BUCKETS)
+
+
+def follow_pipeline_enabled() -> bool:
+    """``PIO_FOLLOW_PIPELINE=off`` serializes fold+emit+warm+publish on
+    the loop thread (the PR-8..11 behavior).  Default on: ``run_forever``
+    hands emit+publish to a dedicated publisher thread so the follower
+    folds the next delta while the previous generation warms — direct
+    ``tick()`` calls (tests, scripts) stay synchronous either way."""
+    return os.environ.get("PIO_FOLLOW_PIPELINE", "").lower() not in (
+        "off", "0", "false")
 
 
 def follow_interval_s() -> float:
@@ -189,6 +211,20 @@ class FollowTrainer:
         self._ckpt_cost_s = 0.0
         self._state_bytes = 0
         self._state_mode = "retrain"
+        # pipelined publisher (run_forever only; direct tick() stays
+        # synchronous): one worker thread emits+publishes generations in
+        # order, bounded at one queued job (backpressure on the fold
+        # loop), so fold(t+1) overlaps emit+warm+publish(t)
+        self._pub_queue = None
+        self._pub_thread: Optional[threading.Thread] = None
+        self._pub_lock = threading.Lock()
+        self._pub_done = threading.Condition(self._pub_lock)
+        self._pub_inflight = 0
+        self._pub_failed = False
+        # events covered by the last PUBLISHED generation — the drain
+        # signal (status().coveredEvents): with the pipeline, the
+        # resident state runs ahead of what serving has installed
+        self._published_events: Optional[int] = None
         self._resolve_mode()
         self._state_path = follow_state_path(
             self.storage, engine_id, engine_variant) if persist else None
@@ -253,7 +289,15 @@ class FollowTrainer:
 
     # -- watermark persistence ------------------------------------------------
 
-    def _persist_state(self) -> None:
+    def _persist_state(self, wm: Optional[Dict] = None,
+                       heads: Optional[Dict] = None,
+                       fold_events: Optional[int] = None) -> None:
+        """Persist the follow watermark.  The pipelined publisher passes
+        the positions of the generation it just published — the loop
+        thread's ``self._wm`` may already describe a NEWER fold (safe
+        either way: a watermark is covered-prefix-reconstructable — a
+        restart rebuilds and re-publishes the state at the watermark —
+        but per-generation positions keep the persisted record exact)."""
         if self._state_path is None:
             return
         from predictionio_tpu.storage.snapshot import _fsync_write
@@ -261,12 +305,13 @@ class FollowTrainer:
         self._state_path.parent.mkdir(parents=True, exist_ok=True)
         _fsync_write(self._state_path, json.dumps({
             "version": 1,
-            "watermark": self._wm,
-            "heads": self._heads,
+            "watermark": self._wm if wm is None else wm,
+            "heads": self._heads if heads is None else heads,
             "generation": self.generation,
             "instanceId": self.instance_id,
             "bootstrapEvents": self.bootstrap_events,
-            "lastFoldEvents": self.last_fold_events,
+            "lastFoldEvents": (self.last_fold_events
+                               if fold_events is None else fold_events),
             "updatedAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
         }, indent=1, sort_keys=True))
 
@@ -280,6 +325,125 @@ class FollowTrainer:
         if not isinstance(doc, dict) or "watermark" not in doc:
             return None
         return doc
+
+    # -- pipelined publisher --------------------------------------------------
+    #
+    # run_forever (and only it) hands each folded generation to ONE
+    # worker thread that emits the model and publishes it; the fold loop
+    # immediately scans/folds the next delta, so fold(t+1) overlaps
+    # emit+warm+publish(t).  Ordering and safety:
+    # - jobs publish strictly in fold order (one worker, FIFO, bounded
+    #   at one queued job — the queue.put is the loop's backpressure);
+    # - the watermark/instance persisted with each generation are the
+    #   positions captured AT ITS FOLD (passed in the job), so a crash
+    #   between publishes restarts from a published-or-reconstructable
+    #   point exactly as before;
+    # - emit reads the fold state through an _EmitSnapshot (COW-marked
+    #   shared arrays), so the loop's next _apply never mutates what an
+    #   in-flight emit is reading;
+    # - any transition that rebuilds state out of band (restage, retrain
+    #   fallback, stop) flushes the queue first.
+
+    def _start_publisher(self) -> None:
+        import queue
+
+        if self._pub_queue is not None:
+            return
+        self._pub_queue = queue.Queue(maxsize=1)
+        t = threading.Thread(target=self._publisher_loop, daemon=True,
+                             name="pio-follow-publish")
+        self._pub_thread = t
+        t.start()
+
+    def _publisher_loop(self) -> None:
+        import queue
+
+        while True:
+            try:
+                job = self._pub_queue.get(timeout=0.25)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if job is None:
+                return
+            try:
+                # an abandoned generation breaks the emit chain: the
+                # NEXT snapshot's incremental hints only describe its
+                # own fold, so emitting it against the two-generations-
+                # old self.model would patch serving state with the
+                # abandoned fold's changes missing.  Skip everything
+                # until the loop thread restages (which rebuilds the
+                # state and clears the flag).
+                if not self._pub_failed:
+                    self._process_publish_job(job)
+            finally:
+                with self._pub_lock:
+                    self._pub_inflight -= 1
+                    self._pub_done.notify_all()
+
+    def _process_publish_job(self, job: dict) -> None:
+        attempts = 0
+        while not self._stop.is_set():
+            try:
+                models = job.get("models")
+                if models is None:
+                    t0 = time.perf_counter()
+                    # the job pins its state object: a concurrent loop-
+                    # thread restage nulling self._fold must not strand
+                    # an in-flight emit
+                    models = [job["state"].emit_snapshot(job["snap"])]
+                    _M_PHASE_S.observe(time.perf_counter() - t0,
+                                       phase="emit")
+                    job["models"] = models  # publish retries skip re-emit
+                self._publish(models, job["mode"], job["duration_s"],
+                              trace=job.get("trace"), wm=job.get("wm"),
+                              heads=job.get("heads"),
+                              fold_events=job.get("events"))
+                self._published_events = job.get("covered")
+                return
+            except Exception:
+                attempts += 1
+                log.exception("pipelined publish failed (attempt %d/3)",
+                              attempts)
+                if attempts >= 3:
+                    # deterministic emit/publish failure: flag the loop
+                    # thread to drop the fold state and restage (the
+                    # same recovery a synchronous failure takes)
+                    self._pub_failed = True
+                    return
+                self._stop.wait(min(self.interval * attempts, 10.0))
+
+    def _enqueue_publish(self, job: dict) -> None:
+        import queue
+
+        with self._pub_lock:
+            self._pub_inflight += 1
+        while True:
+            try:
+                self._pub_queue.put(job, timeout=0.25)
+                return
+            except queue.Full:
+                if self._stop.is_set():
+                    with self._pub_lock:
+                        self._pub_inflight -= 1
+                        self._pub_done.notify_all()
+                    return
+
+    def _flush_publishes(self, timeout: float = 600.0) -> bool:
+        """Block until every enqueued generation has published — called
+        before any out-of-band rebuild/republish (restage, retrain
+        fallback, stop) so publications stay strictly ordered."""
+        if self._pub_queue is None:
+            return True
+        deadline = time.monotonic() + timeout
+        with self._pub_lock:
+            while self._pub_inflight > 0:
+                rest = deadline - time.monotonic()
+                if rest <= 0:
+                    return False
+                self._pub_done.wait(min(rest, 1.0))
+        return True
 
     # -- fold-state checkpoint ------------------------------------------------
     #
@@ -475,6 +639,7 @@ class FollowTrainer:
         # embedded host still needs its in-process copy swapped in
         if self.on_publish is not None:
             self.on_publish([state.model], self._publish_info("restart"))
+        self._published_events = len(state.batch)
         self._update_state_metrics()
         # fold whatever arrived past the checkpoint watermark right now
         # (tick also re-runs the tombstone / log-shape / max-lag edges
@@ -516,6 +681,7 @@ class FollowTrainer:
         # embedded host still needs its in-process copy swapped in
         if self.on_publish is not None:
             self.on_publish([self._fold.model], self._publish_info("restart"))
+        self._published_events = len(self._fold.batch)
         # fold whatever arrived past the watermark right now
         self.tick()
         return True
@@ -523,6 +689,14 @@ class FollowTrainer:
     def _restage(self, publish: bool) -> bool:
         """Full rebuild: read the whole log (snapshot-first) and
         re-bootstrap the fold state."""
+        if not self._flush_publishes():
+            # a publish is wedged past the flush timeout: restaging now
+            # would race it — the stuck job could later install its
+            # older generation OVER the restaged one and persist an
+            # older watermark.  Bail; the next tick retries.
+            log.warning("restage deferred: a pipelined publish has not "
+                        "drained")
+            return False
         app_id, chan = self._app_channel()
         tombs = self._backend.tombstone_state(app_id, chan)
         res = self._backend.snapshot_scan(app_id, chan)
@@ -558,6 +732,7 @@ class FollowTrainer:
         if publish:
             self._publish_guarded([self._fold.model], "restage",
                                   time.perf_counter() - t0)
+            self._published_events = len(self._fold.batch)
         return True
 
     # -- the tick -------------------------------------------------------------
@@ -600,11 +775,28 @@ class FollowTrainer:
             models, pmode, dur = self._pending
             self._publish(models, pmode, dur)
             self._pending = None
+            if self.mode == "fold" and self._fold is not None:
+                self._published_events = len(self._fold.batch)
             return pmode
+        if self._pub_failed:
+            # the publisher gave up on a generation: same recovery as a
+            # synchronous emit/publish failure — drop the state, restage.
+            # Flush BEFORE clearing the flag: queued stale jobs must
+            # drain as skips (their emit chain is broken), not process.
+            self._flush_publishes()
+            self._pub_failed = False
+            log.warning("pipelined publish abandoned a generation — "
+                        "dropping fold state and restaging")
+            self._fold = None
         if self.mode != "fold":
             return self._retrain_tick()
         if self._fold is None:
             return "restage" if self._restage(publish=True) else "idle"
+        if self._pub_queue is not None:
+            # quiescent point for the loop thread: only it mutates the
+            # fold state, so checkpointing here (instead of inside the
+            # publisher's _publish) can never race the next _apply
+            self._maybe_checkpoint()
         app_id, chan = self._app_channel()
         t0 = time.perf_counter()
         tombs = self._backend.tombstone_state(app_id, chan)
@@ -636,10 +828,14 @@ class FollowTrainer:
                      tail["events"], max_lag)
             self._fold = None
             return "restage" if self._restage(publish=True) else "idle"
+        pipelined = self._pub_queue is not None
         with trace.activate():
             with trace.span("follow_fold", events=tail["events"]):
                 try:
-                    model = self._fold.fold(tail["batch"])
+                    if pipelined:
+                        snap = self._fold.fold_apply(tail["batch"])
+                    else:
+                        model = self._fold.fold(tail["batch"])
                 except FoldUnsupported as e:
                     log.warning("fold unsupported mid-stream (%s); "
                                 "restaging in retrain mode", e)
@@ -654,16 +850,40 @@ class FollowTrainer:
                     # Drop it; the next cycle restages from the log.
                     self._fold = None
                     raise
+        for phase, dur in (self._fold.last_phase_s or {}).items():
+            _M_PHASE_S.observe(dur, phase=phase)
+        covered = len(self._fold.batch)
         self._wm, self._heads = tail["watermark"], tail["heads"]
         self.last_fold_events = int(tail["events"])
-        self._publish_guarded([model], "fold", time.perf_counter() - t0,
-                              trace=trace)
+        if pipelined:
+            self._enqueue_publish({
+                "snap": snap, "state": self._fold, "mode": "fold",
+                # duration measured HERE (tail scan + fold), not in the
+                # publisher: queue wait behind the previous generation's
+                # warm and publish-retry backoff are not fold cost, and
+                # would inflate the histogram operators alert on (the
+                # phase histogram carries emit/warm/publish)
+                "duration_s": time.perf_counter() - t0,
+                "covered": covered, "wm": dict(self._wm),
+                "heads": dict(self._heads),
+                "events": int(tail["events"]), "trace": trace,
+            })
+        else:
+            _M_PHASE_S.observe(
+                getattr(self._fold, "last_emit_s", 0.0), phase="emit")
+            self._publish_guarded([model], "fold",
+                                  time.perf_counter() - t0, trace=trace)
+            self._published_events = covered
         _M_LAG.set(0)
         return "fold"
 
     def _retrain_tick(self, force: bool = False) -> str:
         """Fallback path: full Engine.train per tick (delta-staged by
         PR 3's cache), published exactly like a fold."""
+        if not self._flush_publishes():
+            log.warning("retrain deferred: a pipelined publish has not "
+                        "drained")
+            return "idle"
         t0 = time.perf_counter()
         changed, commit = self._probe_store()
         if not force and not changed:
@@ -733,11 +953,15 @@ class FollowTrainer:
         self._pending = None
 
     def _publish(self, models, mode: str, duration_s: float,
-                 trace: Optional[_tracing.Trace] = None) -> None:
+                 trace: Optional[_tracing.Trace] = None,
+                 wm: Optional[Dict] = None, heads: Optional[Dict] = None,
+                 fold_events: Optional[int] = None) -> None:
         """Atomic model publication: durable instance record (daemon) +
         in-process hot-swap (embedded), then watermark persistence —
         the watermark only advances AFTER the generation it describes is
-        published, so a crash between the two re-folds, never skips."""
+        published, so a crash between the two re-folds, never skips.
+        The pipelined publisher passes the generation's own ``wm``/
+        ``heads``/``fold_events`` (the loop thread may already be ahead)."""
         from predictionio_tpu.controller.engine import (
             serialize_engine_params,
         )
@@ -747,6 +971,8 @@ class FollowTrainer:
         if trace is None:
             trace = _tracing.Trace(f"fold-{uuid.uuid4().hex[:12]}")
         self.generation += 1
+        t_pub0 = time.perf_counter()
+        t_warm = 0.0
         try:
             with trace.activate(), trace.span(
                     "model_swap", mode=mode, generation=self.generation,
@@ -786,7 +1012,9 @@ class FollowTrainer:
                             raise
                     self.instance_id = iid
                 if self.on_publish is not None:
+                    tw = time.perf_counter()
                     self.on_publish(models, self._publish_info(mode))
+                    t_warm = time.perf_counter() - tw
         except BaseException:
             # the retry re-runs _publish in full: un-count this attempt
             # so generations advance by exactly one per published swap
@@ -801,8 +1029,17 @@ class FollowTrainer:
             _M_GEN.set(self.generation)
         _M_PUBLISH_TS.set(self.last_publish_at)
         _M_FOLD_S.observe(duration_s, mode=mode)
-        self._persist_state()
-        self._maybe_checkpoint()
+        _M_PHASE_S.observe(t_warm, phase="warm")
+        _M_PHASE_S.observe(
+            max(time.perf_counter() - t_pub0 - t_warm, 0.0),
+            phase="publish")
+        self._persist_state(wm=wm, heads=heads, fold_events=fold_events)
+        if self._pub_queue is None:
+            # synchronous mode only: with the pipeline, the checkpoint
+            # runs on the LOOP thread at its next quiescent point — from
+            # here (the publisher thread) it would race the next _apply's
+            # in-place mutations
+            self._maybe_checkpoint()
         rec = _tracing.get_recorder()
         if rec.enabled:
             rec.record(trace.to_doc(rec.tag, "model_swap"))
@@ -814,13 +1051,21 @@ class FollowTrainer:
 
     def run_forever(self) -> None:
         """Blocking daemon loop with exponential error backoff and crash
-        restart from the persisted watermark."""
+        restart from the persisted watermark.  With the pipeline enabled
+        (default; PIO_FOLLOW_PIPELINE=off reverts), each folded
+        generation's emit+warm+publish runs on the publisher thread so
+        the loop scans and folds the next delta concurrently."""
         while not self._stop.is_set():
             try:
                 if (self.mode == "fold" and self._fold is None
                         and self.generation == 0):
                     self.bootstrap()   # publishes + ticks when it lands
+                    if follow_pipeline_enabled():
+                        self._start_publisher()
                 else:
+                    if (self._pub_queue is None
+                            and follow_pipeline_enabled()):
+                        self._start_publisher()
                     self.tick()
                 self._backoff = 0.0
             except Exception:
@@ -842,12 +1087,27 @@ class FollowTrainer:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+        if self._pub_thread is not None:
+            try:
+                self._pub_queue.put_nowait(None)
+            except Exception:
+                pass   # full queue: the loop's 0.25 s poll sees _stop
+            self._pub_thread.join(timeout=timeout)
 
     def status(self) -> dict:
         """The /stats.json freshness payload."""
         # snapshot once: a concurrent tick can demote (self._fold = None)
         # between a check and a dereference on the HTTP thread
         fold = self._fold
+        covered = None
+        if fold is not None:
+            # with the pipelined publisher the resident state runs ahead
+            # of serving — report what the last PUBLISHED generation
+            # covers, so drains stay deterministic
+            covered = (self._published_events
+                       if self._pub_queue is not None
+                       and self._published_events is not None
+                       else len(fold.batch))
         return {
             "mode": self.mode,
             "generation": self.generation,
@@ -855,12 +1115,11 @@ class FollowTrainer:
             "lastFoldEvents": self.last_fold_events,
             "stateBytes": self._state_bytes,
             "stateMode": self._state_mode,
-            # total events the resident fold state covers — the
+            # total events the live (published) model covers — the
             # deterministic drain signal for scripts/benches (an
             # "idle" outcome alone can be a tick that ran BEFORE an
             # append became visible); None in retrain mode
-            "coveredEvents": (len(fold.batch)
-                              if fold is not None else None),
+            "coveredEvents": covered,
             "lastPublishAt": (
                 _dt.datetime.fromtimestamp(
                     self.last_publish_at,

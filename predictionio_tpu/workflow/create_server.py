@@ -295,7 +295,14 @@ class QueryServerState:
         self.follow_info: Optional[Dict] = None
         self._build_seq = 0           # install-order tickets (see _install)
         self._installed_seq = 0
+        self._tune_gil_switch()
         self.reload()
+        # serving reads user history from the live store per query; the
+        # per-entity index otherwise builds on the FIRST query — at a
+        # million-event log that is seconds of JSON parsing inline in a
+        # query (and contending with a follow bootstrap).  Build it on a
+        # background thread now instead.
+        self._warm_entity_index_async()
         # plugins start only once the state is fully initialized (they get
         # a live QueryServerState with engine/storage/predictor populated)
         for p in plugins or []:
@@ -311,6 +318,47 @@ class QueryServerState:
                 target=self._auto_reload_loop, args=(float(auto_reload),),
                 daemon=True, name="pio-auto-reload")
             t.start()
+
+    @staticmethod
+    def _tune_gil_switch() -> None:
+        """Shorten the interpreter's GIL switch interval (default 5 ms)
+        inside query-server processes: a background fold/emit tick is
+        Python-heavy at small shapes and can hold the GIL a full switch
+        interval at a time, adding multi-ms stalls to colliding queries'
+        p95.  1 ms caps that stall at ~1 ms per handoff for negligible
+        switching overhead.  PIO_GIL_SWITCH_S overrides; <= 0 leaves the
+        interpreter default."""
+        import sys as _sys
+
+        try:
+            s = float(os.environ.get("PIO_GIL_SWITCH_S", "0.001"))
+            if s > 0:
+                _sys.setswitchinterval(s)
+        except (ValueError, OSError):
+            pass
+
+    def _warm_entity_index_async(self) -> None:
+        """Off-thread pre-build of the event store's per-entity serving
+        index (localfs/sharded; other backends simply lack the hook).
+        Failure is benign — the lazy build on first lookup remains."""
+        app_name = getattr(
+            getattr(self.engine_params, "data_source_params", None),
+            "app_name", None)
+        warm = getattr(self.storage.l_events, "warm_entity_index", None)
+        if not app_name or warm is None:
+            return
+
+        def run() -> None:
+            try:
+                app = self.storage.apps.get_by_name(app_name)
+                if app is not None:
+                    warm(app.id)
+            except Exception:
+                log.exception("entity-index warm failed (the lazy build "
+                              "on first query remains)")
+
+        threading.Thread(target=run, daemon=True,
+                         name="pio-entity-index-warm").start()
 
     def _auto_reload_loop(self, interval: float) -> None:
         while not self._auto_stop.wait(interval):

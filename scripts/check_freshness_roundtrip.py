@@ -290,6 +290,54 @@ def main() -> int:
                         f"round {rnd}: probe {body} diverges from "
                         f"from-scratch retrain:\n  got:  {got}\n"
                         f"  want: {want}")
+        if LARGE and not problems:
+            # pruned re-LLR + incremental emit engagement (ISSUE 13): a
+            # brand-new user buying an EXISTING item bumps N — Dunning
+            # G² couples every cell to N, so this is exactly the full
+            # re-LLR the selection-stability certificate prunes — then
+            # the counters must show certified rows and carried/patched
+            # serving-state emits, with parity still exact below
+            from predictionio_tpu.obs.metrics import get_registry
+
+            reg = get_registry()
+            cert0 = reg.counter("pio_follow_rellr_rows_total",
+                                "x").value(outcome="certified")
+            storage.l_events.insert_batch(
+                [buy("nbump_user", "i1")], app_id)
+            n_events += 1
+            if not drain():
+                problems.append("large-catalog: N-bump round never "
+                                "drained")
+            cert = reg.counter("pio_follow_rellr_rows_total",
+                               "x").value(outcome="certified")
+            if not cert > cert0:
+                problems.append(
+                    "large-catalog: the pruned re-LLR certified no rows "
+                    f"on an N-bump fold (certified {cert0} -> {cert}) — "
+                    "certification is not engaging")
+            emit_inc = 0.0
+            for comp in ("inverted", "pop_order", "popularity",
+                         "user_seen", "seen_by_event", "props"):
+                for path in ("carried", "patched"):
+                    emit_inc += reg.counter(
+                        "pio_follow_emit_total",
+                        "x").value(component=comp, path=path)
+            if not emit_inc > 0:
+                problems.append(
+                    "large-catalog: no incremental serving-state emit "
+                    "engaged (pio_follow_emit_total carried/patched all "
+                    "zero)")
+            invalidate_staging_cache()
+            ref = engine.train(ep)
+            for body in [{"user": "u1", "num": 6},
+                         {"user": "nbump_user", "num": 5}]:
+                st, doc = http_json("POST", "/queries.json", body)
+                want = [(s.item, float(s.score)) for s in algo.predict(
+                    ref[0], URQuery.from_json(body)).item_scores]
+                if st != 200 or canon(doc) != want:
+                    problems.append(
+                        f"large-catalog: post-N-bump probe {body} "
+                        "diverges from the from-scratch retrain")
         conn.close()
         if STORAGE_TYPE == "sharded" and SHARDS > 1:
             # the roundtrip must have exercised the PARALLEL cross-shard

@@ -50,6 +50,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.workflow.create_server",
     "predictionio_tpu.models.universal_recommender.engine",
     "predictionio_tpu.streaming.follow",
+    "predictionio_tpu.streaming.fold",
 ]
 
 
@@ -80,6 +81,12 @@ REQUIRED_METRICS = frozenset({
     # state footprint and the sparse|dense|retrain mode flag
     "pio_follow_state_bytes",
     "pio_follow_state_mode",
+    # fold-tick phases + pruned re-LLR (PR 13): the freshness sweep's
+    # per-phase columns and the roundtrip's pruning/incremental-emit
+    # engagement assertions key on these
+    "pio_follow_fold_phase_duration_seconds",
+    "pio_follow_rellr_rows_total",
+    "pio_follow_emit_total",
     # sharded/replicated store contract (PR 9): the failover drill and
     # replica-lag alerting key on these
     "pio_store_shard_events_total",

@@ -135,6 +135,222 @@ def test_sparse_equals_dense_randomized(fs_storage, host_serving,
         assert got == want, q
 
 
+def _cert_counters():
+    from predictionio_tpu.obs.metrics import get_registry
+
+    c = get_registry().counter("pio_follow_rellr_rows_total", "x")
+    return c.value(outcome="certified"), c.value(outcome="selected")
+
+
+@pytest.mark.parametrize("dense_rellr", ["0", "default"])
+def test_pruned_rellr_equals_full_property(fs_storage, host_serving,
+                                           monkeypatch, dense_rellr):
+    """ISSUE-13 pruning exactness: across randomized delta sequences —
+    new-user N bumps, new items (pure end growth), $set props,
+    duplicate-only deltas, and a tombstone restage — the PRUNED full
+    re-LLR emits models bit-identical (idx, scores, tie order) to the
+    kill-switch (PIO_FOLLOW_RELLR_PRUNE=off) oracle, to the dense-state
+    oracle, and finally to a from-scratch train.  The catalog is sized
+    past PIO_FOLLOW_DENSE_RELLR_BYTES so the sparse tail (the pruned
+    path) runs at DEFAULT routing too, and the counter proves
+    certification actually engaged in both parametrizations."""
+    if dense_rellr != "default":
+        monkeypatch.setenv("PIO_FOLLOW_DENSE_RELLR_BYTES", dense_rellr)
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage,
+                                       event_names=("purchase",))
+    rng = np.random.default_rng(29)
+    # ~1300 items: dense f32 re-LLR matrix ≈ 6.8 MB > the 4 MiB default
+    # routing budget → the sparse (prunable) tail runs either way
+    evs = [_buy(f"u{k % 120}", f"i{k}") for k in range(1300)]
+    evs += [_buy(f"u{u}", f"i{it}") for u in range(10) for it in range(8)
+            if (u + it) % 3]
+    fs_storage.l_events.insert_batch(evs, app_id)
+    dead_id = fs_storage.l_events.insert(_buy("deadguy", "i3"), app_id)
+    tail = _tail(fs_storage, app_id, {}, None, None)
+
+    def bootstrap_pair(batch):
+        monkeypatch.setenv("PIO_FOLLOW_RELLR_PRUNE", "off")
+        full = URFoldState.bootstrap(ap, ep.data_source_params, batch)
+        monkeypatch.delenv("PIO_FOLLOW_RELLR_PRUNE")
+        pruned = URFoldState.bootstrap(ap, ep.data_source_params, batch)
+        monkeypatch.setenv("PIO_FOLLOW_STATE", "dense")
+        dense = URFoldState.bootstrap(ap, ep.data_source_params, batch)
+        monkeypatch.delenv("PIO_FOLLOW_STATE")
+        return pruned, full, dense
+
+    pruned, full, dense = bootstrap_pair(tail["batch"])
+    _assert_models_equal(pruned.model, full.model, "bootstrap")
+    _assert_models_equal(pruned.model, dense.model, "bootstrap-dense")
+    cert0, _sel0 = _cert_counters()
+    wm, heads = tail["watermark"], tail["heads"]
+    for rnd in range(6):
+        evs = []
+        if rnd == 0:
+            evs = [_buy("fresh_user_a", "i7")]           # pure N bump
+        elif rnd == 1:
+            evs = [_buy("fresh_user_b", f"brand_new_{rnd}"),
+                   _buy("fresh_user_b", "i7")]           # catalog growth
+        elif rnd == 2:
+            evs = [_buy(f"u{int(u)}", f"i{int(it)}")     # duplicates only
+                   for u in rng.integers(0, 10, 4)
+                   for it in rng.integers(0, 8, 2) if (u + it) % 3]
+            evs = evs or [_buy("u1", "i1")]
+        elif rnd == 3:
+            evs = [_set_item("i2", {"tier": "gold"})]    # $set props
+        elif rnd == 4:
+            evs = [_buy(f"nb{j}", f"i{(j * 37) % 1300}")  # many N bumps
+                   for j in range(6)]
+        elif rnd == 5:
+            # tombstone restage: the additive state cannot subtract, so
+            # both representations rebootstrap from the live log
+            assert fs_storage.l_events.delete(dead_id, app_id)
+            fs_storage.l_events.build_snapshot(app_id)
+            tail = _tail(fs_storage, app_id, {}, None, None)
+            pruned, full, dense = bootstrap_pair(tail["batch"])
+            wm, heads = tail["watermark"], tail["heads"]
+            _assert_models_equal(pruned.model, full.model, "restage")
+            continue
+        fs_storage.l_events.insert_batch(evs, app_id)
+        t = _tail(fs_storage, app_id, wm, pruned.batch, heads)
+        assert t is not None and t["events"] > 0
+        mp = pruned.fold(t["batch"])
+        monkeypatch.setenv("PIO_FOLLOW_RELLR_PRUNE", "off")
+        mf = full.fold(t["batch"])
+        monkeypatch.delenv("PIO_FOLLOW_RELLR_PRUNE")
+        md = dense.fold(t["batch"])
+        wm, heads = t["watermark"], t["heads"]
+        _assert_models_equal(mp, mf, f"round {rnd} pruned-vs-full")
+        _assert_models_equal(mp, md, f"round {rnd} pruned-vs-dense")
+        assert pruned.last_fold_stats == full.last_fold_stats, rnd
+    cert1, _sel1 = _cert_counters()
+    assert cert1 > cert0, "pruning certificate never engaged"
+    # the certificate must be doing real work, not certifying nothing:
+    # the pure-N-bump rounds certify (nearly) the whole catalog
+    assert cert1 - cert0 > 1000
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+
+    invalidate_staging_cache()
+    ref = engine.train(ep)[0]
+    _assert_models_equal(pruned.model, ref, "vs train")
+
+
+def test_select_topk_chunked_matches_inline(monkeypatch):
+    """The worker-pool chunked re-selection is bit-identical to one
+    global pass, across chunk boundaries and row skew."""
+    import predictionio_tpu.streaming.fold as fold_mod
+    from predictionio_tpu.ops.cco import _select_topk_cells
+
+    rng = np.random.default_rng(5)
+    n_rows, width = 257, 4
+    rows = np.sort(rng.integers(0, n_rows, 20_000)).astype(np.int64)
+    cols = rng.integers(0, 900, 20_000).astype(np.int64)
+    scores = rng.choice(
+        np.asarray([0.5, 1.25, 3.0, 7.5], np.float32), 20_000)
+    monkeypatch.setattr(fold_mod, "_RELLR_CHUNK_MIN_CELLS", 1)
+    monkeypatch.setenv("PIO_FOLLOW_RELLR_WORKERS", "3")
+    s_c, i_c = fold_mod._select_topk_chunked(rows, cols, scores,
+                                             n_rows, width)
+    s_i, i_i = _select_topk_cells(rows, cols, scores, n_rows, width)
+    assert np.array_equal(s_c, s_i)
+    assert np.array_equal(i_c, i_i)
+
+
+def test_from_sorted_pairs_matches_from_pairs():
+    """CSRLookup.from_sorted_pairs on presorted deduped pairs is
+    array-identical to from_pairs."""
+    from predictionio_tpu.store.columnar import CSRLookup
+
+    rng = np.random.default_rng(9)
+    flat = np.unique(rng.integers(0, 40, 500) * 97
+                     + rng.integers(0, 97, 500))
+    rows, vals = flat // 97, flat % 97
+    a = CSRLookup.from_pairs(rows, vals, 40)
+    b = CSRLookup.from_sorted_pairs(rows, vals, 40)
+    assert np.array_equal(a.indptr, b.indptr)
+    assert np.array_equal(a.values, b.values)
+
+
+def test_merge_pop_order_matches_full_sort():
+    """_merge_pop_order ≡ host_topk_desc's full order: random updates
+    with heavy ties, catalog growth, and superset changed sets."""
+    from predictionio_tpu.models.common import host_topk_desc
+    from predictionio_tpu.streaming.fold import _merge_pop_order
+
+    rng = np.random.default_rng(3)
+    pop = rng.choice(np.asarray([0, 1, 2, 5, 5, 9], np.float32), 300)
+    order = host_topk_desc(pop, len(pop))[1]
+    for step in range(8):
+        grow = rng.integers(0, 12)
+        new_pop = np.concatenate(
+            [pop, rng.integers(0, 6, grow).astype(np.float32)])
+        changed = np.unique(rng.integers(0, len(pop), 25)).astype(np.int64)
+        new_pop[changed] += rng.integers(0, 3, len(changed))
+        if step % 2:
+            # superset: ids whose value did NOT move must still land
+            # back at their exact slots
+            changed = np.union1d(
+                changed, np.unique(rng.integers(0, len(pop), 10)))
+        changed = np.union1d(
+            changed, np.arange(len(pop), len(new_pop), dtype=np.int64))
+        merged = _merge_pop_order(order, new_pop, changed)
+        want = host_topk_desc(new_pop, len(new_pop))[1]
+        assert np.array_equal(merged, want), step
+        pop, order = new_pop, merged
+
+
+def test_incremental_emit_identity(fs_storage, host_serving):
+    """The incremental emit's three carries are ARRAY-identical to the
+    from-scratch rebuilds: (1) an N-bump fold (every LLR weight moves,
+    no structure) regathers the host_inverted weights through the
+    cached inversion permutation; (2) host_pop_order merges instead of
+    re-sorting; (3) a props/user_seen-untouched fold carries the very
+    same objects."""
+    from test_streaming_follow import _follow_pair
+
+    from predictionio_tpu.models.common import host_topk_desc
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage,
+                                       event_names=("purchase",))
+    fs_storage.l_events.insert_batch(
+        [_buy(f"u{k % 40}", f"i{k}") for k in range(400)]
+        + [_buy(f"u{u}", f"i{it}") for u in range(8) for it in range(6)
+           if (u + it) % 3], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    m1 = follower._fold.model
+    m1.host_inverted("purchase")
+    m1.host_pop_order()
+    # (1)+(2): brand-new user buying an existing item — N bump, same
+    # catalog, popularity changes at exactly one id
+    fs_storage.l_events.insert_batch([_buy("nb_user", "i5")], app_id)
+    assert follower.tick() == "fold"
+    m2 = follower._fold.model
+    carried = m2.__dict__.get("_host_inv", {}).get("purchase")
+    assert carried is not None, "inverted CSR was not carried/patched"
+    fresh_model = follower._fold.model
+    fresh_model.__dict__.pop("_host_inv")
+    fresh = fresh_model.host_inverted("purchase")
+    for a, b in zip(carried, fresh):
+        assert np.array_equal(a, b)
+    merged_order = m2.__dict__.get("_host_pop_order")
+    assert merged_order is not None, "pop order was not merged"
+    want_order = host_topk_desc(
+        np.asarray(m2.popularity, np.float32), len(m2.item_dict))[1]
+    assert np.array_equal(merged_order, want_order)
+    # (3): duplicate-only fold — user_seen/props carry BY OBJECT
+    fs_storage.l_events.insert_batch([_buy("u1", "i300")], app_id)
+    assert follower.tick() == "fold"
+    m3 = follower._fold.model
+    assert m3.user_seen is not m2.user_seen  # (u1, i300) is a new pair
+    fs_storage.l_events.insert_batch(
+        [_buy("u1", "i300")], app_id)        # now a TRUE duplicate
+    assert follower.tick() == "fold"
+    m4 = follower._fold.model
+    assert m4.user_seen is m3.user_seen
+    assert m4.item_properties is m3.item_properties
+
+
 def test_sparse_counts_unit():
     """_SparseCounts merge/gather/remap against a dense reference."""
     from predictionio_tpu.streaming.fold import _SparseCounts

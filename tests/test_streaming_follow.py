@@ -563,6 +563,79 @@ def test_fold_exception_drops_state_and_restages(fs_storage, host_serving,
     assert res.item_scores, "restaged model must serve the new user"
 
 
+def test_pipelined_publish_ordering_and_drain(fs_storage, host_serving):
+    """ISSUE-13 off-thread warm: with the publisher thread running,
+    ticks enqueue emit+publish and return — generations publish strictly
+    in fold order, status().coveredEvents reports what the PUBLISHED
+    model covers (the drain contract), and the served model ends exactly
+    at the from-scratch retrain."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=61), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    n_events = len(follower._fold.batch)
+    follower._start_publisher()
+    try:
+        gens = []
+        real = follower.on_publish
+
+        def record(models, info):
+            gens.append(info["generation"])
+            return real(models, info)
+
+        follower.on_publish = record
+        for k in range(4):
+            fs_storage.l_events.insert_batch(
+                [_buy(f"pipe{k}", "i1")], app_id)
+            n_events += 1
+            assert follower.tick() == "fold"
+        assert follower._flush_publishes(timeout=30)
+        # strictly ordered, one generation per fold
+        assert gens == sorted(gens) and len(gens) == 4
+        assert follower.status()["coveredEvents"] == n_events
+        algo = URAlgorithm(ap)
+        _assert_model_equals_fresh(
+            follower._fold.model, engine, ep,
+            [URQuery(user="pipe3", num=5), URQuery(user="u1", num=5)],
+            algo)
+        # the server really swapped to the last published generation
+        res = state.predict({"user": "pipe3", "num": 6})
+        assert res.item_scores
+    finally:
+        follower.stop(timeout=10)
+
+
+def test_pipelined_publish_failure_restages(fs_storage, host_serving):
+    """A generation whose pipelined emit/publish keeps failing is
+    abandoned after bounded retries; the loop thread's next tick drops
+    the fold state and restages — the follower never wedges silently."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage, event_names=("purchase",))
+    fs_storage.l_events.insert_batch(_seed_events(seed=67), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    follower.interval = 0.01      # fast publisher retry backoff
+    follower._start_publisher()
+    try:
+        real = follower.on_publish
+        follower.on_publish = lambda models, info: (_ for _ in ()).throw(
+            OSError("permanent swap failure"))
+        fs_storage.l_events.insert_batch([_buy("px", "i1")], app_id)
+        assert follower.tick() == "fold"
+        deadline = time.time() + 30
+        while not follower._pub_failed and time.time() < deadline:
+            time.sleep(0.05)
+        assert follower._pub_failed, "publisher never gave up"
+        follower.on_publish = real
+        assert follower.tick() == "restage"
+        res = state.predict({"user": "px", "num": 6})
+        assert res.item_scores is not None
+    finally:
+        follower.stop(timeout=10)
+
+
 # -- fold-state checkpoint ---------------------------------------------------
 
 
