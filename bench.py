@@ -1953,6 +1953,389 @@ def _serve_catalog_sweep(smoke: bool) -> dict:
     return out
 
 
+def _smaps_mem(pid: int, path_substr=None):
+    """(rss_bytes, pss_bytes) summed over ``pid``'s mappings;
+    ``path_substr`` filters to mappings whose backing path contains it
+    (the model-plane arena filter).  PSS divides shared pages across
+    their mappers, so summing PSS over a prefork group counts each
+    shared arena page ONCE — the honest aggregate-resident measure;
+    summing RSS would count it per worker.  (0, 0) where /proc/smaps is
+    unavailable."""
+    rss = pss = 0
+    take = path_substr is None
+    try:
+        with open(f"/proc/{pid}/smaps") as f:
+            for line in f:
+                head = line.split(" ", 1)[0]
+                if "-" in head and not head.endswith(":"):
+                    take = path_substr is None or path_substr in line
+                elif take and line.startswith("Rss:"):
+                    rss += int(line.split()[1]) * 1024
+                elif take and line.startswith("Pss:"):
+                    pss += int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return 0, 0
+    return rss, pss
+
+
+def _pss_proportional() -> bool:
+    """True when this kernel's /proc/<pid>/smaps implements proportional
+    Pss for shared file pages (two children map+touch one 4 MB file; a
+    real kernel reports each Pss ≈ half its Rss).  Virtualized procfs
+    (gVisor-style sandboxes) reports Pss == Rss, which would read the
+    plane's genuinely shared pages as N private copies and fail the
+    memory guard for the measurement's sin — the guard skips there."""
+    import subprocess
+    import tempfile
+    import textwrap
+
+    path = os.path.join(tempfile.mkdtemp(prefix="pio_pss_probe"),
+                        "probe.bin")
+    with open(path, "wb") as f:
+        f.write(b"\xa5" * (4 * 1024 * 1024))
+    src = textwrap.dedent(f"""
+        import mmap, time
+        f = open({path!r}, "rb")
+        m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        x = 0
+        for i in range(0, len(m), 4096):
+            x += m[i]
+        time.sleep(30)
+    """)
+    procs = [subprocess.Popen([sys.executable, "-c", src])
+             for _ in range(2)]
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            rss, pss = _smaps_mem(procs[0].pid, "probe.bin")
+            if rss >= 4 * 1024 * 1024:
+                return pss <= 0.75 * rss
+            time.sleep(0.25)
+        return False
+    finally:
+        for p in procs:
+            p.kill()
+        import shutil
+
+        shutil.rmtree(os.path.dirname(path), ignore_errors=True)
+
+
+def _plane_sweep(smoke: bool) -> dict:
+    """ISSUE-14 headline proof: the shared-memory model plane under real
+    ``pio deploy --workers N`` prefork groups.
+
+    Memory cells (workers ∈ {1, 4} × PIO_MODEL_PLANE ∈ {on, off}, no
+    follower): every cell replays a fixed corpus and diffs responses
+    EXACTLY against the first cell (plane on/off bit-parity —
+    ``plane_parity``), records qps/p50/p95, per-worker RSS/PSS, and —
+    plane-on — the arena-backed PSS per worker.  The
+    ``plane_memory_guard`` asserts workers=4 aggregate arena-resident
+    bytes ≤ 1.5× the workers=1 figure (shared page cache: each worker's
+    PSS share of the one mapped arena sums to ~1× the arena, where
+    private copies would sum to ~4×).  Plane-on cells also measure
+    swap-propagation: a /reload publishes a fresh generation and the
+    cell polls until every worker pid reports it
+    (``plane_swap_propagation_s`` = publish → LAST worker installed).
+
+    Follow cell (workers=4, plane on, --follow): appending one delta
+    must fold exactly ONCE across the whole group
+    (``plane_fold_once`` from the cross-worker /metrics merge — the
+    per-worker-follower baseline folds it 4×) and converge every
+    worker (``plane_follow_propagation_s`` = append → last worker on
+    the folded generation)."""
+    import contextlib
+    import re
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from predictionio_tpu.storage.locator import set_storage
+
+    if smoke:
+        n_items, n_users, k, secs, clients = 800, 200, 8, 0.6, 4
+        worker_counts = (1, 2)
+    elif _cpu_reduced():
+        n_items, n_users, k, secs, clients = 20_000, 2_000, 50, 2.0, 8
+        worker_counts = (1, 4)
+    else:
+        # the acceptance size: 300k-item catalog
+        n_items, n_users, k, secs, clients = 300_000, 5_000, 50, 2.5, 8
+        worker_counts = (1, 4)
+    wmax = worker_counts[-1]
+    out: dict = {
+        "plane_catalog_items": n_items,
+        "plane_parity": "not_run",
+        "plane_memory_guard": "not_run",
+        "plane_fold_once": "not_run",
+    }
+    tmp = tempfile.mkdtemp(prefix="pio_bench_plane")
+    arena_pss: dict = {}
+
+    def info_probe(base):
+        with urllib.request.urlopen(base + "/", timeout=2) as r:
+            return json.loads(r.read())
+
+    def stop_deploy(base, proc):
+        for _ in range(16):
+            try:
+                with urllib.request.urlopen(base + "/stop", timeout=5) as r:
+                    r.read()
+                time.sleep(0.3)
+            except Exception:
+                break
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    try:
+        _storage, ur_json = _fabricate_ur_serving_store(
+            tmp, n_items, n_users, k, "bench-plane", "planeapp")
+        env_base = {
+            **os.environ,
+            "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_FS_PATH": f"{tmp}/store",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "FS",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            "PIO_JAX_PLATFORM": os.environ.get("PIO_JAX_PLATFORM", "cpu"),
+            "PIO_METRICS_FLUSH_S": "0.25",
+            "PIO_MODEL_PLANE_POLL_S": "0.1",
+            "PIO_SERVE_BATCH": "off",
+        }
+        corpus = [{"user": f"u{(j * 13) % n_users}", "num": 10}
+                  for j in range(24)]
+        corpus += [{"user": f"u{j}", "num": 10,
+                    "fields": [{"name": "category",
+                                "values": [f"c{j % 7}"], "bias": -1}]}
+                   for j in range(4)]
+        corpus += [{"user": f"cold{j}", "num": 10} for j in range(2)]
+        reference = None
+        for plane in ("on", "off"):
+            for workers in worker_counts:
+                cell = f"plane_{plane}_w{workers}"
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                env = {**env_base, "PIO_MODEL_PLANE": plane}
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "predictionio_tpu.cli.main",
+                     "deploy", "--engine-json", ur_json,
+                     "--ip", "127.0.0.1", "--port", str(port),
+                     "--workers", str(workers)],
+                    env=env)
+                base = f"http://127.0.0.1:{port}"
+                try:
+                    deadline = time.time() + 300
+                    pids: dict = {}
+                    while True:
+                        try:
+                            d = info_probe(base)
+                            pids[d["pid"]] = d.get("planeGeneration")
+                        except Exception:
+                            pass
+                        if proc.poll() is not None:
+                            raise RuntimeError(
+                                f"{cell} deploy died (rc "
+                                f"{proc.returncode})")
+                        if time.time() > deadline:
+                            raise RuntimeError(
+                                f"{cell}: {len(pids)}/{workers} workers "
+                                "up in 300s")
+                        if len(pids) >= workers and (
+                                plane == "off"
+                                or all((g or 0) >= 1
+                                       for g in pids.values())):
+                            break
+                        time.sleep(0.1)
+                    # response parity across every cell (plane on == off,
+                    # every worker count, bit-exact)
+                    with contextlib.closing(
+                            _keepalive_query_conn(port)) as conn:
+                        got = []
+                        for body in corpus:
+                            status, resp = _conn_post(conn, body)
+                            assert status == 200, resp
+                            got.append([(r["item"], r["score"])
+                                        for r in resp["itemScores"]])
+                    if reference is None:
+                        reference = got
+                        out["plane_parity"] = "ok"
+                    elif got != reference:
+                        bad = next(i for i, (g, w) in
+                                   enumerate(zip(got, reference))
+                                   if g != w)
+                        out["plane_parity"] = (
+                            f"MISMATCH at {cell} corpus #{bad}")
+                    qps, p50, p95, _n, _off, _topo = _measure_qps_latency(
+                        port, corpus, secs, clients)
+                    out[f"{cell}_qps"] = round(qps, 2)
+                    out[f"{cell}_p50_ms"] = round(p50, 4)
+                    out[f"{cell}_p95_ms"] = round(p95, 4)
+                    # per-worker memory (PSS splits shared pages, so the
+                    # group sum counts each shared arena page once)
+                    rss_l, pss_l, arena_l = [], [], []
+                    for pid in pids:
+                        rss, pss = _smaps_mem(pid)
+                        a_rss, a_pss = _smaps_mem(pid, "model_plane")
+                        rss_l.append(rss)
+                        pss_l.append(pss)
+                        arena_l.append(a_pss)
+                    out[f"{cell}_rss_mb"] = [round(v / 1e6, 1)
+                                             for v in rss_l]
+                    out[f"{cell}_pss_sum_mb"] = round(sum(pss_l) / 1e6, 1)
+                    if plane == "on":
+                        out[f"{cell}_arena_pss_mb"] = [
+                            round(v / 1e6, 1) for v in arena_l]
+                        arena_pss[workers] = sum(arena_l)
+                        # swap propagation: ONE /reload publishes a new
+                        # generation; poll until every worker pid serves
+                        # it — publish → LAST worker installed
+                        t0 = time.time()
+                        with urllib.request.urlopen(
+                                base + "/reload", timeout=60) as r:
+                            rel = json.loads(r.read())
+                        gen = int(rel.get("generation") or 0)
+                        conv: dict = {}
+                        deadline = time.time() + 60
+                        while time.time() < deadline:
+                            try:
+                                d = info_probe(base)
+                                conv[d["pid"]] = d.get(
+                                    "planeGeneration") or 0
+                            except Exception:
+                                pass
+                            if len(conv) >= workers and all(
+                                    g >= gen for g in conv.values()):
+                                break
+                            time.sleep(0.05)
+                        converged = len(conv) >= workers and all(
+                            g >= gen for g in conv.values())
+                        out[f"{cell}_swap_propagation_s"] = (
+                            round(time.time() - t0, 3) if converged
+                            else "NOT_CONVERGED")
+                finally:
+                    stop_deploy(base, proc)
+        if arena_pss.get(1) and arena_pss.get(wmax):
+            ratio = arena_pss[wmax] / arena_pss[1]
+            out["plane_memory_ratio_wmax_vs_w1"] = round(ratio, 3)
+            if not _pss_proportional():
+                # the sharing is real (one arena file, N read-only maps
+                # of the same page cache) but THIS kernel's smaps can't
+                # see it — asserting on it would fail the guard for the
+                # measurement's sin, not the plane's
+                out["plane_memory_guard"] = (
+                    "skipped (kernel smaps Pss not proportional — "
+                    "sandbox procfs; re-measure on production hardware)")
+            else:
+                out["plane_memory_guard"] = (
+                    "ok" if ratio <= 1.5 else
+                    f"VIOLATION workers={wmax} aggregate arena PSS "
+                    f"{arena_pss[wmax] / 1e6:.1f} MB > 1.5x workers=1 "
+                    f"{arena_pss[1] / 1e6:.1f} MB")
+        else:
+            out["plane_memory_guard"] = "skipped (no /proc smaps)"
+        # follow cell: ONE fold per delta across the whole group
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = {**env_base, "PIO_MODEL_PLANE": "on",
+               "PIO_FOLLOW_INTERVAL_S": "0.3"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.cli.main",
+             "deploy", "--engine-json", ur_json,
+             "--ip", "127.0.0.1", "--port", str(port),
+             "--workers", str(wmax), "--follow", "0.3"],
+            env=env)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # generation 2 = the publisher's bootstrap (1 = the parent's
+            # initial publish); wait for it so the delta folds
+            # incrementally
+            deadline = time.time() + 300
+            pids = {}
+            while True:
+                try:
+                    d = info_probe(base)
+                    pids[d["pid"]] = d.get("planeGeneration") or 0
+                except Exception:
+                    pass
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"plane follow deploy died (rc {proc.returncode})")
+                if time.time() > deadline:
+                    raise RuntimeError("plane follow cell not ready in "
+                                       f"300s ({pids})")
+                if len(pids) >= wmax and all(g >= 2
+                                             for g in pids.values()):
+                    break
+                time.sleep(0.1)
+            gref = max(pids.values())
+            from predictionio_tpu.events.event import Event
+            from predictionio_tpu.storage.locator import (
+                Storage, StorageConfig,
+            )
+
+            st2 = Storage(StorageConfig(
+                sources={"FS": {"type": "localfs",
+                                "path": f"{tmp}/store"}},
+                repositories={r: "FS" for r in (
+                    "METADATA", "EVENTDATA", "MODELDATA")}))
+            app = st2.apps.get_by_name("planeapp")
+            t0 = time.time()
+            st2.l_events.insert_batch(
+                [Event(event="buy", entity_type="user",
+                       entity_id="plane-newbie",
+                       target_entity_type="item",
+                       target_entity_id=f"i{j}") for j in (0, 1, 2)],
+                app.id)
+            conv = {}
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    d = info_probe(base)
+                    conv[d["pid"]] = d.get("planeGeneration") or 0
+                except Exception:
+                    pass
+                if len(conv) >= wmax and all(g > gref
+                                             for g in conv.values()):
+                    break
+                time.sleep(0.05)
+            converged = len(conv) >= wmax and all(
+                g > gref for g in conv.values())
+            out["plane_follow_propagation_s"] = (
+                round(time.time() - t0, 3) if converged
+                else "NOT_CONVERGED")
+            folds = 0.0
+            deadline = time.time() + 15
+            while time.time() < deadline and folds < 1.0:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=10) as r:
+                    text = r.read().decode()
+                folds = sum(float(m.group(1)) for m in re.finditer(
+                    r'pio_follow_folds_total\{outcome="fold"\}'
+                    r' ([0-9.e+]+)', text))
+                if folds < 1.0:
+                    time.sleep(0.3)
+            out["plane_fold_count"] = folds
+            out["plane_fold_once"] = (
+                "ok" if folds == 1.0 and converged else
+                f"VIOLATION folds={folds} converged={converged} "
+                f"(per-worker followers would fold {wmax}x)")
+        finally:
+            stop_deploy(base, proc)
+        return out
+    finally:
+        set_storage(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serve_scale(smoke: bool) -> dict:
     """Multi-worker query serving (the serving twin of ingest_scale): a
     REAL ``pio deploy --workers N`` CLI subprocess per cell — prefork
@@ -2215,6 +2598,14 @@ def bench_serve_scale(smoke: bool) -> dict:
             # raise — mark it failed too so the record never reads as
             # "parity key silently dropped"
             out["scale_serve_parity"] = f"section_failed: {e}"
+        # ISSUE-14 headline: shared-memory model plane (own stores and
+        # deploys; isolated failure, same pattern as the catalog sweep)
+        try:
+            out.update(_plane_sweep(smoke))
+        except Exception as e:
+            out["plane_memory_guard"] = f"section_failed: {e}"
+            out["plane_parity"] = f"section_failed: {e}"
+            out["plane_fold_once"] = f"section_failed: {e}"
         return out
     finally:
         set_storage(None)
@@ -3314,6 +3705,9 @@ def main() -> int:
         "serve_scale_monotone": "section_failed",
         "scale_serve_parity": "section_failed",
         "scale_serve_flatness": "section_failed",
+        "plane_parity": "section_failed",
+        "plane_memory_guard": "section_failed",
+        "plane_fold_once": "section_failed",
     })
     freshness = _run_section("freshness", args.smoke, {
         "freshness_p50_ms": 0.0, "freshness_p99_ms": 0.0,

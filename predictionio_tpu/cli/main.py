@@ -761,6 +761,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "throughput past the per-process GIL)")
     dp.add_argument("--reuse-port", action="store_true",
                     help=argparse.SUPPRESS)   # internal: prefork child
+    dp.add_argument("--plane-publisher", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: the model
+    # plane's dedicated fold/emit process (spawned by deploy --workers
+    # with --follow; publishes generations into PIO_MODEL_PLANE_DIR
+    # instead of serving queries)
     dp.set_defaults(func=_cmd_deploy)
 
     ud = sub.add_parser("undeploy")

@@ -665,6 +665,21 @@ class URModel(PersistentModel):
             self.device_zeros()
         self.pop_norm()
 
+    def ensure_host_serving_state(self) -> None:
+        """Materialize every host-side derived serving structure —
+        the CSR postings inversions, the popularity total order, the
+        f32 popularity view and its norm — regardless of how the
+        scorer/tail env would resolve in THIS process.  The model-plane
+        publisher calls this before serializing a generation so the
+        mapping workers never rebuild derived state: the publisher pays
+        the one build (or the fold engine's incremental patch) per
+        node."""
+        for name in self.indicator_idx:
+            self.host_inverted(name)
+        self.host_popularity()
+        self.host_pop_order()
+        self.pop_norm()
+
     def pop_norm(self) -> float:
         norm = self.__dict__.get("_pop_norm")
         if norm is None:

@@ -309,6 +309,33 @@ def worker_tag() -> str:
 WORKER_UP = _REGISTRY.gauge(
     "pio_worker_up", "1 per worker process contributing to this scrape")
 
+# per-worker resident memory, refreshed on every snapshot flush and
+# scrape: with the shared model plane, N workers mapping one arena show
+# near-baseline anonymous RSS each (file-backed model pages are shared
+# page cache) — the bench's plane_memory_guard reads exactly this view
+PROCESS_RSS = _REGISTRY.gauge(
+    "pio_process_rss_bytes",
+    "Resident-set bytes of this process, one {worker} series per live "
+    "worker (Linux /proc/self/statm; absent elsewhere).  NOTE: "
+    "file-backed pages (mmapped model-plane arenas) count in EVERY "
+    "mapping worker's RSS — sum PSS, not this, for node totals")
+
+_PAGE_BYTES = (os.sysconf("SC_PAGE_SIZE")
+               if hasattr(os, "sysconf") else 4096)
+
+
+def update_process_rss(tag: Optional[str] = None) -> None:
+    """Refresh this process's pio_process_rss_bytes series (no-op where
+    /proc is unavailable).  ``tag`` overrides the worker label — the
+    snapshot flusher passes its own (calling worker_tag() from inside
+    the flusher-lock hold would deadlock)."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * _PAGE_BYTES
+    except (OSError, ValueError, IndexError):
+        return
+    PROCESS_RSS.set(rss, worker=tag or worker_tag())
+
 
 def mark_worker_up(tag: Optional[str] = None) -> None:
     """Declare THIS process's worker identity.  Clears previous local
@@ -344,6 +371,7 @@ class SnapshotFlusher:
         return os.path.join(self.dir, f"{self.tag}.json")
 
     def flush(self) -> None:
+        update_process_rss(self.tag)
         tmp = self.path + f".tmp{os.getpid()}"
         try:
             os.makedirs(self.dir, exist_ok=True)
@@ -423,6 +451,8 @@ def aggregate_snapshot(registry: Optional[MetricsRegistry] = None) -> dict:
     alternating scrapes across workers converge within one flush
     interval instead of two."""
     registry = registry or _REGISTRY
+    if registry is _REGISTRY:
+        update_process_rss()
     snaps = [registry.snapshot()]
     with _flusher_lock:
         fl = _flusher

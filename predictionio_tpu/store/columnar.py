@@ -866,6 +866,97 @@ def read_batch(path, mmap: bool = True
     return batch, ids, header.get("meta", {})
 
 
+# -- generic named-array container (model-plane arenas) ----------------------
+#
+# Same container discipline as the snapshot files above (magic + JSON
+# header + 64-aligned blobs, mmap loads), generalized to an arbitrary
+# dict of n-D arrays: the shared-memory model plane persists each model
+# generation through this so N prefork workers map ONE copy read-only.
+
+_ARRAYS_MAGIC = b"PIOARR01"
+
+
+def write_arrays(path, arrays: Dict[str, np.ndarray],
+                 meta: Optional[Dict] = None) -> None:
+    """Serialize named n-D arrays into one columnar container file.
+
+    Flush+fsync'd but NOT atomic — callers own the tmp + rename
+    two-phase (the model plane renames under its publish lock)."""
+    import json as _json
+    import os as _os
+
+    entries: Dict[str, Dict] = {}
+    blobs: List[np.ndarray] = []
+    pos = 0
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        pos = (pos + _ALIGN - 1) // _ALIGN * _ALIGN
+        entries[name] = {"dtype": arr.dtype.str, "shape": list(arr.shape),
+                         "off": pos}
+        blobs.append(arr)
+        pos += arr.nbytes
+    header = {"version": 1, "arrays": entries, "meta": meta or {}}
+    hdr = _json.dumps(header, separators=(",", ":")).encode()
+    data_base = 16 + len(hdr)
+    with open(path, "wb") as f:
+        f.write(_ARRAYS_MAGIC)
+        f.write(len(hdr).to_bytes(8, "little"))
+        f.write(hdr)
+        at = data_base
+        for arr in blobs:
+            spec_off = (at - data_base + _ALIGN - 1) // _ALIGN * _ALIGN
+            f.write(b"\0" * (data_base + spec_off - at))
+            f.write(arr.tobytes())
+            at = data_base + spec_off + arr.nbytes
+        f.flush()
+        _os.fsync(f.fileno())
+
+
+def read_arrays(path, mmap: bool = True
+                ) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Load a :func:`write_arrays` container → ``(arrays, meta)``.
+
+    ``mmap=True`` returns READ-ONLY lazy views (``mmap`` +
+    ``np.frombuffer``, so every process mapping the same file shares
+    page cache — the model plane's N×→1× resident-bytes mechanism;
+    ``arr.flags.writeable`` is False, so a worker cannot corrupt the
+    shared mapping).  The views keep the mapping alive through their
+    ``.base`` chain — the file truly unmaps only when the last array
+    (i.e. the model generation holding them) is garbage collected.
+    Raises ValueError on a torn/corrupt file — callers quarantine."""
+    import json as _json
+    import mmap as _mmap
+
+    with open(path, "rb") as _f:
+        try:
+            _raw = _mmap.mmap(_f.fileno(), 0, access=_mmap.ACCESS_READ)
+        except ValueError as e:       # empty file — torn write
+            raise ValueError(f"{path}: not an array container: {e}") from None
+    mm = np.frombuffer(_raw, dtype=np.uint8)
+    if mm.shape[0] < 16 or bytes(mm[:8]) != _ARRAYS_MAGIC:
+        raise ValueError(f"{path}: not an array container (bad magic)")
+    hlen = int.from_bytes(bytes(mm[8:16]), "little")
+    if 16 + hlen > mm.shape[0]:
+        raise ValueError(f"{path}: truncated header")
+    try:
+        header = _json.loads(bytes(mm[16:16 + hlen]))
+    except (UnicodeDecodeError, _json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: corrupt header: {e}") from None
+    data_base = 16 + hlen
+    out: Dict[str, np.ndarray] = {}
+    for name, spec in header.get("arrays", {}).items():
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        n = int(np.prod(shape)) if shape else 1
+        a = data_base + spec["off"]
+        b = a + n * dt.itemsize
+        if b > mm.shape[0]:
+            raise ValueError(f"{path}: truncated array data ({name})")
+        arr = mm[a:b].view(dt).reshape(shape)
+        out[name] = arr if mmap else np.array(arr)
+    return out, header.get("meta", {})
+
+
 def fold_properties(batch: EventBatch, entity_type: Optional[str] = None):
     """Columnar $set/$unset/$delete folding over a native-scanned batch —
     the C++-path analogue of events.event.aggregate_properties (reference:

@@ -11,3 +11,8 @@ hot-swap.
 """
 
 from predictionio_tpu.streaming.follow import FollowTrainer  # noqa: F401
+from predictionio_tpu.streaming.plane import (  # noqa: F401
+    ModelPlane,
+    PlaneUnsupported,
+    PlaneWatcher,
+)

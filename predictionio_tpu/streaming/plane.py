@@ -1,0 +1,664 @@
+"""Shared-memory model plane: one model per node, N prefork workers map it.
+
+Without the plane, every ``pio deploy --workers N`` worker owns a private
+copy of everything: with ``--follow`` each worker runs its OWN embedded
+follower (the same delta folded N times, the same host_inverted CSR built
+N times) and resident model memory is N× the model size.  The plane
+inverts the topology to match the reference's deployment model (many
+stateless serving processes reading ONE trained model from a shared
+store):
+
+- each model generation is emitted exactly ONCE — by the single
+  plane-publisher process (``pio deploy --plane-publisher``, spawned next
+  to the prefork group when ``--follow`` is on) or by whichever worker
+  handles a ``/reload`` — into an mmap-able **arena** file under the
+  storage dir (:func:`store.columnar.write_arrays`: magic + JSON manifest
+  + 64-aligned blobs; two-phase tmp+fsync+rename under a flock'd
+  generation ticket, the same crash-safety discipline as snapshots).  The
+  arena includes the *derived* serving state (host_inverted CSR,
+  host_pop_order, user_seen CSRs) so workers never rebuild it;
+- prefork workers watch the plane's ``CURRENT.json`` manifest
+  (:class:`PlaneWatcher`), map the new generation's arrays READ-ONLY
+  (``mmap`` + ``np.frombuffer`` — all workers share page cache, so
+  resident model bytes go N× → ~1×), reconstruct thin :class:`URModel`
+  wrappers around the views, and install through the query server's
+  build-ticket ``_install`` path.  The old generation unmaps once
+  in-flight queries drain (the arrays' refcounts ARE the drain barrier);
+- stale arena files are GC'd by the publisher (``PIO_MODEL_PLANE_KEEP``
+  newest generations retained; a mapped-but-unlinked arena stays valid —
+  POSIX keeps the pages — so GC can never corrupt a serving worker);
+- a torn arena (publisher SIGKILL'd mid-emit) fails validation on map,
+  is quarantined (``*.quarantine``), and workers keep serving the old
+  generation until the publisher re-emits.
+
+``PIO_MODEL_PLANE=off`` keeps the per-worker in-process path as the
+parity oracle; ``on`` forces the plane even at ``--workers 1`` (the
+in-process test topology); the default (auto) enables it for prefork
+groups (``--workers > 1``).  Only single-:class:`URModel` bundles ride
+the plane — anything else raises :class:`PlaneUnsupported` and the
+caller degrades to the private-model path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from collections.abc import Mapping
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.obs import metrics as _obs_metrics
+from predictionio_tpu.store.columnar import (
+    CSRLookup,
+    IdDict,
+    read_arrays,
+    write_arrays,
+)
+
+log = logging.getLogger("pio.modelplane")
+
+_REG = _obs_metrics.get_registry()
+_M_GEN = _REG.gauge(
+    "pio_model_plane_generation",
+    "Model-plane generation this worker serves, one {worker} series per "
+    "process (the publisher's series is the generation it last emitted) "
+    "— all series equal means the prefork group has converged")
+_M_BYTES = _REG.gauge(
+    "pio_model_plane_bytes",
+    "On-disk bytes of the model-plane arena this worker last mapped "
+    "(or, for the publisher, last emitted), one {worker} series per "
+    "process — ≈ the ONE per-node resident model cost: model tables + "
+    "derived CSRs, shared by every mapping worker via page cache")
+_M_MAP_S = _REG.gauge(
+    "pio_model_plane_map_seconds",
+    "Wall seconds this worker spent mapping + installing its last plane "
+    "generation (mmap + wrapper reconstruction + serving-bundle warm), "
+    "one {worker} series — the per-worker cost that replaced a full "
+    "fold + derived-state rebuild")
+_M_GC = _REG.counter(
+    "pio_model_plane_gc_total",
+    "Stale model-plane arena files unlinked by the publisher's GC "
+    "(generations older than PIO_MODEL_PLANE_KEEP, quarantined torn "
+    "arenas past the keep window, and abandoned tmp files)")
+
+_CURRENT = "CURRENT.json"
+_LOCK = "plane.lock"
+
+
+class PlaneUnsupported(RuntimeError):
+    """The model bundle cannot ride the plane (not exactly one URModel);
+    callers degrade to the private in-process path."""
+
+
+def plane_mode() -> str:
+    """'on' | 'off' | 'auto' from PIO_MODEL_PLANE (default auto)."""
+    conf = os.environ.get("PIO_MODEL_PLANE", "").lower()
+    if conf in ("off", "0", "false"):
+        return "off"
+    if conf in ("on", "1", "true"):
+        return "on"
+    return "auto"
+
+
+def plane_wanted(workers: int) -> bool:
+    """auto enables the plane exactly where private copies multiply:
+    prefork groups.  'on' forces it for a single worker too (tests, and
+    the child workers the parent spawns with the dir pre-resolved)."""
+    mode = plane_mode()
+    return mode == "on" or (mode == "auto" and workers > 1)
+
+
+def plane_poll_s() -> float:
+    """PIO_MODEL_PLANE_POLL_S: seconds between a worker's manifest polls
+    (default 0.2 — the swap-propagation latency bound; the poll is one
+    small-file read)."""
+    try:
+        return max(
+            float(os.environ.get("PIO_MODEL_PLANE_POLL_S", "0.2")), 0.02)
+    except ValueError:
+        return 0.2
+
+
+def plane_keep() -> int:
+    """PIO_MODEL_PLANE_KEEP: newest arena generations the publisher's GC
+    retains on disk (default 3 — current + drain margin; a worker still
+    mapping an unlinked arena keeps serving it, POSIX keeps the pages)."""
+    try:
+        return max(int(os.environ.get("PIO_MODEL_PLANE_KEEP", "3")), 1)
+    except ValueError:
+        return 3
+
+
+def resolve_plane_dir(storage, engine_id: str,
+                      variant: str) -> Optional[str]:
+    """Where the plane lives: PIO_MODEL_PLANE_DIR wins (the prefork
+    parent pins children and the publisher to its own resolution), else
+    next to the engine metadata under the METADATA **localfs** path;
+    None (plane unavailable) for other backends.  A sharedfs METADATA
+    store does NOT auto-resolve: the plane's mmap/GC/flock invariants
+    assume one node's kernel (an unlinked-but-mapped arena stays valid;
+    flock is advisory-reliable), neither of which holds across NFS-style
+    mounts — multi-node sharedfs operators must point
+    PIO_MODEL_PLANE_DIR at a node-LOCAL directory explicitly."""
+    env = os.environ.get("PIO_MODEL_PLANE_DIR")
+    if env:
+        return env
+    try:
+        src = storage.config.sources[storage.config.repositories["METADATA"]]
+    except (KeyError, AttributeError):
+        return None
+    if src.get("type") != "localfs" or not src.get("path"):
+        return None
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in f"{engine_id}-{variant}")
+    return str(Path(src["path"]) / "model_plane" / safe)
+
+
+class _LazyProps(Mapping):
+    """``item_properties`` view over the arena's JSON blob, parsed ONCE
+    on first real access — steady-state workers serve business rules
+    from carried derived indexes and never pay the parse."""
+
+    __slots__ = ("_raw", "_doc")
+
+    def __init__(self, raw: Optional[np.ndarray]):
+        self._raw = raw
+        self._doc: Optional[dict] = None
+
+    def _load(self) -> dict:
+        if self._doc is None:
+            if self._raw is None or len(self._raw) == 0:
+                self._doc = {}
+            else:
+                self._doc = json.loads(bytes(self._raw))
+            self._raw = None   # the parsed dict owns the data now
+        return self._doc
+
+    def __getitem__(self, key):
+        return self._load()[key]
+
+    def __iter__(self):
+        return iter(self._load())
+
+    def __len__(self):
+        return len(self._load())
+
+
+def _json_info(info: Optional[Dict]) -> Dict:
+    """JSON-safe subset of a publish info dict (it may carry follower
+    internals)."""
+    return {k: v for k, v in (info or {}).items()
+            if isinstance(v, (str, int, float, bool, type(None)))}
+
+
+class ModelPlane:
+    """One plane directory: arena emit (publisher side) + arena map
+    (worker side).  Both sides are safe to host in one process (the
+    ``--workers 1`` / in-process-test topology): the caches are
+    per-instance and the publish ticket is a cross-process flock."""
+
+    def __init__(self, directory: str):
+        self.dir = str(directory)
+        # publisher-side caches: dict blobs / props blobs keyed by OBJECT
+        # identity — the fold engine carries unchanged dictionaries and
+        # property maps by object across generations, so steady-state
+        # publishes re-encode nothing
+        self._pub_dicts: Dict[str, Dict[str, Any]] = {}
+        self._pub_props: Optional[Tuple[Any, bytes, int]] = None
+        # worker-side caches: reconstructed IdDicts keyed by content crc
+        # (carried when unchanged, extended when the publisher proves the
+        # previous blob is a byte-prefix), plus the previous generation's
+        # model for derived-prop-index carry
+        self._dict_cache: Dict[str, Tuple[int, IdDict]] = {}
+        self._prev_model = None
+        self._prev_meta: Optional[Dict] = None
+        self.dicts_extended = 0   # test observability
+        self.dicts_rebuilt = 0
+
+    # -- manifest ------------------------------------------------------------
+
+    @property
+    def current_path(self) -> str:
+        return os.path.join(self.dir, _CURRENT)
+
+    def current(self) -> Optional[Dict]:
+        """The live manifest, or None (no generation published yet /
+        torn manifest — the write is atomic, so torn means absent)."""
+        try:
+            with open(self.current_path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if not isinstance(doc, dict) or "generation" not in doc \
+                or "file" not in doc:
+            return None
+        return doc
+
+    @contextmanager
+    def _publish_lock(self):
+        import fcntl
+
+        os.makedirs(self.dir, exist_ok=True)
+        with open(os.path.join(self.dir, _LOCK), "a+") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    # -- publisher side ------------------------------------------------------
+
+    def publish(self, models, info: Optional[Dict] = None) -> int:
+        """Emit one model generation into the arena; returns the plane
+        generation.  Exactly the ``FollowTrainer.on_publish`` signature,
+        so the plane publisher wires in as the follower's publish hook.
+
+        Raises :class:`PlaneUnsupported` for non-UR bundles and lets
+        OSError/ValueError propagate — the follower's publish-retry
+        machinery owns transient failures."""
+        from predictionio_tpu.models.universal_recommender.engine import (
+            URModel,
+        )
+
+        if not (isinstance(models, (list, tuple)) and len(models) == 1
+                and type(models[0]) is URModel):
+            raise PlaneUnsupported(
+                "the model plane serializes exactly one URModel; got "
+                f"{[type(m).__name__ for m in (models or [])]}")
+        model = models[0]
+        # the publisher pays the ONE derived-state build (or the fold
+        # engine's incremental patch) per node; workers only map
+        model.ensure_host_serving_state()
+        arrays, meta = self._model_payload(model)
+        meta["info"] = _json_info(info)
+        with self._publish_lock():
+            cur = self.current()
+            gen = int(cur["generation"]) + 1 if cur else 1
+            meta["generation"] = gen
+            fname = f"gen-{gen:010d}.arena"
+            path = os.path.join(self.dir, fname)
+            tmp = os.path.join(self.dir, f".{fname}.tmp-{os.getpid()}")
+            write_arrays(tmp, arrays, meta)          # flush+fsync inside
+            os.replace(tmp, path)
+            size = os.path.getsize(path)
+            self._write_manifest({
+                "version": 1, "generation": gen, "file": fname,
+                "bytes": size, "publisherPid": os.getpid(),
+                "publishedAt": time.time(),
+            })
+            self._gc(gen)
+        tag = _obs_metrics.worker_tag()
+        _M_GEN.set(gen, worker=tag)
+        _M_BYTES.set(size, worker=tag)
+        log.info("model plane: published generation %d (%s, %.1f MB)",
+                 gen, fname, size / 1e6)
+        return gen
+
+    def _write_manifest(self, doc: Dict) -> None:
+        tmp = self.current_path + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.current_path)
+
+    def _gc(self, newest_gen: int) -> None:
+        """Unlink arenas older than the keep window (plus quarantined
+        torn arenas past it and abandoned tmp files).  A worker still
+        mapping an unlinked arena is unaffected — the mapping holds the
+        pages until the worker's old generation drains."""
+        keep_min = newest_gen - plane_keep() + 1
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        now = time.time()
+        removed = 0
+        for name in names:
+            path = os.path.join(self.dir, name)
+            if ".tmp-" in name:
+                # a SIGKILL'd publisher's partial emit: invisible to
+                # readers (never referenced by the manifest), reclaimed
+                # once clearly abandoned
+                try:
+                    if now - os.path.getmtime(path) > 300:
+                        os.unlink(path)
+                        removed += 1
+                except OSError:
+                    pass
+                continue
+            if not name.startswith("gen-"):
+                continue
+            try:
+                gen = int(name[4:14])
+            except ValueError:
+                continue
+            if gen < keep_min:
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        if removed:
+            _M_GC.inc(removed)
+
+    def _model_payload(self, model) -> Tuple[Dict[str, np.ndarray], Dict]:
+        names: List[str] = list(model.indicator_idx)
+        bl_names: List[str] = list(model.user_seen_by_event)
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Any] = {
+            "schema": 1,
+            "primaryEvent": model.primary_event,
+            "eventNames": names,
+            "blacklistNames": bl_names,
+            "nItems": len(model.item_dict),
+            "nUsers": len(model.user_dict),
+            "dicts": {},
+        }
+        arrays["popularity"] = np.asarray(model.popularity)
+        arrays["pop_order"] = model.host_pop_order()
+        arrays["user_seen_indptr"] = model.user_seen.indptr
+        arrays["user_seen_values"] = model.user_seen.values
+        for j, bname in enumerate(bl_names):
+            csr = model.user_seen_by_event[bname]
+            arrays[f"seen_{j}_indptr"] = csr.indptr
+            arrays[f"seen_{j}_values"] = csr.values
+        for i, name in enumerate(names):
+            arrays[f"ind_{i}_idx"] = model.indicator_idx[name]
+            arrays[f"ind_{i}_llr"] = model.indicator_llr[name]
+            indptr, rows, w = model.host_inverted(name)
+            arrays[f"inv_{i}_indptr"] = indptr
+            arrays[f"inv_{i}_rows"] = rows
+            arrays[f"inv_{i}_w"] = w
+        meta["dicts"]["item"] = self._encode_dict(
+            "item", model.item_dict, arrays)
+        meta["dicts"]["user"] = self._encode_dict(
+            "user", model.user_dict, arrays)
+        for i, name in enumerate(names):
+            d = model.event_item_dicts[name]
+            if d is model.item_dict:
+                meta["dicts"][f"ev_{i}"] = {"sameAs": "item"}
+            else:
+                meta["dicts"][f"ev_{i}"] = self._encode_dict(
+                    f"ev_{i}", d, arrays)
+        blob, crc = self._encode_props(model.item_properties)
+        arrays["props_json"] = np.frombuffer(blob, np.uint8)
+        meta["propsCrc"] = crc
+        return arrays, meta
+
+    def _encode_dict(self, slot: str, d: IdDict,
+                     arrays: Dict[str, np.ndarray]) -> Dict:
+        """Dictionary → flat utf-8 blob + int64 offsets.  The blob is
+        cached by dictionary OBJECT (the fold engine carries unchanged
+        dicts by object), and a changed dictionary whose previous blob
+        is a byte-prefix records ``prevCrc``/``prevN`` so workers
+        holding the previous dictionary extend it in O(new strings)
+        instead of rebuilding — pure END growth of the catalog (the
+        fold engine's common new-item case) stays O(delta) end to
+        end."""
+        cached = self._pub_dicts.get(slot)
+        if cached is not None and cached["obj"] is d:
+            entry = {"crc": cached["crc"], "n": cached["n"]}
+        else:
+            strings = d.strings()
+            enc = [s.encode("utf-8", "surrogatepass") for s in strings]
+            blob = b"".join(enc)
+            offs = np.zeros(len(enc) + 1, np.int64)
+            if enc:
+                np.cumsum([len(b) for b in enc], out=offs[1:])
+            crc = int(zlib.crc32(blob))
+            entry = {"crc": crc, "n": len(strings)}
+            if cached is not None and entry["n"] >= cached["n"] \
+                    and len(blob) >= len(cached["blob"]) \
+                    and blob[:len(cached["blob"])] == cached["blob"]:
+                entry["prevCrc"] = cached["crc"]
+                entry["prevN"] = cached["n"]
+            cached = self._pub_dicts[slot] = {
+                "obj": d, "blob": blob, "offs": offs,
+                "crc": crc, "n": len(strings)}
+        arrays[f"dict_{slot}_blob"] = np.frombuffer(cached["blob"],
+                                                    np.uint8)
+        arrays[f"dict_{slot}_offs"] = cached["offs"]
+        return entry
+
+    def _encode_props(self, props) -> Tuple[bytes, int]:
+        cached = self._pub_props
+        if cached is not None and cached[0] is props:
+            return cached[1], cached[2]
+        blob = json.dumps(dict(props or {}), separators=(",", ":"),
+                          sort_keys=True, default=str).encode()
+        crc = int(zlib.crc32(blob))
+        self._pub_props = (props, blob, crc)
+        return blob, crc
+
+    # -- worker side ---------------------------------------------------------
+
+    def quarantine(self, manifest: Dict, err: Exception) -> None:
+        """Set a torn arena aside (first sibling to rename wins) and
+        keep serving — the publisher's next emit supersedes it."""
+        fname = manifest.get("file")
+        log.warning(
+            "model plane: arena generation %s unusable (%s) — "
+            "quarantined; keeping the served generation",
+            manifest.get("generation"), err)
+        if not fname:
+            return
+        path = os.path.join(self.dir, str(fname))
+        try:
+            os.replace(path, path + ".quarantine")
+        except OSError:
+            pass
+
+    def load(self, manifest: Dict):
+        """Map the manifest's arena → ``(URModel-over-views, info)``.
+
+        The arrays are read-only views into the shared mapping; derived
+        serving state (inverted CSRs, pop order) installs straight into
+        the model's ``__dict__`` caches, and dictionaries / property
+        indexes carry from the previously loaded generation whenever the
+        manifest proves them unchanged.  Raises ValueError/OSError on a
+        torn arena — the caller quarantines."""
+        path = os.path.join(self.dir, str(manifest["file"]))
+        arrays, meta = read_arrays(path, mmap=True)
+        if meta.get("schema") != 1:
+            raise ValueError(f"unknown arena schema {meta.get('schema')}")
+        model = self._build_model(arrays, meta)
+        info = dict(meta.get("info") or {})
+        info["planeGeneration"] = int(meta.get("generation")
+                                      or manifest["generation"])
+        info["planeBytes"] = int(manifest.get("bytes") or 0)
+        return model, info
+
+    def _build_model(self, arrays: Dict[str, np.ndarray], meta: Dict):
+        from predictionio_tpu.models.universal_recommender.engine import (
+            URModel,
+        )
+
+        names = list(meta["eventNames"])
+        item_dict = self._restore_dict("item", meta["dicts"]["item"],
+                                       arrays)
+        user_dict = self._restore_dict("user", meta["dicts"]["user"],
+                                       arrays)
+        event_item_dicts: Dict[str, IdDict] = {}
+        for i, name in enumerate(names):
+            entry = meta["dicts"][f"ev_{i}"]
+            event_item_dicts[name] = (
+                item_dict if entry.get("sameAs") == "item"
+                else self._restore_dict(f"ev_{i}", entry, arrays))
+        user_seen_by_event = {
+            bname: CSRLookup(arrays[f"seen_{j}_indptr"],
+                             arrays[f"seen_{j}_values"])
+            for j, bname in enumerate(meta["blacklistNames"])}
+        prev, prev_meta = self._prev_model, self._prev_meta
+        item_crc = meta["dicts"]["item"]["crc"]
+        props_carried = (
+            prev is not None and prev_meta is not None
+            and meta.get("propsCrc") == prev_meta.get("propsCrc")
+            and item_crc == prev_meta["dicts"]["item"]["crc"])
+        props = (prev.item_properties if props_carried
+                 else _LazyProps(arrays.get("props_json")))
+        model = URModel(
+            primary_event=meta["primaryEvent"],
+            item_dict=item_dict,
+            user_dict=user_dict,
+            indicator_idx={n: arrays[f"ind_{i}_idx"]
+                           for i, n in enumerate(names)},
+            indicator_llr={n: arrays[f"ind_{i}_llr"]
+                           for i, n in enumerate(names)},
+            event_item_dicts=event_item_dicts,
+            popularity=arrays["popularity"],
+            item_properties=props,
+            user_seen=CSRLookup(arrays["user_seen_indptr"],
+                                arrays["user_seen_values"]),
+            user_seen_by_event=user_seen_by_event,
+        )
+        # derived serving state rides the arena: pre-populate the lazy
+        # caches so warm()/first-query find them built (as views)
+        model.__dict__["_host_inv"] = {
+            n: (arrays[f"inv_{i}_indptr"], arrays[f"inv_{i}_rows"],
+                arrays[f"inv_{i}_w"])
+            for i, n in enumerate(names)}
+        model.__dict__["_host_pop_order"] = arrays["pop_order"]
+        if props_carried:
+            # the property-derived indexes (value→ids, date arrays,
+            # known-name set, date-offset LRU) are functions of
+            # (item_dict, item_properties) — both proven unchanged, so
+            # whatever THIS worker already built carries forward and
+            # rules keep serving without a rebuild
+            for attr in ("_prop_value_index", "_prop_date_array",
+                         "_known_prop_names", "_date_off"):
+                v = prev.__dict__.get(attr)
+                if v is not None:
+                    model.__dict__[attr] = v
+        if prev is not None and prev_meta is not None \
+                and item_crc == prev_meta["dicts"]["item"]["crc"]:
+            z = prev.__dict__.get("_host_zeros")
+            if z is not None:   # read-only by contract; same n_items
+                model.__dict__["_host_zeros"] = z
+        model.__dict__["_plane_generation"] = int(meta.get("generation", 0))
+        self._prev_model, self._prev_meta = model, meta
+        return model
+
+    def _restore_dict(self, slot: str, entry: Dict,
+                      arrays: Dict[str, np.ndarray]) -> IdDict:
+        crc, n = int(entry["crc"]), int(entry["n"])
+        cached = self._dict_cache.get(slot)
+        if cached is not None and cached[0] == crc \
+                and len(cached[1]) == n:
+            return cached[1]
+        blob = arrays[f"dict_{slot}_blob"]
+        offs = arrays[f"dict_{slot}_offs"]
+        if cached is not None and entry.get("prevCrc") == cached[0] \
+                and entry.get("prevN") == len(cached[1]):
+            # publisher proved our dictionary is a byte-prefix of the
+            # new blob: extend a clone with only the tail strings
+            d = cached[1].clone()
+            start = int(entry["prevN"])
+            base = int(offs[start])
+            tail = bytes(blob[base:])
+            for j in range(start, n):
+                d.add(tail[int(offs[j]) - base:int(offs[j + 1]) - base]
+                      .decode("utf-8", "surrogatepass"))
+            self.dicts_extended += 1
+        else:
+            raw = bytes(blob)
+            d = IdDict.from_state(
+                [raw[int(offs[j]):int(offs[j + 1])]
+                 .decode("utf-8", "surrogatepass") for j in range(n)])
+            self.dicts_rebuilt += 1
+        self._dict_cache[slot] = (crc, d)
+        return d
+
+
+class PlaneWatcher:
+    """Per-worker manifest watcher: polls ``CURRENT.json`` and installs
+    each new generation through the server's build-ticket install path.
+    ``check_now()`` runs one synchronous check (the ``/reload`` handler
+    and the in-process publisher use it so their response generation is
+    live before they answer)."""
+
+    def __init__(self, plane: ModelPlane, install,
+                 poll_s: Optional[float] = None):
+        self.plane = plane
+        self.install = install     # callable(models, info) -> bool
+        self.poll = poll_s if poll_s is not None else plane_poll_s()
+        self.generation = 0
+        self._bad_gen = 0
+        self._warned_gen = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="pio-model-plane-watch")
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll):
+            try:
+                self.check_now()
+            except Exception:
+                log.exception("model-plane watch failed; keeping the "
+                              "served generation")
+
+    def check_now(self) -> bool:
+        """One check-and-install; True when a new generation went live
+        on this worker."""
+        with self._lock:
+            cur = self.plane.current()
+            if cur is None:
+                return False
+            gen = int(cur.get("generation") or 0)
+            if gen <= self.generation or gen == self._bad_gen:
+                return False
+            t0 = time.perf_counter()
+            try:
+                model, info = self.plane.load(cur)
+            except (ValueError, KeyError) as e:
+                # deterministic content corruption (torn write): retrying
+                # cannot help — quarantine, remember the bad generation
+                # (no re-probe storm), serve the old one until the next
+                # good publish supersedes it
+                self._bad_gen = gen
+                self.plane.quarantine(cur, e)
+                return False
+            except OSError as e:
+                # transient I/O (EMFILE under load, a sibling's
+                # quarantine rename racing us, mid-GC): do NOT
+                # quarantine a possibly-good arena — keep serving and
+                # retry on the next poll (log once per generation)
+                if self._warned_gen != gen:
+                    self._warned_gen = gen
+                    log.warning(
+                        "model plane: could not map generation %s (%s) "
+                        "— keeping the served generation, will retry",
+                        gen, e)
+                return False
+            installed = self.install([model], info)
+            # the generation is consumed either way: install() returns
+            # False only when a newer build ticket (a later check or the
+            # startup private load racing us) already swapped in
+            self.generation = gen
+            tag = _obs_metrics.worker_tag()
+            _M_GEN.set(gen, worker=tag)
+            _M_BYTES.set(int(cur.get("bytes") or 0), worker=tag)
+            if installed:
+                _M_MAP_S.set(time.perf_counter() - t0, worker=tag)
+            _obs_metrics.update_process_rss()
+            return installed

@@ -265,6 +265,7 @@ class QueryServerState:
         feedback_app_name: str = "",
         plugins=None,
         auto_reload: float = 0.0,
+        plane_dir: Optional[str] = None,
     ):
         from predictionio_tpu.api.plugins import PluginRegistry
 
@@ -295,8 +296,27 @@ class QueryServerState:
         self.follow_info: Optional[Dict] = None
         self._build_seq = 0           # install-order tickets (see _install)
         self._installed_seq = 0
+        # shared-memory model plane (streaming.plane): when a plane dir
+        # is wired, this worker WATCHES the plane manifest and installs
+        # each published generation as read-only mmap views — model
+        # emit/fold/warm CPU and N× resident copies leave the serving
+        # workers; publishing happens in the dedicated publisher process
+        # (or through plane_reload / the embedded follower's
+        # plane_publish in single-worker topologies)
+        self.plane = None
+        self.plane_watcher = None
+        self.plane_generation = 0
         self._tune_gil_switch()
         self.reload()
+        if plane_dir:
+            from predictionio_tpu.streaming.plane import (
+                ModelPlane, PlaneWatcher,
+            )
+
+            self.plane = ModelPlane(plane_dir)
+            self.plane_watcher = PlaneWatcher(self.plane,
+                                              self._install_plane)
+            self.plane_watcher.start()
         # serving reads user history from the live store per query; the
         # per-entity index otherwise builds on the FIRST query — at a
         # million-event log that is seconds of JSON parsing inline in a
@@ -360,6 +380,71 @@ class QueryServerState:
         threading.Thread(target=run, daemon=True,
                          name="pio-entity-index-warm").start()
 
+    # -- model-plane integration ---------------------------------------------
+
+    def _install_plane(self, models, info: Optional[Dict] = None) -> bool:
+        """PlaneWatcher install hook: the mapped generation goes through
+        the ONE build-ticket install path like every other swap."""
+        info = dict(info or {})
+        gen = int(info.get("planeGeneration") or 0)
+        installed = self._install(models, follow_info=info)
+        if gen:
+            self.plane_generation = gen
+        return installed
+
+    def plane_reload(self):
+        """Plane-mode /reload: load the latest persisted instance ONCE,
+        publish it as a new plane generation, and install it locally —
+        every prefork sibling's watcher converges on the same generation
+        within one poll interval, so a single /reload reaches the WHOLE
+        group (the old behavior reached only the routed worker).
+        Returns ``(plane_generation, instance_id)``."""
+        instance, models = core_workflow.load_latest_models(
+            self.engine_id, self.engine_version, self.engine_variant,
+            self.storage)
+        gen = self.plane.publish(models, {
+            "mode": "reload", "engineInstanceId": instance.id})
+        self.plane_watcher.check_now()
+        # the mapped install carries no instance row; record it here so
+        # freshness reports it and the auto-reload poller sees this
+        # instance as live (it would otherwise republish every tick)
+        self.instance = instance
+        return gen, instance.id
+
+    def plane_publish_initial(self) -> None:
+        """Prefork parent, at deploy: seed the plane with the loaded
+        instance so every worker converges onto ONE mapped copy from the
+        start (each worker's private startup load is transient — it
+        drops as soon as the mapped generation installs).  No-op when a
+        generation already exists (restart onto a live plane)."""
+        if self.plane is None or self.plane.current() is not None:
+            return
+        self.plane_reload()
+
+    def plane_publish(self, models, info: Optional[Dict] = None) -> None:
+        """Embedded-follower publish hook for single-worker plane
+        topologies (``--workers 1`` with PIO_MODEL_PLANE=on, and the
+        in-process parity/test servers): emit to the arena, then install
+        the MAPPED generation locally — the process serves the same
+        shared bytes a sibling would."""
+        from predictionio_tpu.streaming.plane import PlaneUnsupported
+
+        try:
+            self.plane.publish(models, info)
+        except PlaneUnsupported as e:
+            log.warning("model plane cannot carry this bundle (%s); "
+                        "installing in-process", e)
+            self.swap_models(models, info)
+            return
+        self.plane_watcher.check_now()
+
+    def disable_plane(self) -> None:
+        """Degrade to the private-model path (non-UR bundle at deploy)."""
+        if self.plane_watcher is not None:
+            self.plane_watcher.stop()
+        self.plane = None
+        self.plane_watcher = None
+
     def _auto_reload_loop(self, interval: float) -> None:
         while not self._auto_stop.wait(interval):
             try:
@@ -371,6 +456,17 @@ class QueryServerState:
             current = self.instance
             if latest is not None and (
                     current is None or latest.id != current.id):
+                if self.plane is not None:
+                    # plane mode: ONE publish converges the whole group
+                    # (children are spawned without --auto-reload)
+                    try:
+                        gen, iid = self.plane_reload()
+                        log.info("auto-reload: published instance %s as "
+                                 "plane generation %d", iid, gen)
+                    except Exception:
+                        log.exception("auto-reload: plane publish failed; "
+                                      "keeping current generation")
+                    continue
                 try:
                     if self.reload() is not None:
                         log.info("auto-reload: hot-swapped to instance %s",
@@ -391,6 +487,8 @@ class QueryServerState:
         self._auto_stop.set()
         if self.follower is not None:
             self.follower.stop(timeout=2.0)
+        if self.plane_watcher is not None:
+            self.plane_watcher.stop()
 
     def reload(self) -> Optional[str]:
         """Load + install the latest persisted instance.  Returns its id,
@@ -474,6 +572,10 @@ class QueryServerState:
                           if self.swapped_at else None),
             "engineInstanceId": self.instance.id if self.instance else None,
         }
+        if self.plane is not None:
+            # the generation every prefork sibling converges on — equal
+            # across workers means the group serves ONE mapped model
+            doc["planeGeneration"] = self.plane_generation
         if self.follower is not None:
             doc["follower"] = self.follower.status()
         elif self.follow_info is not None:
@@ -542,6 +644,10 @@ class QueryServerState:
             "queryCount": self.query_count,
             "startedAt": self.started.isoformat(),
             "modelGeneration": self.generation,
+            # None = plane off; else this worker's installed plane
+            # generation (the readiness/convergence probe for the group)
+            "planeGeneration": (self.plane_generation
+                                if self.plane is not None else None),
             # freshness is STATE, not a metric: it must stay readable
             # under PIO_METRICS=off, where /stats.json answers 503
             "freshness": self.freshness(),
@@ -604,7 +710,24 @@ def make_handler(state: QueryServerState):
                 doc["freshness"] = state.freshness()
                 self.send_json(doc)
             elif path == "/reload":
+                from predictionio_tpu.streaming.plane import (
+                    PlaneUnsupported,
+                )
+
                 try:
+                    if state.plane is not None:
+                        try:
+                            # plane mode: ONE reload on ANY worker
+                            # publishes a plane generation the whole
+                            # prefork group converges on (watchers
+                            # install within a poll interval)
+                            gen, iid = state.plane_reload()
+                            self.send_json({"reloaded": True,
+                                            "generation": gen,
+                                            "engineInstanceId": iid})
+                            return
+                        except PlaneUnsupported:
+                            pass   # non-UR bundle: private reload below
                     iid = state.reload()
                     live = state.instance.id if state.instance else None
                     self.send_json({"reloaded": iid is not None,
@@ -722,16 +845,69 @@ def deploy(
     if feedback:
         ds_params = getattr(engine_params.data_source_params, "app_name", "")
         feedback_app = ds_params
+    # shared-memory model plane: with a prefork group (or PIO_MODEL_PLANE
+    # =on), each model generation is emitted ONCE into an mmap-able arena
+    # and every worker maps it read-only — resident model bytes N× → ~1×,
+    # one fold per delta, /reload converges the whole group
+    from predictionio_tpu.streaming import plane as plane_mod
+
+    metrics_dir: Optional[str] = None
+    if workers > 1:
+        # the group metrics dir + the parent's worker tag exist BEFORE
+        # the serving state: the plane seeds its per-worker generation/
+        # rss gauges during state construction, and a later tag change
+        # would strand those series under a stale pid-based label
+        import tempfile
+
+        metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
+        obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
+    plane_dir: Optional[str] = None
+    if plane_mod.plane_wanted(workers):
+        plane_dir = plane_mod.resolve_plane_dir(
+            storage or get_storage(), eid, variant)
+        if plane_dir is None:
+            log.warning(
+                "model plane requested but no plane dir is resolvable "
+                "(set PIO_MODEL_PLANE_DIR or use a localfs METADATA "
+                "store); workers serve private model copies")
     state = QueryServerState(
         engine, engine_params, query_class, eid, engine_version, variant,
         storage=storage, feedback=feedback, feedback_app_name=feedback_app,
-        plugins=plugins, auto_reload=auto_reload,
+        plugins=plugins, auto_reload=auto_reload, plane_dir=plane_dir,
     )
-    if follow > 0:
+    if state.plane is not None and not prefork.is_prefork_child():
+        # seed the plane with the loaded instance so the group converges
+        # onto one mapped copy from the start; a bundle the plane cannot
+        # carry (non-UR) degrades the WHOLE deploy to private models —
+        # decided here, before workers/publisher are spawned
+        try:
+            state.plane_publish_initial()
+        except plane_mod.PlaneUnsupported as e:
+            log.warning("model plane disabled for this engine (%s); "
+                        "workers serve private model copies", e)
+            state.disable_plane()
+            plane_dir = None
+        except Exception:
+            # e.g. a read-only shared store: a plane that cannot be
+            # written is useless — degrade to private models (the
+            # pre-plane behavior) instead of failing the deploy
+            log.exception("model plane seed publish failed; disabling "
+                          "the plane — workers serve private model "
+                          "copies")
+            state.disable_plane()
+            plane_dir = None
+    if follow > 0 and plane_dir is not None and workers > 1:
+        # prefork plane group: NO worker folds — a dedicated publisher
+        # process (spawned below, next to the workers) hosts the one
+        # follower and emits each generation into the arena
+        pass
+    elif follow > 0:
         # embedded follow-trainer: tail the event store every SECS and
         # hot-swap the in-process model (no persistence round trip).
-        # Each prefork worker hosts its own follower — they all read the
-        # same store, so the group converges within one interval.
+        # Reached only outside prefork plane groups: a lone worker (with
+        # or without the plane) hosts the one follower itself; plane-off
+        # prefork workers each host their own (the legacy N-fold path —
+        # PIO_MODEL_PLANE=off is the parity oracle).
         from predictionio_tpu.streaming.fold import FoldUnsupported
         from predictionio_tpu.streaming.follow import FollowTrainer
 
@@ -739,7 +915,11 @@ def deploy(
             state.follower = FollowTrainer(
                 engine, engine_params, eid, engine_version, variant,
                 storage=state.storage, interval=follow,
-                on_publish=state.swap_models, persist=False)
+                # single-worker plane topology: the embedded follower IS
+                # the publisher — emit to the arena, serve the mapped copy
+                on_publish=(state.plane_publish if state.plane is not None
+                            else state.swap_models),
+                persist=False)
         except FoldUnsupported as e:
             # e.g. a data source with no app_name: nothing to tail —
             # serve without a follower rather than raising here, which
@@ -757,14 +937,16 @@ def deploy(
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
     bound_port = httpd.server_address[1]
-    metrics_dir: Optional[str] = None
     if workers > 1:
-        import tempfile
-
-        metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
-        obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
         obs_tracing.arm(directory=os.path.join(metrics_dir, "traces"),
                         tag=f"w0-{os.getpid()}")
+        # plane mode: children are pure consumers — no per-worker
+        # follower (ONE fold per delta, in the publisher process below)
+        # and no per-worker auto-reload poller (the parent's poller
+        # publishes through the plane, converging everyone)
+        plane_child_env = (
+            {"PIO_MODEL_PLANE": "on", "PIO_MODEL_PLANE_DIR": plane_dir}
+            if plane_dir is not None else {})
         child_procs = prefork.spawn_workers(
             workers - 1,
             lambda w: (
@@ -775,14 +957,39 @@ def deploy(
                  "--ip", host, "--port", str(bound_port), "--reuse-port"]
                 + (["--engine-id", engine_id] if engine_id else [])
                 + (["--feedback"] if feedback else [])
-                + (["--auto-reload", str(auto_reload)] if auto_reload else [])
-                + (["--follow", str(follow)] if follow else [])
+                + (["--auto-reload", str(auto_reload)]
+                   if auto_reload and plane_dir is None else [])
+                + (["--follow", str(follow)]
+                   if follow and plane_dir is None else [])
             ),
             build_env=lambda w: {
                 "PIO_METRICS_TAG": f"w{w + 1}-{os.getpid()}",
-                "PIO_METRICS_DIR": metrics_dir},
+                "PIO_METRICS_DIR": metrics_dir,
+                **plane_child_env},
             log=log,
         )
+        if plane_dir is not None and follow > 0:
+            # the ONE fold/emit/warm process per node: hosts the only
+            # follower, publishes each generation into the arena, serves
+            # no queries — fold CPU leaves the serving workers entirely.
+            # Its metrics flush into the group dir, so any worker's
+            # /metrics scrape shows the (single) fold counters.
+            child_procs += prefork.spawn_workers(
+                1,
+                lambda w: (
+                    [sys.executable, "-m", "predictionio_tpu.cli.main",
+                     "deploy", "--engine-json", str(engine_json),
+                     "--variant", variant,
+                     "--engine-version", engine_version,
+                     "--follow", str(follow), "--plane-publisher"]
+                    + (["--engine-id", engine_id] if engine_id else [])
+                ),
+                build_env=lambda w: {
+                    "PIO_METRICS_TAG": f"pub-{os.getpid()}",
+                    "PIO_METRICS_DIR": metrics_dir,
+                    "PIO_MODEL_PLANE_DIR": plane_dir},
+                log=log,
+            )
     log.info("Query server for %s listening on %s:%d", eid, host, bound_port)
     httpd.pio_state = state  # handle for tests/tools
     httpd.pio_workers = child_procs
@@ -803,9 +1010,69 @@ def deploy(
     return 0
 
 
+def run_plane_publisher(
+    engine_json: str,
+    variant: str = "default",
+    engine_id: Optional[str] = None,
+    engine_version: str = "1",
+    follow: float = 2.0,
+) -> int:
+    """The model plane's dedicated fold/emit process: hosts the ONE
+    follow-trainer for a prefork group and publishes every generation
+    into the arena (``on_publish`` = :meth:`ModelPlane.publish`) instead
+    of serving queries.  Spawned by ``deploy --workers N --follow`` in
+    plane mode (internal ``--plane-publisher`` flag); dies with the
+    parent like any prefork child."""
+    from predictionio_tpu.streaming.fold import FoldUnsupported
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    plane_dir = os.environ.get("PIO_MODEL_PLANE_DIR")
+    if not plane_dir:
+        print("Error: --plane-publisher requires PIO_MODEL_PLANE_DIR",
+              file=sys.stderr)
+        return 1
+    prefork.maybe_watch_parent(log)
+    obs_metrics.start_worker_flusher()
+    obs_metrics.mark_worker_up()
+    doc = load_engine_variant(engine_json, variant)
+    factory, engine, engine_params = engine_from_variant(doc)
+    eid = resolve_engine_id(engine_id, doc, factory)
+    plane = ModelPlane(plane_dir)
+    try:
+        trainer = FollowTrainer(
+            engine, engine_params, eid, engine_version, variant,
+            interval=follow, on_publish=plane.publish, persist=False)
+    except FoldUnsupported as e:
+        # nothing to tail (no app_name): the workers keep their private
+        # startup models; exiting loudly beats a zombie publisher
+        print(f"Error: plane publisher cannot follow this engine: {e}",
+              file=sys.stderr)
+        return 1
+    log.info("model-plane publisher for %s: folding every %.2fs into %s",
+             eid, trainer.interval, plane_dir)
+    try:
+        trainer.run_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def run_server_from_args(args) -> int:
     from predictionio_tpu.workflow.create_workflow import resolve_variant_path
 
+    if getattr(args, "plane_publisher", False):
+        try:
+            return run_plane_publisher(
+                engine_json=resolve_variant_path(args),
+                variant=args.variant,
+                engine_id=args.engine_id,
+                engine_version=args.engine_version,
+                follow=getattr(args, "follow", 0.0) or 2.0,
+            )
+        except Exception as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
     try:
         result = deploy(
             engine_json=resolve_variant_path(args),

@@ -51,6 +51,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.models.universal_recommender.engine",
     "predictionio_tpu.streaming.follow",
     "predictionio_tpu.streaming.fold",
+    "predictionio_tpu.streaming.plane",
 ]
 
 
@@ -98,6 +99,14 @@ REQUIRED_METRICS = frozenset({
     "pio_store_scan_shard_duration_seconds",
     "pio_store_scan_workers",
     "pio_store_scan_merged_events_per_sec",
+    # shared-memory model plane (PR 14): the bench's memory guard and
+    # the group-convergence probes key on the per-worker generation/rss
+    # gauges; GC visibility on the counter
+    "pio_model_plane_generation",
+    "pio_model_plane_bytes",
+    "pio_model_plane_map_seconds",
+    "pio_model_plane_gc_total",
+    "pio_process_rss_bytes",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

@@ -332,6 +332,162 @@ def hotswap_phase(engine, ep, query_cls, storage, problems) -> None:
               "from-scratch retrain")
 
 
+def plane_phase(engine, ep, query_cls, storage, problems) -> None:
+    """Shared-memory model plane: a publisher server (embedded follower
+    emitting every generation into the arena) and a pure-consumer
+    sibling share one plane dir — the prefork topology minus process
+    isolation (tests/test_model_plane.py covers the real-process
+    drill).  The corpus replays over HTTP against the CONSUMER while
+    generations hot-swap mid-stream (zero 5xx), then: one /reload on
+    the consumer must converge the publisher's server too, and
+    post-drain responses from the mapped model must EXACTLY match a
+    from-scratch retrain — the ``PIO_MODEL_PLANE=off`` in-process
+    oracle the earlier phases established."""
+    import http.client
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from predictionio_tpu.api.http_util import start_server
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow.create_server import (
+        QueryServerState, make_handler,
+    )
+
+    plane_tmp = tempfile.mkdtemp(prefix="pio_parity_plane")
+    os.environ["PIO_MODEL_PLANE_POLL_S"] = "0.05"
+    app = storage.apps.get_by_name("parityapp")
+    pub = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
+                           "default", storage=storage,
+                           plane_dir=plane_tmp)
+    sub = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
+                           "default", storage=storage,
+                           plane_dir=plane_tmp)
+    follower = None
+    httpd = start_server(make_handler(sub), "127.0.0.1", 0,
+                         background=True)
+    port = httpd.server_address[1]
+    bodies = corpus_bodies()
+    errors_5xx: list = []
+    replay_errors: list = []
+    stop = threading.Event()
+
+    def replay_loop():
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            while not stop.is_set():
+                for body in bodies:
+                    conn.request("POST", "/queries.json",
+                                 _json.dumps(body).encode(),
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    if r.status >= 500:
+                        errors_5xx.append((r.status, payload[:200]))
+            conn.close()
+        except Exception as e:
+            replay_errors.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=replay_loop, daemon=True)
+    try:
+        pub.plane_publish_initial()
+        # one /reload on the consumer converges the sibling BEFORE any
+        # folding (a reload publishes the PERSISTED instance — running
+        # it after fresh folds would legitimately supersede them with
+        # the older trained model, exactly as the build-ticket path
+        # does in-process)
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/reload", timeout=20) as r:
+            rel = _json.loads(r.read())
+        gen = int(rel.get("generation") or 0)
+        deadline = _time.time() + 10
+        while _time.time() < deadline and pub.plane_generation < gen:
+            _time.sleep(0.05)
+        if not rel.get("reloaded") or gen < 2 \
+                or pub.plane_generation < gen:
+            problems.append(
+                f"plane: one /reload did not converge the sibling "
+                f"(reload={rel}, sibling gen={pub.plane_generation})")
+        follower = pub.follower = FollowTrainer(
+            engine, ep, "parity-engine", storage=storage, interval=0.05,
+            on_publish=pub.plane_publish, persist=False)
+        follower.start()
+        t.start()
+        for k in range(5):
+            storage.l_events.insert_batch(
+                [Event(event="purchase", entity_type="user",
+                       entity_id=f"planeswapper{k}",
+                       target_entity_type="item",
+                       target_entity_id=f"e{j}") for j in (0, 1, 2)],
+                app.id)
+            _time.sleep(0.12)
+        deadline = _time.time() + 20
+        while _time.time() < deadline and not (
+                follower.last_outcome == "idle"
+                and sub.plane_generation == pub.plane_generation
+                and sub.plane_generation > 0):
+            _time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        if follower is not None:
+            follower.stop()
+    if sub.plane_generation < 2:
+        problems.append(
+            "plane: consumer never converged past the initial "
+            f"generation (gen={sub.plane_generation}, "
+            f"publisher gen={pub.plane_generation})")
+    if errors_5xx:
+        problems.append(
+            f"plane: {len(errors_5xx)} 5xx during mapped-generation "
+            f"swaps (first: {errors_5xx[0]})")
+    if replay_errors:
+        problems.append(
+            f"plane: replay connection died: {replay_errors[0]}")
+    # post-drain exactness: the mapped model == a from-scratch retrain
+    invalidate_staging_cache()
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    ref = engine.train(ep)[0]
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    for qi, body in enumerate(bodies + [{"user": "planeswapper0",
+                                         "num": 6}]):
+        conn.request("POST", "/queries.json", _json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = r.read()
+        if r.status != 200:
+            problems.append(f"plane: post-drain query #{qi} HTTP "
+                            f"{r.status}: {payload[:200]!r}")
+            continue
+        got = canon_http(_json.loads(payload))
+        want = canon(algo.predict(ref, query_cls.from_json(body)))
+        if got != want:
+            problems.append(
+                f"plane: query #{qi} from the mapped model differs from "
+                f"the in-process oracle:\n  got:  {got}\n  want: {want}")
+    conn.close()
+    httpd.shutdown()
+    httpd.server_close()
+    pub.stop_auto_reload()
+    sub.stop_auto_reload()
+    shutil.rmtree(plane_tmp, ignore_errors=True)
+    if not problems:
+        print(f"plane phase: {sub.plane_generation} mapped generations, "
+              "zero 5xx mid-swap, one /reload converged both servers, "
+              "post-drain responses exactly match the in-process oracle")
+
+
 def main() -> int:
     # pin the scorer so both tails consume the IDENTICAL signal array and
     # any diff is attributable to the tail under test
@@ -402,12 +558,18 @@ def main() -> int:
     os.environ["PIO_UR_SERVE_CANDIDATES"] = "off"
     if not problems:
         hotswap_phase(engine, ep, URQuery, get_storage(), problems)
+    # shared-model-plane phase: mapped read-only generations, live
+    # hot-swap through the arena, group-converging /reload — responses
+    # must equal the PIO_MODEL_PLANE=off oracle established above
+    if not problems:
+        plane_phase(engine, ep, URQuery, get_storage(), problems)
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
         print(f"ok: {len(queries)} queries × (6 serving paths + "
               "http serial/pipelined × candidates on/off + live "
-              "hot-swap phase) identical (items, scores, order)")
+              "hot-swap phase + model-plane phase) identical "
+              "(items, scores, order)")
     return 1 if problems else 0
 
 
