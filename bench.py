@@ -2020,6 +2020,120 @@ def _pss_proportional() -> bool:
         shutil.rmtree(os.path.dirname(path), ignore_errors=True)
 
 
+def _plane_write_amp_guard(smoke: bool) -> dict:
+    """ISSUE-15 acceptance, in-process: publish a keyframe, fold
+    freshness-sweep-shaped deltas (new users + a new item — marginals
+    move every LLR score) and a duplicate-only delta, and assert the
+    delta arenas' write amplification: fold delta ≤ 10% of the
+    full-arena bytes, duplicate-only ≤ 5%.  Every composed worker array
+    is additionally diffed bit-exactly against the publisher's model
+    (the same proof the oracle tests run at smaller scale)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.store.columnar import EventBatch
+    from predictionio_tpu.streaming.fold import URFoldState
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    n_items, hist = (2_000, 4) if smoke else (50_000, 4)
+    out: dict = {"plane_write_amp_guard": "not_run"}
+    tmp = tempfile.mkdtemp(prefix="pio_bench_planeamp")
+    # pin the knobs the guard measures: an inherited DELTA=off (the
+    # debug oracle) or a short keyframe interval would read ~100% write
+    # amp and report a false VIOLATION
+    saved_env = {k: os.environ.pop(k, None)
+                 for k in ("PIO_MODEL_PLANE_DELTA",
+                           "PIO_MODEL_PLANE_FULL_EVERY")}
+    os.environ["PIO_MODEL_PLANE_FULL_EVERY"] = "100"
+    try:
+        ap = URAlgorithmParams(app_name="amp", mesh_dp=1,
+                               max_correlators_per_item=8)
+        dp = URDataSourceParams(app_name="amp", event_names=["buy"])
+        evs = [Event(event="buy", entity_type="user",
+                     entity_id=f"u{k // hist}",
+                     target_entity_type="item",
+                     target_entity_id=f"i{k}")
+               for k in range(n_items)]
+        batch = EventBatch.from_events(evs)
+        batch.prop_columns = {}
+        state = URFoldState.bootstrap(ap, dp, batch)
+        pub = ModelPlane(f"{tmp}/plane")
+        worker = ModelPlane(f"{tmp}/plane")
+        model = state.model
+        model.ensure_host_serving_state()
+        pub.publish([model], {"mode": "fold"})
+        worker.load(worker.current())
+        full_bytes = pub.last_publish_stats["written"]
+        out["plane_full_arena_mb"] = round(full_bytes / 1e6, 3)
+
+        def fold_and_publish(events):
+            d = EventBatch.from_events(
+                events, entity_dict=state.batch.entity_dict,
+                target_dict=state.batch.target_dict,
+                event_dict=state.batch.event_dict)
+            d.prop_columns = {}
+            m = state.fold(d)
+            m.ensure_host_serving_state()
+            pub.publish([m], {"mode": "fold"})
+            mapped, _ = worker.load(worker.current())
+            for name in m.indicator_idx:
+                for a, b in ((m.indicator_idx[name],
+                              mapped.indicator_idx[name]),
+                             (m.indicator_llr[name],
+                              mapped.indicator_llr[name]),
+                             *zip(m.host_inverted(name),
+                                  mapped.__dict__["_host_inv"][name])):
+                    assert np.array_equal(a, b), \
+                        f"delta-composed {name} differs from publisher"
+            assert np.array_equal(m.popularity, mapped.popularity)
+            assert np.array_equal(m.host_pop_order(),
+                                  mapped.__dict__["_host_pop_order"])
+            return pub.last_publish_stats
+
+        amps = []
+        for r in range(2):
+            seed = f"i{(r * 97) % n_items}"
+            adds = [Event(event="buy", entity_type="user",
+                          entity_id=f"probe{r}",
+                          target_entity_type="item",
+                          target_entity_id=seed)]
+            for j in range(6):
+                for tgt in (seed, f"fresh_item_{r}"):
+                    adds.append(Event(
+                        event="buy", entity_type="user",
+                        entity_id=f"cob{r}_{j}",
+                        target_entity_type="item", target_entity_id=tgt))
+            st = fold_and_publish(adds)
+            amps.append(st["written"] / max(full_bytes, 1))
+        dup = fold_and_publish(
+            [Event(event="buy", entity_type="user", entity_id="u0",
+                   target_entity_type="item", target_entity_id="i0")])
+        dup_amp = dup["written"] / max(full_bytes, 1)
+        out["plane_write_amp_fold"] = round(max(amps), 4)
+        out["plane_write_amp_duplicate"] = round(dup_amp, 6)
+        if max(amps) <= 0.10 and dup_amp <= 0.05:
+            out["plane_write_amp_guard"] = "ok"
+        else:
+            out["plane_write_amp_guard"] = (
+                f"VIOLATION fold delta wrote {100 * max(amps):.1f}% "
+                f"(gate 10%), duplicate {100 * dup_amp:.2f}% (gate 5%) "
+                "of the full-arena bytes")
+        return out
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _plane_sweep(smoke: bool) -> dict:
     """ISSUE-14 headline proof: the shared-memory model plane under real
     ``pio deploy --workers N`` prefork groups.
@@ -2042,7 +2156,15 @@ def _plane_sweep(smoke: bool) -> dict:
     (``plane_fold_once`` from the cross-worker /metrics merge — the
     per-worker-follower baseline folds it 4×) and converge every
     worker (``plane_follow_propagation_s`` = append → last worker on
-    the folded generation)."""
+    the folded generation).  The cell also records the delta-arena
+    publish profile (``pio_model_plane_publish_bytes_total`` by path)
+    — write bytes per generation, not just propagation.
+
+    Write-amplification guard (in-process, ISSUE-15): a fold-shaped
+    delta generation must publish ≤ 10% of the full-arena byte count
+    and a duplicate-only delta ≤ 5% (``plane_write_amp_guard``), with
+    the delta-composed worker model verified bit-exact against the
+    ``PIO_MODEL_PLANE_DELTA=off`` oracle by the tests/parity script."""
     import contextlib
     import re
     import shutil
@@ -2328,8 +2450,28 @@ def _plane_sweep(smoke: bool) -> dict:
                 "ok" if folds == 1.0 and converged else
                 f"VIOLATION folds={folds} converged={converged} "
                 f"(per-worker followers would fold {wmax}x)")
+            # delta-arena publish profile across the publisher's whole
+            # life (seed keyframe + bootstrap + the fold delta): bytes
+            # actually written (full+delta) vs referenced
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            pub_bytes = {p: 0.0 for p in ("full", "delta", "ref")}
+            for m in re.finditer(
+                    r'pio_model_plane_publish_bytes_total'
+                    r'\{path="([a-z]+)"\} ([0-9.e+]+)', text):
+                pub_bytes[m.group(1)] = pub_bytes.get(
+                    m.group(1), 0.0) + float(m.group(2))
+            out["plane_follow_publish_mb"] = {
+                p: round(v / 1e6, 3) for p, v in pub_bytes.items()}
+            chains = [float(m.group(1)) for m in re.finditer(
+                r'pio_model_plane_chain_len\{[^}]*\} ([0-9.e+]+)',
+                text)]
+            if chains:
+                out["plane_chain_len"] = max(chains)
         finally:
             stop_deploy(base, proc)
+        out.update(_plane_write_amp_guard(smoke))
         return out
     finally:
         set_storage(None)

@@ -789,7 +789,9 @@ def write_batch(path, batch: EventBatch,
             # pad from the current absolute position to the next one
             spec_off = (at - data_base + _ALIGN - 1) // _ALIGN * _ALIGN
             f.write(b"\0" * (data_base + spec_off - at))
-            f.write(arr.tobytes())
+            # contiguous arrays write straight from their buffer — no
+            # tobytes() copy of the whole column
+            f.write(arr.data if arr.flags.c_contiguous else arr.tobytes())
             at = data_base + spec_off + arr.nbytes
         f.flush()
         _os.fsync(f.fileno())
@@ -906,7 +908,10 @@ def write_arrays(path, arrays: Dict[str, np.ndarray],
         for arr in blobs:
             spec_off = (at - data_base + _ALIGN - 1) // _ALIGN * _ALIGN
             f.write(b"\0" * (data_base + spec_off - at))
-            f.write(arr.tobytes())
+            # no tobytes() copy: the model plane writes full keyframe
+            # arenas through here — hundreds of MB at million-item
+            # catalogs — and delta blobs at fold-tick rates
+            f.write(arr.data if arr.flags.c_contiguous else arr.tobytes())
             at = data_base + spec_off + arr.nbytes
         f.flush()
         _os.fsync(f.fileno())

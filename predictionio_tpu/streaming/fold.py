@@ -1482,6 +1482,17 @@ class URFoldState:
         ids into the previous order by the exact host_topk_desc key."""
         if prev is None:
             return
+        # provenance for the model plane's delta publisher: which ids
+        # moved in pop_order and which indicator rows changed per type —
+        # the EXACT arguments of the patch/merge replays below, so the
+        # publisher can ship instructions instead of rewritten arrays
+        # and plane workers replay the same functions bit-exactly
+        # (streaming.plane).  Keyed to ``prev`` by weakref: the stash is
+        # only valid relative to the generation it patched from.
+        import weakref
+
+        prov: Dict[str, object] = {"prev": weakref.ref(prev), "inv": {}}
+        model.__dict__["_plane_prov"] = prov
         remap = snap.remap
         same_catalog = (not remap["primary"]
                         and len(model.item_dict) == len(prev.item_dict))
@@ -1511,6 +1522,7 @@ class URFoldState:
             model.__dict__["_host_pop_order"] = _merge_pop_order(
                 old_order, np.asarray(model.popularity, np.float32),
                 changed)
+            prov["pop_order"] = np.asarray(changed, np.int64)
             _M_EMIT.inc(1, component="pop_order",
                         path="patched" if len(changed) else "carried")
         # -- host_inverted CSR: carry / weight-regather / row-patch ------
@@ -1575,6 +1587,7 @@ class URFoldState:
             model.__dict__.setdefault("_host_inv", {})[name] = \
                 (indptr, rows, w)
             self._inv_cache[name] = {"for_idx": new_idx, "perm": perm}
+            prov["inv"][name] = np.asarray(changed, np.int64)
             _M_EMIT.inc(1, component="inverted", path="patched")
 
     # -- checkpointing --------------------------------------------------------

@@ -11,25 +11,71 @@ store):
 - each model generation is emitted exactly ONCE — by the single
   plane-publisher process (``pio deploy --plane-publisher``, spawned next
   to the prefork group when ``--follow`` is on) or by whichever worker
-  handles a ``/reload`` — into an mmap-able **arena** file under the
-  storage dir (:func:`store.columnar.write_arrays`: magic + JSON manifest
-  + 64-aligned blobs; two-phase tmp+fsync+rename under a flock'd
-  generation ticket, the same crash-safety discipline as snapshots).  The
-  arena includes the *derived* serving state (host_inverted CSR,
-  host_pop_order, user_seen CSRs) so workers never rebuild it;
+  handles a ``/reload`` — into the plane's **blob store** under the
+  storage dir (:func:`store.columnar.write_arrays` containers: magic +
+  JSON manifest + 64-aligned blobs; two-phase tmp+fsync+rename under a
+  flock'd generation ticket, the same crash-safety discipline as
+  snapshots).  The arena includes the *derived* serving state
+  (host_inverted CSR, host_pop_order, user_seen CSRs) so workers never
+  rebuild it;
 - prefork workers watch the plane's ``CURRENT.json`` manifest
-  (:class:`PlaneWatcher`), map the new generation's arrays READ-ONLY
-  (``mmap`` + ``np.frombuffer`` — all workers share page cache, so
-  resident model bytes go N× → ~1×), reconstruct thin :class:`URModel`
-  wrappers around the views, and install through the query server's
-  build-ticket ``_install`` path.  The old generation unmaps once
-  in-flight queries drain (the arrays' refcounts ARE the drain barrier);
-- stale arena files are GC'd by the publisher (``PIO_MODEL_PLANE_KEEP``
-  newest generations retained; a mapped-but-unlinked arena stays valid —
-  POSIX keeps the pages — so GC can never corrupt a serving worker);
-- a torn arena (publisher SIGKILL'd mid-emit) fails validation on map,
-  is quarantined (``*.quarantine``), and workers keep serving the old
-  generation until the publisher re-emits.
+  (:class:`PlaneWatcher` — an inotify wake on Linux, a cheap stat poll
+  elsewhere), map the new generation's arrays READ-ONLY (``mmap`` +
+  ``np.frombuffer`` — all workers share page cache, so resident model
+  bytes go N× → ~1×), reconstruct thin :class:`URModel` wrappers around
+  the views, and install through the query server's build-ticket
+  ``_install`` path.  The old generation unmaps once in-flight queries
+  drain (the arrays' refcounts ARE the drain barrier);
+- stale blob files are GC'd by the publisher with **chain refcounting**:
+  the newest ``PIO_MODEL_PLANE_KEEP`` generations are retained together
+  with every older generation file their delta chains still reference
+  (back to each kept generation's keyframe) — GC can never unlink a blob
+  a kept manifest needs, and a mapped-but-unlinked blob stays valid
+  (POSIX keeps the pages) so GC can never corrupt a serving worker;
+- a torn blob (publisher SIGKILL'd mid-emit, disk corruption) fails
+  validation on map, the FAILING file is quarantined
+  (``*.quarantine``), and workers keep serving the old generation; the
+  publisher notices the broken chain at its next publish and heals it
+  with a full keyframe.
+
+**Delta arenas** (``PIO_MODEL_PLANE_DELTA``, default on): instead of
+rewriting the whole arena every generation — O(model) write I/O at
+fold-tick rates — ``publish`` emits a small ``gen-N.delta`` container
+holding ONLY the bytes that changed, plus a per-array manifest in its
+header.  Per array the publisher picks the cheapest faithful encoding:
+
+- ``ref`` — unchanged (same object, the fold engine's carried
+  components; or bytes-equal): no bytes written, the worker carries its
+  previous generation's array (which is, inductively, the original
+  mmap view — page sharing survives refs);
+- ``ext`` — pure END growth (the new array is byte-prefix-proven
+  against the previous): only the suffix is written.  Dictionary
+  blob/offs pairs ride this together with the existing
+  ``prevCrc``/``prevN`` machinery, so workers extend their cached
+  ``IdDict`` in O(new strings) without touching the covered prefix;
+- ``patch`` — sparsely changed (a few elements moved, e.g. popularity
+  counts, indicator idx rows): changed flat positions + values;
+- ``nz`` — the indicator LLR case: every *finite* cell's score moves
+  each fold (Dunning G² couples all cells through N) while the -inf
+  padding never does, so the blob is just the values at cells where the
+  (already-composed) idx table is valid — the true changed-bytes floor,
+  ≈ nnz·4 bytes instead of I_p·K·4;
+- ``inv`` / ``pop_order`` — replay instructions: the fold engine
+  PATCHED these (``_patch_inverted_csr`` splice / ``_merge_pop_order``)
+  and a byte-diff would see ~100% change because positions shift, but
+  the patch ARGUMENTS (changed row/id sets — the emit-snapshot
+  provenance ``fold._carry_serving_state`` records on the model) are
+  O(delta).  The worker replays the SAME functions against its previous
+  composed generation, which is bit-exact by induction;
+- ``full`` — genuinely rebuilt arrays, written whole.
+
+A worker composes a delta generation against the one it already serves
+(or, cold, walks the chain back to the last keyframe — bounded by
+``PIO_MODEL_PLANE_FULL_EVERY``, which forces a periodic full-arena
+keyframe).  Composed (non-ref) arrays are worker-private copies until
+the next keyframe re-shares everything via the page cache; refs stay
+mapped views throughout.  ``PIO_MODEL_PLANE_DELTA=off`` keeps the
+full-arena-per-generation writer as the bit-exact parity oracle.
 
 ``PIO_MODEL_PLANE=off`` keeps the per-worker in-process path as the
 parity oracle; ``on`` forces the plane even at ``--workers 1`` (the
@@ -44,6 +90,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import select
 import threading
 import time
 import zlib
@@ -72,21 +119,42 @@ _M_GEN = _REG.gauge(
     "— all series equal means the prefork group has converged")
 _M_BYTES = _REG.gauge(
     "pio_model_plane_bytes",
-    "On-disk bytes of the model-plane arena this worker last mapped "
-    "(or, for the publisher, last emitted), one {worker} series per "
-    "process — ≈ the ONE per-node resident model cost: model tables + "
-    "derived CSRs, shared by every mapping worker via page cache")
+    "On-disk bytes of the model-plane generation file this worker last "
+    "mapped (or, for the publisher, last emitted), one {worker} series "
+    "per process — a full keyframe arena ≈ the per-node resident model "
+    "cost; a delta generation is just that generation's changed bytes")
 _M_MAP_S = _REG.gauge(
     "pio_model_plane_map_seconds",
-    "Wall seconds this worker spent mapping + installing its last plane "
-    "generation (mmap + wrapper reconstruction + serving-bundle warm), "
-    "one {worker} series — the per-worker cost that replaced a full "
-    "fold + derived-state rebuild")
+    "Wall seconds this worker spent mapping/composing + installing its "
+    "last plane generation (mmap + delta compose + wrapper "
+    "reconstruction + serving-bundle warm), one {worker} series — the "
+    "per-worker cost that replaced a full fold + derived-state rebuild")
 _M_GC = _REG.counter(
     "pio_model_plane_gc_total",
-    "Stale model-plane arena files unlinked by the publisher's GC "
-    "(generations older than PIO_MODEL_PLANE_KEEP, quarantined torn "
-    "arenas past the keep window, and abandoned tmp files)")
+    "Stale model-plane blob files unlinked by the publisher's GC "
+    "(generations older than every kept generation's delta chain, "
+    "quarantined torn blobs past the keep window, and abandoned tmp "
+    "files)")
+_M_PUB_BYTES = _REG.counter(
+    "pio_model_plane_publish_bytes_total",
+    "Logical model bytes per publish by path: full (written as whole "
+    "blobs — keyframes and rebuilt arrays), delta (bytes actually "
+    "written by delta encodings: ext suffixes, patch/nz values, replay "
+    "instructions), ref (bytes NOT written — referenced, extended-over, "
+    "patched-over or replay-derived).  (full+delta)/(full+delta+ref) is "
+    "the publish write amplification; delta-scaled folds should keep it "
+    "near the changed-bytes fraction, not 1.0")
+_M_BLOBS = _REG.gauge(
+    "pio_model_plane_blob_count",
+    "Generation blob files currently retained in the plane directory "
+    "(kept window + the delta-chain files it still references), one "
+    "{worker} series set by the publisher after each publish+GC")
+_M_CHAIN = _REG.gauge(
+    "pio_model_plane_chain_len",
+    "Delta generations between the newest published generation and its "
+    "keyframe (0 = the newest generation IS a full keyframe arena) — "
+    "the compose depth a cold worker pays, bounded by "
+    "PIO_MODEL_PLANE_FULL_EVERY, one {worker} series")
 
 _CURRENT = "CURRENT.json"
 _LOCK = "plane.lock"
@@ -95,6 +163,16 @@ _LOCK = "plane.lock"
 class PlaneUnsupported(RuntimeError):
     """The model bundle cannot ride the plane (not exactly one URModel);
     callers degrade to the private in-process path."""
+
+
+class _PlaneCorrupt(ValueError):
+    """Deterministic content corruption in one plane file; ``fname`` is
+    the file that failed (quarantine THAT one — a delta generation can
+    fail because a file earlier in its chain is torn)."""
+
+    def __init__(self, fname: str, msg: str):
+        super().__init__(msg)
+        self.fname = fname
 
 
 def plane_mode() -> str:
@@ -117,8 +195,10 @@ def plane_wanted(workers: int) -> bool:
 
 def plane_poll_s() -> float:
     """PIO_MODEL_PLANE_POLL_S: seconds between a worker's manifest polls
-    (default 0.2 — the swap-propagation latency bound; the poll is one
-    small-file read)."""
+    (default 0.2).  With the inotify fast path this is only the fallback
+    heartbeat — swap propagation wakes on the manifest rename itself;
+    without inotify the watcher stat-polls the manifest at this cadence
+    (one cheap os.stat; the manifest is opened/parsed only on change)."""
     try:
         return max(
             float(os.environ.get("PIO_MODEL_PLANE_POLL_S", "0.2")), 0.02)
@@ -127,13 +207,38 @@ def plane_poll_s() -> float:
 
 
 def plane_keep() -> int:
-    """PIO_MODEL_PLANE_KEEP: newest arena generations the publisher's GC
-    retains on disk (default 3 — current + drain margin; a worker still
-    mapping an unlinked arena keeps serving it, POSIX keeps the pages)."""
+    """PIO_MODEL_PLANE_KEEP: newest generations the publisher's GC
+    retains on disk (default 3 — current + drain margin; each kept
+    delta generation also pins its chain back to its keyframe; a worker
+    still mapping an unlinked blob keeps serving it, POSIX keeps the
+    pages)."""
     try:
         return max(int(os.environ.get("PIO_MODEL_PLANE_KEEP", "3")), 1)
     except ValueError:
         return 3
+
+
+def plane_delta_enabled() -> bool:
+    """``PIO_MODEL_PLANE_DELTA=off`` restores the full-arena-per-
+    generation writer (the bit-exact parity oracle; also the most
+    page-cache-shared steady state).  Default on: publish O(changed
+    bytes) per generation, keyframe every PIO_MODEL_PLANE_FULL_EVERY."""
+    return os.environ.get("PIO_MODEL_PLANE_DELTA", "").lower() not in (
+        "off", "0", "false")
+
+
+def plane_full_every() -> int:
+    """PIO_MODEL_PLANE_FULL_EVERY: force a full keyframe arena every N
+    generations (default 16).  Bounds the delta chain a cold/restarted
+    worker composes AND the interval over which composed (non-ref)
+    arrays live as worker-private copies before the keyframe re-shares
+    them via the page cache.  1 = every generation is a keyframe
+    (equivalent to PIO_MODEL_PLANE_DELTA=off)."""
+    try:
+        return max(int(os.environ.get("PIO_MODEL_PLANE_FULL_EVERY",
+                                      "16")), 1)
+    except ValueError:
+        return 16
 
 
 def resolve_plane_dir(storage, engine_id: str,
@@ -168,16 +273,20 @@ class _LazyProps(Mapping):
 
     __slots__ = ("_raw", "_doc")
 
-    def __init__(self, raw: Optional[np.ndarray]):
+    def __init__(self, raw):
+        # raw: an ndarray, or a zero-arg thunk returning one (delta
+        # compose is lazy for the props blob — an unparsed carried blob
+        # never materializes)
         self._raw = raw
         self._doc: Optional[dict] = None
 
     def _load(self) -> dict:
         if self._doc is None:
-            if self._raw is None or len(self._raw) == 0:
+            raw = self._raw() if callable(self._raw) else self._raw
+            if raw is None or len(raw) == 0:
                 self._doc = {}
             else:
-                self._doc = json.loads(bytes(self._raw))
+                self._doc = json.loads(bytes(raw))
             self._raw = None   # the parsed dict owns the data now
         return self._doc
 
@@ -198,10 +307,90 @@ def _json_info(info: Optional[Dict]) -> Dict:
             if isinstance(v, (str, int, float, bool, type(None)))}
 
 
+def _flat_u8(arr: np.ndarray) -> np.ndarray:
+    """The array's bytes as a flat uint8 view (C-contiguous input)."""
+    return arr.reshape(-1).view(np.uint8)
+
+
+def _freeze(arr: np.ndarray) -> np.ndarray:
+    """Mark a composed array read-only — the same contract as the mmap
+    views: no worker can mutate model state another query is reading."""
+    if arr.flags.writeable:
+        arr.flags.writeable = False
+    return arr
+
+
+class _ComposedGen(Mapping):
+    """One composed generation: array name → ndarray, some entries lazy
+    (dictionary blobs/offsets and the props JSON are only touched when
+    the worker's caches miss).  Lazy entries are self-contained
+    ``(dtype, shape, [byte parts])`` descriptors — raw mmap views, never
+    references to previous :class:`_ComposedGen` objects, so a delta
+    chain does NOT retain every intermediate composed generation in
+    memory.  ``suffix_of`` exposes this generation's ``ext`` suffix so
+    the dictionary extension path can decode only the tail without ever
+    composing (or touching) the covered prefix."""
+
+    __slots__ = ("_arrays", "_parts", "_suffixes")
+
+    def __init__(self):
+        self._arrays: Dict[str, np.ndarray] = {}
+        # name -> (dtype str, shape tuple, [flat uint8 parts])
+        self._parts: Dict[str, Tuple[str, Tuple[int, ...],
+                                     List[np.ndarray]]] = {}
+        # name -> (this generation's suffix bytes, prefix nbytes)
+        self._suffixes: Dict[str, Tuple[np.ndarray, int]] = {}
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        arr = self._arrays.get(name)
+        if arr is None:
+            dt, shape, parts = self._parts.pop(name)
+            flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            arr = _freeze(flat.view(np.dtype(dt)).reshape(shape))
+            self._arrays[name] = arr
+        return arr
+
+    def parts_of(self, name: str):
+        """The byte-parts descriptor (materialized arrays count as one
+        part) — how the next generation chains onto this one without
+        forcing a concat."""
+        got = self._parts.get(name)
+        if got is not None:
+            return got
+        arr = self._arrays[name]
+        return (arr.dtype.str, tuple(arr.shape), [_flat_u8(
+            np.ascontiguousarray(arr))])
+
+    def get(self, name: str, default=None):
+        if name in self._arrays or name in self._parts:
+            return self[name]
+        return default
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._arrays or name in self._parts
+
+    def __iter__(self):
+        yield from self._arrays
+        for n in self._parts:
+            if n not in self._arrays:
+                yield n
+
+    def __len__(self):
+        return len(set(self._arrays) | set(self._parts))
+
+    def suffix_of(self, name: str) -> Optional[Tuple[np.ndarray, int]]:
+        return self._suffixes.get(name)
+
+
+# names whose compose stays lazy (worker caches usually skip them)
+def _lazy_name(name: str) -> bool:
+    return name.startswith("dict_") or name == "props_json"
+
+
 class ModelPlane:
-    """One plane directory: arena emit (publisher side) + arena map
-    (worker side).  Both sides are safe to host in one process (the
-    ``--workers 1`` / in-process-test topology): the caches are
+    """One plane directory: generation emit (publisher side) + map/
+    compose (worker side).  Both sides are safe to host in one process
+    (the ``--workers 1`` / in-process-test topology): the caches are
     per-instance and the publish ticket is a cross-process flock."""
 
     def __init__(self, directory: str):
@@ -211,16 +400,30 @@ class ModelPlane:
         # property maps by object across generations, so steady-state
         # publishes re-encode nothing
         self._pub_dicts: Dict[str, Dict[str, Any]] = {}
-        self._pub_props: Optional[Tuple[Any, bytes, int]] = None
+        self._pub_props: Optional[Tuple[Any, np.ndarray, int]] = None
+        # publisher-side delta state: the last generation THIS instance
+        # published — payload arrays (for identity/bytes diffing), the
+        # model object (provenance validity), and the chain files from
+        # its keyframe (existence-checked before every delta publish so
+        # a quarantined/missing chain heals with a keyframe)
+        self._pub_prev: Optional[Dict[str, Any]] = None
+        self._gc_keyframes: Dict[int, int] = {}   # gen -> its keyframe
         # worker-side caches: reconstructed IdDicts keyed by content crc
         # (carried when unchanged, extended when the publisher proves the
-        # previous blob is a byte-prefix), plus the previous generation's
-        # model for derived-prop-index carry
+        # previous blob is a byte-prefix), the previous generation's
+        # model for derived-prop-index carry, and the composed-array
+        # state the delta chain patches forward
         self._dict_cache: Dict[str, Tuple[int, IdDict]] = {}
         self._prev_model = None
         self._prev_meta: Optional[Dict] = None
+        self._composed: Optional[_ComposedGen] = None
+        self._composed_gen = 0
+        # per event type: {"for_idx": <idx the perm matches>, "perm": …}
+        self._inv_perms: Dict[int, Dict[str, Any]] = {}
+        self._mapped: Dict[str, Tuple[Dict[str, np.ndarray], Dict]] = {}
         self.dicts_extended = 0   # test observability
         self.dicts_rebuilt = 0
+        self.last_publish_stats: Dict[str, int] = {}
 
     # -- manifest ------------------------------------------------------------
 
@@ -256,9 +459,16 @@ class ModelPlane:
     # -- publisher side ------------------------------------------------------
 
     def publish(self, models, info: Optional[Dict] = None) -> int:
-        """Emit one model generation into the arena; returns the plane
-        generation.  Exactly the ``FollowTrainer.on_publish`` signature,
-        so the plane publisher wires in as the follower's publish hook.
+        """Emit one model generation into the blob store; returns the
+        plane generation.  Exactly the ``FollowTrainer.on_publish``
+        signature, so the plane publisher wires in as the follower's
+        publish hook.
+
+        With delta arenas on, a generation whose predecessor THIS
+        instance published (and whose chain files are intact, and whose
+        keyframe interval hasn't lapsed) writes only its changed bytes;
+        everything else — first publish, another process published in
+        between, broken chain, keyframe due — writes a full arena.
 
         Raises :class:`PlaneUnsupported` for non-UR bundles and lets
         OSError/ValueError propagate — the follower's publish-retry
@@ -278,28 +488,222 @@ class ModelPlane:
         model.ensure_host_serving_state()
         arrays, meta = self._model_payload(model)
         meta["info"] = _json_info(info)
+        logical = sum(int(np.asarray(a).nbytes) for a in arrays.values())
+        # a restage/retrain publish rebuilt the whole model: the diff
+        # would write a mostly-full delta AND lengthen the chain —
+        # publish it as a keyframe instead (resets the chain for free)
+        rebuilt = (info or {}).get("mode") in ("restage", "retrain")
         with self._publish_lock():
             cur = self.current()
             gen = int(cur["generation"]) + 1 if cur else 1
+            prev = self._pub_prev
+            delta = None
+            if (plane_delta_enabled() and not rebuilt
+                    and prev is not None and cur is not None
+                    and int(cur["generation"]) == prev["gen"]
+                    and gen - prev["keyframe_gen"] < plane_full_every()
+                    and self._chain_intact(prev)):
+                delta = self._encode_delta(arrays, model, prev)
             meta["generation"] = gen
-            fname = f"gen-{gen:010d}.arena"
+            if delta is not None:
+                entries, blobs, stats = delta
+                meta["planeKind"] = "delta"
+                meta["prevGeneration"] = prev["gen"]
+                meta["prevFile"] = prev["file"]
+                meta["manifest"] = entries
+                keyframe_gen = prev["keyframe_gen"]
+                meta["keyframeGeneration"] = keyframe_gen
+                fname = f"gen-{gen:010d}.delta"
+                payload = blobs
+                chain = prev["chain"] + [fname]
+            else:
+                meta["planeKind"] = "full"
+                meta["keyframeGeneration"] = keyframe_gen = gen
+                stats = {"full": logical, "delta": 0, "ref": 0}
+                fname = f"gen-{gen:010d}.arena"
+                payload = arrays
+                chain = [fname]
             path = os.path.join(self.dir, fname)
             tmp = os.path.join(self.dir, f".{fname}.tmp-{os.getpid()}")
-            write_arrays(tmp, arrays, meta)          # flush+fsync inside
+            write_arrays(tmp, payload, meta)         # flush+fsync inside
             os.replace(tmp, path)
             size = os.path.getsize(path)
             self._write_manifest({
                 "version": 1, "generation": gen, "file": fname,
-                "bytes": size, "publisherPid": os.getpid(),
+                "kind": meta["planeKind"], "bytes": size,
+                "logicalBytes": logical,
+                "keyframeGeneration": keyframe_gen,
+                "publisherPid": os.getpid(),
                 "publishedAt": time.time(),
             })
-            self._gc(gen)
+            self._gc_keyframes[gen] = keyframe_gen
+            kept = self._gc(gen)
+        self._pub_prev = {
+            "gen": gen, "file": fname, "keyframe_gen": keyframe_gen,
+            "chain": chain, "arrays": dict(arrays), "model": model,
+        }
+        self.last_publish_stats = dict(
+            stats, written=stats["full"] + stats["delta"], file=size,
+            logical=logical)
         tag = _obs_metrics.worker_tag()
+        for p in ("full", "delta", "ref"):
+            if stats.get(p):
+                _M_PUB_BYTES.inc(int(stats[p]), path=p)
         _M_GEN.set(gen, worker=tag)
         _M_BYTES.set(size, worker=tag)
-        log.info("model plane: published generation %d (%s, %.1f MB)",
-                 gen, fname, size / 1e6)
+        _M_CHAIN.set(gen - keyframe_gen, worker=tag)
+        if kept is not None:
+            _M_BLOBS.set(kept, worker=tag)
+        log.info(
+            "model plane: published generation %d (%s, %.1f MB on disk, "
+            "%.1f MB logical; full/delta/ref %.1f/%.2f/%.1f MB)",
+            gen, fname, size / 1e6, logical / 1e6,
+            stats["full"] / 1e6, stats["delta"] / 1e6, stats["ref"] / 1e6)
         return gen
+
+    def _chain_intact(self, prev: Dict[str, Any]) -> bool:
+        """Every file of the previous generation's delta chain still
+        present?  A worker may have quarantined a torn file (or an
+        operator removed one): delta-publishing on top would strand the
+        whole group on the old generation forever — heal with a
+        keyframe instead."""
+        for fname in prev["chain"]:
+            if not os.path.exists(os.path.join(self.dir, fname)):
+                log.warning("model plane: chain file %s missing — "
+                            "publishing a full keyframe to heal", fname)
+                return False
+        return True
+
+    def _encode_delta(self, arrays: Dict[str, np.ndarray], model,
+                      prev: Dict[str, Any]):
+        """(manifest entries, blob dict, byte stats) for one delta
+        generation, or None when nothing encodes smaller than a
+        keyframe (shape regressions etc. — callers fall back)."""
+        prev_arrays: Dict[str, np.ndarray] = prev["arrays"]
+        if set(arrays) != set(prev_arrays):
+            return None     # schema changed (event types appeared/went)
+        prov = model.__dict__.get("_plane_prov")
+        prov_ok = bool(prov) and prov["prev"]() is prev["model"]
+        names = list(model.indicator_idx)
+        entries: Dict[str, Dict] = {}
+        blobs: Dict[str, np.ndarray] = {}
+        stats = {"full": 0, "delta": 0, "ref": 0}
+
+        def put_blob(key: str, arr: np.ndarray) -> None:
+            blobs[key] = arr
+            stats["delta"] += int(arr.nbytes)
+
+        # 1) replay instructions from the fold's emit provenance: the
+        #    inverted CSR trios and pop_order byte-shift wholesale under
+        #    a patch (positions move), but the patch ARGUMENTS are tiny
+        if prov_ok:
+            for i, name in enumerate(names):
+                trio = [f"inv_{i}_indptr", f"inv_{i}_rows", f"inv_{i}_w"]
+                changed = prov["inv"].get(name)
+                if changed is None or any(t not in arrays for t in trio):
+                    continue
+                if all(arrays[t] is prev_arrays[t] for t in trio):
+                    continue        # carried by object: plain refs below
+                key = f"instr_inv_{i}"
+                put_blob(key, np.asarray(changed, np.int64))
+                for t in trio:
+                    entries[t] = {"k": "inv", "type": i, "changed": key}
+                    stats["ref"] += int(arrays[t].nbytes)
+            po = prov.get("pop_order")
+            if po is not None and "pop_order" in arrays \
+                    and arrays["pop_order"] is not prev_arrays["pop_order"]:
+                put_blob("instr_pop_order", np.asarray(po, np.int64))
+                entries["pop_order"] = {"k": "pop_order",
+                                        "changed": "instr_pop_order"}
+                stats["ref"] += int(arrays["pop_order"].nbytes)
+        # 2) everything else: generic byte-level delta detection
+        for name, arr in arrays.items():
+            if name in entries:
+                continue
+            arr = np.ascontiguousarray(arr)
+            old = prev_arrays.get(name)
+            entries[name] = self._encode_array(
+                name, arr, None if old is None
+                else np.ascontiguousarray(old),
+                arrays.get(name.replace("_llr", "_idx"))
+                if name.endswith("_llr") else None,
+                put_blob, stats,
+                identical=arrays[name] is prev_arrays.get(name))
+        return entries, blobs, stats
+
+    def _encode_array(self, name: str, arr: np.ndarray,
+                      old: Optional[np.ndarray], mask: Optional[np.ndarray],
+                      put_blob, stats, identical: bool) -> Dict:
+        nb = int(arr.nbytes)
+        if old is not None and old.dtype == arr.dtype \
+                and old.shape[1:] == arr.shape[1:]:
+            if identical:
+                stats["ref"] += nb
+                return {"k": "ref"}
+            a8, o8 = _flat_u8(arr), _flat_u8(old)
+            prefix_eq = False
+            if a8.size >= o8.size:
+                # ONE prefix scan decides both ref (equal sizes) and
+                # ext, with a 4 KB quick reject so the common
+                # changed-everywhere arrays (LLR tables) skip the full
+                # O(nbytes) pass entirely
+                head = min(int(o8.size), 4096)
+                prefix_eq = bool(
+                    np.array_equal(a8[:head], o8[:head])
+                    and np.array_equal(a8[:o8.size], o8))
+            if prefix_eq and a8.size == o8.size:
+                stats["ref"] += nb
+                return {"k": "ref"}
+            if prefix_eq:
+                put_blob(f"{name}", a8[o8.size:].copy())
+                stats["ref"] += int(o8.size)
+                return {"k": "ext", "suffix": name,
+                        "pre": int(o8.size), "shape": list(arr.shape)}
+            # nz: values at the finite cells of the (same-shaped) idx
+            # table; everything the mask calls invalid is one pad value.
+            # Self-contained (no prev needed): the changed-bytes floor
+            # for the LLR tables, whose every finite score moves per
+            # fold while the padding never does
+            if mask is not None and mask.shape == arr.shape:
+                invalid = np.ascontiguousarray(mask) < 0
+                pad_vals = arr[invalid]
+                if len(pad_vals):
+                    pad = pad_vals.ravel()[0]
+                    if np.all(pad_vals == pad):
+                        vals = arr[~invalid]
+                        if vals.nbytes + 64 < nb:
+                            put_blob(f"{name}", vals.copy())
+                            stats["ref"] += nb - int(vals.nbytes)
+                            return {"k": "nz",
+                                    "mask": name.replace("_llr", "_idx"),
+                                    "pad": float(pad),
+                                    "shape": list(arr.shape)}
+            # sparse element patch (covers growth: every element past
+            # the old length counts as changed; a shrunk array cannot
+            # patch — fall through to a full blob)
+            if a8.size >= o8.size:
+                it = arr.dtype.itemsize
+                n_old = o8.size // it
+                flat_a = arr.reshape(-1)
+                diff = np.flatnonzero(
+                    (a8[:o8.size].reshape(-1, it)
+                     != o8.reshape(-1, it)).any(axis=1))
+                n_new = flat_a.shape[0]
+                tail = np.arange(n_old, n_new, dtype=np.int64)
+                idx = (np.concatenate([diff.astype(np.int64), tail])
+                       if len(tail) else diff.astype(np.int64))
+                patch_bytes = int(idx.nbytes + idx.shape[0] * it)
+                if patch_bytes + 64 < nb // 2:
+                    put_blob(f"{name}.pidx", idx)
+                    put_blob(f"{name}.pval", flat_a[idx].copy())
+                    stats["ref"] += nb - patch_bytes
+                    return {"k": "patch", "idx": f"{name}.pidx",
+                            "vals": f"{name}.pval",
+                            "shape": list(arr.shape)}
+        put_blob(name, arr)
+        stats["delta"] -= nb        # full blobs count as full, not delta
+        stats["full"] += nb
+        return {"k": "full", "key": name}
 
     def _write_manifest(self, doc: Dict) -> None:
         tmp = self.current_path + f".tmp-{os.getpid()}"
@@ -309,18 +713,58 @@ class ModelPlane:
             os.fsync(f.fileno())
         os.replace(tmp, self.current_path)
 
-    def _gc(self, newest_gen: int) -> None:
-        """Unlink arenas older than the keep window (plus quarantined
-        torn arenas past it and abandoned tmp files).  A worker still
-        mapping an unlinked arena is unaffected — the mapping holds the
-        pages until the worker's old generation drains."""
+    def _file_keyframe(self, name: str) -> Optional[int]:
+        """A generation file's keyframeGeneration, reading only the JSON
+        header (no blob mapping); None when unreadable."""
+        try:
+            with open(os.path.join(self.dir, name), "rb") as f:
+                head = f.read(16)
+                if len(head) < 16:
+                    return None
+                hlen = int.from_bytes(head[8:16], "little")
+                if hlen > 64 << 20:
+                    return None
+                meta = json.loads(f.read(hlen)).get("meta", {})
+        except (OSError, ValueError):
+            return None
+        kf = meta.get("keyframeGeneration")
+        if kf is not None:
+            return int(kf)
+        g = _gen_of(name)
+        return g if name.endswith(".arena") else None
+
+    def _gc(self, newest_gen: int) -> Optional[int]:
+        """Unlink generation files no kept generation's delta chain can
+        reference.  The kept window is the newest ``PIO_MODEL_PLANE_KEEP``
+        generations; each pins every file back to ITS keyframe (chains
+        are contiguous generation runs and never cross a keyframe), so
+        the reclaim floor is the minimum keyframe over the window —
+        refcounting by construction: a blob referenced by any kept
+        manifest is ≥ the floor and survives.  Also reclaims quarantined
+        files past the floor and abandoned tmp files.  Returns the
+        retained generation-file count (for the blob_count gauge)."""
         keep_min = newest_gen - plane_keep() + 1
         try:
             names = os.listdir(self.dir)
         except OSError:
-            return
+            return None
+        floor = keep_min
+        for g in range(keep_min, newest_gen + 1):
+            kf = self._gc_keyframes.get(g)
+            if kf is None:
+                # published before this process started: read its header
+                for nm in (f"gen-{g:010d}.delta", f"gen-{g:010d}.arena"):
+                    if os.path.exists(os.path.join(self.dir, nm)):
+                        kf = self._file_keyframe(nm)
+                        break
+                self._gc_keyframes[g] = kf if kf is not None else g
+                kf = self._gc_keyframes[g]
+            floor = min(floor, kf)
+        for g in [g for g in self._gc_keyframes if g < floor]:
+            del self._gc_keyframes[g]
         now = time.time()
         removed = 0
+        kept = 0
         for name in names:
             path = os.path.join(self.dir, name)
             if ".tmp-" in name:
@@ -334,20 +778,20 @@ class ModelPlane:
                 except OSError:
                     pass
                 continue
-            if not name.startswith("gen-"):
+            gen = _gen_of(name)
+            if gen is None:
                 continue
-            try:
-                gen = int(name[4:14])
-            except ValueError:
-                continue
-            if gen < keep_min:
+            if gen < floor:
                 try:
                     os.unlink(path)
                     removed += 1
                 except OSError:
                     pass
+            elif not name.endswith(".quarantine"):
+                kept += 1
         if removed:
             _M_GC.inc(removed)
+        return kept
 
     def _model_payload(self, model) -> Tuple[Dict[str, np.ndarray], Dict]:
         names: List[str] = list(model.indicator_idx)
@@ -388,8 +832,8 @@ class ModelPlane:
             else:
                 meta["dicts"][f"ev_{i}"] = self._encode_dict(
                     f"ev_{i}", d, arrays)
-        blob, crc = self._encode_props(model.item_properties)
-        arrays["props_json"] = np.frombuffer(blob, np.uint8)
+        arrays["props_json"], crc = self._encode_props(
+            model.item_properties)
         meta["propsCrc"] = crc
         return arrays, meta
 
@@ -397,12 +841,13 @@ class ModelPlane:
                      arrays: Dict[str, np.ndarray]) -> Dict:
         """Dictionary → flat utf-8 blob + int64 offsets.  The blob is
         cached by dictionary OBJECT (the fold engine carries unchanged
-        dicts by object), and a changed dictionary whose previous blob
-        is a byte-prefix records ``prevCrc``/``prevN`` so workers
-        holding the previous dictionary extend it in O(new strings)
-        instead of rebuilding — pure END growth of the catalog (the
-        fold engine's common new-item case) stays O(delta) end to
-        end."""
+        dicts by object — the cached ndarrays keep their identity so the
+        delta publisher refs them for free), and a changed dictionary
+        whose previous blob is a byte-prefix records ``prevCrc``/
+        ``prevN`` so workers holding the previous dictionary extend it
+        in O(new strings) instead of rebuilding — pure END growth of the
+        catalog (the fold engine's common new-item case) stays O(delta)
+        end to end."""
         cached = self._pub_dicts.get(slot)
         if cached is not None and cached["obj"] is d:
             entry = {"crc": cached["crc"], "n": cached["n"]}
@@ -421,33 +866,37 @@ class ModelPlane:
                 entry["prevCrc"] = cached["crc"]
                 entry["prevN"] = cached["n"]
             cached = self._pub_dicts[slot] = {
-                "obj": d, "blob": blob, "offs": offs,
-                "crc": crc, "n": len(strings)}
-        arrays[f"dict_{slot}_blob"] = np.frombuffer(cached["blob"],
-                                                    np.uint8)
+                "obj": d, "blob": blob,
+                "blob_arr": np.frombuffer(blob, np.uint8),
+                "offs": offs, "crc": crc, "n": len(strings)}
+        arrays[f"dict_{slot}_blob"] = cached["blob_arr"]
         arrays[f"dict_{slot}_offs"] = cached["offs"]
         return entry
 
-    def _encode_props(self, props) -> Tuple[bytes, int]:
+    def _encode_props(self, props) -> Tuple[np.ndarray, int]:
         cached = self._pub_props
         if cached is not None and cached[0] is props:
             return cached[1], cached[2]
         blob = json.dumps(dict(props or {}), separators=(",", ":"),
                           sort_keys=True, default=str).encode()
         crc = int(zlib.crc32(blob))
-        self._pub_props = (props, blob, crc)
-        return blob, crc
+        arr = np.frombuffer(blob, np.uint8)
+        self._pub_props = (props, arr, crc)
+        return arr, crc
 
     # -- worker side ---------------------------------------------------------
 
     def quarantine(self, manifest: Dict, err: Exception) -> None:
-        """Set a torn arena aside (first sibling to rename wins) and
-        keep serving — the publisher's next emit supersedes it."""
-        fname = manifest.get("file")
+        """Set the torn file aside (first sibling to rename wins) and
+        keep serving — the publisher's next emit notices the broken
+        chain and heals it with a keyframe.  The file is the one that
+        actually failed: a delta generation can fail on a file earlier
+        in its chain."""
+        fname = getattr(err, "fname", None) or manifest.get("file")
         log.warning(
-            "model plane: arena generation %s unusable (%s) — "
-            "quarantined; keeping the served generation",
-            manifest.get("generation"), err)
+            "model plane: generation %s unusable (%s: %s) — quarantined "
+            "%s; keeping the served generation",
+            manifest.get("generation"), type(err).__name__, err, fname)
         if not fname:
             return
         path = os.path.join(self.dir, str(fname))
@@ -455,28 +904,279 @@ class ModelPlane:
             os.replace(path, path + ".quarantine")
         except OSError:
             pass
+        self._mapped.pop(str(fname), None)
+
+    def _map_file(self, fname: str):
+        """(arrays, meta) for one generation file, cached by name —
+        an already-mapped file costs a dict hit, not a remap."""
+        hit = self._mapped.get(fname)
+        if hit is not None:
+            return hit
+        path = os.path.join(self.dir, fname)
+        try:
+            arrays, meta = read_arrays(path, mmap=True)
+        except ValueError as e:
+            raise _PlaneCorrupt(fname, str(e)) from e
+        self._mapped[fname] = (arrays, meta)
+        return arrays, meta
 
     def load(self, manifest: Dict):
-        """Map the manifest's arena → ``(URModel-over-views, info)``.
+        """Map/compose the manifest's generation →
+        ``(URModel-over-views, info)``.
 
-        The arrays are read-only views into the shared mapping; derived
-        serving state (inverted CSRs, pop order) installs straight into
-        the model's ``__dict__`` caches, and dictionaries / property
-        indexes carry from the previously loaded generation whenever the
-        manifest proves them unchanged.  Raises ValueError/OSError on a
-        torn arena — the caller quarantines."""
-        path = os.path.join(self.dir, str(manifest["file"]))
-        arrays, meta = read_arrays(path, mmap=True)
-        if meta.get("schema") != 1:
-            raise ValueError(f"unknown arena schema {meta.get('schema')}")
-        model = self._build_model(arrays, meta)
-        info = dict(meta.get("info") or {})
-        info["planeGeneration"] = int(meta.get("generation")
-                                      or manifest["generation"])
+        A full arena maps directly (read-only views into the shared
+        mapping).  A delta generation composes against the previously
+        loaded one — or, cold, walks ``prevFile`` links back to the
+        last keyframe and composes the chain forward.  Derived serving
+        state (inverted CSRs, pop order) installs straight into the
+        model's ``__dict__`` caches, and dictionaries / property indexes
+        carry from the previously loaded generation whenever the
+        manifest proves them unchanged.  Raises ValueError
+        (:class:`_PlaneCorrupt` with the failing file) on torn content —
+        the caller quarantines; OSError (e.g. a chain file briefly
+        missing mid-GC) — the caller retries."""
+        fname = str(manifest["file"])
+        chain: List[Tuple[str, Dict[str, np.ndarray], Dict]] = []
+        f = fname
+        for _ in range(100000):
+            arrays, meta = self._map_file(f)
+            kind = meta.get("planeKind") or "full"
+            chain.append((f, arrays, meta))
+            if kind != "delta":
+                break
+            pg = int(meta.get("prevGeneration") or 0)
+            pf = meta.get("prevFile")
+            if self._composed is not None and self._composed_gen == pg:
+                break
+            if not pf:
+                raise _PlaneCorrupt(f, f"{f}: delta with no prevFile")
+            f = str(pf)
+        else:
+            raise _PlaneCorrupt(fname, "delta chain does not terminate")
+        chain.reverse()
+        composed = self._composed
+        inv_perms = dict(self._inv_perms)
+        for cf, arrays, meta in chain:
+            kind = meta.get("planeKind") or "full"
+            if kind != "delta":
+                composed = _ComposedGen()
+                composed._arrays = {
+                    n: a for n, a in arrays.items()}
+                inv_perms = {}
+            else:
+                composed = self._compose_delta(
+                    cf, composed, arrays, meta, inv_perms)
+        final_meta = chain[-1][2]
+        if final_meta.get("schema") != 1:
+            raise _PlaneCorrupt(
+                chain[-1][0],
+                f"unknown arena schema {final_meta.get('schema')}")
+        model = self._build_model(composed, final_meta)
+        gen = int(final_meta.get("generation")
+                  or manifest["generation"])
+        # commit the compose state only after a fully successful build
+        self._composed, self._composed_gen = composed, gen
+        self._inv_perms = inv_perms
+        live = {cf for cf, _a, _m in chain}
+        for stale in [k for k in self._mapped if k not in live]:
+            del self._mapped[stale]    # views keep their mmaps alive
+        info = dict(final_meta.get("info") or {})
+        info["planeGeneration"] = gen
         info["planeBytes"] = int(manifest.get("bytes") or 0)
         return model, info
 
-    def _build_model(self, arrays: Dict[str, np.ndarray], meta: Dict):
+    def _compose_delta(self, fname: str, prev: Optional[_ComposedGen],
+                       arrays: Dict[str, np.ndarray], meta: Dict,
+                       inv_perms: Dict[int, np.ndarray]) -> _ComposedGen:
+        """Apply one delta generation's manifest over the previous
+        composed generation.  Eager for numeric arrays (everything the
+        model build touches anyway), lazy for dictionary blobs and the
+        props JSON (worker caches usually skip them)."""
+        if prev is None:
+            raise _PlaneCorrupt(
+                fname, f"{fname}: delta chain has no base generation")
+        manifest: Dict[str, Dict] = meta.get("manifest") or {}
+        out = _ComposedGen()
+        memo: Dict[str, np.ndarray] = {}
+        trio_memo: Dict[int, Tuple] = {}
+        resolving: set = set()
+
+        def prev_arr(name: str) -> np.ndarray:
+            try:
+                return prev[name]
+            except KeyError:
+                raise _PlaneCorrupt(
+                    fname, f"{fname}: base generation lacks {name}")
+
+        def resolve(name: str) -> np.ndarray:
+            got = memo.get(name)
+            if got is not None:
+                return got
+            if name in resolving:
+                raise _PlaneCorrupt(fname, f"{fname}: manifest cycle at "
+                                           f"{name}")
+            resolving.add(name)
+            try:
+                entry = manifest.get(name)
+                if entry is None:
+                    raise _PlaneCorrupt(
+                        fname, f"{fname}: manifest lacks {name}")
+                arr = self._compose_entry(fname, name, entry, prev_arr,
+                                          arrays, meta, resolve,
+                                          inv_perms, trio_memo)
+            finally:
+                resolving.discard(name)
+            memo[name] = arr
+            return arr
+
+        for name, entry in manifest.items():
+            k = entry["k"]
+            if _lazy_name(name) and k in ("ref", "ext", "full"):
+                # stay lazy WITHOUT referencing the previous composed
+                # generation: carry a self-contained byte-parts chain
+                try:
+                    if k == "full":
+                        out._arrays[name] = arrays[entry["key"]]
+                    elif name not in prev:
+                        raise _PlaneCorrupt(
+                            fname, f"{fname}: base generation lacks "
+                                   f"{name}")
+                    elif k == "ref":
+                        got = prev._arrays.get(name)
+                        if got is not None:
+                            out._arrays[name] = got
+                        else:
+                            out._parts[name] = prev.parts_of(name)
+                    else:               # ext
+                        suffix = arrays[entry["suffix"]]
+                        dt, _shape, base = prev.parts_of(name)
+                        out._parts[name] = (
+                            dt, tuple(entry["shape"]), base + [suffix])
+                        out._suffixes[name] = (suffix,
+                                               int(entry["pre"]))
+                except KeyError as e:
+                    raise _PlaneCorrupt(
+                        fname, f"{fname}: cannot compose {name}: "
+                               f"{e}") from e
+            else:
+                out._arrays[name] = _freeze(resolve(name))
+        return out
+
+    def _compose_entry(self, fname: str, name: str, entry: Dict,
+                       prev_arr, arrays: Dict[str, np.ndarray],
+                       meta: Dict, resolve, inv_perms,
+                       trio_memo: Dict[int, Tuple]) -> np.ndarray:
+        try:
+            k = entry["k"]
+            if k == "ref":
+                return prev_arr(name)
+            if k == "full":
+                return arrays[entry["key"]]
+            if k == "ext":
+                old = prev_arr(name)
+                suffix = arrays[entry["suffix"]]
+                flat = np.concatenate([_flat_u8(
+                    np.ascontiguousarray(old)), suffix])
+                return flat.view(old.dtype).reshape(
+                    tuple(entry["shape"]))
+            if k == "patch":
+                old = prev_arr(name)
+                shape = tuple(entry["shape"])
+                idx = arrays[entry["idx"]]
+                vals = arrays[entry["vals"]]
+                n = int(np.prod(shape)) if shape else 1
+                flat = np.empty(n, old.dtype)
+                flat[:old.size] = old.reshape(-1)
+                flat[idx] = vals
+                return flat.reshape(shape)
+            if k == "nz":
+                mask = resolve(entry["mask"])
+                vals = arrays[name]
+                out = np.full(mask.shape, entry["pad"], vals.dtype)
+                out[mask >= 0] = vals
+                return out
+            if k == "inv":
+                i = int(entry["type"])
+                part = name.rsplit("_", 1)[1]
+                return self._replay_inv(
+                    fname, i, arrays[entry["changed"]], prev_arr,
+                    resolve, meta, inv_perms, trio_memo)[
+                        {"indptr": 0, "rows": 1, "w": 2}[part]]
+            if k == "pop_order":
+                from predictionio_tpu.streaming.fold import (
+                    _merge_pop_order,
+                )
+
+                old = prev_arr("pop_order")
+                pop = np.asarray(resolve("popularity"), np.float32)
+                return _merge_pop_order(old, pop,
+                                        arrays[entry["changed"]])
+            raise KeyError(f"unknown entry kind {k!r}")
+        except _PlaneCorrupt:
+            raise
+        except (KeyError, IndexError, ValueError) as e:
+            raise _PlaneCorrupt(
+                fname,
+                f"{fname}: cannot compose {name}: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _replay_inv(self, fname: str, i: int, changed: np.ndarray,
+                    prev_arr, resolve, meta: Dict, inv_perms,
+                    trio_memo: Dict[int, Tuple]
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Replay the fold engine's inverted-CSR patch for event type
+        ``i`` — the same functions, the same arguments (the changed-row
+        set from the emit-snapshot provenance), so the result is
+        bit-identical to the publisher's arrays (which were produced by
+        this very replay on its side).  The inversion permutation is
+        maintained across generations like the fold's ``_inv_cache``
+        (validity keyed to the idx object it was built for) and
+        recomputed from the previous idx table when absent — e.g. right
+        after a keyframe."""
+        from predictionio_tpu.streaming.fold import (
+            _inverted_perm,
+            _patch_inverted_csr,
+        )
+
+        got = trio_memo.get(i)
+        if got is not None:
+            return got      # the trio composes once per generation
+        old_indptr = prev_arr(f"inv_{i}_indptr")
+        old_rows = prev_arr(f"inv_{i}_rows")
+        old_idx = prev_arr(f"ind_{i}_idx")
+        new_idx = resolve(f"ind_{i}_idx")
+        new_llr = resolve(f"ind_{i}_llr")
+        dent = meta["dicts"][f"ev_{i}"]
+        if dent.get("sameAs") == "item":
+            dent = meta["dicts"]["item"]
+        n_t = max(int(dent["n"]), 1)
+        i_p = int(new_idx.shape[0])
+        cache = inv_perms.get(i)
+        if cache is not None and cache["for_idx"] is old_idx:
+            perm = cache["perm"]
+        else:
+            perm = _inverted_perm(np.asarray(old_idx))
+        changed = np.asarray(changed, np.int64)
+        if len(changed) == 0:
+            indptr = np.asarray(old_indptr)
+            if len(indptr) < n_t + 1:
+                indptr = np.concatenate([indptr, np.full(
+                    n_t + 1 - len(indptr), indptr[-1], np.int64)])
+            rows = np.asarray(old_rows)
+        else:
+            indptr, rows, perm = _patch_inverted_csr(
+                np.asarray(old_indptr), np.asarray(old_rows), perm,
+                changed, np.asarray(old_idx), np.asarray(new_idx),
+                n_t, i_p)
+        w = np.asarray(new_llr).ravel()[perm].astype(
+            np.float32, copy=False)
+        inv_perms[i] = {"for_idx": new_idx, "perm": perm}
+        trio = (_freeze(np.asarray(indptr)), _freeze(np.asarray(rows)),
+                _freeze(w))
+        trio_memo[i] = trio
+        return trio
+
+    def _build_model(self, arrays, meta: Dict):
         from predictionio_tpu.models.universal_recommender.engine import (
             URModel,
         )
@@ -502,8 +1202,22 @@ class ModelPlane:
             prev is not None and prev_meta is not None
             and meta.get("propsCrc") == prev_meta.get("propsCrc")
             and item_crc == prev_meta["dicts"]["item"]["crc"])
-        props = (prev.item_properties if props_carried
-                 else _LazyProps(arrays.get("props_json")))
+        if props_carried:
+            props = prev.item_properties
+        elif "props_json" in arrays:
+            # the lazy thunk must capture only the SELF-CONTAINED parts
+            # descriptor (raw mmap byte views), never the _ComposedGen —
+            # a long-carried unparsed props object would otherwise pin
+            # an entire stale generation's composed arrays in memory
+            dt, shape, parts = arrays.parts_of("props_json")
+
+            def _raw_props(dt=dt, shape=shape, parts=parts):
+                flat = (parts[0] if len(parts) == 1
+                        else np.concatenate(parts))
+                return flat.view(np.dtype(dt)).reshape(shape)
+            props = _LazyProps(_raw_props)
+        else:
+            props = _LazyProps(None)
         model = URModel(
             primary_event=meta["primaryEvent"],
             item_dict=item_dict,
@@ -519,7 +1233,7 @@ class ModelPlane:
                                 arrays["user_seen_values"]),
             user_seen_by_event=user_seen_by_event,
         )
-        # derived serving state rides the arena: pre-populate the lazy
+        # derived serving state rides the plane: pre-populate the lazy
         # caches so warm()/first-query find them built (as views)
         model.__dict__["_host_inv"] = {
             n: (arrays[f"inv_{i}_indptr"], arrays[f"inv_{i}_rows"],
@@ -542,32 +1256,56 @@ class ModelPlane:
             z = prev.__dict__.get("_host_zeros")
             if z is not None:   # read-only by contract; same n_items
                 model.__dict__["_host_zeros"] = z
-        model.__dict__["_plane_generation"] = int(meta.get("generation", 0))
+        model.__dict__["_plane_generation"] = int(meta.get("generation",
+                                                           0))
         self._prev_model, self._prev_meta = model, meta
         return model
 
-    def _restore_dict(self, slot: str, entry: Dict,
-                      arrays: Dict[str, np.ndarray]) -> IdDict:
+    def _restore_dict(self, slot: str, entry: Dict, arrays) -> IdDict:
         crc, n = int(entry["crc"]), int(entry["n"])
         cached = self._dict_cache.get(slot)
         if cached is not None and cached[0] == crc \
                 and len(cached[1]) == n:
             return cached[1]
-        blob = arrays[f"dict_{slot}_blob"]
-        offs = arrays[f"dict_{slot}_offs"]
         if cached is not None and entry.get("prevCrc") == cached[0] \
                 and entry.get("prevN") == len(cached[1]):
             # publisher proved our dictionary is a byte-prefix of the
             # new blob: extend a clone with only the tail strings
             d = cached[1].clone()
             start = int(entry["prevN"])
-            base = int(offs[start])
-            tail = bytes(blob[base:])
-            for j in range(start, n):
-                d.add(tail[int(offs[j]) - base:int(offs[j + 1]) - base]
-                      .decode("utf-8", "surrogatepass"))
+            suffix = (arrays.suffix_of(f"dict_{slot}_blob")
+                      if isinstance(arrays, _ComposedGen) else None)
+            if suffix is not None:
+                # delta fast path: the ext suffix IS the tail bytes —
+                # decode it with the offs suffix, never composing (or
+                # even touching) the covered prefix
+                tail_blob, base = suffix
+                tail = bytes(tail_blob)
+                offs_sfx = arrays.suffix_of(f"dict_{slot}_offs")
+                if offs_sfx is not None \
+                        and offs_sfx[0].size == (n - start) * 8:
+                    offs_tail = offs_sfx[0].view(np.int64)
+                    bounds = np.concatenate(
+                        [[np.int64(base)], offs_tail]) - base
+                else:
+                    offs = arrays[f"dict_{slot}_offs"]
+                    bounds = np.asarray(offs[start:n + 1], np.int64) - base
+                for j in range(n - start):
+                    d.add(tail[int(bounds[j]):int(bounds[j + 1])]
+                          .decode("utf-8", "surrogatepass"))
+            else:
+                blob = arrays[f"dict_{slot}_blob"]
+                offs = arrays[f"dict_{slot}_offs"]
+                base = int(offs[start])
+                tail = bytes(blob[base:])
+                for j in range(start, n):
+                    d.add(tail[int(offs[j]) - base:
+                               int(offs[j + 1]) - base]
+                          .decode("utf-8", "surrogatepass"))
             self.dicts_extended += 1
         else:
+            blob = arrays[f"dict_{slot}_blob"]
+            offs = arrays[f"dict_{slot}_offs"]
             raw = bytes(blob)
             d = IdDict.from_state(
                 [raw[int(offs[j]):int(offs[j + 1])]
@@ -577,9 +1315,105 @@ class ModelPlane:
         return d
 
 
+def _gen_of(name: str) -> Optional[int]:
+    """Generation number encoded in a plane file name (gen-N.arena,
+    gen-N.delta, either + .quarantine); None for foreign files."""
+    if not name.startswith("gen-"):
+        return None
+    try:
+        return int(name[4:14])
+    except ValueError:
+        return None
+
+
+class _DirNotify:
+    """inotify wake-up on the plane directory (Linux, via ctypes — no
+    external deps): ``wait`` returns as soon as a file lands/renames in
+    the dir, so manifest flips propagate in ~ms instead of a poll
+    period.  Degrades to None (callers poll) anywhere the syscalls are
+    unavailable."""
+
+    IN_CLOSE_WRITE = 0x00000008
+    IN_CREATE = 0x00000100
+    IN_MOVED_TO = 0x00000080
+
+    def __init__(self, directory: str):
+        import ctypes
+        import ctypes.util
+
+        libc_name = ctypes.util.find_library("c")
+        if not libc_name:
+            raise OSError("no libc")
+        libc = ctypes.CDLL(libc_name, use_errno=True)
+        try:
+            init1 = libc.inotify_init1
+            add_watch = libc.inotify_add_watch
+        except AttributeError:   # non-Linux libc: no inotify symbols —
+            raise OSError("inotify unavailable")  # callers poll instead
+        self._fd = init1(os.O_NONBLOCK | 0o2000000)
+        if self._fd < 0:
+            raise OSError("inotify_init1 failed")
+        wd = add_watch(
+            self._fd, os.fsencode(directory),
+            self.IN_CLOSE_WRITE | self.IN_CREATE | self.IN_MOVED_TO)
+        if wd < 0:
+            os.close(self._fd)
+            raise OSError("inotify_add_watch failed")
+        # self-pipe so stop() interrupts a wait immediately
+        self._r, self._w = os.pipe()
+        os.set_blocking(self._r, False)
+        # poll(), not select(): fd numbers in a busy prefork worker can
+        # exceed select's FD_SETSIZE (1024), which raises ValueError and
+        # would kill the watch thread
+        self._poll = select.poll()
+        self._poll.register(self._fd, select.POLLIN)
+        self._poll.register(self._r, select.POLLIN)
+
+    def wait(self, timeout: float) -> bool:
+        """Block up to ``timeout``; True when a directory event (not a
+        stop poke) woke us."""
+        try:
+            ready = self._poll.poll(max(timeout, 0) * 1000)
+        except (OSError, ValueError):
+            return False
+        woke = False
+        for fd, _ev in ready:
+            try:
+                data = os.read(fd, 65536)
+            except OSError:
+                data = b""
+            if fd == self._fd and data:
+                woke = True
+        return woke
+
+    def poke(self) -> None:
+        try:
+            os.write(self._w, b"x")
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        for fd in (self._fd, self._r, self._w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+def plane_notify_enabled() -> bool:
+    """``PIO_MODEL_PLANE_NOTIFY=off`` forces the stat-poll fallback
+    (debugging aid; also for filesystems with broken inotify)."""
+    return os.environ.get("PIO_MODEL_PLANE_NOTIFY", "").lower() not in (
+        "off", "0", "false")
+
+
 class PlaneWatcher:
-    """Per-worker manifest watcher: polls ``CURRENT.json`` and installs
-    each new generation through the server's build-ticket install path.
+    """Per-worker manifest watcher: installs each new generation through
+    the server's build-ticket install path.  Wake-up is inotify on the
+    plane dir where available (manifest renames propagate in ~ms —
+    swap latency is no longer quantized by PIO_MODEL_PLANE_POLL_S);
+    otherwise a stat-cheap poll: one ``os.stat`` of CURRENT.json per
+    period, opening/parsing it only when (mtime, size, ino) moved.
     ``check_now()`` runs one synchronous check (the ``/reload`` handler
     and the in-process publisher use it so their response generation is
     live before they answer)."""
@@ -592,9 +1426,12 @@ class PlaneWatcher:
         self.generation = 0
         self._bad_gen = 0
         self._warned_gen = 0
+        self._retry = False
+        self._stat_sig: Optional[Tuple] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._notify: Optional[_DirNotify] = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -605,14 +1442,49 @@ class PlaneWatcher:
 
     def stop(self, timeout: float = 2.0) -> None:
         self._stop.set()
+        if self._notify is not None:
+            self._notify.poke()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        if self._notify is not None:
+            self._notify.close()
+            self._notify = None
+
+    def _manifest_moved(self) -> bool:
+        """Stat-cheap change probe: did CURRENT.json's (ino, mtime,
+        size) move since the last probe?  First call always reports
+        movement (the worker must catch up with whatever is live)."""
+        try:
+            st = os.stat(self.plane.current_path)
+            sig = (st.st_ino, st.st_mtime_ns, st.st_size)
+        except OSError:
+            sig = None
+        if sig == self._stat_sig:
+            return False
+        self._stat_sig = sig
+        return True
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll):
+        if plane_notify_enabled() and self._notify is None:
             try:
-                self.check_now()
+                os.makedirs(self.plane.dir, exist_ok=True)
+                self._notify = _DirNotify(self.plane.dir)
+            except OSError:
+                self._notify = None     # poll fallback
+        while not self._stop.is_set():
+            if self._notify is not None:
+                self._notify.wait(self.poll)
+            elif self._stop.wait(self.poll):
+                break
+            if self._stop.is_set():
+                break
+            try:
+                # the stat probe elides parsing an unchanged manifest;
+                # a pending transient-failure retry bypasses it (the
+                # manifest didn't move, but the chain may have healed)
+                if self._manifest_moved() or self._retry:
+                    self.check_now()
             except Exception:
                 log.exception("model-plane watch failed; keeping the "
                               "served generation")
@@ -621,6 +1493,7 @@ class PlaneWatcher:
         """One check-and-install; True when a new generation went live
         on this worker."""
         with self._lock:
+            self._retry = False
             cur = self.plane.current()
             if cur is None:
                 return False
@@ -632,17 +1505,18 @@ class PlaneWatcher:
                 model, info = self.plane.load(cur)
             except (ValueError, KeyError) as e:
                 # deterministic content corruption (torn write): retrying
-                # cannot help — quarantine, remember the bad generation
-                # (no re-probe storm), serve the old one until the next
-                # good publish supersedes it
+                # cannot help — quarantine the failing file, remember the
+                # bad generation (no re-probe storm), serve the old one;
+                # the publisher heals the chain with a keyframe
                 self._bad_gen = gen
                 self.plane.quarantine(cur, e)
                 return False
             except OSError as e:
                 # transient I/O (EMFILE under load, a sibling's
                 # quarantine rename racing us, mid-GC): do NOT
-                # quarantine a possibly-good arena — keep serving and
+                # quarantine a possibly-good blob — keep serving and
                 # retry on the next poll (log once per generation)
+                self._retry = True
                 if self._warned_gen != gen:
                     self._warned_gen = gen
                     log.warning(

@@ -576,6 +576,12 @@ class QueryServerState:
             # the generation every prefork sibling converges on — equal
             # across workers means the group serves ONE mapped model
             doc["planeGeneration"] = self.plane_generation
+            if self.plane.last_publish_stats:
+                # this process published: surface the delta-arena write
+                # profile (logical model bytes vs bytes actually written
+                # — the per-generation write amplification, also on the
+                # dashboard as pio_model_plane_publish_bytes_total)
+                doc["planePublish"] = dict(self.plane.last_publish_stats)
         if self.follower is not None:
             doc["follower"] = self.follower.status()
         elif self.follow_info is not None:

@@ -107,6 +107,12 @@ REQUIRED_METRICS = frozenset({
     "pio_model_plane_map_seconds",
     "pio_model_plane_gc_total",
     "pio_process_rss_bytes",
+    # delta arenas (PR 15): the bench's write-amplification guard and
+    # publish-side observability key on the per-path byte counter; the
+    # blob-store/chain gauges feed disk-sizing and restart-cost views
+    "pio_model_plane_publish_bytes_total",
+    "pio_model_plane_blob_count",
+    "pio_model_plane_chain_len",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import os
 import sys
+from pathlib import Path
 
 # runnable from any cwd without an installed package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -342,7 +343,13 @@ def plane_phase(engine, ep, query_cls, storage, problems) -> None:
     the consumer must converge the publisher's server too, and
     post-drain responses from the mapped model must EXACTLY match a
     from-scratch retrain — the ``PIO_MODEL_PLANE=off`` in-process
-    oracle the earlier phases established."""
+    oracle the earlier phases established.
+
+    Runs with DELTA ARENAS ON (the default) and a short keyframe
+    interval, and asserts the fold stream actually published delta
+    generations — the consumer's post-drain parity therefore proves
+    delta-composed mapped models bit-exact against the oracle, not just
+    full arenas."""
     import http.client
     import json as _json
     import shutil
@@ -360,6 +367,11 @@ def plane_phase(engine, ep, query_cls, storage, problems) -> None:
 
     plane_tmp = tempfile.mkdtemp(prefix="pio_parity_plane")
     os.environ["PIO_MODEL_PLANE_POLL_S"] = "0.05"
+    # delta arenas ON with a short keyframe interval: the fold stream
+    # below must cross a keyframe boundary AND publish deltas, so the
+    # replay exercises full→delta→keyframe→delta compose transitions
+    os.environ.pop("PIO_MODEL_PLANE_DELTA", None)
+    os.environ["PIO_MODEL_PLANE_FULL_EVERY"] = "4"
     app = storage.apps.get_by_name("parityapp")
     pub = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
                            "default", storage=storage,
@@ -444,6 +456,12 @@ def plane_phase(engine, ep, query_cls, storage, problems) -> None:
             "plane: consumer never converged past the initial "
             f"generation (gen={sub.plane_generation}, "
             f"publisher gen={pub.plane_generation})")
+    n_delta = len(list(Path(plane_tmp).glob("gen-*.delta")))
+    if n_delta == 0:
+        problems.append(
+            "plane: no delta generation was published — the phase "
+            "validated only full arenas (PIO_MODEL_PLANE_DELTA "
+            "regression?)")
     if errors_5xx:
         problems.append(
             f"plane: {len(errors_5xx)} 5xx during mapped-generation "

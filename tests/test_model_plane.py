@@ -253,11 +253,14 @@ def test_gc_keeps_window_and_never_breaks_a_mapped_arena(
     """GC unlinks generations past PIO_MODEL_PLANE_KEEP (counted in
     pio_model_plane_gc_total); a model still mapping an unlinked arena
     keeps serving identical responses — POSIX keeps the pages until the
-    mapping drops."""
+    mapping drops.  Runs with delta arenas OFF — every generation is a
+    full arena, so the keep window alone decides reclamation (the
+    delta-chain refcount cases live in test_gc_refcount_*)."""
     from predictionio_tpu.obs import metrics as obs_metrics
     from predictionio_tpu.streaming.plane import ModelPlane
 
     monkeypatch.setenv("PIO_MODEL_PLANE_KEEP", "2")
+    monkeypatch.setenv("PIO_MODEL_PLANE_DELTA", "off")
     _seed(mem_storage)
     engine, ep, algo = _ur()
     model = engine.train(ep)[0]
@@ -382,6 +385,402 @@ def test_embedded_follower_publishes_through_plane(
             follower.stop()
         a.stop_auto_reload()
         b.stop_auto_reload()
+
+
+# -- delta arenas ------------------------------------------------------------
+
+
+def _fold_state(n_items=1200, hist=4, k=5):
+    """A resident fold state over a synthetic catalog (one buy per item,
+    hist-item user histories — the freshness-sweep shape)."""
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.store.columnar import EventBatch
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    ap = URAlgorithmParams(app_name="delta", mesh_dp=1,
+                           max_correlators_per_item=k)
+    dp = URDataSourceParams(app_name="delta", event_names=["buy"])
+    evs = [Event(event="buy", entity_type="user",
+                 entity_id=f"u{j // hist}", target_entity_type="item",
+                 target_entity_id=f"i{j}") for j in range(n_items)]
+    batch = EventBatch.from_events(evs)
+    batch.prop_columns = {}
+    return URFoldState.bootstrap(ap, dp, batch)
+
+
+def _fold_delta(state, events):
+    """Fold a delta batch sharing the state's dictionaries (the
+    scan_tail contract) and return the emitted model, serving-state
+    warm included."""
+    from predictionio_tpu.store.columnar import EventBatch
+
+    d = EventBatch.from_events(
+        events, entity_dict=state.batch.entity_dict,
+        target_dict=state.batch.target_dict,
+        event_dict=state.batch.event_dict)
+    d.prop_columns = {}
+    model = state.fold(d)
+    model.ensure_host_serving_state()
+    return model
+
+
+def _freshness_delta(state, r, n_items):
+    """The PR-13 freshness-sweep round shape: new correlated users + a
+    brand-new item — marginals move, so every finite LLR score changes
+    and pure-ref publishing alone cannot stay small."""
+    from predictionio_tpu.events.event import Event
+
+    seed = f"i{(r * 97) % n_items}"
+    evs = [Event(event="buy", entity_type="user", entity_id=f"probe{r}",
+                 target_entity_type="item", target_entity_id=seed)]
+    for j in range(4):
+        for tgt in (seed, f"fresh_item_{r}"):
+            evs.append(Event(event="buy", entity_type="user",
+                             entity_id=f"cob{r}_{j}",
+                             target_entity_type="item",
+                             target_entity_id=tgt))
+    return evs
+
+
+def _assert_models_identical(a, b):
+    """Every serialized array, derived structure, and dictionary —
+    bit-exact, dtypes included."""
+    for n in b.indicator_idx:
+        pairs = [(a.indicator_idx[n], b.indicator_idx[n]),
+                 (a.indicator_llr[n], b.indicator_llr[n])]
+        pairs += list(zip(a.__dict__["_host_inv"][n], b.host_inverted(n)))
+        for x, y in pairs:
+            assert x.dtype == y.dtype
+            assert np.array_equal(x, y)
+        assert (a.event_item_dicts[n].strings()
+                == b.event_item_dicts[n].strings())
+    assert np.array_equal(a.popularity, b.popularity)
+    po_a = a.__dict__["_host_pop_order"]
+    po_b = b.host_pop_order()
+    assert po_a.dtype == po_b.dtype and np.array_equal(po_a, po_b)
+    assert np.array_equal(a.user_seen.indptr, b.user_seen.indptr)
+    assert np.array_equal(a.user_seen.values, b.user_seen.values)
+    for n, csr in b.user_seen_by_event.items():
+        assert np.array_equal(a.user_seen_by_event[n].indptr, csr.indptr)
+        assert np.array_equal(a.user_seen_by_event[n].values, csr.values)
+    assert a.item_dict.strings() == b.item_dict.strings()
+    assert a.user_dict.strings() == b.user_dict.strings()
+    assert dict(a.item_properties) == dict(b.item_properties)
+
+
+def test_delta_composed_bit_exact_vs_full_arena_oracle(
+        plane_dir, tmp_path, monkeypatch):
+    """The acceptance proof at test scale: freshness-shaped folds
+    published as delta generations compose — on an incremental worker
+    AND a cold mid-chain joiner — into models bit-identical to the
+    PIO_MODEL_PLANE_DELTA=off full-arena oracle, every array, derived
+    CSR, and dictionary included, while each delta writes ≤ 10% (and a
+    duplicate-only fold ≤ 5%) of the full-arena bytes."""
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    # k=8 is the freshness-sweep shape (maxCorrelatorsPerItem) the
+    # acceptance criterion is calibrated to: the delta floor is the
+    # finite-LLR values, ≈ (nnz / (I_p·K)) of one table
+    n_items = 2000
+    state = _fold_state(n_items=n_items, k=8)
+    pub = ModelPlane(plane_dir)
+    worker = ModelPlane(plane_dir)
+    oracle_pub = ModelPlane(str(tmp_path / "oracle"))
+    oracle_sub = ModelPlane(str(tmp_path / "oracle"))
+
+    def oracle_load(model):
+        monkeypatch.setenv("PIO_MODEL_PLANE_DELTA", "off")
+        try:
+            oracle_pub.publish([model])
+            return oracle_sub.load(oracle_sub.current())[0]
+        finally:
+            monkeypatch.delenv("PIO_MODEL_PLANE_DELTA")
+
+    m0 = state.model
+    m0.ensure_host_serving_state()
+    pub.publish([m0], {"mode": "fold"})
+    full_bytes = pub.last_publish_stats["written"]
+    w0, _ = worker.load(worker.current())
+    _assert_models_identical(w0, oracle_load(m0))
+    cold = None
+    for r in range(3):
+        m = _fold_delta(state, _freshness_delta(state, r, n_items))
+        pub.publish([m], {"mode": "fold"})
+        st = pub.last_publish_stats
+        assert os.path.exists(
+            os.path.join(plane_dir, f"gen-{r + 2:010d}.delta"))
+        assert st["written"] <= 0.10 * full_bytes, st
+        wa, info = worker.load(worker.current())
+        assert info["planeGeneration"] == r + 2
+        ref = oracle_load(m)
+        _assert_models_identical(wa, ref)
+        if r == 1:
+            cold = ModelPlane(plane_dir)    # joins mid-chain
+        if cold is not None:
+            wc, _ = cold.load(cold.current())
+            _assert_models_identical(wc, ref)
+        # composed arrays are read-only, like mapped views
+        for arr in (wa.indicator_llr["buy"], wa.popularity,
+                    wa.__dict__["_host_inv"]["buy"][2]):
+            assert not arr.flags.writeable
+    # duplicate-only fold: ~zero new bytes, asserted via the counter
+    from predictionio_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.get_registry()
+
+    def written_counter():
+        c = reg.counter("pio_model_plane_publish_bytes_total", "x")
+        return (c.value(path="full") or 0) + (c.value(path="delta") or 0)
+
+    before = written_counter()
+    m = _fold_delta(state, [Event(
+        event="buy", entity_type="user", entity_id="u0",
+        target_entity_type="item", target_entity_id="i0")])
+    pub.publish([m], {"mode": "fold"})
+    assert pub.last_publish_stats["written"] <= 0.05 * full_bytes
+    assert written_counter() - before <= 0.05 * full_bytes
+    wa, _ = worker.load(worker.current())
+    _assert_models_identical(wa, oracle_load(m))
+
+
+def test_publisher_sigkill_mid_blob_and_mid_manifest(plane_dir):
+    """Delta-chain torture: a publisher killed mid-blob leaves an
+    unreferenced tmp file (invisible — the manifest still names the
+    previous generation); killed mid-manifest leaves a tmp CURRENT
+    (ignored — the flip is an atomic rename).  A REFERENCED torn delta
+    (manifest written, bytes truncated by the crash/disk) quarantines
+    the torn file, the old generation keeps serving, and a restarted
+    publisher — which cannot prove the chain — heals with a keyframe."""
+    from predictionio_tpu.streaming.plane import ModelPlane, PlaneWatcher
+
+    n_items = 600
+    state = _fold_state(n_items=n_items)
+    pub = ModelPlane(plane_dir)
+    m0 = state.model
+    m0.ensure_host_serving_state()
+    pub.publish([m0], {"mode": "fold"})
+    m1 = _fold_delta(state, _freshness_delta(state, 0, n_items))
+    pub.publish([m1], {"mode": "fold"})
+    sub = ModelPlane(plane_dir)
+    installed = []
+    watcher = PlaneWatcher(sub, lambda models, info: (
+        installed.append(models[0]), True)[1], poll_s=0.05)
+    assert watcher.check_now() and watcher.generation == 2
+    # SIGKILL mid-blob: partial tmp container only
+    (Path(plane_dir) / ".gen-0000000003.delta.tmp-999").write_bytes(
+        b"PIOARR01" + b"\x00" * 4)
+    # SIGKILL mid-manifest: partial CURRENT tmp only
+    (Path(plane_dir) / "CURRENT.json.tmp-999").write_bytes(b'{"gen')
+    assert not watcher.check_now()
+    assert watcher.generation == 2
+    # torn REFERENCED delta: manifest flipped, delta bytes truncated
+    m2 = _fold_delta(state, _freshness_delta(state, 1, n_items))
+    pub.publish([m2], {"mode": "fold"})
+    torn = Path(plane_dir) / "gen-0000000003.delta"
+    good = torn.read_bytes()
+    torn.write_bytes(good[:len(good) // 2])
+    assert not watcher.check_now()
+    assert watcher.generation == 2          # old generation serves
+    assert (Path(plane_dir)
+            / "gen-0000000003.delta.quarantine").exists()
+    # publisher restart: no in-memory prev state -> full keyframe heals
+    pub2 = ModelPlane(plane_dir)
+    gen = pub2.publish([m2], {"mode": "fold"})
+    assert gen == 4
+    assert (Path(plane_dir) / "gen-0000000004.arena").exists()
+    assert watcher.check_now() and watcher.generation == 4
+    _assert_models_identical(installed[-1], m2)
+
+
+def test_torn_mid_chain_file_quarantines_the_failing_file(plane_dir):
+    """A cold worker composing a chain whose MIDDLE file is torn must
+    quarantine that file — not the newest generation, whose bytes are
+    fine — and the live publisher's next publish heals the chain with a
+    keyframe (chain-intact probe)."""
+    from predictionio_tpu.streaming.plane import ModelPlane, PlaneWatcher
+
+    n_items = 600
+    state = _fold_state(n_items=n_items)
+    pub = ModelPlane(plane_dir)
+    m = state.model
+    m.ensure_host_serving_state()
+    pub.publish([m], {"mode": "fold"})
+    for r in range(2):
+        m = _fold_delta(state, _freshness_delta(state, r, n_items))
+        pub.publish([m], {"mode": "fold"})
+    mid = Path(plane_dir) / "gen-0000000002.delta"
+    mid.write_bytes(mid.read_bytes()[:64])
+    cold = ModelPlane(plane_dir)
+    watcher = PlaneWatcher(cold, lambda models, info: True,
+                           poll_s=0.05)
+    assert not watcher.check_now()
+    assert (Path(plane_dir)
+            / "gen-0000000002.delta.quarantine").exists()
+    assert not (Path(plane_dir)
+                / "gen-0000000003.delta.quarantine").exists()
+    # the LIVE publisher (prev state intact) notices the missing chain
+    # file and publishes a keyframe instead of a delta
+    m2 = _fold_delta(state, _freshness_delta(state, 2, n_items))
+    gen = pub.publish([m2], {"mode": "fold"})
+    assert gen == 4
+    assert (Path(plane_dir) / "gen-0000000004.arena").exists()
+    assert watcher.check_now() and watcher.generation == 4
+
+
+def test_keyframe_interval_and_restart_replay(plane_dir, monkeypatch):
+    """PIO_MODEL_PLANE_FULL_EVERY bounds the chain: every Nth
+    generation is a full arena, and a fresh worker joining at the tip
+    composes from the latest keyframe only — files older than it are
+    not needed (restart cost is the keyframe + the tail deltas)."""
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    monkeypatch.setenv("PIO_MODEL_PLANE_FULL_EVERY", "3")
+    monkeypatch.setenv("PIO_MODEL_PLANE_KEEP", "10")   # no GC here
+    n_items = 600
+    state = _fold_state(n_items=n_items)
+    pub = ModelPlane(plane_dir)
+    m = state.model
+    m.ensure_host_serving_state()
+    pub.publish([m], {"mode": "fold"})          # gen 1: keyframe
+    for r in range(5):                          # gens 2..6
+        m = _fold_delta(state, _freshness_delta(state, r, n_items))
+        pub.publish([m], {"mode": "fold"})
+    names = sorted(p.name for p in Path(plane_dir).glob("gen-*"))
+    # keyframes at 1 and 4 (gen-1 + 3 = interval), deltas between
+    assert "gen-0000000001.arena" in names
+    assert "gen-0000000004.arena" in names
+    assert "gen-0000000005.delta" in names
+    assert "gen-0000000006.delta" in names
+    # a fresh worker needs only keyframe 4 + deltas 5..6: delete older
+    for p in Path(plane_dir).glob("gen-000000000[123].*"):
+        p.unlink()
+    fresh = ModelPlane(plane_dir)
+    mapped, info = fresh.load(fresh.current())
+    assert info["planeGeneration"] == 6
+    _assert_models_identical(mapped, m)
+
+
+def test_gc_refcount_keeps_chain_incl_quarantine_heal(
+        plane_dir, monkeypatch):
+    """The GC-refcount satellite: with delta chains, GC must never
+    unlink a blob a kept generation's manifest still composes from —
+    the keyframe survives while any kept delta references it, even
+    past the PIO_MODEL_PLANE_KEEP count; after a quarantined-then-
+    healed chain, the superseded files (quarantine included) are
+    reclaimed once no kept generation needs them, and a fresh worker
+    can still compose every kept generation."""
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    monkeypatch.setenv("PIO_MODEL_PLANE_KEEP", "2")
+    monkeypatch.setenv("PIO_MODEL_PLANE_FULL_EVERY", "100")
+    n_items = 600
+    state = _fold_state(n_items=n_items)
+    pub = ModelPlane(plane_dir)
+    m = state.model
+    m.ensure_host_serving_state()
+    pub.publish([m], {"mode": "fold"})          # gen 1: keyframe
+    for r in range(4):                          # gens 2..5: deltas
+        m = _fold_delta(state, _freshness_delta(state, r, n_items))
+        pub.publish([m], {"mode": "fold"})
+    names = {p.name for p in Path(plane_dir).glob("gen-*")}
+    # count-only GC would have kept {4, 5}; the refcount keeps the
+    # whole chain back to the keyframe both compose from
+    assert names == {"gen-0000000001.arena", "gen-0000000002.delta",
+                     "gen-0000000003.delta", "gen-0000000004.delta",
+                     "gen-0000000005.delta"}
+    fresh = ModelPlane(plane_dir)
+    _assert_models_identical(fresh.load(fresh.current())[0], m)
+    # quarantine a chain file -> the next publish heals with a keyframe
+    q = Path(plane_dir) / "gen-0000000003.delta"
+    q.replace(str(q) + ".quarantine")
+    m = _fold_delta(state, _freshness_delta(state, 4, n_items))
+    gen = pub.publish([m], {"mode": "fold"})    # gen 6: healing keyframe
+    assert (Path(plane_dir) / "gen-0000000006.arena").exists()
+    gc0 = obs_metrics.get_registry().counter(
+        "pio_model_plane_gc_total", "x").value()
+    for r in range(5, 7):                       # gens 7..8: new chain
+        m = _fold_delta(state, _freshness_delta(state, r, n_items))
+        gen = pub.publish([m], {"mode": "fold"})
+    assert gen == 8
+    names = {p.name for p in Path(plane_dir).glob("gen-*")}
+    # kept gens {7, 8} chain to keyframe 6; everything older —
+    # including the quarantined file — was reclaimed
+    assert names == {"gen-0000000006.arena", "gen-0000000007.delta",
+                     "gen-0000000008.delta"}
+    assert obs_metrics.get_registry().counter(
+        "pio_model_plane_gc_total", "x").value() > gc0
+    fresh2 = ModelPlane(plane_dir)
+    _assert_models_identical(fresh2.load(fresh2.current())[0], m)
+
+
+def test_watcher_inotify_wake_beats_the_poll_period(
+        mem_storage, host_serving, plane_dir):
+    """The propagation-latency satellite: with a deliberately huge poll
+    period, a publish must still install within ~a second — the inotify
+    wake on the manifest rename, not the poll, drives the swap.  (Where
+    inotify is unavailable the watcher falls back to stat-polling and
+    this test is skipped.)"""
+    from predictionio_tpu.streaming.plane import (
+        ModelPlane, PlaneWatcher, _DirNotify,
+    )
+
+    os.makedirs(plane_dir, exist_ok=True)
+    try:
+        probe = _DirNotify(plane_dir)
+        probe.close()
+    except OSError:
+        pytest.skip("inotify unavailable on this platform")
+    _seed(mem_storage)
+    engine, ep, _ = _ur()
+    model = engine.train(ep)[0]
+    pub, sub = ModelPlane(plane_dir), ModelPlane(plane_dir)
+    installed = []
+    watcher = PlaneWatcher(sub, lambda models, info: (
+        installed.append(info["planeGeneration"]), True)[1],
+        poll_s=30.0)
+    watcher.start()
+    try:
+        time.sleep(0.3)                  # let the loop enter its wait
+        t0 = time.time()
+        pub.publish([model])
+        deadline = time.time() + 5
+        while time.time() < deadline and not installed:
+            time.sleep(0.02)
+        assert installed == [1]
+        assert time.time() - t0 < 5.0    # not the 30 s poll
+    finally:
+        watcher.stop()
+
+
+def test_watcher_stat_poll_fallback_converges(
+        mem_storage, host_serving, plane_dir, monkeypatch):
+    """PIO_MODEL_PLANE_NOTIFY=off: the stat-poll fallback still
+    converges within the poll period, and an unchanged manifest costs a
+    stat — not an open/parse — per period."""
+    from predictionio_tpu.streaming.plane import ModelPlane, PlaneWatcher
+
+    monkeypatch.setenv("PIO_MODEL_PLANE_NOTIFY", "off")
+    _seed(mem_storage)
+    engine, ep, _ = _ur()
+    model = engine.train(ep)[0]
+    pub, sub = ModelPlane(plane_dir), ModelPlane(plane_dir)
+    installed = []
+    watcher = PlaneWatcher(sub, lambda models, info: (
+        installed.append(info["planeGeneration"]), True)[1], poll_s=0.05)
+    watcher.start()
+    try:
+        pub.publish([model])
+        deadline = time.time() + 5
+        while time.time() < deadline and not installed:
+            time.sleep(0.02)
+        assert installed == [1]
+    finally:
+        watcher.stop()
 
 
 # -- prefork e2e (real processes) --------------------------------------------
