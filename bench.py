@@ -1807,6 +1807,9 @@ def _serve_catalog_sweep(smoke: bool) -> dict:
                                                    "cpu"),
                 "PIO_METRICS_FLUSH_S": "0.25",
                 "PIO_SERVE_BATCH": "off",
+                # corpus replay repeats queries: keep measuring the
+                # uncached tail (the cache has its own cells)
+                "PIO_SERVE_CACHE": "off",
             }
             # warm-user queries first (the steady-state pruned path),
             # then every rule shape the pruned mask must reproduce
@@ -2230,6 +2233,9 @@ def _plane_sweep(smoke: bool) -> dict:
             "PIO_METRICS_FLUSH_S": "0.25",
             "PIO_MODEL_PLANE_POLL_S": "0.1",
             "PIO_SERVE_BATCH": "off",
+            # the corpus repeats queries: keep measuring the uncached
+            # tail (the response cache has its own cells)
+            "PIO_SERVE_CACHE": "off",
         }
         corpus = [{"user": f"u{(j * 13) % n_users}", "num": 10}
                   for j in range(24)]
@@ -2478,6 +2484,221 @@ def _plane_sweep(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _zipf_user_stream(rng, n_users: int, size: int, s: float):
+    """Zipf(s) draws over a PERMUTED user-id space: rank-1 traffic lands
+    on an arbitrary user id, not u0, so hotness never correlates with
+    the id-ordered item blocks the store builder lays down."""
+    import numpy as np
+
+    p = np.arange(1, n_users + 1, dtype=np.float64) ** -s
+    p /= p.sum()
+    perm = rng.permutation(n_users)
+    return perm[rng.choice(n_users, size=size, p=p)]
+
+
+def _cache_sweep(smoke: bool) -> dict:
+    """ISSUE-16 headline: the provenance-invalidated response cache
+    under Zipf traffic (``PIO_BENCH_ZIPF_S``, default 1.1), in-process
+    so hit latency is the cache alone, not HTTP framing.  Three cells
+    over one real foldable store (chained 6-item histories — every user
+    has unseen signal candidates, so num=4 answers take no popularity
+    backfill and provably survive pop-only swaps):
+
+    - OFF baseline: the uncached pruned tail's p50/p95 — the floor the
+      cache must beat — plus the parity reference answers;
+    - ON steady state: a warm pass fills, a fresh Zipf stream measures
+      hit rate, hit-only p50 and overall p50/p95, every 16th answer
+      checked bit-identical against the OFF reference
+      (``cache_parity``);
+    - FOLDING: the same traffic with a real fold + ``on_swap`` every
+      ``1/folds`` of the stream (a new user buying 2 catalog items:
+      full sparse re-LLR with certification + a popularity bump) —
+      post-swap hit rate and invalidations/swap prove selective
+      invalidation, with an every-32nd oracle spot check on the live
+      generation.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm, URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.serve import response_cache as rc
+    from predictionio_tpu.storage import App
+    from predictionio_tpu.storage.locator import (
+        Storage, StorageConfig, set_storage,
+    )
+    from predictionio_tpu.streaming.fold import URFoldState
+
+    if smoke:
+        n_users, n_queries, folds = 400, 1_200, 6
+    elif _cpu_reduced():
+        n_users, n_queries, folds = 6_000, 9_000, 8
+    else:
+        n_users, n_queries, folds = 24_000, 30_000, 8
+    n_items = 4 * n_users
+    zipf_s = float(os.environ.get("PIO_BENCH_ZIPF_S", "1.1"))
+    # host serving + candidate pruning on, and the pruned sparse re-LLR
+    # forced at every scale so folds carry serve provenance exactly as
+    # the million-item regime does; cache knobs reset to defaults
+    pins = {"PIO_UR_SERVE_SCORER": "host", "PIO_UR_SERVE_TAIL": "host",
+            "PIO_UR_SERVE_CANDIDATES": "on",
+            "PIO_FOLLOW_DENSE_RELLR_BYTES": "1"}
+    drops = ("PIO_SERVE_CACHE", "PIO_SERVE_CACHE_MAX",
+             "PIO_SERVE_CACHE_TTL_S", "PIO_SERVE_CACHE_AUDIT_N")
+    saved = {k: os.environ.get(k) for k in (*pins, *drops)}
+    os.environ.update(pins)
+    for k in drops:
+        os.environ.pop(k, None)
+    tmp = tempfile.mkdtemp(prefix="pio_bench_cache")
+    out: dict = {"cache_zipf_s": zipf_s, "cache_users": n_users,
+                 "cache_catalog_items": n_items,
+                 "cache_queries": n_queries, "cache_parity": "not_run"}
+    cache = rc.get_cache()
+    try:
+        storage = Storage(StorageConfig(
+            sources={"FS": {"type": "localfs", "path": f"{tmp}/store"}},
+            repositories={r: "FS" for r in ("METADATA", "EVENTDATA",
+                                            "MODELDATA")}))
+        set_storage(storage)
+        app_id = storage.apps.insert(App(0, "cacheapp"))
+        # user u owns items 4u..4u+3 and also buys the next block's
+        # first two — the overlap makes 4u+6..4u+9 unseen correlators
+        evs = []
+        for u in range(n_users):
+            for j in range(6):
+                evs.append(Event(
+                    event="buy", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{(4 * u + j) % n_items}"))
+        for s0 in range(0, len(evs), 20_000):
+            storage.l_events.insert_batch(evs[s0:s0 + 20_000], app_id)
+        ap = URAlgorithmParams(app_name="cacheapp", mesh_dp=1,
+                               max_correlators_per_item=8)
+        dp = URDataSourceParams(app_name="cacheapp", event_names=["buy"])
+        tail = storage.l_events.scan_tail_from(app_id, None, {},
+                                               base=None, heads=None)
+        fold = URFoldState.bootstrap(ap, dp, tail["batch"])
+        wm, heads = tail["watermark"], tail["heads"]
+        model = fold.model
+        algo = URAlgorithm(ap)
+        rng = np.random.default_rng(16)
+        streams = [_zipf_user_stream(rng, n_users, n_queries, zipf_s)
+                   for _ in range(3)]
+
+        def q_for(uid):
+            # 1-in-5 queries over-asks past the signal candidates and
+            # pads from popularity backfill — the droppable population
+            return URQuery(user=f"u{uid}",
+                           num=10 if uid % 5 == 0 else 4)
+
+        def canon(res):
+            return [(x.item, float(x.score)) for x in res.item_scores]
+
+        # lazy serving-bundle warm happens outside every timed region;
+        # clear() drops the armed generation too, so re-arm after it
+        cache.on_swap([model])
+        algo.predict(model, q_for(int(streams[0][0])))
+        cache.clear()
+        cache.on_swap([model])
+        cache.hit_count = cache.miss_count = 0
+
+        # -- OFF baseline (the pruned floor) + parity references ----------
+        os.environ["PIO_SERVE_CACHE"] = "off"
+        off_ms, off_ref = [], {}
+        try:
+            for j, uid in enumerate(streams[1]):
+                q = q_for(int(uid))
+                t0 = time.perf_counter()
+                res = algo.predict(model, q)
+                off_ms.append((time.perf_counter() - t0) * 1e3)
+                if j % 16 == 0:
+                    off_ref[j] = canon(res)
+        finally:
+            del os.environ["PIO_SERVE_CACHE"]
+        out["cache_off_p50_ms"] = round(float(np.percentile(off_ms, 50)), 4)
+        out["cache_off_p95_ms"] = round(float(np.percentile(off_ms, 95)), 4)
+
+        # -- ON steady state: warm pass, then a fresh Zipf stream ---------
+        for uid in streams[0]:
+            algo.predict(model, q_for(int(uid)))
+        cache.hit_count = cache.miss_count = 0
+        on_ms, hit_ms, mismatches = [], [], 0
+        for j, uid in enumerate(streams[1]):
+            q = q_for(int(uid))
+            h0 = cache.hit_count
+            t0 = time.perf_counter()
+            res = algo.predict(model, q)
+            dt = (time.perf_counter() - t0) * 1e3
+            on_ms.append(dt)
+            if cache.hit_count > h0:
+                hit_ms.append(dt)
+            if j % 16 == 0 and canon(res) != off_ref[j]:
+                mismatches += 1
+        total = cache.hit_count + cache.miss_count
+        out["cache_hit_rate"] = round(cache.hit_count / max(total, 1), 4)
+        out["cache_on_p50_ms"] = round(float(np.percentile(on_ms, 50)), 4)
+        out["cache_on_p95_ms"] = round(float(np.percentile(on_ms, 95)), 4)
+        out["cache_hit_p50_ms"] = (
+            round(float(np.percentile(hit_ms, 50)), 4) if hit_ms else None)
+        out["cache_entries"] = len(cache)
+        out["cache_parity"] = ("ok" if mismatches == 0
+                               else f"{mismatches} mismatches")
+
+        # -- FOLDING: swaps mid-stream, selective survival ----------------
+        every = max(n_queries // folds, 1)
+        inv, selective, swaps = [], 0, 0
+        f_hits = f_total = 0
+        for j, uid in enumerate(streams[2]):
+            if j and j % every == 0:
+                storage.l_events.insert_batch(
+                    [Event(event="buy", entity_type="user",
+                           entity_id=f"fold{swaps}",
+                           target_entity_type="item",
+                           target_entity_id=f"i{rng.integers(n_items)}")
+                     for _ in range(2)], app_id)
+                tail = storage.l_events.scan_tail_from(
+                    app_id, None, wm, base=fold.batch, heads=heads)
+                wm, heads = tail["watermark"], tail["heads"]
+                model = fold.fold(tail["batch"])
+                cache.on_swap([model])
+                swaps += 1
+                inv.append(cache.last_swap_invalidated)
+                selective += cache.last_swap_reason == "selective"
+            q = q_for(int(uid))
+            h0 = cache.hit_count
+            res = algo.predict(model, q)
+            f_total += 1
+            f_hits += cache.hit_count > h0
+            if j % 32 == 0:
+                os.environ["PIO_SERVE_CACHE"] = "off"
+                try:
+                    if canon(res) != canon(algo.predict(model, q)):
+                        mismatches += 1
+                        out["cache_parity"] = f"{mismatches} mismatches"
+                finally:
+                    del os.environ["PIO_SERVE_CACHE"]
+        out["cache_swaps"] = swaps
+        out["cache_selective_swaps"] = selective
+        out["cache_invalidations_per_swap"] = (
+            round(float(np.mean(inv)), 1) if inv else None)
+        out["cache_fold_hit_rate"] = round(f_hits / max(f_total, 1), 4)
+        return out
+    finally:
+        cache.clear()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        set_storage(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serve_scale(smoke: bool) -> dict:
     """Multi-worker query serving (the serving twin of ingest_scale): a
     REAL ``pio deploy --workers N`` CLI subprocess per cell — prefork
@@ -2550,6 +2771,10 @@ def bench_serve_scale(smoke: bool) -> dict:
             "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
             "PIO_JAX_PLATFORM": os.environ.get("PIO_JAX_PLATFORM", "cpu"),
             "PIO_METRICS_FLUSH_S": "0.25",
+            # corpus replay repeats queries: qps/p50 cells must keep
+            # measuring the uncached tail (the response cache has its
+            # own _cache_sweep cells)
+            "PIO_SERVE_CACHE": "off",
         }
         # the parity corpus: every rule shape the mask cache serves, with
         # enough repetition that steady-state cells run on cache hits
@@ -2748,6 +2973,13 @@ def bench_serve_scale(smoke: bool) -> dict:
             out["plane_memory_guard"] = f"section_failed: {e}"
             out["plane_parity"] = f"section_failed: {e}"
             out["plane_fold_once"] = f"section_failed: {e}"
+        # ISSUE-16 headline: provenance-invalidated response cache (own
+        # in-process store; isolated failure, same pattern as above)
+        try:
+            out.update(_cache_sweep(smoke))
+        except Exception as e:
+            out["cache_hit_rate"] = f"section_failed: {e}"
+            out["cache_parity"] = f"section_failed: {e}"
         return out
     finally:
         set_storage(None)

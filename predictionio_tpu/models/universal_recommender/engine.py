@@ -61,6 +61,7 @@ from predictionio_tpu.ops.als import (
     pad_ids as als_pad_ids,
 )
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.serve import response_cache as _resp_cache
 from predictionio_tpu.store.columnar import CSRLookup, IdDict, fold_properties
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
 
@@ -765,10 +766,46 @@ class URModel(PersistentModel):
         """Composed business-rule masks, one LRU per (model generation,
         tail kind).  Living in ``__dict__`` (never pickled) means a
         hot-swap/auto-reload — which loads a NEW model object — starts
-        from an empty cache: invalidation is the model generation
-        itself."""
+        from an empty cache... UNLESS swap provenance proves the mask
+        inputs untouched, in which case :meth:`adopt_rule_caches`
+        carries the LRU objects to the new generation."""
         return self._lru(f"_rule_mask_{kind}", _rule_mask_cache_max(),
                          "rule_mask")
+
+    # serving caches that are pure functions of (item_dict,
+    # item_properties): when a swap proves both unchanged, the LRU
+    # OBJECTS carry to the new generation (values are read-only by
+    # contract, the LRUs are thread-safe, and in-flight queries on the
+    # old generation share them harmlessly — the entries are
+    # bit-identical for both)
+    _SWAP_CARRY_ATTRS = ("_rule_mask_host", "_rule_mask_device",
+                         "_host_value_mask", "_dev_value_mask",
+                         "_date_off", "_dev_date")
+
+    def adopt_rule_caches(self, prev: "URModel", carry: bool) -> None:
+        """Swap-survival for the PR-4 rule caches: composed rule masks,
+        value-mask bitsets and date offsets/arrays depend ONLY on the
+        item dictionary and item properties, so a generation swap whose
+        provenance proves both untouched (fold: same catalog + props
+        carried by object; plane: item crc + propsCrc equal) keeps every
+        entry hot instead of flushing wholesale at fold-tick rates.
+        ``carry=False`` records the flush that used to be silent —
+        carried vs dropped land in pio_ur_rule_mask_cache_total."""
+        n_rules = 0
+        for attr in ("_rule_mask_host", "_rule_mask_device"):
+            c = prev.__dict__.get(attr)
+            if c is not None:
+                n_rules += len(c)
+        if not carry:
+            if n_rules:
+                _M_MASK_CACHE.inc(n_rules, outcome="dropped")
+            return
+        for attr in self._SWAP_CARRY_ATTRS:
+            c = prev.__dict__.get(attr)
+            if c is not None:
+                self.__dict__.setdefault(attr, c)
+        if n_rules:
+            _M_MASK_CACHE.inc(n_rules, outcome="carried")
 
     def known_prop_names(self) -> frozenset:
         """Property names that exist on at least one item — the gate that
@@ -1523,6 +1560,37 @@ class URAlgorithm(Algorithm):
         lap("history")
         num = min(query.num, n_items)
         cand_label = "off"
+        # -- provenance-invalidated response cache (serve.response_cache)
+        # consulted before any scoring.  The key covers everything the
+        # answer depends on (k, canonical rules, history ids, blacklist
+        # ids — the latter two recomputed fresh, so user drift reroutes
+        # to a new key instead of needing invalidation); a hit is
+        # bit-identical to the tail by the swap-sweep proof, spot-checked
+        # online every PIO_SERVE_CACHE_AUDIT_N hits.  hist_override
+        # (eval's anti-leakage path) always bypasses.
+        cache = _resp_cache.get_cache()
+        ckey = rkey = cached_items = None
+        audit = False
+        if cache.armed_for(model):
+            if hist_override is not None:
+                cache.count_bypass()
+            else:
+                # strict date parsing (400 on malformed) runs in the key
+                # builder, exactly as the uncached mask path would
+                rkey = self._mask_rule_key(query)
+                ckey = _resp_cache.make_key(
+                    num, rkey, hist, self._blacklist_ids(model, query))
+                cached_items, audit = cache.lookup(model, ckey)
+                lap("cache")
+                if cached_items is not None and not audit:
+                    if meta is not None:
+                        meta["candidates"] = "cache"
+                    for name, dt in stages:
+                        _M_STAGE.observe(dt, stage=name, tail=tail,
+                                         candidates="cache")
+                    return URResult([ItemScore(n, s)
+                                     for n, s in cached_items])
+        fill: Optional[dict] = {} if ckey is not None else None
         if tail == "host" and _serve_candidates() == "on":
             # candidate-pruned tail: the sparse scorer result feeds a
             # pruned mask/topk/backfill pass; a per-query fallback
@@ -1539,7 +1607,8 @@ class URAlgorithm(Algorithm):
                 sub.append((name, now - t[0]))
                 t[0] = now
 
-            res = self._host_tail_pruned(model, query, sparse, num, sub_lap)
+            res = self._host_tail_pruned(model, query, sparse, num, sub_lap,
+                                         fill=fill)
             if res is not None:
                 stages.extend(sub)
                 cand_label = "on"
@@ -1547,7 +1616,8 @@ class URAlgorithm(Algorithm):
                 t[0] = _time.perf_counter()   # discard the aborted laps
                 res = self._host_tail(
                     model, query,
-                    self._sparse_signal_dense(n_items, sparse), num, lap)
+                    self._sparse_signal_dense(n_items, sparse), num, lap,
+                    fill=fill)
         else:
             signal = (self._score_history(model, hist)
                       if hist is not None else None)
@@ -1555,10 +1625,14 @@ class URAlgorithm(Algorithm):
             have_signal = signal is not None
             if tail == "host":
                 sig_np = None if signal is None else np.asarray(signal)
-                res = self._host_tail(model, query, sig_np, num, lap)
+                res = self._host_tail(model, query, sig_np, num, lap,
+                                      fill=fill)
             else:
                 res = self._device_tail(model, query, signal, have_signal,
-                                        num, lap)
+                                        num, lap, fill=fill)
+        if ckey is not None:
+            self._cache_settle(cache, model, ckey, rkey, res, cached_items,
+                               hist, fill, num)
         if meta is not None:
             meta["candidates"] = cand_label
         for name, dt in stages:
@@ -1566,8 +1640,28 @@ class URAlgorithm(Algorithm):
                              candidates=cand_label)
         return res
 
+    def _cache_settle(self, cache, model: URModel, ckey: tuple,
+                      rkey: Optional[tuple], res: URResult,
+                      cached_items, hist, fill: Optional[dict],
+                      num: int) -> None:
+        """Post-tail response-cache bookkeeping: fill after a miss, or —
+        on an audited hit — compare the fresh answer bit-for-bit against
+        the cached one (a mismatch means the invalidation proof broke:
+        count it, full-flush, and the caller serves the FRESH result)."""
+        items = tuple((r.item, float(r.score)) for r in res.item_scores)
+        if cached_items is not None:
+            if items != cached_items:
+                cache.audit_mismatch(ckey)
+            return
+        used_backfill = bool((fill or {}).get("backfill")) or (
+            len(items) < num and self.params.backfill_type != "none")
+        cache.put(model, ckey, items, hist, (fill or {}).get("ids", ()),
+                  used_backfill, rkey is not None,
+                  bool(self.params.use_llr_weights))
+
     def _device_tail(self, model: URModel, query: URQuery, signal,
-                     have_signal: bool, num: int, lap) -> URResult:
+                     have_signal: bool, num: int, lap,
+                     fill: Optional[dict] = None) -> URResult:
         mask = self._mask_for(model, query, host=False)
         black_ids = self._blacklist_ids(model, query)
         lap("mask")
@@ -1582,13 +1676,13 @@ class URAlgorithm(Algorithm):
         lap("topk")
         res = self._assemble(model, num, have_signal,
                              out[0], out[1].astype(np.int32),
-                             out[2], out[3].astype(np.int32))
+                             out[2], out[3].astype(np.int32), fill=fill)
         lap("assemble")
         return res
 
     def _host_tail(self, model: URModel, query: URQuery,
                    signal: Optional[np.ndarray], num: int,
-                   lap=None) -> URResult:
+                   lap=None, fill: Optional[dict] = None) -> URResult:
         """The zero-dispatch serve tail: same math as _serve_topk, in
         numpy, with the composed rule mask cached per canonical rule set.
         Elementwise f32 products match XLA's bit-for-bit and
@@ -1638,14 +1732,16 @@ class URAlgorithm(Algorithm):
             st if st is not None else empty_f,
             si if si is not None else empty_i,
             bt if bt is not None else empty_f,
-            bi if bi is not None else empty_i)
+            bi if bi is not None else empty_i, fill=fill)
         if lap is not None:
             lap("assemble")
         return res
 
     def _host_tail_pruned(self, model: URModel, query: URQuery,
                           sparse: Optional[Tuple[np.ndarray, np.ndarray]],
-                          num: int, lap=None) -> Optional[URResult]:
+                          num: int, lap=None,
+                          fill: Optional[dict] = None
+                          ) -> Optional[URResult]:
         """Candidate-pruned host tail: mask composition, blacklist,
         signal top-k, and popularity backfill all touch ONLY the sparse
         scorer's candidate rows (plus an O(num) walk of the precomputed
@@ -1739,7 +1835,7 @@ class URAlgorithm(Algorithm):
             st if st is not None else empty_f,
             si if si is not None else empty_i,
             bt if bt is not None else empty_f,
-            bi if bi is not None else empty_i)
+            bi if bi is not None else empty_i, fill=fill)
         if lap is not None:
             lap("assemble")
         return res
@@ -1856,12 +1952,15 @@ class URAlgorithm(Algorithm):
         return None
 
     def _assemble(self, model: URModel, num: int, have_signal: bool,
-                  st, si, bt, bi) -> URResult:
+                  st, si, bt, bi, fill: Optional[dict] = None) -> URResult:
         """Host tail shared by predict and serve_batch_predict: signal
         picks first, then popularity backfill PADS short lists up to num
-        (reference UR appends popRank-ordered items)."""
+        (reference UR appends popRank-ordered items).  ``fill``, when
+        given, receives the response cache's entry facts — the picked
+        item ids and how many came from backfill."""
         results: List[ItemScore] = []
         chosen = set()
+        bf_ids: List[int] = []
         if have_signal:
             for s, j in zip(st, si):
                 if np.isfinite(s) and s > 0 and len(results) < num:
@@ -1875,6 +1974,10 @@ class URAlgorithm(Algorithm):
                 if int(j) in chosen or not np.isfinite(s):
                     continue
                 results.append(ItemScore(model.item_dict.str(int(j)), float(s) / norm))
+                bf_ids.append(int(j))
+        if fill is not None:
+            fill["ids"] = list(chosen) + bf_ids
+            fill["backfill"] = len(bf_ids)
         return URResult(results)
 
     def serve_batch_predict(self, model: URModel,
@@ -1887,13 +1990,58 @@ class URAlgorithm(Algorithm):
         chip).  Live-store semantics identical to predict(); the separate
         eval-only batch_predict (model-history, anti-leakage) is
         untouched.
+
+        Shares the serial path's response cache (serve.response_cache)
+        with per-row outcome counting: cached rows peel off before any
+        device work, only the miss subset runs the batched tail, and the
+        misses fill the same cache serial predict consults — one cache
+        contract for both paths.
         """
         n_items = len(model.item_dict)
         if not queries or n_items == 0:
             return [URResult([]) for _ in queries]
+        hists = [self._query_hist(model, q) for q in queries]
+        cache = _resp_cache.get_cache()
+        if not cache.armed_for(model):
+            return self._serve_batch_uncached(model, queries, hists)
+        keys: List[Tuple[tuple, Optional[tuple], int]] = []
+        out: List[Optional[URResult]] = [None] * len(queries)
+        misses: List[int] = []
+        audited: Dict[int, tuple] = {}
+        for r, q in enumerate(queries):
+            num = min(q.num, n_items)
+            rkey = self._mask_rule_key(q)
+            ckey = _resp_cache.make_key(
+                num, rkey, hists[r], self._blacklist_ids(model, q))
+            keys.append((ckey, rkey, num))
+            items, audit = cache.lookup(model, ckey)
+            if items is not None and not audit:
+                out[r] = URResult([ItemScore(n, s) for n, s in items])
+            else:
+                misses.append(r)
+                if items is not None:
+                    audited[r] = items
+        if misses:
+            fills: List[dict] = [{} for _ in misses]
+            fresh = self._serve_batch_uncached(
+                model, [queries[r] for r in misses],
+                [hists[r] for r in misses], fills)
+            for i, r in enumerate(misses):
+                out[r] = fresh[i]
+                ckey, rkey, num = keys[r]
+                self._cache_settle(cache, model, ckey, rkey, fresh[i],
+                                   audited.get(r), hists[r], fills[i], num)
+        return out
+
+    def _serve_batch_uncached(self, model: URModel,
+                              queries: Sequence[URQuery], hists,
+                              fills: Optional[List[dict]] = None,
+                              ) -> List[URResult]:
+        """The batched tail itself (histories already fetched), shared
+        by the cache-armed wrapper (miss subset) and unarmed serving."""
+        n_items = len(model.item_dict)
         b = len(queries)
         bp = bucket_width(b, min_width=1)
-        hists = [self._query_hist(model, q) for q in queries]
         have_signal = [h is not None and any(len(v) for v in h.values())
                        for h in hists]
         scorer = _serve_scorer()
@@ -1913,13 +2061,15 @@ class URAlgorithm(Algorithm):
                     out = []
                     for r, q in enumerate(queries):
                         nm = min(q.num, n_items)
+                        f = fills[r] if fills is not None else None
                         res = self._host_tail_pruned(model, q, sparses[r],
-                                                     nm)
+                                                     nm, fill=f)
                         if res is None:
                             res = self._host_tail(
                                 model, q,
                                 self._sparse_signal_dense(n_items,
-                                                          sparses[r]), nm)
+                                                          sparses[r]), nm,
+                                fill=f)
                         out.append(res)
                     return out
                 rows = [self._sparse_signal_dense(n_items, s)
@@ -1931,7 +2081,8 @@ class URAlgorithm(Algorithm):
                 rows = [rows_all[r] if rows_all is not None and have_signal[r]
                         else None for r in range(b)]
             return [
-                self._host_tail(model, q, rows[r], min(q.num, n_items))
+                self._host_tail(model, q, rows[r], min(q.num, n_items),
+                                fill=fills[r] if fills is not None else None)
                 for r, q in enumerate(queries)
             ]
         total = None
@@ -1965,7 +2116,8 @@ class URAlgorithm(Algorithm):
         return [
             self._assemble(model, nums[r], have_signal[r],
                            out[r, 0], out[r, 1].astype(np.int32),
-                           out[r, 2], out[r, 3].astype(np.int32))
+                           out[r, 2], out[r, 3].astype(np.int32),
+                           fill=fills[r] if fills is not None else None)
             for r in range(b)
         ]
 

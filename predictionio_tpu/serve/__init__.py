@@ -1,0 +1,8 @@
+"""Serving-side subsystems that sit ABOVE one engine's predict math.
+
+``response_cache`` — the provenance-invalidated top-k response cache:
+whole-answer memoization across generation swaps, keyed on everything a
+response depends on and selectively invalidated by the fold engine's
+changed-set provenance (see response_cache module docstring for the
+exactness argument).
+"""

@@ -1498,8 +1498,9 @@ class URFoldState:
                         and len(model.item_dict) == len(prev.item_dict))
         grown_ok = same_catalog or (remap["primary"]
                                     and remap.get("primary_identity"))
-        if same_catalog and not remap["props"] \
-                and model.item_properties is prev.item_properties:
+        props_carried = (same_catalog and not remap["props"]
+                         and model.item_properties is prev.item_properties)
+        if props_carried:
             carried = False
             for attr in ("_prop_value_index", "_prop_date_array",
                          "_known_prop_names", "_date_off"):
@@ -1509,8 +1510,55 @@ class URFoldState:
                     carried = True
             if carried:
                 _M_EMIT.inc(1, component="props", path="carried")
+        # rule-mask / value-mask / date caches: pure functions of
+        # (item_dict, item_properties) — exactly what props_carried
+        # proves unchanged, so the LRU objects survive the swap (and a
+        # props change records the drop instead of flushing silently)
+        model.adopt_rule_caches(prev, carry=props_carried)
         if not grown_ok:
             return
+        # -- serve-level provenance (serve.response_cache) ---------------
+        # The response cache needs per-type changed primary rows and
+        # changed popularity ids INDEPENDENT of whether this process ever
+        # built the host inverted index or pop order, so they come
+        # straight from the emit hints (the same rows the CSR patch
+        # trusts for bit-exactness) + COW object identity for untouched
+        # types.  Any unknowable piece (full re-select, column remap,
+        # non-incremental popularity) withholds the stash entirely — the
+        # cache then full-flushes, never serves stale.
+        n_new, n_old = len(model.item_dict), len(prev.item_dict)
+        grow = (np.arange(n_old, n_new, dtype=np.int64) if n_new > n_old
+                else None)
+        sinv: Dict[str, np.ndarray] = {}
+        serve_ok = set(model.indicator_idx) == set(prev.indicator_idx)
+        for name in (model.indicator_idx if serve_ok else ()):
+            if remap["types"].get(name) \
+                    and not remap["type_identity"].get(name):
+                serve_ok = False   # target-column ids shifted
+                break
+            new_idx = model.indicator_idx[name]
+            old_idx = prev.indicator_idx.get(name)
+            if new_idx is old_idx:
+                changed = np.zeros(0, np.int64)   # COW: provably untouched
+            elif new_idx is None or old_idx is None:
+                serve_ok = False
+                break
+            else:
+                hint = snap.hints.get(name)
+                if hint is None or hint.get("idx_rows") is None:
+                    serve_ok = False   # full re-select: any row may move
+                    break
+                changed = np.asarray(hint["idx_rows"], np.int64)
+                if new_idx.shape[0] > old_idx.shape[0]:
+                    changed = np.union1d(changed, np.arange(
+                        old_idx.shape[0], new_idx.shape[0],
+                        dtype=np.int64))
+            sinv[name] = changed
+        if serve_ok and snap.pop_changed is not None:
+            pchg = np.asarray(snap.pop_changed, np.int64)
+            if grow is not None:
+                pchg = np.union1d(pchg, grow)
+            prov["serve"] = {"inv": sinv, "pop": pchg}
         # -- host_pop_order: incremental merge of (changed ∪ new) ids ----
         old_order = prev.__dict__.get("_host_pop_order")
         if old_order is not None and snap.pop_changed is not None:

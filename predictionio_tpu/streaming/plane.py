@@ -505,6 +505,15 @@ class ModelPlane:
                     and self._chain_intact(prev)):
                 delta = self._encode_delta(arrays, model, prev)
             meta["generation"] = gen
+            # serve-level provenance for the WORKERS' response caches:
+            # the fold's changed sets, serialized alongside the arena so
+            # a subscriber's generation swap can invalidate selectively
+            # (serve.response_cache).  Rides deltas AND periodic
+            # keyframes — only a rebuild (restage/retrain) or a broken
+            # prev-generation link publishes provenance-free, which
+            # workers answer with a full flush.
+            sprov_blobs = self._serve_prov_payload(model, meta, cur, prev,
+                                                   rebuilt)
             if delta is not None:
                 entries, blobs, stats = delta
                 meta["planeKind"] = "delta"
@@ -523,6 +532,12 @@ class ModelPlane:
                 fname = f"gen-{gen:010d}.arena"
                 payload = arrays
                 chain = [fname]
+            if sprov_blobs:
+                # blobs ride the WRITTEN payload only — never
+                # self._pub_prev["arrays"], whose key set must keep
+                # matching the model payload for delta encoding
+                payload = dict(payload)
+                payload.update(sprov_blobs)
             path = os.path.join(self.dir, fname)
             tmp = os.path.join(self.dir, f".{fname}.tmp-{os.getpid()}")
             write_arrays(tmp, payload, meta)         # flush+fsync inside
@@ -573,6 +588,34 @@ class ModelPlane:
                             "publishing a full keyframe to heal", fname)
                 return False
         return True
+
+    def _serve_prov_payload(self, model, meta: Dict, cur, prev,
+                            rebuilt: bool) -> Dict[str, np.ndarray]:
+        """``meta["serveProv"]`` + its int64 blobs when the fold's
+        serve-level provenance is valid against the generation THIS
+        instance published last (and the plane hasn't moved underneath
+        us); {} otherwise — absent provenance makes workers full-flush,
+        never serve stale."""
+        from predictionio_tpu.serve.response_cache import _swap_provenance
+
+        if (rebuilt or prev is None or cur is None
+                or int(cur["generation"]) != prev["gen"]):
+            return {}
+        sp = _swap_provenance(model, prev["model"])
+        if sp is None:
+            return {}
+        blobs: Dict[str, np.ndarray] = {}
+        inv_keys: Dict[str, str] = {}
+        for i, name in enumerate(model.indicator_idx):
+            key = f"sprov_inv_{i}"
+            blobs[key] = np.ascontiguousarray(sp["inv"][name], np.int64)
+            inv_keys[name] = key
+        blobs["sprov_pop"] = np.ascontiguousarray(sp["pop"], np.int64)
+        meta["serveProv"] = {
+            "prev": int(prev["gen"]),
+            "props": int(bool(sp["props_changed"])),
+            "inv": inv_keys, "pop": "sprov_pop"}
+        return blobs
 
     def _encode_delta(self, arrays: Dict[str, np.ndarray], model,
                       prev: Dict[str, Any]):
@@ -974,6 +1017,25 @@ class ModelPlane:
         model = self._build_model(composed, final_meta)
         gen = int(final_meta.get("generation")
                   or manifest["generation"])
+        # serve-level provenance (serve.response_cache): small int64
+        # changed-set blobs COPIED out of the newest file's mapping (so
+        # they never pin it) — only meaningful when this worker's
+        # installed generation is exactly prevGeneration, which the
+        # cache checks itself; malformed/missing blobs simply leave the
+        # model provenance-free (full flush, never stale)
+        sp = final_meta.get("serveProv")
+        if isinstance(sp, dict):
+            try:
+                raw = chain[-1][1]
+                model.__dict__["_serve_prov"] = {
+                    "prev_gen": int(sp["prev"]),
+                    "props_changed": bool(sp.get("props")),
+                    "inv": {str(name): np.array(raw[str(key)], np.int64)
+                            for name, key in dict(sp["inv"]).items()},
+                    "pop": np.array(raw[str(sp["pop"])], np.int64),
+                }
+            except (KeyError, TypeError, ValueError):
+                model.__dict__.pop("_serve_prov", None)
         # commit the compose state only after a fully successful build
         self._composed, self._composed_gen = composed, gen
         self._inv_perms = inv_perms
@@ -1251,6 +1313,11 @@ class ModelPlane:
                 v = prev.__dict__.get(attr)
                 if v is not None:
                     model.__dict__[attr] = v
+        if prev is not None:
+            # composed rule masks / value bitsets / date arrays carry on
+            # the same (item crc + propsCrc) proof; a props change
+            # records the per-entry drop instead of flushing silently
+            model.adopt_rule_caches(prev, carry=props_carried)
         if prev is not None and prev_meta is not None \
                 and item_crc == prev_meta["dicts"]["item"]["crc"]:
             z = prev.__dict__.get("_host_zeros")

@@ -34,6 +34,7 @@ from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import tracing as obs_tracing
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.obs.metrics import SIZE_BUCKETS
+from predictionio_tpu.serve import response_cache as _response_cache
 from predictionio_tpu.storage.locator import Storage, get_storage
 from predictionio_tpu.workflow import core_workflow
 from predictionio_tpu.workflow.create_workflow import (
@@ -552,6 +553,19 @@ class QueryServerState:
             if ticket <= self._installed_seq:
                 return False   # a build that started later already installed
             self._installed_seq = ticket
+            # response cache: re-arm on the new generation BEFORE the
+            # predictor goes live, sweeping exactly the entries its swap
+            # provenance cannot prove unchanged (serve.response_cache);
+            # the cache must never be able to break an install
+            try:
+                _response_cache.get_cache().on_swap(models)
+            except Exception:
+                log.exception("response-cache swap sweep failed — "
+                              "disarming the cache")
+                try:
+                    _response_cache.get_cache().disarm()
+                except Exception:
+                    pass
             self.predictor = predictor
             self.batcher = batcher
             if instance is not None:

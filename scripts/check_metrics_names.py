@@ -52,6 +52,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.streaming.follow",
     "predictionio_tpu.streaming.fold",
     "predictionio_tpu.streaming.plane",
+    "predictionio_tpu.serve.response_cache",
 ]
 
 
@@ -113,6 +114,13 @@ REQUIRED_METRICS = frozenset({
     "pio_model_plane_publish_bytes_total",
     "pio_model_plane_blob_count",
     "pio_model_plane_chain_len",
+    # provenance-invalidated response cache (PR 16): hit-rate dashboards
+    # key on the outcome counter; the zero-staleness alert keys on the
+    # audit-mismatch counter staying 0
+    "pio_serve_cache_total",
+    "pio_serve_cache_invalidations_total",
+    "pio_serve_cache_entries",
+    "pio_serve_cache_audit_mismatch_total",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

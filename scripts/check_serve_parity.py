@@ -506,10 +506,168 @@ def plane_phase(engine, ep, query_cls, storage, problems) -> None:
               "post-drain responses exactly match the in-process oracle")
 
 
+def cache_phase(engine, ep, query_cls, storage, problems) -> None:
+    """Provenance-invalidated response cache over the live front end:
+    the corpus replays against a deployed server with the cache ON while
+    an embedded follower swaps generations mid-stream (zero 5xx — a hit
+    must never observe a half-swapped model either), then every
+    post-drain answer — cached hits included — must be bit-identical to
+    the ``PIO_SERVE_CACHE=off`` oracle on the same generation, with the
+    online audit (every 3rd hit) recording zero mismatches and the cache
+    proven live (hit_count > 0, not vacuously dark)."""
+    import http.client
+    import json as _json
+    import threading
+    import time as _time
+
+    from predictionio_tpu.api.http_util import start_server
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.serve import response_cache as rc
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow.create_server import (
+        QueryServerState, make_handler,
+    )
+
+    saved = {k: os.environ.get(k)
+             for k in ("PIO_SERVE_CACHE", "PIO_SERVE_CACHE_AUDIT_N",
+                       "PIO_FOLLOW_DENSE_RELLR_BYTES")}
+    os.environ.pop("PIO_SERVE_CACHE", None)          # cache ON
+    os.environ["PIO_SERVE_CACHE_AUDIT_N"] = "3"      # audit every 3rd hit
+    # force the pruned sparse re-LLR at toy scale so folds carry serve
+    # provenance exactly as the at-scale regime does
+    os.environ["PIO_FOLLOW_DENSE_RELLR_BYTES"] = "1"
+    cache = rc.get_cache()
+    cache.clear()
+    cache.hit_count = cache.miss_count = 0
+    audit0 = rc._M_AUDIT.value()
+    app = storage.apps.get_by_name("parityapp")
+    state = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
+                             "default", storage=storage)
+    follower = state.follower = FollowTrainer(
+        engine, ep, "parity-engine", storage=storage, interval=0.05,
+        on_publish=state.swap_models, persist=False)
+    follower.start()
+    httpd = start_server(make_handler(state), "127.0.0.1", 0,
+                         background=True)
+    port = httpd.server_address[1]
+    bodies = corpus_bodies()
+    gen_start = state.generation
+    errors_5xx: list = []
+    replay_errors: list = []
+    stop = threading.Event()
+
+    def replay_loop():
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            while not stop.is_set():
+                for body in bodies:
+                    conn.request("POST", "/queries.json",
+                                 _json.dumps(body).encode(),
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    if r.status >= 500:
+                        errors_5xx.append((r.status, payload[:200]))
+            conn.close()
+        except Exception as e:
+            replay_errors.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=replay_loop, daemon=True)
+    try:
+        t.start()
+        for k in range(4):
+            storage.l_events.insert_batch(
+                [Event(event="purchase", entity_type="user",
+                       entity_id=f"cacheswapper{k}",
+                       target_entity_type="item",
+                       target_entity_id=f"e{j}") for j in (0, 1, 2)],
+                app.id)
+            _time.sleep(0.15)
+        deadline = _time.time() + 20
+        while _time.time() < deadline and (
+                state.generation <= gen_start
+                or follower.last_outcome != "idle"):
+            _time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        follower.stop()
+    swaps = state.generation - gen_start
+    if swaps < 1:
+        problems.append("cache: follower never swapped a generation "
+                        f"(outcome={follower.last_outcome})")
+    if errors_5xx:
+        problems.append(
+            f"cache: {len(errors_5xx)} 5xx responses with the cache on "
+            f"during swaps (first: {errors_5xx[0]})")
+    if replay_errors:
+        problems.append(
+            f"cache: replay connection died: {replay_errors[0]}")
+    # post-drain: fill + hit for every body, each bit-identical to the
+    # PIO_SERVE_CACHE=off oracle on the SAME generation (the deployed
+    # server is in-process, so the env flip governs its lookups too)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+
+    def post(body):
+        conn.request("POST", "/queries.json", _json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = r.read()
+        if r.status != 200:
+            return None, f"HTTP {r.status}: {payload[:200]!r}"
+        return canon_http(_json.loads(payload)), None
+
+    for qi, body in enumerate(bodies + [{"user": "cacheswapper0",
+                                         "num": 6}]):
+        first, err = post(body)
+        second = None
+        if err is None:
+            second, err = post(body)           # warm: a cache hit
+        if err is None:
+            os.environ["PIO_SERVE_CACHE"] = "off"
+            try:
+                oracle, err = post(body)
+            finally:
+                os.environ.pop("PIO_SERVE_CACHE", None)
+        if err is not None:
+            problems.append(f"cache: post-drain query #{qi} {err}")
+            continue
+        if first != oracle or second != oracle:
+            problems.append(
+                f"cache: query #{qi} differs from the cache-off oracle:"
+                f"\n  fill: {first}\n  hit:  {second}\n  want: {oracle}")
+    conn.close()
+    httpd.shutdown()
+    httpd.server_close()
+    if cache.hit_count == 0:
+        problems.append("cache: hit_count stayed 0 — the phase never "
+                        "served a cached answer (cache dark?)")
+    audit_failures = rc._M_AUDIT.value() - audit0
+    if audit_failures:
+        problems.append(f"cache: {audit_failures} online audit "
+                        "mismatches — a cached answer diverged from the "
+                        "recomputed tail")
+    cache.clear()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    if not problems:
+        print(f"cache phase: {swaps} mid-stream swaps with the cache on, "
+              f"zero 5xx, {cache.hit_count} hits, fill+hit responses "
+              "exactly match the cache-off oracle, zero audit mismatches")
+
+
 def main() -> int:
     # pin the scorer so both tails consume the IDENTICAL signal array and
     # any diff is attributable to the tail under test
     os.environ["PIO_UR_SERVE_SCORER"] = "host"
+    # the tail/wire phases replay repeated corpora through armed servers:
+    # keep them measuring the TAILS, not the response cache (which gets
+    # its own phase below)
+    os.environ["PIO_SERVE_CACHE"] = "off"
     build_app()
     from predictionio_tpu.controller.engine import EngineParams
     from predictionio_tpu.models.universal_recommender import (
@@ -581,13 +739,17 @@ def main() -> int:
     # must equal the PIO_MODEL_PLANE=off oracle established above
     if not problems:
         plane_phase(engine, ep, URQuery, get_storage(), problems)
+    # response-cache phase: the same live-swap drill with the cache ON,
+    # hits bit-identical to the cache-off oracle
+    if not problems:
+        cache_phase(engine, ep, URQuery, get_storage(), problems)
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
         print(f"ok: {len(queries)} queries × (6 serving paths + "
               "http serial/pipelined × candidates on/off + live "
-              "hot-swap phase + model-plane phase) identical "
-              "(items, scores, order)")
+              "hot-swap phase + model-plane phase + response-cache "
+              "phase) identical (items, scores, order)")
     return 1 if problems else 0
 
 

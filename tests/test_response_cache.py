@@ -1,0 +1,635 @@
+"""Provenance-invalidated response cache (serve.response_cache).
+
+The cache's contract is ZERO staleness: a hit must be bit-identical to
+the uncached tail, with ``PIO_SERVE_CACHE=off`` as the oracle.  These
+tests drive real folds, real hot-swaps through
+``QueryServerState.swap_models`` and the real model plane — the same
+no-mocks rule as test_streaming_follow — plus direct unit coverage of
+the key builder, the LRU bound, and 8-thread concurrency.
+"""
+
+import os
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.serve import response_cache as rc
+
+
+# -- helpers (test_streaming_follow idiom) -----------------------------------
+
+
+def _buy(u, i, event="purchase"):
+    from predictionio_tpu.events.event import Event
+
+    return Event(event=event, entity_type="user", entity_id=u,
+                 target_entity_type="item", target_entity_id=i)
+
+
+def _set_item(i, props):
+    from predictionio_tpu.events.event import DataMap, Event
+
+    return Event(event="$set", entity_type="item", entity_id=i,
+                 properties=DataMap(props))
+
+
+def _cluster_events():
+    """Two DISJOINT user/item clusters: a delta confined to cluster B
+    provably cannot move any cluster-A answer (no shared users, items,
+    or co-occurrence cells) — the shape selective invalidation needs."""
+    evs = []
+    for u in range(6):
+        for it in range(4):
+            if u == 1 and it >= 2:
+                continue        # a1's own history stays short (iA0, iA1)
+            evs.append(_buy(f"a{u}", f"iA{it}"))
+            evs.append(_buy(f"b{u}", f"iB{it}"))
+    return evs
+
+
+def _ur_setup(fs_storage, app_name="rcapp", event_names=("purchase",),
+              **algo_kw):
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithmParams, URDataSourceParams,
+    )
+    from predictionio_tpu.storage.base import App
+
+    app_id = fs_storage.apps.insert(App(0, app_name))
+    engine = UniversalRecommenderEngine.apply()
+    ap = URAlgorithmParams(app_name=app_name, mesh_dp=1,
+                           max_correlators_per_item=6, **algo_kw)
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name=app_name, event_names=list(event_names)),
+        algorithm_params_list=[("ur", ap)])
+    return app_id, engine, ap, ep
+
+
+def _follow_pair(fs_storage, app_id, engine, ap, ep):
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow import core_workflow
+    from predictionio_tpu.workflow.create_server import QueryServerState
+
+    core_workflow.run_train(engine, ep, engine_id="rc-eng",
+                            storage=fs_storage)
+    state = QueryServerState(
+        engine, ep, UniversalRecommenderEngine.query_class, "rc-eng",
+        "1", "default", storage=fs_storage)
+    follower = state.follower = FollowTrainer(
+        engine, ep, "rc-eng", storage=fs_storage, interval=3600,
+        on_publish=state.swap_models, persist=False)
+    assert follower.mode == "fold"
+    assert follower.bootstrap()
+    return state, follower
+
+
+def _canon(res):
+    return [(s.item, float(s.score)) for s in res.item_scores]
+
+
+def _oracle(state, body):
+    """The cold answer: same server, same generation, cache OFF."""
+    os.environ["PIO_SERVE_CACHE"] = "off"
+    try:
+        return _canon(state.predict(body))
+    finally:
+        del os.environ["PIO_SERVE_CACHE"]
+
+
+@pytest.fixture()
+def host_serving(monkeypatch):
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+
+
+@pytest.fixture()
+def resp_cache(monkeypatch):
+    """The process singleton, reset to defaults around each test."""
+    for var in ("PIO_SERVE_CACHE", "PIO_SERVE_CACHE_MAX",
+                "PIO_SERVE_CACHE_TTL_S", "PIO_SERVE_CACHE_AUDIT_N"):
+        monkeypatch.delenv(var, raising=False)
+    cache = rc.get_cache()
+    cache.clear()
+    cache.hit_count = cache.miss_count = 0
+    cache.last_swap_invalidated = 0
+    cache.last_swap_reason = ""
+    yield cache
+    cache.clear()
+
+
+def _fake_model(n=0):
+    return types.SimpleNamespace(indicator_idx={}, item_dict=None,
+                                 popularity=None)
+
+
+def _entry_args(seed):
+    hist = {"purchase": np.array([seed, seed + 10], np.int64)}
+    return (((f"it{seed}", 1.0),), hist, [seed], False, False, False)
+
+
+# -- unit: key builder + intersection ----------------------------------------
+
+
+def test_make_key_canonicalization():
+    h = {"purchase": np.array([3, 7, 9], np.int64),
+         "view": np.zeros(0, np.int64)}
+    k1 = rc.make_key(5, None, h, [4, 2, 2])
+    # blacklist canonicalizes to its sorted-unique id set
+    assert k1 == rc.make_key(5, None, h, [2, 4])
+    # empty per-type history arrays don't participate in the key
+    assert k1 == rc.make_key(
+        5, None, {"purchase": np.array([3, 7, 9], np.int64)}, [2, 4])
+    # every other component is significant
+    assert k1 != rc.make_key(6, None, h, [2, 4])
+    assert k1 != rc.make_key(5, ("f",), h, [2, 4])
+    assert k1 != rc.make_key(5, None, h, [2])
+    assert k1 != rc.make_key(
+        5, None, {"purchase": np.array([3, 7], np.int64)}, [2, 4])
+    # no-history / no-blacklist shapes hash too
+    assert rc.make_key(5, None, None, []) == rc.make_key(5, None, {}, [])
+
+
+def test_intersects_sorted_arrays():
+    a = np.array([1, 5, 9], np.int64)
+    assert rc._intersects(a, np.array([5], np.int64))
+    assert rc._intersects(np.array([9], np.int64), a)
+    assert not rc._intersects(a, np.array([2, 4, 10], np.int64))
+    assert not rc._intersects(a, np.zeros(0, np.int64))
+    assert not rc._intersects(np.zeros(0, np.int64), a)
+
+
+# -- unit: LRU bound, stale puts, kill switch --------------------------------
+
+
+def test_lru_bound_eviction_and_stale_put(monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_CACHE_MAX", "4")
+    cache = rc.ResponseCache()
+    model = _fake_model()
+    cache.on_swap([model])
+    for k in range(6):
+        cache.put(model, ("k", k), *_entry_args(k))
+    assert len(cache) == 4
+    # LRU: the two oldest fell off, the newest four serve
+    assert cache.lookup(model, ("k", 0))[0] is None
+    assert cache.lookup(model, ("k", 1))[0] is None
+    for k in range(2, 6):
+        items, _ = cache.lookup(model, ("k", k))
+        assert items == ((f"it{k}", 1.0),)
+    # a put from a superseded generation is refused
+    cache.put(_fake_model(), ("stale",), *_entry_args(99))
+    assert cache.lookup(model, ("stale",))[0] is None
+    # a lookup against a superseded generation bypasses (no hit, no fill)
+    assert cache.lookup(_fake_model(), ("k", 5))[0] is None
+    # kill switch: puts refused, armed_for goes dark
+    monkeypatch.setenv("PIO_SERVE_CACHE", "off")
+    assert not cache.armed_for(model)
+    cache.put(model, ("dark",), *_entry_args(7))
+    monkeypatch.delenv("PIO_SERVE_CACHE")
+    assert cache.lookup(model, ("dark",))[0] is None
+
+
+def test_swap_without_provenance_flushes_unit():
+    cache = rc.ResponseCache()
+    m1, m2 = _fake_model(), _fake_model()
+    cache.on_swap([m1])
+    cache.put(m1, ("k",), *_entry_args(1))
+    assert len(cache) == 1
+    # m2 carries no provenance relative to m1 → full flush
+    cache.on_swap([m2])
+    assert len(cache) == 0
+    assert cache.last_swap_reason == "no_provenance"
+    assert cache.last_swap_invalidated == 1
+    # a non-single-model install disarms entirely
+    cache.put(m2, ("k2",), *_entry_args(2))
+    cache.on_swap([m2, m2])
+    assert len(cache) == 0
+    assert not cache.armed_for(m2)
+
+
+def test_thread_safety_under_concurrent_swaps(monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_CACHE_MAX", "64")
+    cache = rc.ResponseCache()
+    models = [_fake_model() for _ in range(3)]
+    cache.on_swap([models[0]])
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def worker(tid):
+        try:
+            barrier.wait()
+            for j in range(400):
+                m = models[(tid + j) % 3]
+                if j % 97 == 0:
+                    cache.on_swap([m])
+                elif j % 31 == 0:
+                    cache.clear() if j % 62 else cache.on_swap([m])
+                else:
+                    key = ("t", tid, j % 40)
+                    items, _ = cache.lookup(m, key)
+                    if items is None:
+                        cache.put(m, key, *_entry_args(j))
+                len(cache)
+        except Exception as e:   # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(cache) <= 64
+
+
+# -- served responses: hits are bit-identical to the off oracle --------------
+
+
+def test_cache_hit_bit_identical_to_oracle(fs_storage, host_serving,
+                                           resp_cache):
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"iA{k}", {"category": "red" if k < 2 else "blue"})
+         for k in range(4)], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    bodies = [
+        {"user": "a1", "num": 3},
+        {"user": "nobody", "num": 4},
+        {"item": "iA1", "num": 4},
+        {"user": "b2", "num": 4, "blacklistItems": ["iB1", "iB1"]},
+        {"user": "a2", "num": 4,
+         "fields": [{"name": "category", "values": ["red"], "bias": -1}]},
+    ]
+    first = [_canon(state.predict(b)) for b in bodies]       # miss + fill
+    assert resp_cache.miss_count == len(bodies)
+    assert len(resp_cache) == len(bodies)
+    again = [_canon(state.predict(b)) for b in bodies]       # all hits
+    assert resp_cache.hit_count == len(bodies)
+    assert again == first
+    for b, want in zip(bodies, first):
+        assert _oracle(state, b) == want
+    # blacklist canonicalization: dup/order variants share one entry
+    hits0 = resp_cache.hit_count
+    state.predict({"user": "b2", "num": 4, "blacklistItems": ["iB1"]})
+    assert resp_cache.hit_count == hits0 + 1
+
+
+def test_user_drift_reroutes_key_without_invalidation(fs_storage,
+                                                      host_serving,
+                                                      resp_cache):
+    """An event append changes the user's history fingerprint — the next
+    lookup MISSES under a new key even with no swap in between (the
+    fold/swap only has to cover model drift, never user drift)."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    body = {"user": "a1", "num": 3}
+    state.predict(body)
+    assert resp_cache.miss_count == 1
+    # a1 buys something new: same query text, different history → miss
+    fs_storage.l_events.insert_batch([_buy("a1", "iA3")], app_id)
+    got = _canon(state.predict(body))
+    assert resp_cache.miss_count == 2 and resp_cache.hit_count == 0
+    assert _oracle(state, body) == got
+
+
+# -- swap invalidation: selective survival, flush fallbacks ------------------
+
+
+def test_fold_swap_selective_invalidation(fs_storage, host_serving,
+                                          resp_cache):
+    """A duplicate-only fold (pop moved on one B item, zero indicator
+    rows) drops exactly the entries its changed sets reach: the cluster-B
+    answer goes, the cluster-A answer survives the swap AS A HIT."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    qa = {"user": "a1", "num": 2}       # 2 signal picks, no backfill
+    qb = {"user": "b1", "num": 6}       # pads from popularity backfill
+    want_a, want_b = _canon(state.predict(qa)), _canon(state.predict(qb))
+    assert len(resp_cache) == 2
+    # duplicate of an existing (b0, iB0) pair: no new co-occurrence
+    # cells, but iB0's popularity count bumps
+    fs_storage.l_events.insert_batch([_buy("b0", "iB0")], app_id)
+    assert follower.tick() == "fold"
+    assert resp_cache.last_swap_reason == "selective"
+    assert resp_cache.last_swap_invalidated == 1
+    # cluster A survived: served from cache, still oracle-identical
+    hits0 = resp_cache.hit_count
+    got_a = _canon(state.predict(qa))
+    assert resp_cache.hit_count == hits0 + 1
+    assert got_a == want_a == _oracle(state, qa)
+    # cluster B was dropped: recomputed fresh against the new generation
+    miss0 = resp_cache.miss_count
+    got_b = _canon(state.predict(qb))
+    assert resp_cache.miss_count == miss0 + 1
+    assert got_b == _oracle(state, qb)
+
+
+def test_props_change_drops_rule_entries_keeps_plain(fs_storage,
+                                                     host_serving,
+                                                     resp_cache):
+    """A $set fold: entries that composed business rules drop (the mask
+    depends on properties), plain history entries survive."""
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"iA{k}", {"category": "red"}) for k in range(4)], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    # a1's unseen candidates are iA2/iA3 — both red at fill time
+    plain = {"user": "a1", "num": 2}
+    ruled = {"user": "a1", "num": 4,
+             "fields": [{"name": "category", "values": ["red"], "bias": -1}]}
+    state.predict(plain)
+    want_ruled = _canon(state.predict(ruled))
+    assert want_ruled, "fixture: red filter should match items"
+    # move iA3 to blue — a pure $set fold (no pair events)
+    fs_storage.l_events.insert_batch(
+        [_set_item("iA3", {"category": "blue"})], app_id)
+    assert follower.tick() == "fold"
+    assert resp_cache.last_swap_reason == "selective"
+    hits0, miss0 = resp_cache.hit_count, resp_cache.miss_count
+    got_plain = _canon(state.predict(plain))
+    assert resp_cache.hit_count == hits0 + 1          # survived
+    got_ruled = _canon(state.predict(ruled))
+    assert resp_cache.miss_count == miss0 + 1         # dropped, refilled
+    assert got_plain == _oracle(state, plain)
+    assert got_ruled == _oracle(state, ruled)
+    assert "iA3" not in [n for n, _ in got_ruled]
+
+
+def test_retrain_and_restage_swaps_full_flush(fs_storage, host_serving,
+                                              resp_cache):
+    """Provenance-free generations (a from-scratch retrain swap, a
+    max-lag restage) flush everything — and post-flush answers still
+    match the oracle on the new generation."""
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    body = {"user": "a1", "num": 3}
+    state.predict(body)
+    assert len(resp_cache) == 1
+    # retrain swap: no _plane_prov linkage to the armed generation
+    invalidate_staging_cache()
+    state.swap_models(list(engine.train(ep)))
+    assert len(resp_cache) == 0
+    assert resp_cache.last_swap_reason == "no_provenance"
+    got = _canon(state.predict(body))
+    assert got == _oracle(state, body)
+    assert len(resp_cache) == 1
+    # restage: max-lag breach rebuilds the fold state from scratch
+    follower.max_lag = 2
+    fs_storage.l_events.insert_batch(
+        [_buy(f"n{k}", "iA0") for k in range(6)], app_id)
+    assert follower.tick() == "restage"
+    assert len(resp_cache) == 0
+    assert resp_cache.last_swap_reason == "no_provenance"
+    got = _canon(state.predict(body))
+    assert got == _oracle(state, body)
+
+
+def test_rule_mask_cache_carries_when_props_untouched(fs_storage,
+                                                      host_serving,
+                                                      resp_cache,
+                                                      monkeypatch):
+    """Satellite: the rule-mask LRU survives swaps whose provenance
+    proves properties untouched (carried BY OBJECT), and drops across a
+    props-changing fold."""
+    monkeypatch.setenv("PIO_UR_SERVE_CANDIDATES", "off")
+    app_id, engine, ap, ep = _ur_setup(
+        fs_storage, available_date_name="", expire_date_name="")
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    fs_storage.l_events.insert_batch(
+        [_set_item(f"iA{k}", {"category": "red"}) for k in range(4)], app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    ruled = {"user": "a1", "num": 4,
+             "fields": [{"name": "category", "values": ["red"], "bias": -1}]}
+    assert state.predict(ruled).item_scores
+    m1 = follower._fold.model
+    lru1 = m1.rule_mask_cache("host")
+    assert len(lru1) > 0, "fixture: dense mask cache must populate"
+    # props-untouched fold → the LRU object itself carries
+    fs_storage.l_events.insert_batch([_buy("b0", "iB0")], app_id)
+    assert follower.tick() == "fold"
+    m2 = follower._fold.model
+    assert m2 is not m1
+    assert m2.rule_mask_cache("host") is lru1
+    # props-changing fold → fresh (empty) cache on the new generation
+    fs_storage.l_events.insert_batch(
+        [_set_item("iA2", {"category": "blue"})], app_id)
+    assert follower.tick() == "fold"
+    m3 = follower._fold.model
+    assert m3.rule_mask_cache("host") is not lru1
+    assert len(m3.rule_mask_cache("host")) == 0
+    after = {s.item for s in state.predict(ruled).item_scores}
+    assert "iA2" not in after and after
+
+
+# -- batch path --------------------------------------------------------------
+
+
+def test_serve_batch_predict_shares_the_cache(fs_storage, host_serving,
+                                              resp_cache):
+    """serve_batch_predict consults and fills the SAME cache with
+    per-row outcome counting — a single predict warms the batch path and
+    vice versa, all bit-identical to the unbatched answers."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    model = follower._fold.model
+    algo = URAlgorithm(ap)
+    queries = [URQuery(user="a1", num=3), URQuery(user="b1", num=3),
+               URQuery(user="nobody", num=2)]
+    # warm one row through the single-query path
+    single = _canon(state.predict({"user": "a1", "num": 3}))
+    assert resp_cache.miss_count == 1
+    batch = algo.serve_batch_predict(model, queries)
+    assert resp_cache.hit_count == 1                  # a1 came from cache
+    assert resp_cache.miss_count == 3                 # b1 + nobody filled
+    assert _canon(batch[0]) == single
+    for q, res in zip(queries, batch):
+        assert _canon(algo.predict(model, q)) == _canon(res)
+    # the whole batch now serves from cache
+    again = algo.serve_batch_predict(model, queries)
+    assert resp_cache.miss_count == 3
+    assert [_canon(r) for r in again] == [_canon(r) for r in batch]
+
+
+# -- plane workers: provenance rides the arena -------------------------------
+
+
+def test_plane_worker_selective_invalidation(fs_storage, host_serving,
+                                             resp_cache, tmp_path):
+    """A prefork worker never sees the publisher's in-process weakref
+    stash — the changed sets must ride the arena.  Load gen N and N+1
+    through ModelPlane, swap the worker-side cache between them, and the
+    cluster-A entry survives selectively off the plane-carried
+    provenance."""
+    from predictionio_tpu.models.universal_recommender import URQuery
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+    from predictionio_tpu.streaming.fold import URFoldState
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    tail = fs_storage.l_events.scan_tail_from(app_id, None, {}, base=None,
+                                              heads=None)
+    fold = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    wm, heads = tail["watermark"], tail["heads"]
+    pub, sub = ModelPlane(str(tmp_path / "plane")), \
+        ModelPlane(str(tmp_path / "plane"))
+    pub.publish([fold.model])
+    w1, _ = sub.load(sub.current())
+    resp_cache.on_swap([w1])
+    algo = URAlgorithm(ap)
+    qa, qb = URQuery(user="a1", num=2), URQuery(user="b1", num=6)
+    want_a = _canon(algo.predict(w1, qa))
+    want_b = _canon(algo.predict(w1, qb))
+    assert len(resp_cache) == 2
+    # duplicate-only delta published as generation 2
+    fs_storage.l_events.insert_batch([_buy("b0", "iB0")], app_id)
+    tail = fs_storage.l_events.scan_tail_from(app_id, None, wm,
+                                              base=fold.batch, heads=heads)
+    m2 = fold.fold(tail["batch"])
+    pub.publish([m2])
+    w2, info = sub.load(sub.current())
+    sp = w2.__dict__.get("_serve_prov")
+    assert sp is not None, "serve provenance must ride the arena"
+    assert sp["prev_gen"] == w1.__dict__["_plane_generation"]
+    assert not sp["props_changed"]
+    resp_cache.on_swap([w2])
+    assert resp_cache.last_swap_reason == "selective"
+    hits0, miss0 = resp_cache.hit_count, resp_cache.miss_count
+    got_a = _canon(algo.predict(w2, qa))
+    assert resp_cache.hit_count == hits0 + 1          # survived the swap
+    assert got_a == want_a
+    got_b = _canon(algo.predict(w2, qb))
+    assert resp_cache.miss_count == miss0 + 1         # dropped, refilled
+    os.environ["PIO_SERVE_CACHE"] = "off"
+    try:
+        assert got_a == _canon(algo.predict(w2, qa))
+        assert got_b == _canon(algo.predict(w2, qb))
+    finally:
+        del os.environ["PIO_SERVE_CACHE"]
+
+
+def test_plane_rebuild_without_provenance_flushes(fs_storage, host_serving,
+                                                  resp_cache, tmp_path):
+    """A rebuilt generation (restage/retrain — no fold linkage to the
+    previous publish) carries no serveProv in the arena — the worker-
+    side swap must full-flush."""
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+    from predictionio_tpu.streaming.fold import URFoldState
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    fs_storage.l_events.insert_batch(_cluster_events(), app_id)
+    tail = fs_storage.l_events.scan_tail_from(app_id, None, {}, base=None,
+                                              heads=None)
+    fold = URFoldState.bootstrap(ap, ep.data_source_params, tail["batch"])
+    pub, sub = ModelPlane(str(tmp_path / "plane")), \
+        ModelPlane(str(tmp_path / "plane"))
+    pub.publish([fold.model])
+    w1, _ = sub.load(sub.current())
+    resp_cache.on_swap([w1])
+    resp_cache.put(w1, ("seed",), *_entry_args(1))
+    # generation 2 is a from-scratch retrain: no _plane_prov linkage
+    fs_storage.l_events.insert_batch([_buy("b0", "iB0")], app_id)
+    invalidate_staging_cache()
+    pub.publish(list(engine.train(ep)))
+    w2, _ = sub.load(sub.current())
+    assert "_serve_prov" not in w2.__dict__
+    resp_cache.on_swap([w2])
+    assert len(resp_cache) == 0
+    assert resp_cache.last_swap_reason == "no_provenance"
+
+
+# -- randomized property test: replay after every swap ------------------------
+
+
+def test_randomized_folds_replay_bit_identical(fs_storage, host_serving,
+                                               resp_cache, monkeypatch):
+    """The acceptance property: across a randomized fold sequence (N
+    bumps, new items, $set, duplicate-only, restage) every query replay
+    after every swap is bit-identical to a cold PIO_SERVE_CACHE=off
+    server on the SAME generation, with the online audit sampling every
+    third hit and recording zero mismatches."""
+    # force the pruned sparse re-LLR even at toy scale so folds carry
+    # serve provenance exactly as the million-item regime does
+    monkeypatch.setenv("PIO_FOLLOW_DENSE_RELLR_BYTES", "1")
+    monkeypatch.setenv("PIO_SERVE_CACHE_AUDIT_N", "3")
+    audit0 = rc._M_AUDIT.value()
+    rng = np.random.default_rng(7)
+    app_id, engine, ap, ep = _ur_setup(fs_storage)
+    evs = [_buy(f"u{u}", f"i{it}")
+           for u in range(10) for it in range(8) if rng.random() < 0.5]
+    evs += [_set_item(f"i{it}", {"category": "red" if it < 4 else "blue"})
+            for it in range(8)]
+    fs_storage.l_events.insert_batch(evs, app_id)
+    state, follower = _follow_pair(fs_storage, app_id, engine, ap, ep)
+    bodies = ([{"user": f"u{u}", "num": 5} for u in range(0, 10, 2)]
+              + [{"user": "nobody", "num": 3}, {"item": "i1", "num": 4},
+                 {"user": "u1", "num": 5, "blacklistItems": ["i2"]},
+                 {"user": "u3", "num": 6, "fields": [
+                     {"name": "category", "values": ["red"], "bias": -1}]}])
+
+    def replay(tag):
+        for b in bodies:
+            got = _canon(state.predict(b))
+            assert got == _oracle(state, b), (tag, b)
+
+    replay("bootstrap")
+    replay("warm")         # second pass: mostly hits, audited every 3rd
+    deltas = [
+        # existing-user count bumps (new pairs, no new entities)
+        [_buy(f"u{rng.integers(10)}", f"i{rng.integers(8)}")
+         for _ in range(4)],
+        # brand-new items + a new user (catalog growth)
+        [_buy("u1", "fresh_x"), _buy("u2", "fresh_x"),
+         _buy("newbie", "i0"), _buy("newbie", "fresh_y")],
+        # property churn only
+        [_set_item(f"i{k}", {"category": "gold"}) for k in (1, 5)],
+        # duplicate-only (fold must skip every re-LLR)
+        [e for e in evs if e.event == "purchase"][:10],
+        # another bump round after growth
+        [_buy(f"u{rng.integers(10)}", f"i{rng.integers(8)}")
+         for _ in range(3)],
+    ]
+    selective_swaps = 0
+    for k, delta in enumerate(deltas):
+        fs_storage.l_events.insert_batch(delta, app_id)
+        assert follower.tick() == "fold", k
+        if resp_cache.last_swap_reason == "selective":
+            selective_swaps += 1
+        replay(f"fold{k}")
+    # restage: provenance-free rebuild mid-sequence
+    follower.max_lag = 2
+    fs_storage.l_events.insert_batch(
+        [_buy(f"z{k}", "i0") for k in range(6)], app_id)
+    assert follower.tick() == "restage"
+    follower.max_lag = None
+    assert resp_cache.last_swap_reason == "no_provenance"
+    replay("restage")
+    # the sequence must have exercised BOTH regimes
+    assert selective_swaps >= 1
+    assert resp_cache.hit_count > 0
+    # zero staleness, zero audit failures
+    assert rc._M_AUDIT.value() == audit0
