@@ -1696,6 +1696,83 @@ def _serve_trace_overhead(smoke: bool, storage, ur_json: str) -> float:
         httpd.server_close()
 
 
+def _serve_lineage_overhead(smoke: bool, storage, ur_json: str) -> float:
+    """Lineage-recorder overhead guard, same interleaved A/B min-of
+    methodology as _serve_trace_overhead: the serial keep-alive
+    /queries.json loop with the lineage recorder enabled vs disabled
+    (what PIO_LINEAGE=off buys).  The serve-path cost under test is the
+    per-query install-handoff bookkeeping in predict(); the budget is
+    the same ≤3%."""
+    import contextlib
+
+    from predictionio_tpu.obs import lineage as obs_lineage
+    from predictionio_tpu.workflow.create_server import deploy
+
+    n_q = 50 if smoke else 150
+    httpd = deploy(engine_json=ur_json, host="127.0.0.1", port=0,
+                   storage=storage, background=True)
+    port = httpd.server_address[1]
+    lin = obs_lineage.get_lineage()
+    was_enabled = lin.enabled
+    try:
+        bodies = [{"user": f"u{j * 13}", "num": 10} for j in range(8)]
+
+        def run(enabled: bool) -> float:
+            lin.enabled = enabled
+            with contextlib.closing(_keepalive_query_conn(port)) as conn:
+                t0 = time.perf_counter()
+                for q in range(n_q):
+                    status, _ = _conn_post(conn, bodies[q % len(bodies)])
+                    assert status == 200
+                return time.perf_counter() - t0
+
+        for _attempt in range(3):
+            run(True)   # warm
+            ons, offs = [], []
+            for _ in range(5):
+                offs.append(run(False))
+                ons.append(run(True))
+            pct = (min(ons) - min(offs)) / min(offs) * 100.0
+            if pct <= 3.0:
+                return pct
+        raise RuntimeError(
+            f"lineage overhead {pct:.2f}% exceeds the 3% budget "
+            "vs PIO_LINEAGE=off")
+    finally:
+        lin.enabled = was_enabled
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _lineage_stage_breakdown(base: str, limit: int = 6) -> dict:
+    """Per-stage freshness breakdown from the deploy's own
+    /lineage.json (the merged cross-process record ring): mean ms and
+    sample count per stage over the newest closed records.  Replaces
+    the old hand-stitched phase-histogram scrape — a lineage record
+    carries the same fold phases PLUS the cross-process hops (plane
+    write, watcher wake, compose, install, first serve) the
+    publisher-local histogram never saw."""
+    import urllib.request
+
+    with urllib.request.urlopen(base + "/lineage.json", timeout=10) as r:
+        index = json.loads(r.read()).get("records", [])
+    closed = [e for e in index
+              if e.get("outcome") in ("complete", "published")]
+    agg: dict = {}
+    for entry in closed[:limit]:
+        with urllib.request.urlopen(
+                base + f"/lineage/{entry['lid']}.json", timeout=10) as r:
+            doc = json.loads(r.read())
+        for st in doc.get("stages", ()):
+            a = agg.setdefault(st["stage"], {"total_ms": 0.0, "n": 0})
+            a["total_ms"] += float(st.get("duration_s") or 0.0) * 1e3
+            a["n"] += 1
+    out = {name: {"mean_ms": round(a["total_ms"] / a["n"], 2), "n": a["n"]}
+           for name, a in sorted(agg.items()) if a["n"]}
+    out["_records"] = len(closed[:limit])
+    return out
+
+
 def _trace_waterfall_demo(port: int, workers: int) -> str:
     """Cross-worker flight-recorder proof against a LIVE prefork group:
     pin a keep-alive connection to one worker (GET / → pid), serve an
@@ -2757,6 +2834,7 @@ def bench_serve_scale(smoke: bool) -> dict:
         "serve_scale_parity": "not_run",
         "serve_scale_trace_waterfall": "not_run",
         "serve_scale_trace_guard": "not_run",
+        "serve_scale_lineage_guard": "not_run",
         "serve_scale_monotone": "not_run",
     }
     try:
@@ -2893,6 +2971,15 @@ def bench_serve_scale(smoke: bool) -> dict:
                     if mode == "off" and workers == worker_counts[-1]:
                         out["serve_scale_trace_waterfall"] = (
                             _trace_waterfall_demo(port, workers))
+                        # generation-lineage breakdown across the SAME
+                        # prefork group: the merged /lineage.json ring
+                        # (sibling files) is reachable from any worker
+                        try:
+                            out["serve_scale_lineage_stages"] = (
+                                _lineage_stage_breakdown(base))
+                        except Exception as e:  # noqa: BLE001 - diag
+                            out["serve_scale_lineage_stages"] = (
+                                f"scrape_failed: {e}")
                 finally:
                     # graceful /stop fan-in (undeploy-style), then escalate
                     for _ in range(16):
@@ -2955,6 +3042,13 @@ def bench_serve_scale(smoke: bool) -> dict:
             out["serve_scale_trace_guard"] = "ok"
         except RuntimeError as e:
             out["serve_scale_trace_guard"] = f"EXCEEDED {e}"
+        # same interleaved in-process A/B for the lineage recorder
+        try:
+            pct = _serve_lineage_overhead(smoke, _storage, ur_json)
+            out["serve_scale_lineage_overhead_pct"] = round(pct, 3)
+            out["serve_scale_lineage_guard"] = "ok"
+        except RuntimeError as e:
+            out["serve_scale_lineage_guard"] = f"EXCEEDED {e}"
         # ISSUE-7 headline: pruned-vs-dense catalog sweep (own stores and
         # deploys; a failure here must not discard the main sweep's keys)
         try:
@@ -3204,8 +3298,17 @@ def _freshness_catalog_sweep(smoke: bool) -> dict:
                     f"{fr.get('stateMode')} (expected fold/sparse)")
             if not lat or max(lat) > 10_000 or len(lat) < rounds:
                 p99_ok = False
-            # per-phase fold-tick costs + pruning/emit engagement, from
-            # the deploy's own /metrics (cell-clean: fresh process)
+            # per-stage fold-tick + publish costs from the deploy's own
+            # merged /lineage.json (cell-clean: fresh process).  The
+            # lineage records replace the old phase-histogram stitch:
+            # same fold phases (fold.apply/fold.rellr/fold.emit) plus
+            # the end-to-end hops (publish, plane.write, watcher_wake,
+            # compose, install, first_serve) the histogram never saw.
+            try:
+                cell["lineage_stages"] = _lineage_stage_breakdown(base)
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                cell["lineage_scrape_error"] = str(e)
+            # pruning/emit engagement still comes from /metrics
             try:
                 from predictionio_tpu.obs.exposition import (
                     family_total, parse_prometheus_text,
@@ -3214,22 +3317,6 @@ def _freshness_catalog_sweep(smoke: bool) -> dict:
                 with urllib.request.urlopen(base + "/metrics",
                                             timeout=10) as r:
                     fams, _ = parse_prometheus_text(r.read().decode())
-                phases = {}
-                for ph in ("apply", "rellr", "emit", "warm", "publish"):
-                    cnt = family_total(
-                        fams,
-                        "pio_follow_fold_phase_duration_seconds_count",
-                        phase=ph)
-                    tot = family_total(
-                        fams,
-                        "pio_follow_fold_phase_duration_seconds_sum",
-                        phase=ph)
-                    if cnt:
-                        phases[ph] = {
-                            "total_s": round(tot, 3),
-                            "mean_ms": round(tot / cnt * 1e3, 1),
-                            "ticks": int(cnt)}
-                cell["phase"] = phases
                 cell["rellr_rows"] = {
                     o: int(family_total(fams,
                                         "pio_follow_rellr_rows_total",
@@ -3240,7 +3327,7 @@ def _freshness_catalog_sweep(smoke: bool) -> dict:
                         "pio_follow_emit_total", ())
                     if labels.get("path") in ("carried", "patched")))
             except Exception as e:  # noqa: BLE001 - diagnostics only
-                cell["phase_scrape_error"] = str(e)
+                cell["metrics_scrape_error"] = str(e)
             # collect parity probes BEFORE stopping the deploy
             probe_bodies = (
                 [{"user": f"u{(j * 131) % max(n_items // hist, 1)}",
@@ -4075,6 +4162,7 @@ def main() -> int:
         "serve_scale_parity": "section_failed",
         "serve_scale_trace_waterfall": "section_failed",
         "serve_scale_trace_guard": "section_failed",
+        "serve_scale_lineage_guard": "section_failed",
         "serve_scale_speedup_wmax_vs_w1": 0.0,
         "serve_scale_monotone": "section_failed",
         "scale_serve_parity": "section_failed",

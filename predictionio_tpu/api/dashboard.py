@@ -8,6 +8,8 @@ engine params and metric scores).
   GET /evaluations.json         completed evaluation instances
   GET /spans/<instance>.json    span journal of one train/eval run
   GET /snapshots.json           per-(app, channel) event-store snapshot coverage
+  GET /lineage.json             generation lineage index (cross-process merged)
+  GET /lineage/<gen>.html       one generation's freshness waterfall
   GET /metrics                  Prometheus text (incl. pio_snapshot_* gauges)
   GET /stats.json               per-(route, status) request windows
 """
@@ -21,6 +23,7 @@ from typing import Optional
 
 from predictionio_tpu import __version__
 from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.obs import lineage as obs_lineage
 from predictionio_tpu.obs import spans as obs_spans
 from predictionio_tpu.obs import tracing as obs_tracing
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
@@ -139,6 +142,84 @@ def _trace_rows(limit: int = 25) -> str:
         )
         for e in entries
     ) or "<tr><td colspan=7><i>no retained traces</i></td></tr>"
+
+
+def _lineage_rows(limit: int = 25) -> str:
+    """Recent generation lineage records (cross-process merged) for the
+    front page, each linking to its freshness waterfall."""
+    entries = obs_lineage.get_lineage().index(limit=limit)["records"]
+    return "".join(
+        "<tr><td>{genlink}</td><td>{lid}</td><td>{outcome}</td>"
+        "<td>{dur:.1f} ms</td><td>{stages}</td><td>{origin}</td>"
+        "<td>{workers}</td><td>{start}</td></tr>".format(
+            genlink=('<a href="/lineage/{g}.html">{g}</a>'.format(
+                g=html.escape(str(e["generation"])))
+                if e.get("generation") is not None else ""),
+            lid=html.escape(str(e.get("lid", ""))),
+            outcome=html.escape(str(e.get("outcome", ""))),
+            dur=float(e.get("durationMs") or 0.0),
+            stages=e.get("stageCount", 0),
+            origin=html.escape(str(e.get("origin", ""))),
+            workers=html.escape(",".join(e.get("workers") or [])),
+            start=html.escape(_fmt_epoch(e.get("start"))[:19]),
+        )
+        for e in entries
+    ) or "<tr><td colspan=8><i>no lineage records</i></td></tr>"
+
+
+def _render_lineage_html(doc: dict) -> str:
+    """Waterfall view of one generation's lineage: every pipeline stage
+    (append→fold→publish→plane→install→first serve) as an offset bar,
+    child stages (cache invalidation) indented under their parent."""
+    total_ms = max(float(doc.get("durationMs") or 0.0), 1e-6)
+    t0 = float(doc.get("start") or 0.0)
+    rows = []
+    for s in doc.get("stages", ()):
+        off_ms = max((float(s.get("start", t0)) - t0) * 1e3, 0.0)
+        dur_ms = float(s.get("duration_s", 0.0)) * 1e3
+        left = min(off_ms / total_ms * 100.0, 100.0)
+        width = max(min(dur_ms / total_ms * 100.0, 100.0 - left), 0.3)
+        attrs = s.get("attrs") or {}
+        attr_txt = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        rows.append(
+            "<tr><td style='padding-left:{ind}em'>{name}</td>"
+            "<td>{worker}</td><td>{dur:.3f} ms</td>"
+            "<td class=wf><div class=bar "
+            "style='margin-left:{left:.2f}%;width:{width:.2f}%'></div></td>"
+            "<td class=attrs>{attrs}</td></tr>".format(
+                ind=1.5 if s.get("parent") else 0.5,
+                name=html.escape(str(s.get("stage", "?"))),
+                worker=html.escape(str(s.get("worker", ""))),
+                dur=dur_ms, left=left, width=width,
+                attrs=html.escape(attr_txt)))
+    head = ("generation {gen} &middot; {outcome} in {dur:.1f} ms "
+            "(origin {origin}, workers {workers})".format(
+                gen=html.escape(str(doc.get("generation", "?"))),
+                outcome=html.escape(str(doc.get("outcome", "?"))),
+                dur=total_ms,
+                origin=html.escape(str(doc.get("origin", "?"))),
+                workers=html.escape(
+                    ",".join(doc.get("workers") or []) or "?")))
+    lid = html.escape(str(doc.get("lid", "")))
+    return f"""<!DOCTYPE html>
+<html><head><title>lineage {lid}</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ border: 1px solid #ccc; padding: 4px 8px; text-align: left; }}
+ td.wf {{ width: 40%; position: relative; }}
+ td.attrs {{ color: #666; font-size: 85%; }}
+ div.bar {{ background: #57a35a; height: 0.9em; border-radius: 2px; }}
+</style></head>
+<body><h1>Lineage {lid}</h1>
+<p>{head}</p>
+<table><tr><th>stage</th><th>worker</th><th>duration</th><th>waterfall</th>
+<th>attrs</th></tr>
+{''.join(rows) or '<tr><td colspan=5><i>no stages recorded</i></td></tr>'}
+</table>
+<p><a href="/lineage.json">lineage index</a>
+&middot; <a href="/">dashboard</a></p>
+</body></html>"""
 
 
 def _render_waterfall_html(doc: dict) -> str:
@@ -266,10 +347,16 @@ def _render_html(storage: Storage) -> str:
 <table><tr><th>request id</th><th>route</th><th>status</th><th>duration</th>
 <th>kept</th><th>worker</th><th>started</th></tr>
 {_trace_rows()}</table>
+<h2>Generation lineage <small>(append &rarr; servable)</small></h2>
+<table><tr><th>generation</th><th>lineage id</th><th>outcome</th>
+<th>duration</th><th>stages</th><th>origin</th><th>workers</th>
+<th>started</th></tr>
+{_lineage_rows()}</table>
 <p><a href="/metrics">/metrics</a> &middot;
 <a href="/stats.json">/stats.json</a> &middot;
 <a href="/snapshots.json">/snapshots.json</a> &middot;
-<a href="/traces.json">/traces.json</a></p>
+<a href="/traces.json">/traces.json</a> &middot;
+<a href="/lineage.json">/lineage.json</a></p>
 </body></html>"""
 
 
@@ -303,6 +390,18 @@ def make_handler(storage: Storage):
                 self.send_json({"snapshots": _snapshot_rows(storage)})
             elif obs_tracing.handle_trace_request(self, path):
                 pass   # /traces.json + /traces/{rid}.json
+            elif obs_lineage.handle_lineage_request(self, path):
+                pass   # /lineage.json + /lineage/{gen|ln-id}.json
+            elif path.startswith("/lineage/") and path.endswith(".html"):
+                token = path[len("/lineage/"):-len(".html")]
+                rec = obs_lineage.get_lineage()
+                doc = (rec.get_generation(int(token)) if token.isdigit()
+                       else rec.get(token))
+                if doc is None:
+                    self.send_error_json(
+                        404, f"no lineage record for {token!r}")
+                else:
+                    self.send_html(_render_lineage_html(doc))
             elif path.startswith("/traces/") and path.endswith(".html"):
                 rid = path[len("/traces/"):-len(".html")]
                 doc = obs_tracing.get_recorder().get(rid)
@@ -340,9 +439,10 @@ def run_dashboard(
     background: bool = False,
 ):
     storage = storage or get_storage()
-    # join the deployment's traces dir so the flight-recorder tables can
-    # show traces retained by the event/query servers sharing this storage
+    # join the deployment's traces + lineage dirs so the tables can show
+    # records retained by the event/query servers sharing this storage
     obs_tracing.arm(storage=storage)
+    obs_lineage.arm(storage=storage)
     httpd = start_server(make_handler(storage), host, port, background=background)
     log.info("Dashboard listening on %s:%d", host, httpd.server_address[1])
     if background:

@@ -31,8 +31,11 @@ from typing import Any, Dict, List, Optional, Tuple
 from predictionio_tpu import __version__
 from predictionio_tpu.api.http_util import JsonHandler, start_server
 from predictionio_tpu.events.event import Event, parse_time
+from predictionio_tpu.obs import lineage as obs_lineage
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import tracing as obs_tracing
+from predictionio_tpu.obs import tsdb as obs_tsdb
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.storage.base import AccessKey
 from predictionio_tpu.storage.locator import Storage, get_storage
@@ -179,6 +182,15 @@ def make_handler(state: EventServerState):
                 return
             if obs_tracing.handle_trace_request(self, path):
                 # flight-recorder index + waterfalls, cross-worker merged
+                return
+            if obs_lineage.handle_lineage_request(self, path):
+                # generation lineage (the query-server side writes the
+                # records; an event server sharing the group dir serves
+                # the merged view too)
+                return
+            if obs_tsdb.handle_history_request(self, path):
+                return
+            if obs_slo.handle_healthz_request(self, path):
                 return
             if path == "/stop":
                 # graceful shutdown (same contract as the query server's
@@ -519,6 +531,9 @@ def run_event_server(
     # workers via PIO_METRICS_DIR env; a dashboard via the shared storage
     # path) can merge them into their /traces.json
     obs_tracing.arm(storage=state.storage)
+    obs_lineage.arm(storage=state.storage)
+    if obs_metrics.get_registry().enabled:
+        obs_tsdb.start_sampler()
     httpd = start_server(make_handler(state), host, port,
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
@@ -537,6 +552,8 @@ def run_event_server(
         # the parent's traces join the group dir the children will
         # resolve from their PIO_METRICS_DIR environment
         obs_tracing.arm(directory=os.path.join(metrics_dir, "traces"),
+                        tag=f"w0-{os.getpid()}")
+        obs_lineage.arm(directory=os.path.join(metrics_dir, "lineage"),
                         tag=f"w0-{os.getpid()}")
         children = prefork.spawn_workers(
             workers - 1,

@@ -14,6 +14,8 @@ bin/pio (SURVEY.md §1-2).  Subcommand surface mirrors the reference:
   eventserver / adminserver / dashboard   REST ingestion / admin API / eval dashboard
   metrics                                 scrape + pretty-print a server's /metrics
   trace                                   browse a server's request flight recorder
+  lineage                                 browse generation lineage (freshness waterfalls)
+  top                                     sparkline view of a server's metrics history
   status                                  storage + env sanity report
   version
 
@@ -454,6 +456,160 @@ def _cmd_trace(args) -> int:
         return 1
 
 
+def _cmd_lineage(args) -> int:
+    """`pio lineage <url>` — browse a deployment's generation lineage:
+    the merged record index by default, one generation's freshness
+    waterfall (append-observed → fold → publish → plane write → watcher
+    wake → compose → install → first serve) with `--gen` or `--lid`.
+    Any worker of a prefork group answers for the whole group (the
+    records are merged across the publisher and every worker)."""
+    import urllib.error
+    import urllib.request
+
+    from predictionio_tpu.obs.lineage import render_lineage_text
+
+    base = args.url
+    if "://" not in base:
+        base = f"http://{base}"
+    base = base.rstrip("/")
+    for suffix in ("/lineage.json", "/lineage"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+
+    def fetch(path):
+        with urllib.request.urlopen(base + path, timeout=args.timeout) as r:
+            return json.loads(r.read().decode("utf-8", "replace"))
+
+    try:
+        token = args.gen if args.gen is not None else args.lid
+        if token is not None:
+            doc = fetch(f"/lineage/{token}.json")
+            sys.stdout.write(render_lineage_text(doc))
+            return 0
+        index = fetch("/lineage.json")
+        records = index.get("records", [])
+        print(f"{len(records)} lineage record(s) "
+              f"(answered by worker {index.get('worker', '?')}):")
+        for r in records:
+            print("  gen %-6s %-18s %-10s %8.1f ms  %2d stages  "
+                  "origin=%s workers=%s"
+                  % (r.get("generation", "?"), r.get("lid", "?"),
+                     r.get("outcome", "?"),
+                     float(r.get("durationMs") or 0.0),
+                     r.get("stageCount", 0), r.get("origin", "?"),
+                     ",".join(r.get("workers") or [])))
+        if records:
+            print(f"(pio lineage {args.url} --gen <generation> renders a "
+                  "waterfall)")
+        return 0
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read()).get("message", "")
+        except Exception:
+            msg = str(e)
+        print(f"Error: {base}: HTTP {e.code}: {msg}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"Error: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals) -> str:
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return _SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        _SPARK_BLOCKS[int((v - lo) / (hi - lo) * (len(_SPARK_BLOCKS) - 1))]
+        for v in vals)
+
+
+def _cmd_top(args) -> int:
+    """`pio top <url>` — one-shot terminal view of a server's recent
+    history (/metrics/history.json: the local time-series ring): a
+    sparkline + latest value per key signal.  No Prometheus needed."""
+    import urllib.error
+    import urllib.request
+
+    base = args.url
+    if "://" not in base:
+        base = f"http://{base}"
+    base = base.rstrip("/")
+    url = base + "/metrics/history.json"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            history = json.loads(r.read().decode("utf-8", "replace"))
+    except urllib.error.HTTPError as e:
+        print(f"Error: {url}: HTTP {e.code}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"Error: cannot reach {url}: {e}", file=sys.stderr)
+        return 1
+    samples = history.get("samples", [])[-args.window:]
+    if len(samples) < 2:
+        print("Not enough history yet (the sampler ticks every "
+              f"{history.get('intervalSeconds', '?')} s) — try again "
+              "shortly.")
+        return 1
+
+    def series_vals(metric, reducer, match=""):
+        out = []
+        for s in samples:
+            entry = (s.get("m") or {}).get(metric)
+            vals = [float(v) for k, v in (entry or {}).get(
+                "series", {}).items()
+                if not match or match in k] if entry else []
+            out.append(reducer(vals) if vals else 0.0)
+        return out
+
+    def rate(vals):
+        rates = []
+        for (p, c), (tp, tc) in zip(
+                zip(vals, vals[1:]),
+                zip((s["t"] for s in samples),
+                    (s["t"] for s in samples[1:]))):
+            dt = max(tc - tp, 1e-9)
+            rates.append(max(c - p, 0.0) / dt)
+        return rates
+
+    rows = [
+        ("req/s", rate(series_vals("pio_http_requests_total", sum)),
+         "{:.1f}"),
+        ("events ingested/s",
+         rate(series_vals("pio_events_ingested_total", sum)), "{:.1f}"),
+        ("folds/s", rate(series_vals("pio_follow_folds_total", sum)),
+         "{:.2f}"),
+        ("fold lag (events)",
+         series_vals("pio_follow_lag_events", max)[1:], "{:.0f}"),
+        ("state MB",
+         [v / 1e6 for v in
+          series_vals("pio_follow_state_bytes", max)[1:]], "{:.1f}"),
+        ("rss MB (sum)",
+         [v / 1e6 for v in
+          series_vals("pio_process_rss_bytes", sum)[1:]], "{:.0f}"),
+        ("plane chain len",
+         series_vals("pio_model_plane_chain_len", max)[1:], "{:.0f}"),
+        ("cache entries",
+         series_vals("pio_serve_cache_entries", sum)[1:], "{:.0f}"),
+        ("slo burn (fast, max)",
+         series_vals("pio_slo_burn_rate", max, match='window="fast"')[1:],
+         "{:.2f}"),
+    ]
+    span_s = samples[-1]["t"] - samples[0]["t"]
+    print(f"{base}  —  {len(samples)} samples over {span_s:.0f}s "
+          f"(worker {history.get('worker', '?')})")
+    for label, vals, fmt in rows:
+        if not vals:
+            continue
+        last = fmt.format(vals[-1])
+        print(f"  {label:<22} {_sparkline(vals)}  {last}")
+    return 0
+
+
 def _cmd_train(args) -> int:
     from predictionio_tpu.workflow.create_workflow import run_train_from_args
 
@@ -714,6 +870,35 @@ def build_parser() -> argparse.ArgumentParser:
                     help="render the slowest retained trace's waterfall")
     tc.add_argument("--timeout", type=float, default=10.0)
     tc.set_defaults(func=_cmd_trace)
+
+    ln = sub.add_parser(
+        "lineage",
+        help="browse a deployment's generation lineage "
+             "(/lineage.json index; --gen/--lid render a freshness "
+             "waterfall)")
+    ln.add_argument("url",
+                    help="server base URL or host:port (e.g. "
+                         "http://127.0.0.1:8000 or 127.0.0.1:8000)")
+    ln.add_argument("--gen", default=None,
+                    help="render the waterfall of this plane/model "
+                         "generation")
+    ln.add_argument("--lid", default=None,
+                    help="render the waterfall of this lineage id "
+                         "(ln-...)")
+    ln.add_argument("--timeout", type=float, default=10.0)
+    ln.set_defaults(func=_cmd_lineage)
+
+    tp = sub.add_parser(
+        "top",
+        help="sparkline view of a server's recent metrics history "
+             "(/metrics/history.json ring)")
+    tp.add_argument("url",
+                    help="server base URL or host:port (e.g. "
+                         "http://127.0.0.1:8000 or 127.0.0.1:8000)")
+    tp.add_argument("--window", type=int, default=60,
+                    help="samples to render (default 60)")
+    tp.add_argument("--timeout", type=float, default=10.0)
+    tp.set_defaults(func=_cmd_top)
 
     tr = sub.add_parser("train")
     tr.add_argument("--engine-json", default="engine.json")

@@ -309,6 +309,29 @@ def worker_tag() -> str:
 WORKER_UP = _REGISTRY.gauge(
     "pio_worker_up", "1 per worker process contributing to this scrape")
 
+# dead-worker hygiene for every sibling-file merge (/metrics snapshots,
+# /traces.json rings, /lineage.json rings): files whose mtime exceeds
+# PIO_OBS_SIBLING_STALE_S are a dead group member's leftovers — evicted
+# (unlinked) from the merge and counted here by kind
+STALE_SIBLINGS = _REGISTRY.counter(
+    "pio_obs_stale_siblings_total",
+    "Dead-worker sibling files evicted from cross-worker merges after "
+    "PIO_OBS_SIBLING_STALE_S (default 600 s), by kind "
+    "(metrics | traces | lineage)")
+
+
+def sibling_stale_s() -> float:
+    """PIO_OBS_SIBLING_STALE_S: sibling files older than this are
+    evicted from /metrics, /traces.json, and /lineage.json merges
+    (default 600 s — long enough to ride out a stop-the-world pause,
+    short enough that a SIGKILLed worker's gauges don't haunt the group
+    for a day)."""
+    try:
+        return max(float(os.environ.get("PIO_OBS_SIBLING_STALE_S", "600")),
+                   1.0)
+    except ValueError:
+        return 600.0
+
 # per-worker resident memory, refreshed on every snapshot flush and
 # scrape: with the shared model plane, N workers mapping one arena show
 # near-baseline anonymous RSS each (file-backed model pages are shared
@@ -341,9 +364,15 @@ def mark_worker_up(tag: Optional[str] = None) -> None:
     """Declare THIS process's worker identity.  Clears previous local
     pio_worker_up series first: a process only ever IS one worker, and a
     programmatic server restarted in-process (tests) must not keep
-    advertising its old tag."""
+    advertising its old tag.  Also SEEDS pio_process_rss_bytes for this
+    worker: a freshly-forked worker that has served zero requests must
+    still report an RSS row on the group's first scrape (the snapshot
+    flusher's first flush would otherwise race the first scrape and the
+    worker would be invisible to the memory dashboards)."""
+    tag = tag or worker_tag()
     WORKER_UP.clear_series()
-    WORKER_UP.set(1, worker=tag or worker_tag())
+    WORKER_UP.set(1, worker=tag)
+    update_process_rss(tag)
 
 
 class SnapshotFlusher:
@@ -464,6 +493,7 @@ def aggregate_snapshot(registry: Optional[MetricsRegistry] = None) -> dict:
         # longer exists (in-flight requests, worker_up) and must read 0,
         # or an idle server reports the dead worker's last values forever
         stale_after = max(10.0 * fl.interval, 15.0)
+        evict_after = sibling_stale_s()
         try:
             names = sorted(os.listdir(fl.dir))
         except OSError:
@@ -475,6 +505,20 @@ def aggregate_snapshot(registry: Optional[MetricsRegistry] = None) -> dict:
             path = os.path.join(fl.dir, name)
             try:
                 mtime = os.stat(path).st_mtime
+            except OSError:
+                continue
+            if now - mtime > evict_after:
+                # LONG-dead sibling: merging its snapshot forever would
+                # keep a killed worker's counters in every scrape until
+                # the dir is torn down — evict the file (its acked work
+                # already aged out of every rate window)
+                try:
+                    os.unlink(path)
+                    STALE_SIBLINGS.inc(1, kind="metrics")
+                except OSError:
+                    pass
+                continue
+            try:
                 with open(path) as f:
                     snap = json.load(f)
             except (OSError, json.JSONDecodeError):

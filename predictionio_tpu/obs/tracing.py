@@ -163,9 +163,6 @@ class FlightRecorder:
     # disk write), so a sibling can still fetch any retained trace
     # within ~this many seconds
     PERSIST_THROTTLE_S = 0.5
-    # sibling files whose mtime is older than this are dead groups'
-    # leftovers: skipped on merge and opportunistically unlinked
-    STALE_FILE_S = 86400.0
 
     def __init__(self, ring: Optional[int] = None,
                  slow_ms: Optional[float] = None,
@@ -319,16 +316,24 @@ class FlightRecorder:
             return []
         docs: List[dict] = []
         now = time.time()
+        stale_after = _metrics.sibling_stale_s()
         for name in names:
             path = self.dir / name
             try:
                 mtime = os.stat(path).st_mtime
             except OSError:
                 continue
-            if now - mtime > self.STALE_FILE_S:
-                # a long-dead group's leftovers; reclaim the disk
-                with contextlib.suppress(OSError):
-                    os.unlink(path)
+            if now - mtime > stale_after:
+                # a dead group member's leftovers: evict from the merge
+                # and reclaim the disk — but never our OWN file (the
+                # live in-memory ring is merged separately and the next
+                # retention re-creates it)
+                if name != f"{self.tag}.json":
+                    try:
+                        os.unlink(path)
+                        _metrics.STALE_SIBLINGS.inc(1, kind="traces")
+                    except OSError:
+                        pass
                 continue
             try:
                 with open(path) as f:

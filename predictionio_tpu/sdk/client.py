@@ -617,3 +617,20 @@ class EngineClient:
             return int(self.freshness().get("generation") or 0)
         except (PIOError, ValueError):
             return 0
+
+    def lineage(self, generation: Optional[int] = None) -> Dict[str, Any]:
+        """Generation lineage from the deployment: the merged record
+        index (``{"records": [...]}``), or one generation's freshness
+        waterfall when ``generation`` is given — every stage from
+        append-observed through first-serve, contributed by whichever
+        processes ran them (cross-process merge).  Lets a client measure
+        its own append→servable latency end to end."""
+        if generation is None:
+            return self._conn.request("GET", "/lineage.json")
+        return self._conn.request("GET", f"/lineage/{int(generation)}.json")
+
+    def healthz(self) -> Dict[str, Any]:
+        """The deployment's SLO burn-rate verdicts (/healthz — always
+        HTTP 200; the ``status`` field carries ok | warn | burning |
+        no_data)."""
+        return self._conn.request("GET", "/healthz")

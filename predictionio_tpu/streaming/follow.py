@@ -38,6 +38,7 @@ import uuid
 from pathlib import Path
 from typing import Callable, Dict, Optional
 
+from predictionio_tpu.obs import lineage as _lineage
 from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.obs.metrics import LATENCY_BUCKETS
@@ -225,6 +226,10 @@ class FollowTrainer:
         # signal (status().coveredEvents): with the pipeline, the
         # resident state runs ahead of what serving has installed
         self._published_events: Optional[int] = None
+        # lineage id of the generation currently being published — set
+        # just before on_publish so _publish_info can stamp it into the
+        # manifest info that rides the model plane to every worker
+        self._lineage_id: Optional[str] = None
         self._resolve_mode()
         self._state_path = follow_state_path(
             self.storage, engine_id, engine_variant) if persist else None
@@ -389,17 +394,23 @@ class FollowTrainer:
                 models = job.get("models")
                 if models is None:
                     t0 = time.perf_counter()
+                    w_emit = time.time()
                     # the job pins its state object: a concurrent loop-
                     # thread restage nulling self._fold must not strand
                     # an in-flight emit
                     models = [job["state"].emit_snapshot(job["snap"])]
-                    _M_PHASE_S.observe(time.perf_counter() - t0,
-                                       phase="emit")
+                    emit_s = time.perf_counter() - t0
+                    _M_PHASE_S.observe(emit_s, phase="emit")
+                    if job.get("lineage"):
+                        _lineage.get_lineage().stage(
+                            job["lineage"], "fold.emit", start=w_emit,
+                            duration_s=emit_s)
                     job["models"] = models  # publish retries skip re-emit
                 self._publish(models, job["mode"], job["duration_s"],
                               trace=job.get("trace"), wm=job.get("wm"),
                               heads=job.get("heads"),
-                              fold_events=job.get("events"))
+                              fold_events=job.get("events"),
+                              lineage=job.get("lineage"))
                 self._published_events = job.get("covered")
                 return
             except Exception:
@@ -772,8 +783,8 @@ class FollowTrainer:
 
     def _tick_inner(self) -> str:
         if self._pending is not None:
-            models, pmode, dur = self._pending
-            self._publish(models, pmode, dur)
+            models, pmode, dur, plid = self._pending
+            self._publish(models, pmode, dur, lineage=plid)
             self._pending = None
             if self.mode == "fold" and self._fold is not None:
                 self._published_events = len(self._fold.batch)
@@ -799,6 +810,7 @@ class FollowTrainer:
             self._maybe_checkpoint()
         app_id, chan = self._app_channel()
         t0 = time.perf_counter()
+        w_tick = time.time()
         tombs = self._backend.tombstone_state(app_id, chan)
         if tombs != self._tombstones:
             # a tombstone arrived mid-follow: folded events may be dead —
@@ -829,6 +841,19 @@ class FollowTrainer:
             self._fold = None
             return "restage" if self._restage(publish=True) else "idle"
         pipelined = self._pub_queue is not None
+        # the generation's lineage record opens HERE — the first moment
+        # the fold tick observed appended events; every later stage
+        # (fold, emit, publish, plane write, watcher wake, compose,
+        # install, first serve) hangs off this id
+        lin = _lineage.get_lineage()
+        lid: Optional[str] = None
+        if lin.enabled:
+            lid = lin.new_id()
+            lin.begin(lid, start=w_tick)
+            lin.stage(lid, "append_observed", start=w_tick,
+                      duration_s=time.perf_counter() - t0,
+                      events=int(tail["events"]))
+        w_fold = time.time()
         with trace.activate():
             with trace.span("follow_fold", events=tail["events"]):
                 try:
@@ -850,8 +875,19 @@ class FollowTrainer:
                     # Drop it; the next cycle restages from the log.
                     self._fold = None
                     raise
-        for phase, dur in (self._fold.last_phase_s or {}).items():
+        phases = dict(self._fold.last_phase_s or {})
+        for phase, dur in phases.items():
             _M_PHASE_S.observe(dur, phase=phase)
+        if lid is not None:
+            # lay the fold phases out sequentially from the fold's wall
+            # start — apply runs first, the RELLR refresh inside it is
+            # accounted separately (fold.py subtracts it from apply)
+            cursor = w_fold
+            for phase in ("apply", "rellr"):
+                dur = float(phases.get(phase, 0.0))
+                lin.stage(lid, f"fold.{phase}", start=cursor,
+                          duration_s=dur)
+                cursor += dur
         covered = len(self._fold.batch)
         self._wm, self._heads = tail["watermark"], tail["heads"]
         self.last_fold_events = int(tail["events"])
@@ -867,12 +903,14 @@ class FollowTrainer:
                 "covered": covered, "wm": dict(self._wm),
                 "heads": dict(self._heads),
                 "events": int(tail["events"]), "trace": trace,
+                "lineage": lid,
             })
         else:
             _M_PHASE_S.observe(
                 getattr(self._fold, "last_emit_s", 0.0), phase="emit")
             self._publish_guarded([model], "fold",
-                                  time.perf_counter() - t0, trace=trace)
+                                  time.perf_counter() - t0, trace=trace,
+                                  lineage=lid)
             self._published_events = covered
         _M_LAG.set(0)
         return "fold"
@@ -933,7 +971,7 @@ class FollowTrainer:
     # -- publication ----------------------------------------------------------
 
     def _publish_info(self, mode: str) -> dict:
-        return {
+        info = {
             "mode": mode,
             "generation": self.generation,
             "engineInstanceId": self.instance_id,
@@ -942,20 +980,30 @@ class FollowTrainer:
             "stateBytes": self._state_bytes,
             "stateMode": self._state_mode,
         }
+        if self._lineage_id is not None:
+            # rides the plane manifest's info dict to every consumer:
+            # PlaneWatcher reads it back out of plane.load so the
+            # install/first-serve stages land on the SAME record this
+            # fold tick opened, from processes that never saw the fold
+            info["lineageId"] = self._lineage_id
+        return info
 
     def _publish_guarded(self, models, mode: str, duration_s: float,
-                         trace: Optional[_tracing.Trace] = None) -> None:
+                         trace: Optional[_tracing.Trace] = None,
+                         lineage: Optional[str] = None) -> None:
         """Publish, retaining the generation in ``_pending`` so a
         transient publish failure is retried first thing next tick
         instead of stranding an already-folded generation unpublished."""
-        self._pending = (models, mode, duration_s)
-        self._publish(models, mode, duration_s, trace=trace)
+        self._pending = (models, mode, duration_s, lineage)
+        self._publish(models, mode, duration_s, trace=trace,
+                      lineage=lineage)
         self._pending = None
 
     def _publish(self, models, mode: str, duration_s: float,
                  trace: Optional[_tracing.Trace] = None,
                  wm: Optional[Dict] = None, heads: Optional[Dict] = None,
-                 fold_events: Optional[int] = None) -> None:
+                 fold_events: Optional[int] = None,
+                 lineage: Optional[str] = None) -> None:
         """Atomic model publication: durable instance record (daemon) +
         in-process hot-swap (embedded), then watermark persistence —
         the watermark only advances AFTER the generation it describes is
@@ -972,7 +1020,9 @@ class FollowTrainer:
             trace = _tracing.Trace(f"fold-{uuid.uuid4().hex[:12]}")
         self.generation += 1
         t_pub0 = time.perf_counter()
+        w_pub = time.time()
         t_warm = 0.0
+        self._lineage_id = lineage
         try:
             with trace.activate(), trace.span(
                     "model_swap", mode=mode, generation=self.generation,
@@ -1040,6 +1090,12 @@ class FollowTrainer:
             # here (the publisher thread) it would race the next _apply's
             # in-place mutations
             self._maybe_checkpoint()
+        lin = _lineage.get_lineage()
+        if lineage is not None and lin.enabled:
+            lin.stage(lineage, "publish", start=w_pub,
+                      duration_s=time.perf_counter() - t_pub0,
+                      mode=mode, warm_s=round(t_warm, 6))
+            lin.close(lineage, outcome="published")
         rec = _tracing.get_recorder()
         if rec.enabled:
             rec.record(trace.to_doc(rec.tag, "model_swap"))

@@ -101,6 +101,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from predictionio_tpu.obs import lineage as _lineage
 from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.store.columnar import (
     CSRLookup,
@@ -483,6 +484,7 @@ class ModelPlane:
                 "the model plane serializes exactly one URModel; got "
                 f"{[type(m).__name__ for m in (models or [])]}")
         model = models[0]
+        w0, t0 = time.time(), time.perf_counter()
         # the publisher pays the ONE derived-state build (or the fold
         # engine's incremental patch) per node; workers only map
         model.ensure_host_serving_state()
@@ -569,6 +571,20 @@ class ModelPlane:
         _M_CHAIN.set(gen - keyframe_gen, worker=tag)
         if kept is not None:
             _M_BLOBS.set(kept, worker=tag)
+        lid = (info or {}).get("lineageId")
+        if lid:
+            lin = _lineage.get_lineage()
+            if lin.enabled:
+                # the PLANE generation is the id workers install under —
+                # note it here so /lineage/<gen>.json resolves from the
+                # number any consumer actually sees
+                lin.stage(lid, "plane.write", start=w0,
+                          duration_s=time.perf_counter() - t0,
+                          generation=gen, kind=meta["planeKind"],
+                          bytes=int(size), full=int(stats["full"]),
+                          delta=int(stats["delta"]),
+                          ref=int(stats["ref"]))
+                lin.note_generation(lid, gen)
         log.info(
             "model plane: published generation %d (%s, %.1f MB on disk, "
             "%.1f MB logical; full/delta/ref %.1f/%.2f/%.1f MB)",
@@ -1568,6 +1584,7 @@ class PlaneWatcher:
             if gen <= self.generation or gen == self._bad_gen:
                 return False
             t0 = time.perf_counter()
+            w_wake = time.time()
             try:
                 model, info = self.plane.load(cur)
             except (ValueError, KeyError) as e:
@@ -1591,6 +1608,20 @@ class PlaneWatcher:
                         "— keeping the served generation, will retry",
                         gen, e)
                 return False
+            lid = (info or {}).get("lineageId")
+            if lid:
+                lin = _lineage.get_lineage()
+                if lin.enabled:
+                    # watcher_wake spans publish→this poll noticing it
+                    # (the cross-process freshness gap); compose is the
+                    # mmap+chain-compose this worker just paid
+                    pub_at = float(cur.get("publishedAt") or w_wake)
+                    lin.stage(lid, "watcher_wake", start=pub_at,
+                              duration_s=max(w_wake - pub_at, 0.0))
+                    lin.stage(lid, "compose", start=w_wake,
+                              duration_s=time.perf_counter() - t0,
+                              generation=gen,
+                              kind=str(cur.get("kind") or ""))
             installed = self.install([model], info)
             # the generation is consumed either way: install() returns
             # False only when a newer build ticket (a later check or the

@@ -30,8 +30,11 @@ from typing import Any, Callable, Dict, Optional
 
 from predictionio_tpu.api import prefork
 from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.obs import lineage as obs_lineage
 from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs import slo as obs_slo
 from predictionio_tpu.obs import tracing as obs_tracing
+from predictionio_tpu.obs import tsdb as obs_tsdb
 from predictionio_tpu.obs.exposition import StatsCollector, metrics_payload
 from predictionio_tpu.obs.metrics import SIZE_BUCKETS
 from predictionio_tpu.serve import response_cache as _response_cache
@@ -297,6 +300,10 @@ class QueryServerState:
         self.follow_info: Optional[Dict] = None
         self._build_seq = 0           # install-order tickets (see _install)
         self._installed_seq = 0
+        # (lineage id, generation) of the newest install whose
+        # first_serve stage this worker still owes — grabbed by the
+        # first predict() that runs on the new generation
+        self._lineage_pending: Optional[tuple] = None
         # shared-memory model plane (streaming.plane): when a plane dir
         # is wired, this worker WATCHES the plane manifest and installs
         # each published generation as read-only mmap views — model
@@ -522,6 +529,7 @@ class QueryServerState:
         the bundle was dropped as stale, True when it went live."""
         import jax
 
+        w_inst, t_inst = time.time(), time.perf_counter()
         with self._lock:
             self._build_seq += 1
             ticket = self._build_seq
@@ -557,8 +565,22 @@ class QueryServerState:
             # predictor goes live, sweeping exactly the entries its swap
             # provenance cannot prove unchanged (serve.response_cache);
             # the cache must never be able to break an install
+            w_cache, t_cache = time.time(), time.perf_counter()
+            cache_attrs = None
             try:
-                _response_cache.get_cache().on_swap(models)
+                cache = _response_cache.get_cache()
+                cache.on_swap(models)
+                cache_attrs = {
+                    "start": w_cache,
+                    "duration_s": time.perf_counter() - t_cache,
+                    # workers without provenance flush everything — that
+                    # IS the interesting outcome on a lineage waterfall
+                    "outcome": ("full_flush"
+                                if cache.last_swap_reason == "no_provenance"
+                                else cache.last_swap_reason or "noop"),
+                    "dropped": int(cache.last_swap_invalidated),
+                    "entries": len(cache),
+                }
             except Exception:
                 log.exception("response-cache swap sweep failed — "
                               "disarming the cache")
@@ -574,7 +596,25 @@ class QueryServerState:
             self.swapped_at = _dt.datetime.now(_dt.timezone.utc)
             if follow_info is not None:
                 self.follow_info = dict(follow_info)
+            lid = (follow_info or {}).get("lineageId")
+            gen = int((follow_info or {}).get("planeGeneration")
+                      or self.generation)
+            if lid:
+                # first_serve is owed by whichever predict() runs next on
+                # this generation; newer installs overwrite the debt (the
+                # superseded generation never served from this worker)
+                self._lineage_pending = (lid, gen)
         _M_GENERATION.set(self.generation)
+        if lid:
+            lin = obs_lineage.get_lineage()
+            if lin.enabled:
+                lin.note_generation(lid, gen)
+                if cache_attrs is not None:
+                    lin.stage(lid, "cache_invalidation",
+                              parent="install", **cache_attrs)
+                lin.stage(lid, "install", start=w_inst,
+                          duration_s=time.perf_counter() - t_inst,
+                          generation=gen, flush=True)
         return True
 
     def freshness(self) -> Dict:
@@ -616,10 +656,20 @@ class QueryServerState:
 
     def predict(self, body: Dict) -> Any:
         query = self.parse_query(body)
+        w_q, t_q = time.time(), time.perf_counter()
         with self._lock:
             predictor = self.predictor
             batcher = self.batcher
+            pending, self._lineage_pending = self._lineage_pending, None
         prediction = batcher.predict(query) if batcher else predictor(query)
+        if pending is not None:
+            # the freshness waterfall's last hop: this worker ANSWERED a
+            # query from the new generation (not merely installed it)
+            lin = obs_lineage.get_lineage()
+            if lin.enabled:
+                lin.stage(pending[0], "first_serve", start=w_q,
+                          duration_s=time.perf_counter() - t_q,
+                          generation=pending[1], flush=True)
         prediction = self.plugins.apply(query, prediction)
         self.query_count += 1
         if self.feedback and self.feedback_app_name:
@@ -718,6 +768,12 @@ def make_handler(state: QueryServerState):
                                      "charset=utf-8")
             elif obs_tracing.handle_trace_request(self, path):
                 pass   # /traces.json + /traces/{rid}.json (flight recorder)
+            elif obs_lineage.handle_lineage_request(self, path):
+                pass   # /lineage.json + /lineage/{gen|ln-id}.json
+            elif obs_tsdb.handle_history_request(self, path):
+                pass   # /metrics/history.json (local time-series ring)
+            elif obs_slo.handle_healthz_request(self, path):
+                pass   # /healthz (SLO burn-rate verdicts, always 200)
             elif path == "/stats.json":
                 if self.stats_collector is None:
                     self.send_error_json(
@@ -953,12 +1009,20 @@ def deploy(
     # from PIO_METRICS_DIR; single workers persist next to the storage
     # spans dir so the dashboard can merge them
     obs_tracing.arm(storage=state.storage)
+    # lineage records persist next to the traces (children resolve the
+    # group dir from PIO_METRICS_DIR); the history sampler gives every
+    # serving process its /metrics/history.json ring + SLO gauges
+    obs_lineage.arm(storage=state.storage)
+    if obs_metrics.get_registry().enabled:
+        obs_tsdb.start_sampler()
     httpd = start_server(make_handler(state), host, port,
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
     bound_port = httpd.server_address[1]
     if workers > 1:
         obs_tracing.arm(directory=os.path.join(metrics_dir, "traces"),
+                        tag=f"w0-{os.getpid()}")
+        obs_lineage.arm(directory=os.path.join(metrics_dir, "lineage"),
                         tag=f"w0-{os.getpid()}")
         # plane mode: children are pure consumers — no per-worker
         # follower (ONE fold per delta, in the publisher process below)
@@ -1055,6 +1119,10 @@ def run_plane_publisher(
     prefork.maybe_watch_parent(log)
     obs_metrics.start_worker_flusher()
     obs_metrics.mark_worker_up()
+    # the publisher OPENS every lineage record (fold + publish stages);
+    # PIO_METRICS_DIR is in its spawn env, so arm() lands the records in
+    # the group dir the serving workers merge from
+    obs_lineage.arm()
     doc = load_engine_variant(engine_json, variant)
     factory, engine, engine_params = engine_from_variant(doc)
     eid = resolve_engine_id(engine_id, doc, factory)

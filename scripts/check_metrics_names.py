@@ -53,6 +53,9 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.streaming.fold",
     "predictionio_tpu.streaming.plane",
     "predictionio_tpu.serve.response_cache",
+    "predictionio_tpu.obs.lineage",
+    "predictionio_tpu.obs.tsdb",
+    "predictionio_tpu.obs.slo",
 ]
 
 
@@ -121,9 +124,21 @@ REQUIRED_METRICS = frozenset({
     "pio_serve_cache_invalidations_total",
     "pio_serve_cache_entries",
     "pio_serve_cache_audit_mismatch_total",
+    # generation lineage + local TSDB + SLO engine (PR 17): the
+    # roundtrip check keys on the record counter; dashboards and
+    # /healthz key on the burn gauges; sibling-eviction visibility on
+    # the stale counter
+    "pio_lineage_records_total",
+    "pio_obs_stale_siblings_total",
+    "pio_slo_burn_rate",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})
+# lineage stage calls name their stage in args[1] (args[0] is the
+# lineage id); their attr kwargs follow the same naming contract
+STAGE_CALL_NAMES = frozenset({"stage"})
+# control kwargs, not attr names
+_EXEMPT_KWARGS = ("parent", "attrs", "start", "duration_s", "flush")
 # span attrs assigned post-hoc (rec["attrs"] = {...}) use literal dict
 # keys; f-string keys (dynamic stage suffixes) are checked on their
 # literal prefix parts only
@@ -162,16 +177,53 @@ def lint_span_names(pkg_root: str) -> list:
                 fname = (node.func.attr if isinstance(node.func, ast.Attribute)
                          else node.func.id if isinstance(node.func, ast.Name)
                          else None)
-                if fname not in SPAN_CALL_NAMES:
+                if fname in SPAN_CALL_NAMES:
+                    name_idx = 0
+                elif fname in STAGE_CALL_NAMES:
+                    name_idx = 1
+                else:
                     continue
                 where = f"{rel}:{node.lineno}"
-                if (node.args and isinstance(node.args[0], ast.Constant)
-                        and isinstance(node.args[0].value, str)):
-                    check(node.args[0].value, where)
+                if (len(node.args) > name_idx
+                        and isinstance(node.args[name_idx], ast.Constant)
+                        and isinstance(node.args[name_idx].value, str)):
+                    check(node.args[name_idx].value, where)
                 for kw in node.keywords:
-                    if kw.arg and kw.arg not in ("parent", "attrs",
-                                                 "start", "duration_s"):
+                    if kw.arg and kw.arg not in _EXEMPT_KWARGS:
                         check(kw.arg, where)
+    return problems
+
+
+def lint_docs_catalog(repo_root: str, registered: set) -> list:
+    """Cross-check the docs metric-catalog table against the code:
+    every REQUIRED metric must appear in the table, and every pio_ name
+    the table documents must be registered or at least declared in the
+    package source (some gauges register lazily on first publish)."""
+    path = os.path.join(repo_root, "docs", "operations.md")
+    if not os.path.exists(path):
+        return [f"{path}: missing (the metric catalog lives there)"]
+    name_re = re.compile(r"pio_[a-z0-9_]+")
+    docs_names = set()
+    with open(path) as f:
+        for line in f:
+            if line.startswith("| `pio_"):
+                docs_names.update(name_re.findall(line))
+    declared = set(registered)
+    pkg_root = os.path.join(repo_root, "predictionio_tpu")
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn)) as f:
+                    declared.update(name_re.findall(f.read()))
+    problems = []
+    for miss in sorted(REQUIRED_METRICS - docs_names):
+        problems.append(
+            f"docs/operations.md: required metric {miss} missing from "
+            "the metric-catalog table")
+    for ghost in sorted(docs_names - declared):
+        problems.append(
+            f"docs/operations.md: catalog documents {ghost} but no such "
+            "metric exists in the package")
     return problems
 
 
@@ -201,6 +253,7 @@ def main() -> int:
         problems.append(
             f"required metric {req} not registered (middleware contract "
             "broken by a front-end change?)")
+    problems += lint_docs_catalog(os.path.dirname(pkg_root), names)
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 import urllib.request
 
 # runnable from any cwd without an installed package
@@ -100,9 +101,22 @@ def main() -> int:
                 if r.headers.get("X-Request-ID") != RID:
                     problems.append("response did not echo our request id")
                 r.read()
-            with urllib.request.urlopen(base + f"/traces/{RID}.json",
-                                        timeout=10) as r:
-                doc = json.loads(r.read())
+            # retention happens in the middleware tail AFTER the response
+            # bytes are flushed — a pool sibling can serve our immediate
+            # fetch before the POST's thread has indexed the trace, so
+            # poll briefly (normally lands within a few ms)
+            doc = None
+            deadline = time.time() + 5.0
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                            base + f"/traces/{RID}.json", timeout=10) as r:
+                        doc = json.loads(r.read())
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code != 404 or time.time() > deadline:
+                        raise
+                    time.sleep(0.01)
             if doc.get("reason") != "slow":
                 problems.append(
                     f"kept for {doc.get('reason')!r}, expected 'slow'")

@@ -1,0 +1,358 @@
+"""Generation-lineage coverage: cross-process record merge and outcome
+computation, SIGKILLed-publisher abandonment via supersession, stale
+sibling-file eviction from every merge (lineage + traces), the RSS seed
+at worker start, the local metrics time-series ring, the SLO burn-rate
+engine's verdicts, and the 2-worker prefork lineage roundtrip script."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.obs import lineage as obs_lineage
+from predictionio_tpu.obs import metrics as obs_metrics
+from predictionio_tpu.obs.lineage import LineageRecorder, merge_records
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _frag(lid, start, stages, outcome=None, origin=None, generation=None):
+    doc = {"lid": lid, "start": start, "stages": stages}
+    if outcome:
+        doc["outcome"] = outcome
+    if origin:
+        doc["origin"] = origin
+    if generation is not None:
+        doc["generation"] = generation
+    return doc
+
+
+def _stage(name, start, worker="w", duration_s=0.01, **extra):
+    return {"stage": name, "start": start, "duration_s": duration_s,
+            "worker": worker, **extra}
+
+
+class TestMergeRecords:
+    def test_complete_needs_publish_install_and_first_serve(self):
+        recs = merge_records([
+            _frag("ln-a", 100.0,
+                  [_stage("append_observed", 100.0, "pub"),
+                   _stage("publish", 100.5, "pub")],
+                  outcome="published", origin="pub", generation=7),
+            _frag("ln-a", 100.0,
+                  [_stage("install", 101.0, "w1"),
+                   _stage("first_serve", 101.2, "w1")]),
+        ])
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["outcome"] == "complete"
+        assert rec["generation"] == 7
+        assert rec["origin"] == "pub"
+        assert rec["workers"] == ["pub", "w1"]
+        # end-to-end duration spans the last stage's end
+        assert rec["durationMs"] == pytest.approx(
+            (101.2 + 0.01 - 100.0) * 1e3, abs=1.0)
+
+    def test_published_without_worker_stages(self):
+        recs = merge_records([
+            _frag("ln-b", 50.0, [_stage("publish", 50.4, "pub")],
+                  outcome="published")])
+        assert recs[0]["outcome"] == "published"
+
+    def test_open_record_superseded_by_newer_publish_is_abandoned(self):
+        recs = merge_records([
+            _frag("ln-dead", 10.0,
+                  [_stage("append_observed", 10.0, "pub")]),
+            _frag("ln-live", 20.0, [_stage("publish", 20.3, "pub")],
+                  outcome="published"),
+        ])
+        by = {r["lid"]: r for r in recs}
+        assert by["ln-dead"]["outcome"] == "abandoned"
+        assert by["ln-live"]["outcome"] == "published"
+        # newest first
+        assert [r["lid"] for r in recs] == ["ln-live", "ln-dead"]
+
+    def test_newest_open_record_stays_open(self):
+        recs = merge_records([
+            _frag("ln-old", 10.0, [_stage("publish", 10.1, "pub")],
+                  outcome="published"),
+            _frag("ln-new", 30.0,
+                  [_stage("append_observed", 30.0, "pub")]),
+        ])
+        by = {r["lid"]: r for r in recs}
+        assert by["ln-new"]["outcome"] == "open"
+
+    def test_stage_dedupe_across_own_file_reread(self):
+        s = _stage("publish", 5.0, "pub")
+        recs = merge_records([
+            _frag("ln-c", 5.0, [s], outcome="published"),
+            _frag("ln-c", 5.0, [dict(s)]),   # same stage via file re-read
+        ])
+        assert len(recs[0]["stages"]) == 1
+
+
+class TestRecorderCrossProcess:
+    def test_sibling_merge_reunites_publisher_and_worker(self, tmp_path):
+        pub = LineageRecorder(directory=tmp_path, tag="pub-1", enabled=True)
+        worker = LineageRecorder(directory=tmp_path, tag="w1-1",
+                                 enabled=True)
+        lid = pub.new_id()
+        t0 = time.time()
+        pub.begin(lid, start=t0)
+        pub.stage(lid, "append_observed", start=t0, duration_s=0.01)
+        pub.stage(lid, "publish", start=t0 + 0.1, duration_s=0.02)
+        pub.note_generation(lid, 3)
+        pub.close(lid, outcome="published")
+        worker.stage(lid, "install", start=t0 + 0.3, duration_s=0.01,
+                     flush=True)
+        worker.stage(lid, "cache_invalidation", parent="install",
+                     start=t0 + 0.3, duration_s=0.001, flush=True)
+        worker.stage(lid, "first_serve", start=t0 + 0.4, duration_s=0.005,
+                     flush=True)
+        # either side's merged view sees the whole record
+        for rec in (pub, worker):
+            doc = rec.get(lid)
+            assert doc is not None
+            assert doc["outcome"] == "complete"
+            assert doc["generation"] == 3
+            assert doc["origin"] == "pub-1"
+            assert set(doc["workers"]) == {"pub-1", "w1-1"}
+            kids = [s for s in doc["stages"]
+                    if s["stage"] == "cache_invalidation"]
+            assert kids and kids[0]["parent"] == "install"
+        assert worker.get_generation(3)["lid"] == lid
+        entry = worker.index()["records"][0]
+        assert entry["lid"] == lid and entry["outcome"] == "complete"
+        text = obs_lineage.render_lineage_text(worker.get(lid))
+        for name in ("publish", "install", "first_serve"):
+            assert name in text
+
+    def test_disabled_recorder_records_nothing(self, tmp_path):
+        rec = LineageRecorder(directory=tmp_path, tag="w", enabled=False)
+        lid = rec.new_id()
+        rec.begin(lid)
+        rec.stage(lid, "publish", flush=True)
+        assert rec.merged() == []
+        assert list(tmp_path.glob("*.json")) == []
+
+    def test_sigkill_publisher_leaves_abandoned_record(self, tmp_path):
+        """A publisher SIGKILLed mid-publish must leave its open record
+        on disk; the merge closes it as ``abandoned`` once the NEXT
+        generation reaches publish — no cooperation from the corpse."""
+        child_src = (
+            "import sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "from predictionio_tpu.obs.lineage import LineageRecorder\n"
+            "rec = LineageRecorder(directory=%r, tag='pub-dead', "
+            "enabled=True)\n"
+            "lid = rec.new_id()\n"
+            "rec.begin(lid, start=time.time())\n"
+            "rec.stage(lid, 'append_observed', duration_s=0.01, "
+            "flush=True)\n"
+            "print(lid, flush=True)\n"
+            "time.sleep(120)\n" % (str(REPO), str(tmp_path)))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child_src],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "PIO_METRICS": "off"})
+        try:
+            dead_lid = proc.stdout.readline().strip()
+            assert dead_lid.startswith("ln-")
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        # the next fold tick in a fresh publisher supersedes it
+        nxt = LineageRecorder(directory=tmp_path, tag="pub-2", enabled=True)
+        lid2 = nxt.new_id()
+        nxt.begin(lid2, start=time.time())
+        nxt.stage(lid2, "publish", duration_s=0.02)
+        nxt.note_generation(lid2, 9)
+        nxt.close(lid2, outcome="published")
+        worker = LineageRecorder(directory=tmp_path, tag="w0-2",
+                                 enabled=True)
+        worker.stage(lid2, "install", duration_s=0.01, flush=True)
+        worker.stage(lid2, "first_serve", duration_s=0.01, flush=True)
+        by = {r["lid"]: r for r in worker.merged()}
+        assert by[dead_lid]["outcome"] == "abandoned"
+        assert by[dead_lid]["origin"] == "pub-dead"
+        assert by[lid2]["outcome"] == "complete"
+
+
+class TestStaleSiblingEviction:
+    def _age(self, path: Path, by_s: float = 3600.0):
+        old = time.time() - by_s
+        os.utime(path, (old, old))
+
+    def test_lineage_merge_evicts_dead_sibling(self, tmp_path):
+        dead = tmp_path / "w9-dead.json"
+        dead.write_text(json.dumps({
+            "worker": "w9-dead",
+            "records": [_frag("ln-ghost", 1.0,
+                              [_stage("publish", 1.0, "w9-dead")],
+                              outcome="published")]}))
+        self._age(dead)
+        before = obs_metrics.STALE_SIBLINGS.value(kind="lineage")
+        rec = LineageRecorder(directory=tmp_path, tag="w0", enabled=True)
+        lids = {r["lid"] for r in rec.merged()}
+        assert "ln-ghost" not in lids
+        assert not dead.exists()
+        assert obs_metrics.STALE_SIBLINGS.value(kind="lineage") == before + 1
+
+    def test_lineage_merge_never_evicts_own_file(self, tmp_path):
+        rec = LineageRecorder(directory=tmp_path, tag="w0", enabled=True)
+        lid = rec.new_id()
+        rec.begin(lid)
+        own = tmp_path / "w0.json"
+        assert own.exists()
+        self._age(own)
+        rec.merged()
+        assert own.exists()
+
+    def test_trace_merge_evicts_dead_sibling(self, tmp_path):
+        from predictionio_tpu.obs.tracing import FlightRecorder
+
+        dead = tmp_path / "w9-dead.json"
+        dead.write_text(json.dumps({
+            "worker": "w9-dead",
+            "traces": [{"rid": "ghost-rid", "start": 1.0, "durationMs": 1,
+                        "spans": []}]}))
+        self._age(dead)
+        before = obs_metrics.STALE_SIBLINGS.value(kind="traces")
+        rec = FlightRecorder(directory=tmp_path, tag="w0", enabled=True)
+        rids = {t.get("rid") for t in rec._sibling_docs()}
+        assert "ghost-rid" not in rids
+        assert not dead.exists()
+        assert obs_metrics.STALE_SIBLINGS.value(kind="traces") == before + 1
+
+
+def test_mark_worker_up_seeds_rss():
+    if not os.path.exists("/proc/self/statm"):
+        pytest.skip("no /proc on this platform")
+    obs_metrics.mark_worker_up("rss-seed-test")
+    assert obs_metrics.PROCESS_RSS.value(worker="rss-seed-test") > 0
+
+
+class TestTsdb:
+    def test_sampler_ring_reduces_and_bounds(self):
+        from predictionio_tpu.obs.tsdb import MetricsSampler
+
+        reg = obs_metrics.get_registry()
+        c = reg.counter("pio_lineage_records_total", "x")
+        sampler = MetricsSampler(interval=60.0, ring=4)
+        c.inc()
+        for _ in range(6):
+            sampler.sample_now()
+        samples = sampler.samples()
+        assert len(samples) == 4   # bounded ring
+        entry = samples[-1]["m"].get("pio_lineage_records_total")
+        assert entry and entry["type"] == "counter"
+        assert sum(entry["series"].values()) >= 1
+        # histograms keep bucket bounds hoisted per metric, not per sample
+        hist = sampler.history(limit=2)
+        assert len(hist["samples"]) == 2
+        assert "buckets" in hist and "intervalSeconds" in hist
+        fold = samples[-1]["m"].get("pio_follow_fold_duration_seconds")
+        if fold is not None:
+            for v in fold["series"].values():
+                assert set(v) == {"counts", "sum", "count"}
+
+
+class TestSloEngine:
+    CACHE_SLO = ({"name": "cache_audit", "kind": "counter_delta",
+                  "metric": "pio_serve_cache_audit_mismatch_total",
+                  "match": "", "threshold": 0.0, "help": "x"},)
+    LAG_SLO = ({"name": "replica_lag", "kind": "gauge_max",
+                "metric": "pio_store_replica_lag_events", "match": "",
+                "threshold": 10000.0, "help": "x"},)
+
+    @staticmethod
+    def _counter_samples(values, t0=1000.0, dt=10.0):
+        return [{"t": t0 + i * dt,
+                 "m": {"pio_serve_cache_audit_mismatch_total": {
+                     "type": "counter", "series": {"{}": float(v)}}}}
+                for i, v in enumerate(values)]
+
+    def test_no_data_on_empty_ring(self):
+        from predictionio_tpu.obs.slo import SloEngine
+
+        doc = SloEngine(self.CACHE_SLO).evaluate([], {})
+        assert doc["status"] == "no_data"
+        assert doc["slos"]["cache_audit"]["verdict"] == "no_data"
+
+    def test_flat_counter_is_ok(self):
+        from predictionio_tpu.obs.slo import SloEngine
+
+        doc = SloEngine(self.CACHE_SLO).evaluate(
+            self._counter_samples([3, 3, 3, 3, 3]), {})
+        assert doc["status"] == "ok"
+
+    def test_burning_requires_both_windows(self, monkeypatch):
+        from predictionio_tpu.obs.slo import SloEngine
+
+        monkeypatch.setenv("PIO_SLO_FAST_S", "60")
+        monkeypatch.setenv("PIO_SLO_SLOW_S", "600")
+        # every interval increments the mismatch counter: burn 10x in
+        # BOTH windows -> burning
+        doc = SloEngine(self.CACHE_SLO).evaluate(
+            self._counter_samples([0, 1, 2, 3, 4]), {})
+        v = doc["slos"]["cache_audit"]
+        assert v["verdict"] == "burning"
+        assert v["windows"]["fast"]["burn"] > 1
+        assert v["windows"]["slow"]["burn"] > 1
+        # violations confined to the OLD part of the ring: the slow
+        # window still burns, the fast window is clean -> warn, not
+        # burning (the multi-window pattern's whole point)
+        values = [0, 5, 10, 15, 15, 15, 15, 15, 15, 15, 15, 15]
+        doc = SloEngine(self.CACHE_SLO).evaluate(
+            self._counter_samples(values, dt=30.0), {})
+        v = doc["slos"]["cache_audit"]
+        assert v["verdict"] == "warn"
+        assert v["windows"]["fast"]["burn"] <= 1 \
+            < v["windows"]["slow"]["burn"]
+
+    def test_counter_restart_not_a_violation(self):
+        from predictionio_tpu.obs.slo import SloEngine
+
+        # a worker restart drops the total; delta<0 folds to c1 (=0
+        # here), so the restart interval itself does not violate
+        doc = SloEngine(self.CACHE_SLO).evaluate(
+            self._counter_samples([5, 5, 0, 0, 0]), {})
+        assert doc["status"] == "ok"
+
+    def test_gauge_max_threshold(self):
+        from predictionio_tpu.obs.slo import SloEngine
+
+        def lag_samples(v):
+            return [{"t": 1000.0 + i * 10,
+                     "m": {"pio_store_replica_lag_events": {
+                         "type": "gauge", "series": {"{}": float(v)}}}}
+                    for i in range(5)]
+
+        assert SloEngine(self.LAG_SLO).evaluate(
+            lag_samples(500), {})["status"] == "ok"
+        assert SloEngine(self.LAG_SLO).evaluate(
+            lag_samples(20000), {})["status"] == "burning"
+
+    def test_burn_gauges_exported(self):
+        from predictionio_tpu.obs.slo import SloEngine
+
+        SloEngine(self.CACHE_SLO).evaluate(
+            self._counter_samples([0, 1, 2, 3]), {})
+        reg = obs_metrics.get_registry()
+        g = reg.gauge("pio_slo_burn_rate", "x")
+        assert g.value(slo="cache_audit", window="fast") > 1
+
+
+def test_check_lineage_roundtrip_script():
+    r = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_lineage_roundtrip.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok:" in r.stdout
