@@ -1205,22 +1205,35 @@ def bench_store_scale(smoke: bool) -> dict:
     unreplicated).  Every cell recounts the on-disk shard union and
     requires every eventId unique — the exactly-once integrity check —
     and the scan cell requires the merged columnar batch to carry
-    exactly the ingested set."""
+    exactly the ingested set.
+
+    Native A/B (ISSUE-18 tentpole): every legacy cell pins
+    ``PIO_NATIVE=off``; each shard count then re-times the LIVE fan-out
+    scan with the native scan core on, diffs the result bit-exactly
+    against the off run (codes, ids, watermark), and the
+    ``native_scan_recovery`` guard requires the native s4 fan-out to
+    hold >=0.9x the native s1 rate — the merge, not the parse, was the
+    pre-native wall."""
     import shutil
     import tempfile
 
+    from predictionio_tpu.native import core as _ncore
     from predictionio_tpu.storage.sharded import ShardedEvents
 
     n = 20_000 if smoke else 300_000
     batch = 1_000
     out: dict = {"store_scale_events": n}
     saved_fsync = os.environ.get("PIO_FSYNC")
+    saved_native = os.environ.get("PIO_NATIVE")
+    have_native = _ncore.lib() is not None
+    out["store_scale_native"] = "on" if have_native else "no_toolchain"
     try:
         for shards in (1, 2, 4):
             tmp = tempfile.mkdtemp(prefix=f"pio_store_s{shards}")
             ev = None
             try:
                 os.environ["PIO_FSYNC"] = "rotate"
+                os.environ["PIO_NATIVE"] = "off"
                 ev = ShardedEvents(tmp, shards=shards, replicas=1)
                 reqs = [
                     [{"event": "buy", "entityType": "user",
@@ -1275,6 +1288,56 @@ def bench_store_scale(smoke: bool) -> dict:
                 for k in range(shards):
                     out[f"store_scan_s{shards}_shard{k}_seconds"] = round(
                         _M_SCAN_SHARD_S.value(shard=str(k)), 6)
+                if have_native:
+                    # native A/B on the NO-CRUTCH live fan-out: per-shard
+                    # columnar snapshots hidden before every run, so both
+                    # cells pay the full segment re-parse — the workload
+                    # the GIL-dropping scan core parallelizes.  Runs are
+                    # diffed bit-exactly (codes, ids, watermark).
+                    def _drop_shard_snaps():
+                        for sh in ev._shards:
+                            for node in ("a", "b", "c"):
+                                try:
+                                    root = sh.node_root(node)
+                                except Exception:
+                                    continue
+                                if root is None:
+                                    continue
+                                for sd in root.glob(
+                                        "events/app_*/*/snapshot"):
+                                    shutil.rmtree(sd, ignore_errors=True)
+
+                    ab = {}
+                    for nat in ("off", "on"):
+                        os.environ["PIO_NATIVE"] = nat
+                        _drop_shard_snaps()
+                        t0 = time.perf_counter()
+                        ab[nat] = ev._fanout_snapshot_scan(1)
+                        wall = time.perf_counter() - t0
+                        key = ("store_scan_fanout_py_"
+                               if nat == "off"
+                               else "store_scan_fanout_native_")
+                        out[f"{key}s{shards}_events_per_sec"] = n / wall
+                    os.environ["PIO_NATIVE"] = "off"
+                    nres, pres = ab["on"], ab["off"]
+                    ok = (nres["events"] == pres["events"] == n
+                          and nres["watermark"] == pres["watermark"]
+                          and all(np.array_equal(
+                              getattr(nres["batch"], c),
+                              getattr(pres["batch"], c))
+                              for c in ("event_codes", "entity_type_codes",
+                                        "entity_ids", "target_ids",
+                                        "times_us"))
+                          and np.array_equal(nres["ids"].blob,
+                                             pres["ids"].blob)
+                          and np.array_equal(nres["ids"].offs,
+                                             pres["ids"].offs))
+                    out[f"store_scale_native_parity_s{shards}"] = (
+                        "ok" if ok else "MISMATCH vs PIO_NATIVE=off")
+                    if not ok:
+                        raise AssertionError(
+                            f"shards={shards}: native fan-out diverged "
+                            "from the PIO_NATIVE=off oracle")
                 out[f"store_scale_integrity_s{shards}"] = "ok"
             finally:
                 # close BEFORE rmtree even on failure, or leaked follower
@@ -1293,6 +1356,20 @@ def bench_store_scale(smoke: bool) -> dict:
                 f"scan_parallel_recovery: shards=4 merged cold scan holds "
                 f"only {ratio:.2f}x of shards=1 (guard: >=0.5x)")
         out["store_scale_scan_parallel_recovery"] = "ok"
+        # native_scan_recovery guard (ISSUE-18 tentpole): with the native
+        # scan core, the LIVE fan-out at shards=4 must hold >=0.9x the
+        # shards=1 rate — no merged-snapshot crutch in either cell
+        if have_native:
+            nratio = (
+                out["store_scan_fanout_native_s4_events_per_sec"]
+                / max(out["store_scan_fanout_native_s1_events_per_sec"],
+                      1e-9))
+            out["store_native_scan_recovery_ratio"] = round(nratio, 3)
+            out["store_scale_native_scan_recovery"] = (
+                "ok" if nratio >= 0.9
+                else f"BELOW {nratio:.2f}x < 0.9x")
+        else:
+            out["store_scale_native_scan_recovery"] = "no_toolchain"
         # replication cost: identical shape with and without the barrier
         n_r = max(2_000, n // 10)
         for replicas in (1, 2):
@@ -1326,6 +1403,10 @@ def bench_store_scale(smoke: bool) -> dict:
             os.environ.pop("PIO_FSYNC", None)
         else:
             os.environ["PIO_FSYNC"] = saved_fsync
+        if saved_native is None:
+            os.environ.pop("PIO_NATIVE", None)
+        else:
+            os.environ["PIO_NATIVE"] = saved_native
     return out
 
 
@@ -2826,8 +2907,15 @@ def bench_serve_scale(smoke: bool) -> dict:
     # off — the auto cells document that resolution; the "on" cells force
     # the micro-batcher so batching-vs-not is actually measured; the
     # "notrace" cells are batch-off with PIO_TRACING=off, the baseline
-    # for the always-on flight-recorder overhead guard
-    batch_modes = ("off", "auto", "on", "notrace")
+    # for the always-on flight-recorder overhead guard; the "native"
+    # cells are batch-off with PIO_NATIVE=on (serve fast lane + native
+    # HTTP parse/assemble), every other cell pinned to PIO_NATIVE=off —
+    # the shared parity corpus proves the lane response-invisible
+    from predictionio_tpu.native import core as _ncore
+
+    have_native = _ncore.lib() is not None
+    batch_modes = ("off", "auto", "on", "notrace") + (
+        ("native",) if have_native else ())
     tmp = tempfile.mkdtemp(prefix="pio_bench_servescale")
     out: dict = {
         "serve_scale_catalog_items": n_items,
@@ -2836,6 +2924,7 @@ def bench_serve_scale(smoke: bool) -> dict:
         "serve_scale_trace_guard": "not_run",
         "serve_scale_lineage_guard": "not_run",
         "serve_scale_monotone": "not_run",
+        "serve_scale_native": "on" if have_native else "no_toolchain",
     }
     try:
         _storage, ur_json = _fabricate_ur_serving_store(
@@ -2853,6 +2942,9 @@ def bench_serve_scale(smoke: bool) -> dict:
             # measuring the uncached tail (the response cache has its
             # own _cache_sweep cells)
             "PIO_SERVE_CACHE": "off",
+            # legacy cells pin the native lane off; only the "native"
+            # batch-mode cells flip it on
+            "PIO_NATIVE": "off",
         }
         # the parity corpus: every rule shape the mask cache serves, with
         # enough repetition that steady-state cells run on cache hits
@@ -2878,9 +2970,12 @@ def bench_serve_scale(smoke: bool) -> dict:
                     port = s.getsockname()[1]
                 env = {**env_base,
                        "PIO_SERVE_BATCH":
-                           "off" if mode == "notrace" else mode}
+                           "off" if mode in ("notrace", "native")
+                           else mode}
                 if mode == "notrace":
                     env["PIO_TRACING"] = "off"
+                if mode == "native":
+                    env["PIO_NATIVE"] = "on"
                 proc = subprocess.Popen(
                     [sys.executable, "-m", "predictionio_tpu.cli.main",
                      "deploy", "--engine-json", ur_json,
@@ -3003,6 +3098,20 @@ def bench_serve_scale(smoke: bool) -> dict:
             f"serve_scale_w{worker_counts[-1]}_off_"
             f"c{client_counts[-1]}_qps", 0.0)
         out["serve_scale_speedup_wmax_vs_w1"] = wmax / w1 if w1 else 0.0
+        # native_serve_speedup guard (ISSUE-18 tentpole): the native fast
+        # lane must hold >=2x the single-worker batch-off qps at the
+        # heaviest client count; parity of the native cells is already
+        # proven by the shared corpus diff above
+        if have_native:
+            n1 = out.get(
+                f"serve_scale_w1_native_c{client_counts[-1]}_qps", 0.0)
+            out["serve_scale_native_speedup_w1"] = (
+                round(n1 / w1, 3) if w1 else 0.0)
+            out["serve_scale_native_serve_speedup"] = (
+                "ok" if w1 and n1 / w1 >= 2.0
+                else f"BELOW {n1 / w1 if w1 else 0.0:.2f}x < 2.0x")
+        else:
+            out["serve_scale_native_serve_speedup"] = "no_toolchain"
         # concurrency-sweep guard: qps must be monotone-nondecreasing
         # (±10%) from c1 up — the old thread-per-connection stack FELL at
         # c32 (BENCH_r05: 368.7 < 412.6 at c1) from thread/accept
@@ -4165,6 +4274,7 @@ def main() -> int:
         "serve_scale_lineage_guard": "section_failed",
         "serve_scale_speedup_wmax_vs_w1": 0.0,
         "serve_scale_monotone": "section_failed",
+        "serve_scale_native_serve_speedup": "section_failed",
         "scale_serve_parity": "section_failed",
         "scale_serve_flatness": "section_failed",
         "plane_parity": "section_failed",
@@ -4195,6 +4305,7 @@ def main() -> int:
         "store_scale_events": 0,
         "store_scan_parallel_recovery_ratio": 0.0,
         "store_scale_scan_parallel_recovery": "section_failed",
+        "store_scale_native_scan_recovery": "section_failed",
     })
     store_failover = _run_section("store_failover", args.smoke, {
         "store_failover_acked_events": 0,

@@ -54,6 +54,7 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.native import core as _ncore
 from predictionio_tpu.obs import tracing as _tracing
 from predictionio_tpu.obs.metrics import get_registry
 
@@ -151,13 +152,125 @@ def _head_prefix(status: int, ctype: str) -> bytes:
 
 def assemble_response(status: int, body: bytes, ctype: str = _CT_JSON,
                       rid: str = "", close: bool = False) -> bytes:
-    parts = [_head_prefix(status, ctype)]
+    prefix = _head_prefix(status, ctype)
+    tail = _CLOSE_TAIL if close else _KEEP_TAIL
+    if _ncore.http_enabled():
+        # native assembly: one pre-sized buffer filled with the GIL
+        # dropped; value-equal to the join below (a bytearray writes and
+        # compares identically)
+        try:
+            out = _ncore.http_assemble(
+                prefix, rid.encode("latin-1") if rid else None, tail, body)
+            if out is not None:
+                return out
+        except Exception:
+            _ncore.note_fallback("error")
+    parts = [prefix]
     if rid:
         parts.append(b"X-Request-ID: %s\r\n" % rid.encode("latin-1"))
     parts.append(b"Content-Length: %d\r\n" % len(body))
-    parts.append(_CLOSE_TAIL if close else _KEEP_TAIL)
+    parts.append(tail)
     parts.append(body)
     return b"".join(parts)
+
+
+# refusal map for the native head parser: rc -> the oracle's exact
+# (status, message) in its exact first-error-wins order (data_plane.cpp
+# walks lines the same way the Python loop below does)
+_NATIVE_REFUSALS = {
+    1: (400, "malformed request line"),
+    2: (400, "too many headers"),
+    3: (400, "obsolete header line folding"),
+    4: (400, "conflicting Content-Length headers"),
+    5: (501, "Transfer-Encoding not supported"),
+    6: (400, "bad Content-Length"),
+}
+
+
+def parse_request_head(head: bytes) -> Tuple:
+    """Parse one request head (the bytes before CRLFCRLF, exclusive).
+
+    → ``("refuse", status, message)`` or
+      ``("ok", command, path, version, headers, need)``.
+
+    Dual implementation behind ``PIO_NATIVE``: the native core scans the
+    buffer once with the GIL dropped and hands back spans; the Python
+    path below is the oracle (and the fallback).  Both produce identical
+    results for every input, including the refusal ORDER — refusal
+    precedence is part of the wire contract (the comments in the Python
+    walk explain why each one exists)."""
+    if _ncore.http_enabled():
+        try:
+            rc, out, spans = _ncore.http_parse_head(head)
+            _ncore.note_call("http")
+            if rc:
+                status, msg = _NATIVE_REFUSALS[rc]
+                return ("refuse", status, msg)
+            command = bytes(head[out[1]:out[1] + out[2]]).decode("latin-1")
+            path = bytes(head[out[3]:out[3] + out[4]]).decode("latin-1")
+            version = bytes(head[out[5]:out[5] + out[6]]).decode("latin-1")
+            headers = _Headers()
+            for i in range(int(out[0])):
+                o = 4 * i
+                name = bytes(
+                    head[spans[o]:spans[o] + spans[o + 1]]
+                ).decode("latin-1").lower()
+                headers[name] = bytes(
+                    head[spans[o + 2]:spans[o + 2] + spans[o + 3]]
+                ).decode("latin-1")
+            need = int(out[8]) if out[7] else 0
+            return ("ok", command, path, version, headers, need)
+        except Exception:
+            _ncore.note_fallback("error")
+    return _py_parse_request_head(head)
+
+
+def _py_parse_request_head(head: bytes) -> Tuple:
+    lines = head.split(b"\r\n")
+    try:
+        command, path, version = lines[0].decode("latin-1").split(" ", 2)
+    except ValueError:
+        return ("refuse", 400, "malformed request line")
+    if len(lines) - 1 > 100:       # stdlib's header-count cap
+        return ("refuse", 400, "too many headers")
+    headers = _Headers()
+    for ln in lines[1:]:
+        if ln[:1] in (b" ", b"\t"):
+            # obs-fold continuations would otherwise parse as a
+            # fresh header after .strip() — " Content-Length: 7"
+            # overwriting the real one is a body-boundary desync
+            # (request smuggling behind a fold-forwarding proxy).
+            # RFC 9112 §5.2: reject outside message/http.
+            return ("refuse", 400, "obsolete header line folding")
+        name, _, value = ln.decode("latin-1").partition(":")
+        name = name.strip().lower()
+        value = value.strip()
+        if (name == "content-length"
+                and headers.get(name, value) != value):
+            # repeated differing Content-Length: an intermediary
+            # honoring the FIRST one would desync on our LAST-wins
+            return ("refuse", 400, "conflicting Content-Length headers")
+        headers[name] = value
+    if headers.get("transfer-encoding") is not None:
+        # we don't decode chunked bodies; silently ignoring the
+        # header would leave the chunk bytes in the stream to be
+        # parsed as the next pipelined request — a desync /
+        # request-smuggling vector behind a chunked-forwarding
+        # proxy.  RFC 9112 §6.1: respond 501 and close.  Checked
+        # BEFORE Expect handling so we never send 100 Continue
+        # inviting a body we are about to refuse.
+        return ("refuse", 501, "Transfer-Encoding not supported")
+    cl = headers.get("content-length")
+    # strict 1*DIGIT per RFC 9110 — int() alone accepts '1_0',
+    # ' 10 ', and non-ASCII digits, values an intermediary may
+    # interpret differently and desync the body boundary on
+    if cl is None:
+        need = 0
+    elif cl.isascii() and cl.isdigit():
+        need = int(cl)
+    else:
+        return ("refuse", 400, "bad Content-Length")
+    return ("ok", command, path, version, headers, need)
 
 
 def _int_env(name: str, default: int) -> int:
@@ -675,64 +788,19 @@ class EventLoopHTTPServer:
                 if len(inbuf) > 65536:
                     self._refuse(conn, 431, "header section too large")
                 return
-            lines = bytes(inbuf[:hend]).split(b"\r\n")
+            head = bytes(inbuf[:hend])
             del inbuf[:hend + 4]
-            try:
-                command, path, version = (
-                    lines[0].decode("latin-1").split(" ", 2))
-            except ValueError:
+            # head-level parse (request line, header walk, refusal
+            # precedence) lives in parse_request_head — native core or
+            # Python oracle, identical results; the connection-level
+            # decisions (413 cap, close vs keep-alive, 100-continue)
+            # stay here
+            res = parse_request_head(head)
+            if res[0] == "refuse":
                 # never advertises keep-alive: the refusal closes
-                self._refuse(conn, 400, "malformed request line")
+                self._refuse(conn, res[1], res[2])
                 return
-            if len(lines) - 1 > 100:       # stdlib's header-count cap
-                self._refuse(conn, 400, "too many headers")
-                return
-            headers = _Headers()
-            bad_header = None
-            for ln in lines[1:]:
-                if ln[:1] in (b" ", b"\t"):
-                    # obs-fold continuations would otherwise parse as a
-                    # fresh header after .strip() — " Content-Length: 7"
-                    # overwriting the real one is a body-boundary desync
-                    # (request smuggling behind a fold-forwarding proxy).
-                    # RFC 9112 §5.2: reject outside message/http.
-                    bad_header = "obsolete header line folding"
-                    break
-                name, _, value = ln.decode("latin-1").partition(":")
-                name = name.strip().lower()
-                value = value.strip()
-                if (name == "content-length"
-                        and headers.get(name, value) != value):
-                    # repeated differing Content-Length: an intermediary
-                    # honoring the FIRST one would desync on our LAST-wins
-                    bad_header = "conflicting Content-Length headers"
-                    break
-                headers[name] = value
-            if bad_header is not None:
-                self._refuse(conn, 400, bad_header)
-                return
-            if headers.get("transfer-encoding") is not None:
-                # we don't decode chunked bodies; silently ignoring the
-                # header would leave the chunk bytes in the stream to be
-                # parsed as the next pipelined request — a desync /
-                # request-smuggling vector behind a chunked-forwarding
-                # proxy.  RFC 9112 §6.1: respond 501 and close.  Checked
-                # BEFORE Expect handling so we never send 100 Continue
-                # inviting a body we are about to refuse.
-                self._refuse(
-                    conn, 501, "Transfer-Encoding not supported")
-                return
-            cl = headers.get("content-length")
-            # strict 1*DIGIT per RFC 9110 — int() alone accepts '1_0',
-            # ' 10 ', and non-ASCII digits, values an intermediary may
-            # interpret differently and desync the body boundary on
-            if cl is None:
-                need = 0
-            elif cl.isascii() and cl.isdigit():
-                need = int(cl)
-            else:
-                self._refuse(conn, 400, "bad Content-Length")
-                return
+            _, command, path, version, headers, need = res
             if need > self.max_body:
                 # refuse before buffering, not after: the old drain-based
                 # loop read oversized bodies just to discard them

@@ -14,6 +14,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from predictionio_tpu.native import core as _ncore
+
 
 def opt_str_list(d: Dict, key: str) -> Optional[List[str]]:
     """Wire contract for optional list fields: a present-but-empty list
@@ -170,6 +172,14 @@ def host_topk_desc(s: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
     k = min(int(k), n)
     if k <= 0:
         return s[:0].astype(np.float32), np.zeros(0, np.int32)
+    if (s.dtype == np.float32 and s.ndim == 1
+            and s.flags.c_contiguous and _ncore.serve_enabled()):
+        try:
+            vals, idx = _ncore.topk_f32(s, k)
+            _ncore.note_call("serve")
+            return vals, idx
+        except Exception:
+            _ncore.note_fallback("error")
     kk = topk_order_keys(s)
     if k >= n:
         order = np.argsort(kk)[::-1]
@@ -192,7 +202,23 @@ def gather_csr_rows(indptr: np.ndarray, ids,
     ``[0, len(indptr) - 1)`` and empty segments are dropped, matching
     the loop's filters.  Element order is identical to the loop's
     (segments in id order, elements in storage order), so float
-    accumulations downstream see the same addition order."""
+    accumulations downstream see the same addition order.
+
+    For the serve tail's concrete column shapes — one int32 row column,
+    optionally one float32 weight column — the gather runs in the native
+    serve core with the GIL dropped (element order identical); anything
+    else stays on the numpy path."""
+    if (_ncore.serve_enabled() and 1 <= len(cols) <= 2
+            and all(c.ndim == 1 and c.flags.c_contiguous for c in cols)
+            and cols[0].dtype == np.int32
+            and (len(cols) == 1 or cols[1].dtype == np.float32)):
+        try:
+            o0, o1 = _ncore.csr_gather(
+                indptr, ids, cols[0], cols[1] if len(cols) == 2 else None)
+            _ncore.note_call("serve")
+            return (o0,) if o1 is None else (o0, o1)
+        except Exception:
+            _ncore.note_fallback("error")
     n = len(indptr) - 1
     ids = np.asarray(ids, np.int64)
     if len(ids):

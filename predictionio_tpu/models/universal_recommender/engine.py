@@ -51,6 +51,7 @@ from predictionio_tpu.models.common import (
     gather_csr_rows,
     host_topk_desc,
 )
+from predictionio_tpu.native import core as _ncore
 from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.obs import spans as _spans
 from predictionio_tpu.obs import tracing as _tracing
@@ -61,6 +62,7 @@ from predictionio_tpu.ops.als import (
     pad_ids as als_pad_ids,
 )
 from predictionio_tpu.parallel.mesh import MeshSpec, create_mesh
+from predictionio_tpu.serve import history_cache as _history_cache
 from predictionio_tpu.serve import response_cache as _resp_cache
 from predictionio_tpu.store.columnar import CSRLookup, IdDict, fold_properties
 from predictionio_tpu.store.event_store import LEventStore, PEventStore
@@ -1363,22 +1365,22 @@ class URAlgorithm(Algorithm):
 
     def _user_history(self, model: URModel, user: str) -> Dict[str, np.ndarray]:
         """Recent item ids per event type, from the live event store
-        (reference: URAlgorithm.predict reading LEventStore)."""
+        (reference: URAlgorithm.predict reading LEventStore).
+
+        The store read goes through the append-invalidated per-worker
+        history cache (serve/history_cache): the cached value is the raw
+        target-entity-id strings — model-independent, so it survives
+        generation swaps — and the per-model ``item_dict`` mapping runs
+        per query.  ``PIO_HISTORY_CACHE=off`` reads the store every time
+        (the staleness oracle)."""
         hist: Dict[str, np.ndarray] = {}
         for name, item_dict in model.event_item_dicts.items():
-            try:
-                events = LEventStore.find_by_entity(
-                    self.params.app_name, "user", user,
-                    event_names=[name], limit=self.params.max_query_events,
-                )
-            except ValueError:
-                events = []
-            ids = [
-                item_dict.id(e.target_entity_id)
-                for e in events
-                if e.target_entity_id is not None and item_dict.id(e.target_entity_id) is not None
-            ]
-            hist[name] = np.asarray(sorted(set(ids)), np.int32)
+            raw = _history_cache.user_history_targets(
+                self.params.app_name, "user", user, name,
+                self.params.max_query_events)
+            ids = {item_dict.id(t) for t in raw}
+            ids.discard(None)
+            hist[name] = np.asarray(sorted(ids), np.int32)
         return hist
 
     def warm(self, model: URModel) -> None:
@@ -1448,6 +1450,27 @@ class URAlgorithm(Algorithm):
             per_type.append((name, cat_rows, cat_w))
         if not per_type:
             return None
+        if _ncore.serve_enabled():
+            # fully-native tail: unique + per-type compacted bincount run
+            # with the GIL dropped; bit-exact vs the numpy path below
+            # (same f64 accumulate order, f32 cast, f32 weight multiply,
+            # f32 type-order total adds)
+            try:
+                cand = _ncore.unique_i32(
+                    np.concatenate([r for _, r, _ in per_type]))
+                scratch = np.empty(len(cand), np.float64)
+                ntotal = np.empty(len(cand), np.float32)
+                first = True
+                for name, cat_rows, cat_w in per_type:
+                    weight = float(
+                        self.params.indicator_weights.get(name, 1.0))
+                    _ncore.score_accum(cand, cat_rows, cat_w, weight,
+                                       scratch, ntotal, first)
+                    first = False
+                _ncore.note_call("serve")
+                return cand, ntotal
+            except Exception:
+                _ncore.note_fallback("error")
         cand = np.unique(
             np.concatenate([r for _, r, _ in per_type])).astype(np.int32)
         total: Optional[np.ndarray] = None

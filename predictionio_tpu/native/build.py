@@ -1,0 +1,79 @@
+"""Shared lazy in-tree build for the native cores.
+
+One helper both bindings modules (``scanner``, ``core``) go through: the
+``.so`` artifact under ``native/_build`` is keyed by a SHA-256 of the
+C++ source *content* — an mtime key can silently serve a stale library
+after a checkout, a copy, or an edit that lands in the same clock
+second, and a stale data-plane core is a parity bug, not a perf bug.
+
+``scripts/build_native.sh`` calls :func:`build` eagerly; everything else
+builds lazily on first use and degrades to the pure-Python path when no
+toolchain exists (``compiler()`` is None).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import shutil
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("pio.native")
+
+BUILD_DIR = Path(__file__).parent / "_build"
+
+_CXX_CANDIDATES = ("g++", "c++", "clang++")
+
+
+def compiler() -> Optional[str]:
+    """First available C++ compiler on PATH, or None (no toolchain)."""
+    for cxx in _CXX_CANDIDATES:
+        if shutil.which(cxx):
+            return cxx
+    return None
+
+
+def source_key(src: Path) -> str:
+    """Content hash of ``src`` — the build-cache key (first 16 hex
+    chars: enough to never collide between edits of one file)."""
+    return hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+
+
+def artifact_path(src: Path, stem: str) -> Path:
+    return BUILD_DIR / f"{stem}-{source_key(src)}.so"
+
+
+def build(src: Path, stem: str, timeout: int = 300) -> Path:
+    """Compile ``src`` into its content-keyed artifact (no-op when the
+    artifact already exists).  Raises on any build failure — callers
+    that want graceful degradation wrap this (``load``)."""
+    so = artifact_path(src, stem)
+    if so.exists():
+        return so
+    cxx = compiler()
+    if cxx is None:
+        raise RuntimeError("no C++ compiler on PATH")
+    BUILD_DIR.mkdir(exist_ok=True)
+    for old in BUILD_DIR.glob(f"{stem}-*.so"):
+        old.unlink(missing_ok=True)
+    tmp = so.with_suffix(".so.tmp")
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           str(src), "-o", str(tmp)]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+    # rename-into-place: a concurrent builder (two processes racing the
+    # first use) never loads a half-written .so
+    tmp.replace(so)
+    return so
+
+
+def load(src: Path, stem: str) -> Optional[ctypes.CDLL]:
+    """Build-if-needed and dlopen; None when the toolchain is missing or
+    the build/load fails (logged once by the caller)."""
+    try:
+        return ctypes.CDLL(str(build(src, stem)))
+    except Exception as e:  # compiler missing, build error, load error
+        log.warning("native %s unavailable (%s); using Python path", stem, e)
+        return None

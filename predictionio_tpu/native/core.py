@@ -1,0 +1,483 @@
+"""``PIO_NATIVE`` knob + ctypes bindings for the GIL-releasing data-plane
+cores (``data_plane.cpp``).
+
+Two cores behind ONE knob, same kill-switch discipline as
+``PIO_FOLLOW_RELLR_PRUNE`` / ``PIO_MODEL_PLANE_DELTA``:
+
+- ``PIO_NATIVE=auto`` (default): use the native library when it builds
+  and loads; silently fall back to the pure-Python oracle otherwise.
+- ``PIO_NATIVE=on``: prefer native, and count every denied use as a
+  ``pio_native_fallback_total{reason="no_build"}`` so an operator who
+  *expected* native can see it never engaged.
+- ``PIO_NATIVE=off``: the exact-parity Python oracle, always.
+
+Every call crosses through ``ctypes.CDLL``, which releases the GIL for
+the duration of the C call — that is the point: per-shard columnar scans
+and concurrent serve-tail queries overlap on real cores instead of
+serializing on the interpreter lock.
+
+The library builds lazily on first use (content-hash-keyed artifact via
+:mod:`predictionio_tpu.native.build`); with no C++ toolchain every
+``*_enabled()`` gate answers False and callers stay on the Python path —
+tier-1 must be green either way.
+
+Observability:
+
+- ``pio_native_active``                 gauge, 1 while native is engaged
+- ``pio_native_calls_total{core}``      logical native operations served
+- ``pio_native_fallback_total{reason}`` Python-path fallbacks and why
+  (``no_build`` = wanted but not loadable, counted once per core;
+  ``error`` = native raised and the oracle answered; ``unsupported`` =
+  input shape the native core declines, e.g. an extension header)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.native import build as _build
+from predictionio_tpu.obs import metrics as obs_metrics
+
+_SRC = Path(__file__).parent / "data_plane.cpp"
+_STEM = "libdataplane"
+_ABI_VERSION = 1
+
+_M_ACTIVE = obs_metrics.get_registry().gauge(
+    "pio_native_active",
+    "1 while the native data-plane cores are loaded and engaged")
+_M_CALLS = obs_metrics.get_registry().counter(
+    "pio_native_calls_total",
+    "Logical operations served by a native core, by core (scan/serve/http)")
+_M_FALLBACK = obs_metrics.get_registry().counter(
+    "pio_native_fallback_total",
+    "Data-plane operations answered by the Python oracle instead of a "
+    "native core, by reason (no_build/error/unsupported)")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+_no_build_counted: set = set()
+_active_state: Optional[bool] = None
+
+_c_p = ctypes.c_void_p
+_c_i64 = ctypes.c_int64
+_c_i32 = ctypes.c_int32
+_c_int = ctypes.c_int
+_c_f32 = ctypes.c_float
+_c_char_p = ctypes.c_char_p
+
+
+def mode() -> str:
+    """Resolved knob value: "auto" | "on" | "off" (re-read per call, so
+    a test or an operator can flip it live)."""
+    v = os.environ.get("PIO_NATIVE", "auto").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return "off"
+    if v in ("on", "1", "true", "yes"):
+        return "on"
+    return "auto"
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare the C ABI (argtypes/restype) once at load."""
+    lib.dp_abi_version.restype = _c_i64
+    # scan: columnar header
+    lib.dp_col_parse.argtypes = [_c_char_p, _c_i64]
+    lib.dp_col_parse.restype = _c_p
+    lib.dp_col_free.argtypes = [_c_p]
+    lib.dp_col_rows.argtypes = [_c_p]
+    lib.dp_col_rows.restype = _c_i64
+    lib.dp_col_spec.argtypes = [_c_p, _c_int, _c_p]
+    lib.dp_col_spec.restype = _c_int
+    lib.dp_col_dict_n.argtypes = [_c_p, _c_int]
+    lib.dp_col_dict_n.restype = _c_i64
+    lib.dp_col_dict_bytes.argtypes = [_c_p, _c_int]
+    lib.dp_col_dict_bytes.restype = _c_i64
+    lib.dp_col_dict_copy.argtypes = [_c_p, _c_int, _c_p, _c_p]
+    lib.dp_col_nprops.argtypes = [_c_p]
+    lib.dp_col_nprops.restype = _c_i64
+    lib.dp_col_prop_key_bytes.argtypes = [_c_p, _c_i64]
+    lib.dp_col_prop_key_bytes.restype = _c_i64
+    lib.dp_col_prop_key_copy.argtypes = [_c_p, _c_i64, _c_p]
+    lib.dp_col_prop_spec.argtypes = [_c_p, _c_i64, _c_int, _c_p]
+    lib.dp_col_prop_spec.restype = _c_int
+    lib.dp_col_prop_dict_n.argtypes = [_c_p, _c_i64]
+    lib.dp_col_prop_dict_n.restype = _c_i64
+    lib.dp_col_prop_dict_bytes.argtypes = [_c_p, _c_i64]
+    lib.dp_col_prop_dict_bytes.restype = _c_i64
+    lib.dp_col_prop_dict_copy.argtypes = [_c_p, _c_i64, _c_p, _c_p]
+    lib.dp_col_meta_span.argtypes = [_c_p, _c_p]
+    # scan: dict handles + merge gathers
+    lib.dp_dict_new.restype = _c_p
+    lib.dp_dict_free.argtypes = [_c_p]
+    lib.dp_dict_len.argtypes = [_c_p]
+    lib.dp_dict_len.restype = _c_i64
+    lib.dp_dict_union.argtypes = [_c_p, _c_char_p, _c_p, _c_i64, _c_p]
+    lib.dp_dict_union.restype = _c_i64
+    lib.dp_dict_export.argtypes = [_c_p, _c_i64]
+    lib.dp_dict_export.restype = _c_i64
+    lib.dp_dict_export_blob.argtypes = [_c_p]
+    lib.dp_dict_export_blob.restype = _c_p
+    lib.dp_dict_export_offs.argtypes = [_c_p]
+    lib.dp_dict_export_offs.restype = _c_p
+    lib.dp_take_i32.argtypes = [_c_p, _c_i64, _c_p, _c_i64, _c_p, _c_int]
+    lib.dp_take_i32.restype = _c_int
+    # serve
+    lib.dp_csr_gather_size.argtypes = [_c_p, _c_i64, _c_p, _c_i64]
+    lib.dp_csr_gather_size.restype = _c_i64
+    lib.dp_csr_gather.argtypes = [_c_p, _c_i64, _c_p, _c_i64,
+                                  _c_p, _c_p, _c_p, _c_p]
+    lib.dp_csr_gather.restype = _c_i64
+    lib.dp_unique_i32.argtypes = [_c_p, _c_i64, _c_p]
+    lib.dp_unique_i32.restype = _c_i64
+    lib.dp_score_accum.argtypes = [_c_p, _c_i64, _c_p, _c_i64, _c_p,
+                                   _c_f32, _c_p, _c_p, _c_int]
+    lib.dp_topk_f32.argtypes = [_c_p, _c_i64, _c_i64, _c_p, _c_p]
+    # http
+    lib.dp_http_parse.argtypes = [_c_char_p, _c_i64, _c_i64, _c_p, _c_p]
+    lib.dp_http_parse.restype = _c_int
+    lib.dp_http_assemble.argtypes = [_c_char_p, _c_i64, _c_char_p, _c_i64,
+                                     _c_char_p, _c_i64, _c_char_p, _c_i64,
+                                     _c_p, _c_i64]
+    lib.dp_http_assemble.restype = _c_i64
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None when no
+    toolchain / build failure (callers then stay on the Python path)."""
+    global _lib, _lib_tried
+    if not _lib_tried:
+        with _lock:
+            if not _lib_tried:
+                loaded = _build.load(_SRC, _STEM)
+                if loaded is not None:
+                    try:
+                        _bind(loaded)
+                        if loaded.dp_abi_version() != _ABI_VERSION:
+                            loaded = None
+                    except Exception:
+                        loaded = None
+                _lib = loaded
+                _lib_tried = True
+    return _lib
+
+
+def reset_for_tests() -> None:
+    """Forget the loaded library so a test can simulate a missing
+    toolchain (monkeypatching ``build.load``) or force a rebuild."""
+    global _lib, _lib_tried, _active_state
+    with _lock:
+        _lib = None
+        _lib_tried = False
+        _active_state = None
+        _no_build_counted.clear()
+
+
+def _enabled(core: str) -> bool:
+    global _active_state
+    m = mode()
+    if m == "off":
+        if _active_state is not False:
+            _active_state = False
+            _M_ACTIVE.set(0.0)
+        return False
+    ok = lib() is not None
+    if not ok and core not in _no_build_counted:
+        # wanted native (auto/on) but it never loaded: one fallback mark
+        # per core per process, not one per call
+        _no_build_counted.add(core)
+        _M_FALLBACK.inc(reason="no_build")
+    if _active_state is not ok:
+        _active_state = ok
+        _M_ACTIVE.set(1.0 if ok else 0.0)
+    return ok
+
+
+def scan_enabled() -> bool:
+    return _enabled("scan")
+
+
+def serve_enabled() -> bool:
+    return _enabled("serve")
+
+
+def http_enabled() -> bool:
+    return _enabled("http")
+
+
+def note_call(core: str) -> None:
+    _M_CALLS.inc(core=core)
+
+
+def note_fallback(reason: str) -> None:
+    _M_FALLBACK.inc(reason=reason)
+
+
+def _ptr(arr: np.ndarray):
+    return _c_p(arr.ctypes.data)
+
+
+# ---------------------------------------------------------------------------
+# scan core wrappers
+# ---------------------------------------------------------------------------
+
+
+class ColumnarHeader:
+    """Parsed PIOCOL01 JSON header (native).  ``parse`` returns None when
+    the C parser declines the header (unknown extension / corrupt) — the
+    caller falls back to ``json.loads``, which either handles it or
+    raises the oracle's error."""
+
+    __slots__ = ("_h", "_lib")
+
+    def __init__(self, handle, lib_):
+        self._h = handle
+        self._lib = lib_
+
+    @classmethod
+    def parse(cls, header_bytes: bytes) -> Optional["ColumnarHeader"]:
+        L = lib()
+        if L is None:
+            return None
+        h = L.dp_col_parse(header_bytes, len(header_bytes))
+        if not h:
+            return None
+        return cls(h, L)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.dp_col_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def rows(self) -> int:
+        return int(self._lib.dp_col_rows(self._h))
+
+    def spec(self, which: int) -> Optional[Tuple[int, int]]:
+        """(n, off) of fixed column 0..5, ids blob 6, ids offs 7."""
+        out = np.empty(2, np.int64)
+        if self._lib.dp_col_spec(self._h, which, _ptr(out)) != 0:
+            return None
+        return int(out[0]), int(out[1])
+
+    def dict_blob(self, which: int) -> Tuple[bytes, np.ndarray]:
+        n = int(self._lib.dp_col_dict_n(self._h, which))
+        nb = int(self._lib.dp_col_dict_bytes(self._h, which))
+        blob = ctypes.create_string_buffer(nb if nb else 1)
+        offs = np.empty(n + 1, np.int64)
+        self._lib.dp_col_dict_copy(self._h, which, blob, _ptr(offs))
+        return blob.raw[:nb], offs
+
+    @property
+    def nprops(self) -> int:
+        return int(self._lib.dp_col_nprops(self._h))
+
+    def prop_key(self, i: int) -> str:
+        nb = int(self._lib.dp_col_prop_key_bytes(self._h, i))
+        buf = ctypes.create_string_buffer(nb if nb else 1)
+        self._lib.dp_col_prop_key_copy(self._h, i, buf)
+        return buf.raw[:nb].decode("utf-8", "surrogatepass")
+
+    def prop_spec(self, i: int, which: int) -> Optional[Tuple[int, int]]:
+        """(n, off): 0 rows, 1 kind, 2 num, 3 str_offs, 4 codes."""
+        out = np.empty(2, np.int64)
+        if self._lib.dp_col_prop_spec(self._h, i, which, _ptr(out)) != 0:
+            return None
+        return int(out[0]), int(out[1])
+
+    def prop_dict_blob(self, i: int) -> Tuple[bytes, np.ndarray]:
+        n = int(self._lib.dp_col_prop_dict_n(self._h, i))
+        nb = int(self._lib.dp_col_prop_dict_bytes(self._h, i))
+        blob = ctypes.create_string_buffer(nb if nb else 1)
+        offs = np.empty(n + 1, np.int64)
+        self._lib.dp_col_prop_dict_copy(self._h, i, blob, _ptr(offs))
+        return blob.raw[:nb], offs
+
+    def meta_span(self) -> Optional[Tuple[int, int]]:
+        out = np.empty(2, np.int64)
+        self._lib.dp_col_meta_span(self._h, _ptr(out))
+        if out[0] < 0:
+            return None
+        return int(out[0]), int(out[1])
+
+
+class DictHandle:
+    """Native string-dictionary union handle (BatchMerger's k-way merge):
+    codes assigned in first-appearance order across bulk unions — the
+    exact code-assignment order of the Python oracle."""
+
+    __slots__ = ("_h", "_lib")
+
+    def __init__(self):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = L
+        self._h = L.dp_dict_new()
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.dp_dict_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def __len__(self) -> int:
+        return int(self._lib.dp_dict_len(self._h))
+
+    def union(self, blob: bytes, offs: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Bulk-union n strings; → (int32 code map [n], n_new)."""
+        n = len(offs) - 1
+        out = np.empty(n, np.int32)
+        offs = np.ascontiguousarray(offs, np.int64)
+        nnew = self._lib.dp_dict_union(self._h, blob, _ptr(offs), n, _ptr(out))
+        return out, int(nnew)
+
+    def export(self, start: int) -> Tuple[bytes, np.ndarray]:
+        """Strings [start, len) as (utf-8 blob, int64 offsets)."""
+        nb = int(self._lib.dp_dict_export(self._h, start))
+        if nb < 0:
+            raise ValueError("bad export range")
+        n = len(self) - start
+        blob = ctypes.string_at(self._lib.dp_dict_export_blob(self._h), nb)
+        offs = np.ctypeslib.as_array(
+            ctypes.cast(self._lib.dp_dict_export_offs(self._h),
+                        ctypes.POINTER(ctypes.c_int64)), shape=(n + 1,)).copy()
+        return blob, offs
+
+
+def take_i32(cmap: np.ndarray, codes: np.ndarray, out: np.ndarray,
+             sentinel: bool) -> bool:
+    """``out[i] = cmap[codes[i]]`` with the GIL dropped; with sentinel,
+    negative codes pass through as -1 (the merged target_ids contract).
+    False on an out-of-range code — caller re-runs the numpy oracle,
+    which raises the identical IndexError."""
+    L = lib()
+    cmap = np.ascontiguousarray(cmap, np.int32)
+    codes = np.ascontiguousarray(codes, np.int32)
+    rc = L.dp_take_i32(_ptr(cmap), len(cmap), _ptr(codes), len(codes),
+                       _ptr(out), 1 if sentinel else 0)
+    return rc == 0
+
+
+# ---------------------------------------------------------------------------
+# serve core wrappers
+# ---------------------------------------------------------------------------
+
+
+def csr_gather(indptr: np.ndarray, ids: np.ndarray, rows: np.ndarray,
+               w: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Native twin of ``models.common.gather_csr_rows`` for the serve
+    tail's (int32 rows[, float32 weights]) column shapes — identical
+    element order, GIL dropped for both passes."""
+    L = lib()
+    indptr = np.ascontiguousarray(indptr, np.int64)
+    ids = np.ascontiguousarray(ids, np.int64)
+    rows = np.ascontiguousarray(rows, np.int32)
+    n_rows = len(indptr) - 1
+    total = int(L.dp_csr_gather_size(_ptr(indptr), n_rows, _ptr(ids), len(ids)))
+    o0 = np.empty(total, np.int32)
+    o1 = None
+    w_ptr = o1_ptr = None
+    if w is not None:
+        w = np.ascontiguousarray(w, np.float32)
+        o1 = np.empty(total, np.float32)
+        w_ptr, o1_ptr = _ptr(w), _ptr(o1)
+    if total:
+        L.dp_csr_gather(_ptr(indptr), n_rows, _ptr(ids), len(ids),
+                        _ptr(rows), w_ptr, _ptr(o0), o1_ptr)
+    return o0, o1
+
+
+def unique_i32(values: np.ndarray) -> np.ndarray:
+    """Ascending unique int32 (``np.unique`` parity), GIL dropped."""
+    L = lib()
+    values = np.ascontiguousarray(values, np.int32)
+    out = np.empty(len(values), np.int32)
+    n = int(L.dp_unique_i32(_ptr(values), len(values), _ptr(out)))
+    return out[:n].copy()
+
+
+def score_accum(cand: np.ndarray, rows: np.ndarray, w: Optional[np.ndarray],
+                weight: float, scratch: np.ndarray, out: np.ndarray,
+                first: bool) -> None:
+    """One event type's serve-tail score accumulation over the compacted
+    candidate space — bit-exact vs searchsorted + float64 bincount +
+    f32 cast + f32 weight multiply + f32 total add (see data_plane.cpp)."""
+    L = lib()
+    rows = np.ascontiguousarray(rows, np.int32)
+    w_ptr = None
+    if w is not None:
+        w = np.ascontiguousarray(w, np.float32)
+        w_ptr = _ptr(w)
+    L.dp_score_accum(_ptr(cand), len(cand), _ptr(rows), len(rows), w_ptr,
+                     _c_f32(weight), _ptr(scratch), _ptr(out),
+                     1 if first else 0)
+
+
+def topk_f32(s: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """``host_topk_desc`` for a contiguous float32 vector (same composite
+    key, same total order incl. -0.0 and boundary ties), GIL dropped."""
+    L = lib()
+    k = min(int(k), len(s))
+    vals = np.empty(k, np.float32)
+    idx = np.empty(k, np.int32)
+    if k:
+        L.dp_topk_f32(_ptr(s), len(s), k, _ptr(vals), _ptr(idx))
+    return vals, idx
+
+
+# ---------------------------------------------------------------------------
+# http core wrappers
+# ---------------------------------------------------------------------------
+
+_HTTP_MAX_HEADERS = 100
+
+
+def http_parse_head(head: bytes) -> Tuple[int, np.ndarray, np.ndarray]:
+    """Parse one request head (bytes before the CRLFCRLF) natively.
+
+    → (rc, out int64[9], spans int32[4 per header]); rc numbers the
+    oracle's refusals in its exact first-error-wins order (see
+    data_plane.cpp); rc 0 is a parsed request."""
+    L = lib()
+    out = np.empty(9, np.int64)
+    # worst case one header per 3 bytes ("a:\r\n" is 4); +2 slots for the
+    # request line edge and the trailing-empty-line edge
+    max_spans = (len(head) // 3 + 2) * 4
+    spans = np.empty(max(max_spans, 8), np.int32)
+    rc = L.dp_http_parse(head, len(head), _HTTP_MAX_HEADERS,
+                         _ptr(out), _ptr(spans))
+    return int(rc), out, spans
+
+
+def http_assemble(prefix: bytes, request_id: Optional[bytes], tail: bytes,
+                  body: bytes) -> Optional[bytearray]:
+    """Native response assembly: prefix + optional X-Request-ID line +
+    Content-Length line + tail + body, one pre-sized buffer, GIL
+    dropped.  Value-equal to the oracle's ``bytes`` join (a bytearray
+    compares and sends identically)."""
+    L = lib()
+    rid = request_id or b""
+    cap = len(prefix) + len(rid) + len(tail) + len(body) + 64
+    buf = bytearray(cap)
+    cbuf = (ctypes.c_char * cap).from_buffer(buf)
+    n = L.dp_http_assemble(prefix, len(prefix), rid, len(rid),
+                           tail, len(tail), body, len(body),
+                           ctypes.addressof(cbuf), cap)
+    del cbuf
+    if n < 0:
+        return None
+    del buf[n:]
+    return buf

@@ -1,9 +1,11 @@
 """ctypes bindings for the native event-log scanner.
 
-Builds ``libeventscan.so`` from eventlog_scanner.cpp on first use (g++ -O3,
-cached next to the source keyed by source mtime) and exposes
-``scan_segments(paths) -> EventBatch``.  Falls back gracefully: callers check
-``native_available()`` and use the pure-Python path otherwise.
+Builds ``libeventscan.so`` from eventlog_scanner.cpp on first use via
+:mod:`predictionio_tpu.native.build` (artifact keyed by a SHA-256 of the
+source *content* — an mtime key could silently serve a stale ``.so``)
+and exposes ``scan_segments(paths) -> EventBatch``.  Falls back
+gracefully: callers check ``native_available()`` and use the pure-Python
+path otherwise.
 """
 
 from __future__ import annotations
@@ -11,17 +13,17 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from pathlib import Path
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from predictionio_tpu.native import build as _native_build
+
 log = logging.getLogger("pio.native")
 
 _SRC = Path(__file__).parent / "eventlog_scanner.cpp"
-_BUILD_DIR = Path(__file__).parent / "_build"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
@@ -34,18 +36,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             return _lib
         if _load_failed:
             return None
-        so = _BUILD_DIR / f"libeventscan-{int(_SRC.stat().st_mtime)}.so"
         try:
-            if not so.exists():
-                _BUILD_DIR.mkdir(exist_ok=True)
-                for old in _BUILD_DIR.glob("libeventscan-*.so"):
-                    old.unlink(missing_ok=True)
-                cmd = [
-                    "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                    str(_SRC), "-o", str(so),
-                ]
-                subprocess.run(cmd, check=True, capture_output=True, timeout=300)
-            lib = ctypes.CDLL(str(so))
+            lib = ctypes.CDLL(str(_native_build.build(_SRC, "libeventscan")))
             lib.scan_new.restype = ctypes.c_void_p
             lib.scan_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.scan_run.argtypes = [ctypes.c_void_p, ctypes.c_int]

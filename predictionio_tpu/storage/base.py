@@ -107,6 +107,36 @@ class EvaluationInstance:
 # ---------------------------------------------------------------------------
 
 
+# -- append listeners ---------------------------------------------------------
+# In-process subscribers to event-log mutations (the serve lane's user-
+# history cache invalidates through this).  A listener is called with a
+# list of (entity_type, entity_id) pairs just appended, or None when the
+# mutation's entities are unknown / everything may have changed (event
+# delete, channel remove, TTL trim).  Listener exceptions never fail a
+# write.  Scope is per-process, matching the caches that subscribe.
+_APPEND_LISTENERS: List[Any] = []
+
+
+def add_append_listener(fn) -> None:
+    """Subscribe ``fn(entities: Optional[List[tuple]])`` to event-log
+    mutations in this process (idempotent per function)."""
+    if fn not in _APPEND_LISTENERS:
+        _APPEND_LISTENERS.append(fn)
+
+
+def notify_append(entities: Optional[List[tuple]]) -> None:
+    """Called by event backends after a durable mutation; ``entities``
+    is the appended (entity_type, entity_id) pairs, or None when
+    unknown."""
+    for fn in list(_APPEND_LISTENERS):
+        try:
+            fn(entities)
+        except Exception:
+            import logging
+            logging.getLogger("pio.storage").exception(
+                "append listener failed")
+
+
 class Apps(abc.ABC):
     @abc.abstractmethod
     def insert(self, app: App) -> Optional[int]: ...

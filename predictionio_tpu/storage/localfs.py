@@ -46,6 +46,10 @@ DEFAULT_CHANNEL = "_default"
 
 log = logging.getLogger("pio.storage")
 
+# event-mutation listener bus lives in storage.base (every backend
+# notifies it); alias kept local for the call sites below
+_notify_append = base.notify_append
+
 # -- write-path instruments (obs tentpole).  All recorded at group-commit
 # granularity (one observation per physical write/fsync, not per event),
 # so the hot ingest loop pays a few dict updates per THOUSANDS of events.
@@ -809,6 +813,7 @@ class FSEvents(base.LEvents, base.PEvents):
                 w.close()
         if d.exists():
             shutil.rmtree(d)
+            _notify_append(None)   # channel data gone: invalidate everything
             return True
         return False
 
@@ -832,6 +837,7 @@ class FSEvents(base.LEvents, base.PEvents):
     ) -> List[str]:
         self._append_lines("".join(e.to_json_line() + "\n" for e in events),
                            app_id, channel_id)
+        _notify_append([(e.entity_type, e.entity_id) for e in events])
         return [e.event_id for e in events]
 
     def insert_json_batch(
@@ -844,6 +850,7 @@ class FSEvents(base.LEvents, base.PEvents):
         explicit eventTime/creationTime share the batch's commit instant."""
         results: List[dict] = []
         lines: List[str] = []
+        ents: List[tuple] = []
         now_iso = _dt.datetime.now(_dt.timezone.utc).isoformat()
         for item in items:
             try:
@@ -851,11 +858,13 @@ class FSEvents(base.LEvents, base.PEvents):
                 lines.append(json.dumps(d, separators=(",", ":"),
                                         sort_keys=True))
                 results.append({"status": 201, "eventId": d["eventId"]})
+                ents.append((str(d["entityType"]), str(d["entityId"])))
             except (ValueError, KeyError, TypeError) as e:
                 results.append({"status": 400, "message": str(e)})
         if lines:
             self._append_lines("".join(ln + "\n" for ln in lines),
                                app_id, channel_id)
+            _notify_append(ents)
         return results
 
     def _append_lines(self, lines: str, app_id: int,
@@ -1290,6 +1299,7 @@ class FSEvents(base.LEvents, base.PEvents):
                 return False
             with open(self._tombstone_path(d), "a") as f:
                 f.write(event_id + "\n")
+        _notify_append(None)   # entity unknown here: invalidate everything
         return True
 
     def find(

@@ -183,11 +183,22 @@ def get_storage(refresh: bool = False) -> Storage:
     with _default_lock:
         if _default is None or refresh:
             _default = Storage()
-        return _default
+            changed = True
+        else:
+            changed = False
+        result = _default
+    if changed:
+        base.notify_append(None)   # new default: cached reads are stale
+    return result
 
 
 def set_storage(storage: Optional[Storage]) -> None:
-    """Override the process-default storage (used by tests and servers)."""
+    """Override the process-default storage (used by tests and servers).
+
+    Cached reads keyed by app/entity names (the serve lane's history
+    cache) describe the OLD storage once the default moves — flush them
+    through the mutation bus."""
     global _default
     with _default_lock:
         _default = storage
+    base.notify_append(None)

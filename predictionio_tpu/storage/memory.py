@@ -208,7 +208,10 @@ class MemEvents(base.LEvents, base.PEvents):
         with self._lock:
             key = (app_id, channel_id)
             self._gens[key] = self._gens.get(key, 0) + 1
-            return self._events.pop(key, None) is not None
+            removed = self._events.pop(key, None) is not None
+        if removed:
+            base.notify_append(None)   # bucket gone: invalidate everything
+        return removed
 
     def compact(self, app_id: int, channel_id: Optional[int] = None,
                 before=None) -> Dict[str, int]:
@@ -227,7 +230,10 @@ class MemEvents(base.LEvents, base.PEvents):
             if doomed:
                 gkey = (app_id, channel_id)
                 self._gens[gkey] = self._gens.get(gkey, 0) + 1
-            return {"kept": len(bucket), "expired": len(doomed), "segments": 0}
+            out = {"kept": len(bucket), "expired": len(doomed), "segments": 0}
+        if doomed:
+            base.notify_append(None)   # TTL trim: invalidate everything
+        return out
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         bucket = self._bucket(app_id, channel_id)
@@ -240,6 +246,7 @@ class MemEvents(base.LEvents, base.PEvents):
                 key = (app_id, channel_id)
                 self._gens[key] = self._gens.get(key, 0) + 1
             bucket[event.event_id] = event
+        base.notify_append([(event.entity_type, event.entity_id)])
         return event.event_id
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
@@ -255,7 +262,9 @@ class MemEvents(base.LEvents, base.PEvents):
                 # generation so holders restage instead of double-reading
                 key = (app_id, channel_id)
                 self._gens[key] = self._gens.get(key, 0) + 1
-            return ok
+        if ok:
+            base.notify_append(None)   # entity unknown: invalidate all
+        return ok
 
     # -- delta-tail protocol (count watermark + generation fingerprint) ------
 

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from predictionio_tpu.events.event import Event
+from predictionio_tpu.native import core as _ncore
 
 
 class IdDict:
@@ -23,54 +24,132 @@ class IdDict:
 
     Used to map external entity ids ("u123", item SKUs, event verbs) to dense
     int32 codes suitable for device-side gathers/segment ops.
+
+    Lazily materializable: the native scan path builds instances straight
+    from a utf-8 blob + int64 offsets (:meth:`from_blob`) or grows them by
+    appending merged-dictionary export blobs — WITHOUT decoding.  A
+    cross-shard merge that only re-codes integer columns never pays the
+    per-string decode or the reverse-index dictcomp at all; the first
+    accessor that needs Python strings (or the string→id index) pays it
+    once.  ``from_state`` is lazy on the index side for the same reason:
+    snapshot loads stop paying an eager dictcomp per dictionary.
     """
 
-    __slots__ = ("_to_id", "_to_str")
+    __slots__ = ("_to_id", "_to_str", "_pending")
 
     def __init__(self, items: Optional[Sequence[str]] = None):
-        self._to_id: Dict[str, int] = {}
+        self._to_id: Optional[Dict[str, int]] = {}
         self._to_str: List[str] = []
+        self._pending: Optional[List[Tuple[bytes, np.ndarray]]] = None
         if items:
             for s in items:
                 self.add(s)
 
+    # -- lazy plumbing --------------------------------------------------------
+
+    @classmethod
+    def from_blob(cls, blob: bytes, offs: np.ndarray) -> "IdDict":
+        """Dictionary over ``n`` utf-8 strings packed as ``blob`` +
+        ``n+1`` offsets; nothing is decoded until an accessor needs it."""
+        d = cls.__new__(cls)
+        d._to_str = []
+        d._to_id = None
+        d._pending = [(blob, offs)] if len(offs) > 1 else None
+        if d._pending is None:
+            d._to_id = {}
+        return d
+
+    def _append_pending(self, blob: bytes, offs: np.ndarray) -> None:
+        """Append already-deduplicated strings (codes continue from the
+        current length) as an undecoded blob; the reverse index goes
+        stale until the next materialization."""
+        if len(offs) <= 1:
+            return
+        if self._pending is None:
+            self._pending = []
+        self._pending.append((blob, offs))
+        self._to_id = None
+
+    def _strings(self) -> List[str]:
+        """The live ``_to_str`` list with any pending blobs decoded in."""
+        if self._pending is not None:
+            to_str = self._to_str
+            for blob, offs in self._pending:
+                text = blob.decode("utf-8", "surrogatepass")
+                o = offs.tolist() if hasattr(offs, "tolist") else list(offs)
+                if len(text) == len(blob):
+                    # pure ASCII: byte offsets ARE char offsets — slice the
+                    # single decoded str instead of per-piece decodes
+                    to_str.extend(text[o[j]:o[j + 1]]
+                                  for j in range(len(o) - 1))
+                else:
+                    to_str.extend(
+                        blob[o[j]:o[j + 1]].decode("utf-8", "surrogatepass")
+                        for j in range(len(o) - 1))
+            self._pending = None
+        return self._to_str
+
+    def _index(self) -> Dict[str, int]:
+        if self._to_id is None:
+            self._to_id = {s: i for i, s in enumerate(self._strings())}
+        return self._to_id
+
+    # -- public API (unchanged semantics) ------------------------------------
+
     def add(self, s: str) -> int:
-        i = self._to_id.get(s)
+        to_id = self._to_id
+        if to_id is None:
+            to_id = self._index()
+        i = to_id.get(s)
         if i is None:
             i = len(self._to_str)
-            self._to_id[s] = i
+            to_id[s] = i
             self._to_str.append(s)
         return i
 
     def id(self, s: str) -> Optional[int]:
-        return self._to_id.get(s)
+        to_id = self._to_id
+        if to_id is None:
+            to_id = self._index()
+        return to_id.get(s)
 
     def str(self, i: int) -> str:
+        if self._pending is not None:
+            self._strings()
         return self._to_str[i]
 
     def __len__(self) -> int:
-        return len(self._to_str)
+        n = len(self._to_str)
+        if self._pending is not None:
+            for _blob, offs in self._pending:
+                n += len(offs) - 1
+        return n
 
     def __contains__(self, s: str) -> bool:
-        return s in self._to_id
+        to_id = self._to_id
+        if to_id is None:
+            to_id = self._index()
+        return s in to_id
 
     def strings(self) -> List[str]:
-        return list(self._to_str)
+        return list(self._strings())
 
     def clone(self) -> "IdDict":
         """O(n) C-level copy (dict/list copy constructors) — the
         copy-on-write step when a dictionary is shared with an emitted
         model: ~10× cheaper than re-adding every string through
-        ``__init__`` at million-entry sizes."""
-        out = IdDict()
-        out._to_id = dict(self._to_id)
+        ``__init__`` at million-entry sizes.  Pending blobs are shared
+        (immutable), not decoded."""
+        out = IdDict.__new__(IdDict)
+        out._to_id = dict(self._to_id) if self._to_id is not None else None
         out._to_str = list(self._to_str)
+        out._pending = list(self._pending) if self._pending is not None else None
         return out
 
     def encode(self, values: Sequence[str]) -> np.ndarray:
         # hot loop: one list-comp over a local-aliased dict .get — hits
         # never touch a method frame, only misses pay the add() call
-        get = self._to_id.get
+        get = self._index().get
         add = self.add
         codes = [c if (c := get(v)) is not None else add(v) for v in values]
         return np.fromiter(codes, dtype=np.int32, count=len(codes))
@@ -78,19 +157,53 @@ class IdDict:
     def lookup_many(self, values: Sequence[str]) -> np.ndarray:
         """ids for known strings, -1 for unknown — one list-comp over a
         local-aliased ``.get`` + one fromiter, for bulk translation."""
-        get = self._to_id.get
+        get = self._index().get
         return np.fromiter([get(v, -1) for v in values], dtype=np.int32,
                            count=len(values))
 
     def to_state(self) -> List[str]:
-        return self._to_str
+        return self._strings()
 
     @classmethod
     def from_state(cls, strings: Sequence[str]) -> "IdDict":
-        d = cls()
+        d = cls.__new__(cls)
         d._to_str = list(strings)
-        d._to_id = {s: i for i, s in enumerate(d._to_str)}
+        d._to_id = None
+        d._pending = None
         return d
+
+    # __slots__ + lazy state need an explicit pickle protocol: the state
+    # is just the string list (always wrapped in a tuple — an empty list
+    # would read as falsy and skip __setstate__)
+    def __getstate__(self):
+        return (list(self._strings()),)
+
+    def __setstate__(self, state) -> None:
+        self._to_str = list(state[0])
+        self._to_id = None
+        self._pending = None
+
+
+def _export_dict_blob(d: IdDict) -> Tuple[bytes, np.ndarray]:
+    """``(utf-8 blob, int64 offsets)`` for every string of ``d``.
+
+    A blob-backed dictionary (native columnar read, never mutated)
+    hands back its blob with zero work — the common case in a native
+    cross-shard merge.  Otherwise encode once; for ASCII content the
+    char lengths double as byte lengths."""
+    if not d._to_str and d._pending is not None and len(d._pending) == 1:
+        return d._pending[0]
+    strs = d._strings()
+    joined = "".join(strs)
+    blob = joined.encode("utf-8", "surrogatepass")
+    if len(blob) == len(joined):
+        lens = [len(s) for s in strs]
+    else:
+        lens = [len(s.encode("utf-8", "surrogatepass")) for s in strs]
+    offs = np.zeros(len(strs) + 1, np.int64)
+    if strs:
+        np.cumsum(lens, out=offs[1:])
+    return blob, offs
 
 
 class CSRLookup:
@@ -550,19 +663,41 @@ class BatchMerger:
         self._props_ok = True
         self._ids_ok = True
         self._rows = 0
+        # native dictionary-union handles (PIO_NATIVE): only for fresh
+        # targets — seeding a handle from a large pre-populated base dict
+        # would cost O(base) per tail merge, exactly what base= avoids
+        self._native = base is None and _ncore.scan_enabled()
+        self._handles: Dict[int, object] = {}
+        self._handle_keep: List[IdDict] = []
 
-    @staticmethod
-    def _code_map(target: IdDict, part_dict: IdDict) -> Optional[np.ndarray]:
+    def _code_map(self, target: IdDict,
+                  part_dict: IdDict) -> Optional[np.ndarray]:
         """Merge ``part_dict`` into ``target``; None = identity (the
         part's codes are already valid in the target).  The first part
         into an empty target bulk-installs its strings (a dictcomp, ~3×
-        a per-string add loop) and needs no gather at all."""
+        a per-string add loop) and needs no gather at all.
+
+        Native path (PIO_NATIVE): the union runs in C with the GIL
+        dropped, operating on utf-8 blobs; the target accumulates the
+        new strings as UNDECODED pending blobs (in handle order == code
+        order), so a merge whose consumer never reads the strings skips
+        the decode entirely.  Code assignment order is identical to the
+        Python path, and a mid-merge native failure falls back cleanly:
+        materializing the pending blobs reconstructs exactly the state
+        the Python path needs."""
         if part_dict is target:
             return None
+        if self._native:
+            try:
+                return self._code_map_native(target, part_dict)
+            except Exception:
+                _ncore.note_fallback("error")
+                self._native = False
         if not len(target):
             strings = part_dict.strings()
             target._to_str = strings
             target._to_id = {s: i for i, s in enumerate(strings)}
+            target._pending = None
             return None
         n = len(part_dict)
         if not n:
@@ -571,7 +706,7 @@ class BatchMerger:
         # cross-shard case (disjoint entity vocabularies): filter misses,
         # bulk-install them, then map every string through one lookup
         strings = part_dict.strings()
-        to_id = target._to_id
+        to_id = target._index()
         miss = [s for s in strings if s not in to_id]
         if miss:
             start = len(target._to_str)
@@ -579,6 +714,28 @@ class BatchMerger:
             target._to_str.extend(miss)
         return np.fromiter(map(to_id.__getitem__, strings), np.int32,
                            count=n)
+
+    def _code_map_native(self, target: IdDict,
+                         part_dict: IdDict) -> Optional[np.ndarray]:
+        h = self._handles.get(id(target))
+        if h is None:
+            h = _ncore.DictHandle()
+            if len(target):      # defensive: fresh targets start empty
+                blob, offs = _export_dict_blob(target)
+                h.union(blob, offs)
+            self._handles[id(target)] = h
+            self._handle_keep.append(target)   # pin: id() stays unique
+        was_empty = len(h) == 0
+        blob, offs = _export_dict_blob(part_dict)
+        cmap, n_new = h.union(blob, offs)
+        if was_empty:
+            # bulk-install: the part's codes are already the target's
+            target._append_pending(blob, offs)
+            return None
+        if n_new:
+            new_blob, new_offs = h.export(len(h) - n_new)
+            target._append_pending(new_blob, new_offs)
+        return cmap
 
     def add(self, batch: EventBatch,
             ids: Optional["EventIdColumn"] = None) -> None:
@@ -623,6 +780,7 @@ class BatchMerger:
             str_offs[0] = 0
             codes = np.empty(total, np.int32)
             ep = cp = 0
+            native = _ncore.scan_enabled()
             for row_off, col, cmap in entries:
                 m, k = len(col), len(col.codes)
                 np.add(col.rows, row_off, out=rows[ep:ep + m])
@@ -633,7 +791,8 @@ class BatchMerger:
                 if k:
                     if cmap is None:
                         codes[cp:cp + k] = col.codes
-                    else:
+                    elif not (native and _ncore.take_i32(
+                            cmap, col.codes, codes[cp:cp + k], False)):
                         np.take(cmap, np.asarray(col.codes),
                                 out=codes[cp:cp + k])
                 ep += m
@@ -668,6 +827,9 @@ class BatchMerger:
         ts = np.empty(n, np.int64)
         rt = np.empty(n, np.float32)
         at = 0
+        native = _ncore.scan_enabled()
+        if native:
+            _ncore.note_call("scan")
         for b, _ids, ev_map, et_map, ei_map, ti_map in self._parts:
             m = len(b)
             if m:
@@ -678,13 +840,15 @@ class BatchMerger:
                 ):
                     if cmap is None:
                         out_col[at:at + m] = codes
-                    else:
+                    elif not (native and _ncore.take_i32(
+                            cmap, codes, out_col[at:at + m], False)):
                         np.take(cmap, np.asarray(codes),
                                 out=out_col[at:at + m])
                 sl = ti[at:at + m]
                 if ti_map is None:
                     sl[:] = b.target_ids
-                else:
+                elif not (native and _ncore.take_i32(
+                        ti_map, b.target_ids, sl, True)):
                     # -1 sentinel rides the gather: code -1 hits the
                     # appended last slot, which holds -1
                     ti_ext = np.append(ti_map, np.int32(-1))
@@ -821,8 +985,29 @@ def read_batch(path, mmap: bool = True
     hlen = int.from_bytes(bytes(mm[8:16]), "little")
     if 16 + hlen > mm.shape[0]:
         raise ValueError(f"{path}: truncated header")
+    hdr_bytes = bytes(mm[16:16 + hlen])
+    if _ncore.scan_enabled():
+        # native header parse: the JSON decode (including every
+        # dictionary string unescape) runs in C with the GIL dropped,
+        # and the dictionaries come back as undecoded blobs — per-shard
+        # reads in the scan fan-out overlap for real.  A declined header
+        # (unknown extension / corrupt) falls through to json.loads,
+        # which either handles it or raises the oracle's ValueError.
+        nh = _ncore.ColumnarHeader.parse(hdr_bytes)
+        if nh is not None:
+            try:
+                out = _read_batch_native(path, mm, nh, hdr_bytes, 16 + hlen,
+                                         mmap)
+                _ncore.note_call("scan")
+                return out
+            except ValueError:
+                raise               # oracle-shape errors (truncation etc.)
+            except Exception:
+                _ncore.note_fallback("error")
+        else:
+            _ncore.note_fallback("unsupported")
     try:
-        header = _json.loads(bytes(mm[16:16 + hlen]))
+        header = _json.loads(hdr_bytes)
     except (UnicodeDecodeError, _json.JSONDecodeError) as e:
         raise ValueError(f"{path}: corrupt header: {e}") from None
     data_base = 16 + hlen
@@ -866,6 +1051,59 @@ def read_batch(path, mmap: bool = True
         if len(ids) != len(batch):
             raise ValueError(f"{path}: id column length mismatch")
     return batch, ids, header.get("meta", {})
+
+
+_NATIVE_COL_DTYPES = ("<i4", "<i4", "<i4", "<i4", "<i8", "<f4")
+_NATIVE_PROP_DTYPES = ("<i8", "|i1", "<f8", "<i8", "<i4")
+
+
+def _read_batch_native(path, mm: np.ndarray, nh, hdr_bytes: bytes,
+                       data_base: int, want_mmap: bool):
+    """The native twin of ``read_batch``'s body: specs/dicts/meta come
+    from the C header parse (``nh``), columns are the same zero-copy
+    ``frombuffer`` views, dictionaries stay undecoded blobs.  Raises the
+    oracle's ValueErrors for truncated data / length mismatches."""
+    import json as _json
+
+    def view(spec, dtype) -> np.ndarray:
+        dt = np.dtype(dtype)
+        n, off = spec
+        a, b = data_base + off, data_base + off + n * dt.itemsize
+        if b > mm.shape[0]:
+            raise ValueError(f"{path}: truncated column data")
+        arr = mm[a:b].view(dt)
+        return arr if want_mmap else np.array(arr)
+
+    cols = [view(nh.spec(i), dt)
+            for i, dt in enumerate(_NATIVE_COL_DTYPES)]
+    props: Dict[str, PropColumn] = {}
+    for i in range(nh.nprops):
+        arrs = [view(nh.prop_spec(i, w), dt)
+                for w, dt in enumerate(_NATIVE_PROP_DTYPES)]
+        props[nh.prop_key(i)] = PropColumn(
+            rows=arrs[0], kind=arrs[1], num=arrs[2], str_offs=arrs[3],
+            codes=arrs[4], dict=IdDict.from_blob(*nh.prop_dict_blob(i)))
+    batch = EventBatch(
+        event_codes=cols[0], entity_type_codes=cols[1], entity_ids=cols[2],
+        target_ids=cols[3], times_us=cols[4], ratings=cols[5],
+        event_dict=IdDict.from_blob(*nh.dict_blob(0)),
+        entity_type_dict=IdDict.from_blob(*nh.dict_blob(1)),
+        entity_dict=IdDict.from_blob(*nh.dict_blob(2)),
+        target_dict=IdDict.from_blob(*nh.dict_blob(3)),
+        prop_columns=props,
+    )
+    if len(batch) != nh.rows:
+        raise ValueError(f"{path}: row-count mismatch")
+    ids = None
+    blob_spec = nh.spec(6)
+    if blob_spec is not None:
+        ids = EventIdColumn(view(blob_spec, "|u1"), view(nh.spec(7), "<i8"))
+        if len(ids) != len(batch):
+            raise ValueError(f"{path}: id column length mismatch")
+    span = nh.meta_span()
+    meta = (_json.loads(hdr_bytes[span[0]:span[0] + span[1]])
+            if span is not None else {})
+    return batch, ids, meta
 
 
 # -- generic named-array container (model-plane arenas) ----------------------

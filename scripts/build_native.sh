@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Eagerly build the native data-plane cores into predictionio_tpu/native/_build.
+#
+# Everything this script does also happens lazily on first use; run it at
+# image-build or deploy time so the first serve/scan request never pays the
+# compile.  Artifacts are keyed by a SHA-256 of the C++ source CONTENT
+# (native/build.py), so a rebuild after any edit is automatic and a stale
+# .so can never be served; re-running with unchanged sources is a no-op.
+#
+# Exits non-zero when no C++ toolchain is on PATH — callers that want the
+# graceful-degradation behavior (tier-1 runs without a toolchain) simply
+# don't run this script; the Python oracle serves everything.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+python - <<'PY'
+from pathlib import Path
+
+from predictionio_tpu.native import build
+
+root = Path("predictionio_tpu/native")
+targets = [
+    (root / "eventlog_scanner.cpp", "libeventscan"),
+    (root / "data_plane.cpp", "libdataplane"),
+]
+cxx = build.compiler()
+if cxx is None:
+    raise SystemExit("build_native.sh: no C++ compiler on PATH "
+                     "(g++/c++/clang++); the Python oracle will serve")
+for src, stem in targets:
+    so = build.build(src, stem)
+    print(f"built {so} ({cxx})")
+PY

@@ -53,6 +53,8 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.streaming.fold",
     "predictionio_tpu.streaming.plane",
     "predictionio_tpu.serve.response_cache",
+    "predictionio_tpu.serve.history_cache",
+    "predictionio_tpu.native.core",
     "predictionio_tpu.obs.lineage",
     "predictionio_tpu.obs.tsdb",
     "predictionio_tpu.obs.slo",
@@ -131,6 +133,15 @@ REQUIRED_METRICS = frozenset({
     "pio_lineage_records_total",
     "pio_obs_stale_siblings_total",
     "pio_slo_burn_rate",
+    # native data-plane cores + history cache (PR 18): the fallback
+    # runbook keys on the reason counter, capacity/rollout dashboards on
+    # the active gauge and per-core call counter; history-cache hit-rate
+    # and staleness views on the outcome counter and entries gauge
+    "pio_native_active",
+    "pio_native_calls_total",
+    "pio_native_fallback_total",
+    "pio_history_cache_total",
+    "pio_history_cache_entries",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

@@ -506,6 +506,149 @@ def plane_phase(engine, ep, query_cls, storage, problems) -> None:
               "post-drain responses exactly match the in-process oracle")
 
 
+def native_phase(engine, ep, query_cls, storage, problems) -> None:
+    """Native data-plane cores (ISSUE-18): the corpus replays over HTTP
+    against a live deploy running ``PIO_NATIVE=on`` — native HTTP
+    parse/assemble plus the native serve fast lane — while an embedded
+    follower swaps generations mid-stream.  Zero 5xx; after the drain
+    every response must EXACTLY match the ``PIO_NATIVE=off`` Python
+    oracle on a from-scratch retrain.  Skips (loudly, success) when no
+    C++ toolchain built the cores — the off path IS the behavior then."""
+    import http.client
+    import json as _json
+    import threading
+    import time as _time
+
+    from predictionio_tpu.api.http_util import start_server
+    from predictionio_tpu.events.event import Event
+    from predictionio_tpu.native import core as ncore
+    from predictionio_tpu.store.event_store import invalidate_staging_cache
+    from predictionio_tpu.streaming.follow import FollowTrainer
+    from predictionio_tpu.workflow.create_server import (
+        QueryServerState, make_handler,
+    )
+
+    if ncore.lib() is None:
+        print("native phase: skipped (no C++ toolchain; PIO_NATIVE=off "
+              "Python path is the behavior)")
+        return
+    saved = os.environ.get("PIO_NATIVE")
+    os.environ["PIO_NATIVE"] = "on"
+    app = storage.apps.get_by_name("parityapp")
+    state = QueryServerState(engine, ep, query_cls, "parity-engine", "1",
+                             "default", storage=storage)
+    follower = state.follower = FollowTrainer(
+        engine, ep, "parity-engine", storage=storage, interval=0.05,
+        on_publish=state.swap_models, persist=False)
+    follower.start()
+    httpd = start_server(make_handler(state), "127.0.0.1", 0,
+                         background=True)
+    port = httpd.server_address[1]
+    bodies = corpus_bodies()
+    gen_start = state.generation
+    calls0 = ncore._M_CALLS.value(core="http")
+    errors_5xx: list = []
+    replay_errors: list = []
+    stop = threading.Event()
+
+    def replay_loop():
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            while not stop.is_set():
+                for body in bodies:
+                    conn.request("POST", "/queries.json",
+                                 _json.dumps(body).encode(),
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    if r.status >= 500:
+                        errors_5xx.append((r.status, payload[:200]))
+            conn.close()
+        except Exception as e:
+            replay_errors.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=replay_loop, daemon=True)
+    try:
+        t.start()
+        for k in range(4):
+            storage.l_events.insert_batch(
+                [Event(event="purchase", entity_type="user",
+                       entity_id=f"natswapper{k}",
+                       target_entity_type="item",
+                       target_entity_id=f"e{j}") for j in (0, 1, 2)],
+                app.id)
+            _time.sleep(0.15)
+        deadline = _time.time() + 20
+        while _time.time() < deadline and (
+                state.generation <= gen_start
+                or follower.last_outcome != "idle"):
+            _time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        follower.stop()
+    swaps = state.generation - gen_start
+    if swaps < 1:
+        problems.append("native: follower never swapped a generation "
+                        f"(outcome={follower.last_outcome})")
+    if errors_5xx:
+        problems.append(
+            f"native: {len(errors_5xx)} 5xx responses with PIO_NATIVE=on "
+            f"during swaps (first: {errors_5xx[0]})")
+    if replay_errors:
+        problems.append(
+            f"native: replay connection died: {replay_errors[0]}")
+    if ncore._M_CALLS.value(core="http") <= calls0:
+        problems.append("native: pio_native_calls_total{core=http} never "
+                        "moved — the native lane was dark, the phase "
+                        "proved nothing")
+    # post-drain exactness: oracle answers computed with the native lane
+    # OFF (the Python path), then replayed over HTTP with it ON — the
+    # deployed server is in-process, so the env flip governs each side
+    invalidate_staging_cache()
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm,
+    )
+
+    all_bodies = bodies + [{"user": "natswapper0", "num": 6}]
+    os.environ["PIO_NATIVE"] = "off"
+    try:
+        ref = engine.train(ep)[0]
+        algo = URAlgorithm(ep.algorithm_params_list[0][1])
+        oracle = [canon(algo.predict(ref, query_cls.from_json(b)))
+                  for b in all_bodies]
+    finally:
+        os.environ["PIO_NATIVE"] = "on"
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    for qi, body in enumerate(all_bodies):
+        conn.request("POST", "/queries.json", _json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = r.read()
+        if r.status != 200:
+            problems.append(f"native: post-drain query #{qi} HTTP "
+                            f"{r.status}: {payload[:200]!r}")
+            continue
+        got = canon_http(_json.loads(payload))
+        if got != oracle[qi]:
+            problems.append(
+                f"native: query #{qi} with PIO_NATIVE=on differs from "
+                f"the Python oracle:\n  got:  {got}\n"
+                f"  want: {oracle[qi]}")
+    conn.close()
+    httpd.shutdown()
+    httpd.server_close()
+    if saved is None:
+        os.environ.pop("PIO_NATIVE", None)
+    else:
+        os.environ["PIO_NATIVE"] = saved
+    if not problems:
+        print(f"native phase: {swaps} mid-stream generation swaps with "
+              "PIO_NATIVE=on, zero 5xx, post-drain responses exactly "
+              "match the PIO_NATIVE=off oracle")
+
+
 def cache_phase(engine, ep, query_cls, storage, problems) -> None:
     """Provenance-invalidated response cache over the live front end:
     the corpus replays against a deployed server with the cache ON while
@@ -743,13 +886,18 @@ def main() -> int:
     # hits bit-identical to the cache-off oracle
     if not problems:
         cache_phase(engine, ep, URQuery, get_storage(), problems)
+    # native-cores phase: the live-swap drill with PIO_NATIVE=on, then
+    # post-drain exactness against the Python oracle
+    if not problems:
+        native_phase(engine, ep, URQuery, get_storage(), problems)
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
         print(f"ok: {len(queries)} queries × (6 serving paths + "
               "http serial/pipelined × candidates on/off + live "
               "hot-swap phase + model-plane phase + response-cache "
-              "phase) identical (items, scores, order)")
+              "phase + native-cores phase) identical (items, scores, "
+              "order)")
     return 1 if problems else 0
 
 

@@ -1,0 +1,639 @@
+"""Native GIL-releasing data-plane cores (PR 18 tentpole).
+
+Contracts under test, all against the ``PIO_NATIVE=off`` Python oracle:
+
+- **scan core**: columnar ``read_batch`` (header parse, dict decode,
+  props, meta, ids) and ``BatchMerger`` k-way merges are bit-exact on
+  randomized corpora with disagreeing per-part dictionaries and unicode
+  torture strings; the sharded live fan-out (multi-shard, tombstones)
+  produces identical rows/codes/ids/watermarks native vs oracle.
+- **serve core**: ``gather_csr_rows`` / ``host_topk_desc`` native
+  dispatch is bit-exact (element order, dtypes, -0.0 and boundary-tie
+  total order), the full host scorer (unique + weighted compacted
+  bincount + f32 weight multiply) matches the numpy oracle to the bit,
+  and the engine-level predict path answers identically on vs off.
+- **http core**: ``parse_request_head`` refusal ORDER and parsed
+  results match the Python walk over a randomized head corpus;
+  ``assemble_response`` is value-equal.
+- **degradation**: with the build simulated away, ``PIO_NATIVE=on``
+  answers every call from the oracle with zero behavior change and
+  bumps ``pio_native_fallback_total{reason="no_build"}``.
+- **history cache** (satellite): ``PIO_HISTORY_CACHE=off`` is the
+  always-fresh staleness oracle; the cache matches it across appends,
+  per-entity invalidation, deletes, and storage swaps.
+- **build keying** (satellite): artifacts are keyed on source CONTENT —
+  an edited source can never serve a stale ``.so``.
+"""
+
+import datetime as dt
+import itertools
+import random
+import string
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.native import build as native_build
+from predictionio_tpu.native import core as ncore
+from predictionio_tpu.store import columnar as col
+
+_HAVE_NATIVE = ncore.lib() is not None
+
+needs_native = pytest.mark.skipif(
+    not _HAVE_NATIVE, reason="no C++ toolchain; native cores not built")
+
+
+@pytest.fixture()
+def native_on(monkeypatch):
+    monkeypatch.setenv("PIO_NATIVE", "on")
+
+
+def _rand_str(rng):
+    if rng.random() < 0.2:
+        return "".join(rng.choice("héllo😀日本 ñ" + string.ascii_letters)
+                       for _ in range(rng.randint(1, 8)))
+    return "".join(rng.choice(string.ascii_lowercase)
+                   for _ in range(rng.randint(1, 10)))
+
+
+def _make_batch(n, seed):
+    from predictionio_tpu.events.event import Event
+
+    rng = random.Random(seed)
+    evs = []
+    for _ in range(n):
+        name = rng.choice(["buy", "view", "$set"])
+        tgt = (None if name == "$set" or rng.random() < 0.3
+               else f"i{rng.randint(0, 50)}")
+        props = {}
+        if rng.random() < 0.5:
+            props = {"rating": rng.random() * 5, "tag": _rand_str(rng)}
+        evs.append(Event(
+            event=name, entity_type=rng.choice(["user", "item"]),
+            entity_id=f"u{rng.randint(0, max(n // 2, 1))}",
+            target_entity_id=tgt,
+            event_time=dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc),
+            properties=props))
+    return col.EventBatch.from_events(evs)
+
+
+def _assert_batches_equal(x, y):
+    for f in ("event_codes", "entity_type_codes", "entity_ids",
+              "target_ids", "times_us"):
+        assert np.array_equal(getattr(x, f), getattr(y, f)), f
+    assert np.array_equal(np.isnan(x.ratings), np.isnan(y.ratings))
+    assert np.array_equal(x.ratings[~np.isnan(x.ratings)],
+                          y.ratings[~np.isnan(y.ratings)])
+    for d in ("event_dict", "entity_type_dict", "entity_dict",
+              "target_dict"):
+        assert getattr(x, d).strings() == getattr(y, d).strings(), d
+    px, py = x.prop_columns or {}, y.prop_columns or {}
+    assert set(px) == set(py)
+    for k in px:
+        for f in ("rows", "kind", "num", "str_offs", "codes"):
+            assert np.array_equal(getattr(px[k], f), getattr(py[k], f)), (k, f)
+        assert px[k].dict.strings() == py[k].dict.strings(), k
+
+
+# -- scan core ---------------------------------------------------------------
+
+
+@needs_native
+def test_read_batch_parity(tmp_path, monkeypatch):
+    rng = random.Random(5)
+    b = _make_batch(400, 1)
+    ids = col.EventIdColumn.from_ids(
+        [f"ev-{i}-{_rand_str(rng)}" for i in range(len(b))])
+    p = tmp_path / "batch.col"
+    col.write_batch(p, b, event_ids=ids, meta={"watermark": {"s": 12}})
+    monkeypatch.setenv("PIO_NATIVE", "off")
+    b0, i0, m0 = col.read_batch(p)
+    monkeypatch.setenv("PIO_NATIVE", "on")
+    before = ncore._M_CALLS.value(core="scan")
+    b1, i1, m1 = col.read_batch(p)
+    assert ncore._M_CALLS.value(core="scan") == before + 1
+    _assert_batches_equal(b0, b1)
+    assert i0.tolist() == i1.tolist()
+    assert m0 == m1 == {"watermark": {"s": 12}}
+
+
+@needs_native
+def test_read_batch_lone_surrogate_strings(tmp_path, monkeypatch):
+    """JSON legally carries lone surrogates (Python's own json emits
+    them); the native header parser must decode them identically."""
+    d = col.IdDict(["ok", "bad\ud800end", "café"])
+    b = _make_batch(8, 2)
+    b = col.EventBatch(
+        event_codes=b.event_codes, entity_type_codes=b.entity_type_codes,
+        entity_ids=b.entity_ids, target_ids=b.target_ids,
+        times_us=b.times_us, ratings=b.ratings,
+        event_dict=b.event_dict, entity_type_dict=b.entity_type_dict,
+        entity_dict=d, target_dict=b.target_dict,
+        prop_columns=b.prop_columns)
+    p = tmp_path / "surr.col"
+    col.write_batch(p, b)
+    monkeypatch.setenv("PIO_NATIVE", "off")
+    b0, _, _ = col.read_batch(p)
+    monkeypatch.setenv("PIO_NATIVE", "on")
+    b1, _, _ = col.read_batch(p)
+    assert (b0.entity_dict.strings() == b1.entity_dict.strings()
+            == ["ok", "bad\ud800end", "café"])
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [3, 4])
+def test_batch_merger_parity(monkeypatch, seed):
+    """K-way merges of parts with DISAGREEING dictionaries re-code
+    identically under the native bulk-union."""
+    parts = [_make_batch(120, seed * 10 + i) for i in range(4)]
+    ids = [col.EventIdColumn.from_ids([f"p{i}e{j}" for j in range(len(p))])
+           for i, p in enumerate(parts)]
+
+    def merge():
+        m = col.BatchMerger()
+        for p, i in zip(parts, ids):
+            m.add(p, i)
+        return m.finish()
+
+    monkeypatch.setenv("PIO_NATIVE", "off")
+    b0, i0 = merge()
+    monkeypatch.setenv("PIO_NATIVE", "on")
+    b1, i1 = merge()
+    _assert_batches_equal(b0, b1)
+    assert i0.tolist() == i1.tolist()
+
+
+@needs_native
+def test_sharded_fanout_parity(tmp_path, monkeypatch):
+    """The live multi-shard fan-out (tombstones, disagreeing per-shard
+    dicts) is bit-exact native vs oracle, snapshot crutch hidden."""
+    import shutil
+
+    from predictionio_tpu.storage.sharded import ShardedEvents
+
+    monkeypatch.setenv("PIO_FSYNC", "rotate")
+    rng = np.random.default_rng(12)
+    ev = ShardedEvents(tmp_path / "s", shards=3, replicas=1)
+    try:
+        items = []
+        for k in range(240):
+            d = {"event": ("buy", "view", "$set")[k % 3],
+                 "entityType": "user" if k % 3 != 2 else "item",
+                 "entityId": f"u{k % 13}" if k % 3 != 2 else f"i{k % 7}",
+                 "eventId": f"e{k}",
+                 "eventTime": (dt.datetime(2026, 1, 1,
+                                           tzinfo=dt.timezone.utc)
+                               + dt.timedelta(seconds=k)).isoformat()}
+            if k % 3 != 2:
+                d["targetEntityType"] = "item"
+                d["targetEntityId"] = f"i{k % 29}"
+            if k % 4:
+                d["properties"] = {"rating": int(rng.integers(0, 6)),
+                                   "color": f"c{rng.integers(0, 9)}"}
+            items.append(d)
+        assert all(r["status"] == 201
+                   for r in ev.insert_json_batch(items, 1))
+        for k in (3, 17, 101, 200):
+            assert ev.delete(f"e{k}", 1)
+        ev.build_snapshot(1)
+        shutil.rmtree(ev._chan_dir(1, None), ignore_errors=True)
+
+        monkeypatch.setenv("PIO_SCAN_WORKERS", "3")
+        monkeypatch.setenv("PIO_NATIVE", "on")
+        nat = ev._fanout_snapshot_scan(1)
+        monkeypatch.setenv("PIO_NATIVE", "off")
+        ora = ev._fanout_snapshot_scan(1)
+        assert nat["events"] == ora["events"] == 236
+        assert nat["watermark"] == ora["watermark"]
+        _assert_batches_equal(nat["batch"], ora["batch"])
+        assert np.array_equal(nat["ids"].blob, ora["ids"].blob)
+        assert np.array_equal(nat["ids"].offs, ora["ids"].offs)
+    finally:
+        ev.close()
+
+
+# -- serve core --------------------------------------------------------------
+
+
+@needs_native
+def test_gather_csr_rows_parity(monkeypatch):
+    from predictionio_tpu.models import common as mc
+
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        n = int(rng.integers(1, 40))
+        lens = rng.integers(0, 6, n)
+        indptr = np.concatenate(([0], np.cumsum(lens))).astype(np.int64)
+        rows = rng.integers(0, 1000, int(indptr[-1])).astype(np.int32)
+        w = rng.random(int(indptr[-1])).astype(np.float32)
+        ids = rng.integers(-3, n + 3, int(rng.integers(0, 20)))
+        monkeypatch.setenv("PIO_NATIVE", "off")
+        a2 = mc.gather_csr_rows(indptr, ids, rows, w)
+        a1 = mc.gather_csr_rows(indptr, ids, rows)
+        monkeypatch.setenv("PIO_NATIVE", "on")
+        b2 = mc.gather_csr_rows(indptr, ids, rows, w)
+        b1 = mc.gather_csr_rows(indptr, ids, rows)
+        assert all(np.array_equal(x, y) and x.dtype == y.dtype
+                   for x, y in zip(a2, b2))
+        assert np.array_equal(a1[0], b1[0]) and len(b1) == 1
+
+
+@needs_native
+def test_host_topk_parity_total_order(monkeypatch):
+    """Native top-k reproduces the composite-key total order exactly:
+    -0.0 < +0.0, boundary ties broken lower-index-first."""
+    from predictionio_tpu.models import common as mc
+
+    rng = np.random.default_rng(1)
+    for trial in range(80):
+        n = int(rng.integers(1, 200))
+        if trial % 3:
+            s = rng.choice(np.asarray(
+                [0.0, -0.0, 1.5, -2.25, np.inf, -np.inf], np.float32), n)
+        else:
+            s = rng.standard_normal(n).astype(np.float32)
+        k = int(rng.integers(0, n + 5))
+        monkeypatch.setenv("PIO_NATIVE", "off")
+        v0, i0 = mc.host_topk_desc(s, k)
+        monkeypatch.setenv("PIO_NATIVE", "on")
+        v1, i1 = mc.host_topk_desc(s, k)
+        # bit-compare (view) so -0.0 vs +0.0 can't silently pass
+        assert np.array_equal(v0.view(np.int32), v1.view(np.int32))
+        assert np.array_equal(i0, i1)
+
+
+@needs_native
+def test_score_accum_parity_weight_semantics():
+    """unique + compacted weighted bincount + f32 cast + f32 weight
+    multiply + f32 type-order adds — bit-exact vs the numpy oracle,
+    including weight != 1.0 (f32 multiply, not f64)."""
+    rng = np.random.default_rng(2)
+    for _ in range(40):
+        types = []
+        for _t in range(int(rng.integers(1, 4))):
+            m = int(rng.integers(0, 300))
+            rows = rng.integers(0, 500, m).astype(np.int32)
+            w = (rng.random(m).astype(np.float32)
+                 if rng.random() < 0.5 else None)
+            weight = float(rng.choice([1.0, 2.0, 0.25, 3.7]))
+            types.append((rows, w, weight))
+        allr = np.concatenate([r for r, _, _ in types]) if types else \
+            np.zeros(0, np.int32)
+        cand_o = np.unique(allr).astype(np.int32)
+        total_o = None
+        for rows, w, weight in types:
+            rel = np.searchsorted(cand_o, rows)
+            if w is not None:
+                sc = np.bincount(rel, weights=w,
+                                 minlength=len(cand_o)).astype(np.float32)
+            else:
+                sc = np.bincount(rel, minlength=len(cand_o)).astype(
+                    np.float32)
+            if weight != 1.0:
+                sc *= np.float32(weight)
+            total_o = sc if total_o is None else total_o + sc
+        cand_n = ncore.unique_i32(allr)
+        assert np.array_equal(cand_o, cand_n)
+        scratch = np.empty(len(cand_n), np.float64)
+        total_n = np.empty(len(cand_n), np.float32)
+        first = True
+        for rows, w, weight in types:
+            ncore.score_accum(cand_n, rows, w, weight, scratch, total_n,
+                              first)
+            first = False
+        assert np.array_equal(total_o.view(np.int32),
+                              total_n.view(np.int32))
+
+
+# -- http core ---------------------------------------------------------------
+
+
+def _head_corpus():
+    rng = random.Random(42)
+    names = [b"Content-Length", b"content-length", b"CONTENT-length",
+             b"Host", b"X-Foo", b"Transfer-Encoding", b"Connection",
+             b"Expect", b"", b"  weird  ", b"a:b"]
+    vals = [b"7", b"07", b"7 ", b" 7", b"\xbc\xbd", b"abc", b"1_0", b"",
+            b"close", b"keep-alive", b"100-continue", b"chunked",
+            b"\x85x", b"\xa0 9", b"9\xa0", b"12\x1c", b"10", b"007"]
+    lines0 = [b"GET /q HTTP/1.1", b"POST /e?k=1 HTTP/1.0", b"GET /",
+              b"G E T /x HTTP/1.1", b"GET  /x HTTP/1.1", b"",
+              b"GET /x HTTP/1.1 extra", b"\xff\xfe /p HTTP/1.1"]
+    heads = []
+    for _ in range(1500):
+        parts = [rng.choice(lines0)]
+        for _h in range(rng.randint(0, 6)):
+            style = rng.random()
+            if style < 0.1:
+                parts.append(rng.choice([b" folded", b"\tfold", b"  "]))
+            elif style < 0.2:
+                parts.append(rng.choice([b"noColonHere", b":", b"::",
+                                         b"a:"]))
+            else:
+                parts.append(rng.choice(names) + b":" + rng.choice(vals))
+        heads.append(b"\r\n".join(parts))
+    heads.append(b"GET /x HTTP/1.1" + b"\r\nH: 1" * 101)   # count cap
+    heads.append(b"GET /x HTTP/1.1" + b"\r\nH: 1" * 100)   # at the cap
+    return heads
+
+
+@needs_native
+def test_http_parse_head_parity(monkeypatch):
+    """Refusal order and parsed results are identical native vs oracle
+    over a randomized head corpus.  The one permitted divergence: a
+    Content-Length beyond ~1e18 saturates natively — both sides still
+    refuse 413 at any real max_body."""
+    from predictionio_tpu.api import http_util as hu
+
+    for head in _head_corpus():
+        ora = hu._py_parse_request_head(head)
+        monkeypatch.setenv("PIO_NATIVE", "on")
+        nat = hu.parse_request_head(head)
+        monkeypatch.setenv("PIO_NATIVE", "off")
+        off = hu.parse_request_head(head)
+        assert off == ora       # off-mode IS the oracle
+        if nat != ora:
+            assert (nat[0] == ora[0] == "ok" and nat[:5] == ora[:5]
+                    and min(nat[5], ora[5]) > (1 << 56))
+
+
+@needs_native
+def test_http_assemble_parity(monkeypatch):
+    from predictionio_tpu.api import http_util as hu
+
+    for status, body, rid, close in itertools.product(
+            (200, 400, 503), (b"", b'{"x":1}', b"z" * 5000),
+            ("", "req-123"), (False, True)):
+        monkeypatch.setenv("PIO_NATIVE", "off")
+        ora = hu.assemble_response(status, body, rid=rid, close=close)
+        monkeypatch.setenv("PIO_NATIVE", "on")
+        nat = hu.assemble_response(status, body, rid=rid, close=close)
+        assert bytes(nat) == ora
+
+
+# -- engine-level serve parity ----------------------------------------------
+
+
+@needs_native
+def test_predict_parity_native_vs_oracle(mem_storage, monkeypatch):
+    """End-to-end predict answers are identical on vs off — rules,
+    blacklist, cold user — through the full native serve lane."""
+    from predictionio_tpu.controller.engine import EngineParams
+    from predictionio_tpu.events.event import DataMap, Event
+    from predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine, URQuery)
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm, URAlgorithmParams, URDataSourceParams)
+    from predictionio_tpu.storage import App
+
+    app_id = mem_storage.apps.insert(App(0, "natserve"))
+    rng = np.random.default_rng(7)
+    events = []
+    for u in range(20):
+        for it in range(8):
+            if rng.random() < 0.6:
+                events.append(Event(
+                    event="purchase", entity_type="user",
+                    entity_id=f"u{u}", target_entity_type="item",
+                    target_entity_id=f"i{it}"))
+            if rng.random() < 0.8:
+                events.append(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{it}"))
+    for it in range(8):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{it}",
+            properties=DataMap(
+                {"category": "odd" if it % 2 else "even"})))
+    mem_storage.l_events.insert_batch(events, app_id)
+
+    ep = EngineParams(
+        data_source_params=URDataSourceParams(
+            app_name="natserve", event_names=["purchase", "view"]),
+        algorithm_params_list=[("ur", URAlgorithmParams(
+            app_name="natserve", mesh_dp=1,
+            max_correlators_per_item=8, min_llr=0.0))])
+    engine = UniversalRecommenderEngine.apply()
+    models = engine.train(ep)
+    algo = URAlgorithm(ep.algorithm_params_list[0][1])
+    model = models[0]
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+    monkeypatch.setenv("PIO_SERVE_CACHE", "off")
+    queries = [
+        URQuery.from_json({"user": "u2", "num": 6}),
+        URQuery.from_json({"user": "stranger", "num": 5}),
+        URQuery.from_json({"user": "u3", "num": 6,
+                           "fields": [{"name": "category",
+                                       "values": ["odd"], "bias": -1}]}),
+        URQuery.from_json({"user": "u4", "num": 6,
+                           "blacklistItems": ["i0", "i3"]}),
+        URQuery.from_json({"user": "u5", "num": 8,
+                           "fields": [{"name": "category",
+                                       "values": ["even"],
+                                       "bias": 2.5}]}),
+    ]
+
+    def canon(r):
+        return [(s.item, float(s.score)) for s in r.item_scores]
+
+    monkeypatch.setenv("PIO_NATIVE", "off")
+    off = [canon(algo.predict(model, q)) for q in queries]
+    monkeypatch.setenv("PIO_NATIVE", "on")
+    on = [canon(algo.predict(model, q)) for q in queries]
+    assert any(off), "fixture produced only empty results"
+    assert off == on
+
+
+# -- graceful degradation ----------------------------------------------------
+
+
+def test_no_toolchain_simulation(monkeypatch, tmp_path):
+    """With the build gone, PIO_NATIVE=on answers everything from the
+    oracle — zero behavior change — and counts the denial once per core
+    as fallback_total{reason="no_build"}."""
+    from predictionio_tpu.api import http_util as hu
+    from predictionio_tpu.models import common as mc
+
+    b = _make_batch(60, 9)
+    p = tmp_path / "x.col"
+    col.write_batch(p, b, meta={"m": 1})
+    monkeypatch.setenv("PIO_NATIVE", "off")
+    b0, _, m0 = col.read_batch(p)
+    g0 = mc.gather_csr_rows(
+        np.array([0, 2, 5], np.int64), [0, 1],
+        np.arange(5, dtype=np.int32))
+    h0 = hu.parse_request_head(b"GET /x HTTP/1.1\r\nContent-Length: 3")
+
+    monkeypatch.setattr(native_build, "load", lambda *a, **k: None)
+    ncore.reset_for_tests()
+    try:
+        monkeypatch.setenv("PIO_NATIVE", "on")
+        before = ncore._M_FALLBACK.value(reason="no_build")
+        b1, _, m1 = col.read_batch(p)
+        g1 = mc.gather_csr_rows(
+            np.array([0, 2, 5], np.int64), [0, 1],
+            np.arange(5, dtype=np.int32))
+        h1 = hu.parse_request_head(
+            b"GET /x HTTP/1.1\r\nContent-Length: 3")
+        _assert_batches_equal(b0, b1)
+        assert m0 == m1
+        assert np.array_equal(g0[0], g1[0])
+        assert h0 == h1
+        # one denial per core, not per call
+        col.read_batch(p)
+        gained = ncore._M_FALLBACK.value(reason="no_build") - before
+        assert gained == len({"scan", "serve", "http"})
+        assert ncore._M_ACTIVE.value() == 0
+    finally:
+        ncore.reset_for_tests()
+
+
+# -- build caching (satellite 2) ---------------------------------------------
+
+
+def test_build_artifacts_content_keyed(tmp_path):
+    """source_key/artifact_path change with CONTENT, not mtime — the
+    regression that let an edited .cpp serve a stale .so."""
+    src = tmp_path / "thing.cpp"
+    src.write_text("int a() { return 1; }\n")
+    k1 = native_build.source_key(src)
+    p1 = native_build.artifact_path(src, "libthing")
+    import os
+    st = src.stat()
+    src.write_text("int a() { return 2; }\n")
+    os.utime(src, (st.st_atime, st.st_mtime))   # same mtime, new content
+    k2 = native_build.source_key(src)
+    assert k1 != k2
+    assert p1 != native_build.artifact_path(src, "libthing")
+    assert p1.name.startswith("libthing-") and p1.suffix == ".so"
+
+
+@needs_native
+def test_build_replaces_stale_artifact(tmp_path, monkeypatch):
+    monkeypatch.setattr(native_build, "BUILD_DIR", tmp_path / "_build")
+    src = tmp_path / "mini.cpp"
+    src.write_text('extern "C" int mini() { return 7; }\n')
+    so1 = native_build.build(src, "libmini")
+    assert so1.exists()
+    src.write_text('extern "C" int mini() { return 8; }\n')
+    so2 = native_build.build(src, "libmini")
+    assert so2 != so1 and so2.exists()
+    assert not so1.exists()       # old content-keyed artifact cleaned
+    import ctypes
+    assert ctypes.CDLL(str(so2)).mini() == 8
+
+
+# -- history cache (satellite 1) ---------------------------------------------
+
+
+def _hev(u, i, name="buy"):
+    from predictionio_tpu.events.event import Event
+
+    return Event(event=name, entity_type="user", entity_id=u,
+                 target_entity_id=i,
+                 event_time=dt.datetime(2024, 1, 1,
+                                        tzinfo=dt.timezone.utc))
+
+
+def test_history_cache_staleness_oracle(mem_storage, monkeypatch):
+    """The cache NEVER serves a read the PIO_HISTORY_CACHE=off oracle
+    answers differently: appends invalidate per entity, deletes flush,
+    and unrelated entities keep their entries."""
+    from predictionio_tpu.serve import history_cache as hc
+    from predictionio_tpu.storage import App
+
+    app_id = mem_storage.apps.insert(App(0, "histapp"))
+    cache = hc.get_cache()
+    cache.reset_for_tests()
+
+    def oracle(u):
+        monkeypatch.setenv("PIO_HISTORY_CACHE", "off")
+        try:
+            return hc.user_history_targets("histapp", "user", u, "buy", 50)
+        finally:
+            monkeypatch.delenv("PIO_HISTORY_CACHE")
+
+    def cached(u):
+        return hc.user_history_targets("histapp", "user", u, "buy", 50)
+
+    def hits():
+        return hc._M_LOOKUP.value(outcome="hit")
+
+    mem_storage.l_events.insert_batch(
+        [_hev("u1", "i1"), _hev("u1", "i2"), _hev("u2", "i9")], app_id)
+    assert sorted(cached("u1")) == sorted(oracle("u1")) == ["i1", "i2"]
+    h0 = hits()
+    cached("u1")
+    assert hits() == h0 + 1                   # second read was a hit
+
+    # append for u1: only u1 re-reads
+    mem_storage.l_events.insert_batch([_hev("u1", "i3")], app_id)
+    assert sorted(cached("u1")) == sorted(oracle("u1"))
+    cached("u2")
+    h1 = hits()
+    mem_storage.l_events.insert_batch([_hev("u1", "i4")], app_id)
+    cached("u2")                              # u2 untouched -> still a hit
+    assert hits() == h1 + 1
+
+    # delete flushes (entity unknown); result matches the oracle
+    eid = mem_storage.l_events.insert(_hev("u1", "i5"), app_id)
+    assert "i5" in cached("u1")
+    mem_storage.l_events.delete(eid, app_id)
+    assert sorted(cached("u1")) == sorted(oracle("u1"))
+    assert "i5" not in cached("u1")
+
+    # unknown app: empty and uncacheable, both modes
+    assert hc.user_history_targets("ghost", "user", "u", "buy", 5) == ()
+
+
+def test_history_cache_user_history_engine_parity(mem_storage, monkeypatch):
+    """Engine-level ``_user_history`` is identical with the cache on vs
+    the off oracle, before and after mid-stream appends."""
+    from predictionio_tpu.serve import history_cache as hc
+    from predictionio_tpu.storage import App
+
+    class _Dict:
+        def __init__(self, ids):
+            self._m = {s: k for k, s in enumerate(ids)}
+
+        def id(self, s):
+            return self._m.get(s)
+
+    class _Model:
+        event_item_dicts = {"buy": _Dict([f"i{k}" for k in range(10)])}
+
+    class _Params:
+        app_name = "uheng"
+        max_query_events = 50
+
+    from predictionio_tpu.models.universal_recommender.engine import (
+        URAlgorithm)
+
+    app_id = mem_storage.apps.insert(App(0, "uheng"))
+    hc.get_cache().reset_for_tests()
+    algo = URAlgorithm.__new__(URAlgorithm)
+    algo.params = _Params()
+
+    mem_storage.l_events.insert_batch(
+        [_hev("u1", "i1"), _hev("u1", "i7"), _hev("u1", "zzz")], app_id)
+
+    def both(u):
+        on = URAlgorithm._user_history(algo, _Model(), u)
+        monkeypatch.setenv("PIO_HISTORY_CACHE", "off")
+        try:
+            off = URAlgorithm._user_history(algo, _Model(), u)
+        finally:
+            monkeypatch.delenv("PIO_HISTORY_CACHE")
+        assert set(on) == set(off)
+        for k in on:
+            assert np.array_equal(on[k], off[k]), k
+        return on
+
+    h = both("u1")
+    assert h["buy"].tolist() == [1, 7]        # "zzz" filtered by the dict
+    mem_storage.l_events.insert_batch([_hev("u1", "i2")], app_id)
+    h = both("u1")
+    assert h["buy"].tolist() == [1, 2, 7]
+    assert both("nobody")["buy"].tolist() == []
