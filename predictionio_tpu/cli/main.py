@@ -622,6 +622,33 @@ def _cmd_deploy(args) -> int:
     return run_server_from_args(args)
 
 
+def _cmd_plane_subscribe(args) -> int:
+    """Standalone replication subscriber daemon: blocks, mirroring the
+    publisher's plane into --plane-dir until interrupted.  Serving
+    processes on this node simply watch that directory
+    (PIO_MODEL_PLANE_DIR) — they never learn replication exists."""
+    import time as _time
+
+    from predictionio_tpu.streaming.replicate import PlaneSubscriber
+
+    try:
+        sub = PlaneSubscriber(args.plane_dir, args.source, node=args.node)
+        sub.start()
+    except (RuntimeError, ValueError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"plane-subscribe: mirroring {args.source} into "
+          f"{args.plane_dir} (node {sub.node})")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sub.stop()
+    return 0
+
+
 def _cmd_undeploy(args) -> int:
     """Stop a deployed query server (reference Console.undeploy: contacts
     the running server rather than killing a pid).
@@ -951,7 +978,34 @@ def build_parser() -> argparse.ArgumentParser:
     # plane's dedicated fold/emit process (spawned by deploy --workers
     # with --follow; publishes generations into PIO_MODEL_PLANE_DIR
     # instead of serving queries)
+    dp.add_argument("--plane-publish", default=None, metavar="[HOST:]PORT",
+                    help="also serve this node's model plane to "
+                         "replication subscribers on [HOST:]PORT — every "
+                         "published generation streams to each connected "
+                         "`deploy --plane-from` / `plane-subscribe` node")
+    dp.add_argument("--plane-from", default=None, metavar="HOST:PORT",
+                    help="be a replication SUBSCRIBER: feed the local "
+                         "plane dir (PIO_MODEL_PLANE_DIR, node-local) "
+                         "from the publisher at HOST:PORT instead of "
+                         "folding locally (conflicts with --follow)")
     dp.set_defaults(func=_cmd_deploy)
+
+    ps = sub.add_parser(
+        "plane-subscribe",
+        help="standalone model-plane replication subscriber: mirror a "
+             "remote publisher's plane into a local directory (serving "
+             "processes on this node watch that directory as usual)")
+    ps.add_argument("--from", dest="source", required=True,
+                    metavar="HOST:PORT",
+                    help="the publisher endpoint (deploy --plane-publish)")
+    ps.add_argument("--plane-dir", required=True,
+                    help="node-LOCAL plane directory to land generations "
+                         "into (the same dir serving processes use as "
+                         "PIO_MODEL_PLANE_DIR)")
+    ps.add_argument("--node", default=None,
+                    help="subscriber name reported to the publisher "
+                         "(default: hostname-pid)")
+    ps.set_defaults(func=_cmd_plane_subscribe)
 
     ud = sub.add_parser("undeploy")
     ud.add_argument("--ip", default="127.0.0.1")

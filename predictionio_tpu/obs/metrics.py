@@ -110,6 +110,15 @@ class Gauge(_Metric):
     def dec(self, amount: float = 1.0, **labels: str) -> None:
         self.inc(-amount, **labels)
 
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled series entirely (vs. set(0): the series
+        disappears from /metrics).  For per-peer gauges whose peer went
+        away — a dead replication subscriber's lag series must not
+        linger at its last value and trip lag alerts forever."""
+        key = _label_key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+
     def value(self, **labels: str) -> float:
         return float(self._series.get(_label_key(labels), 0.0))
 

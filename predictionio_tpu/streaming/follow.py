@@ -36,7 +36,7 @@ import threading
 import time
 import uuid
 from pathlib import Path
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from predictionio_tpu.obs import lineage as _lineage
 from predictionio_tpu.obs import metrics as _obs_metrics
@@ -230,6 +230,10 @@ class FollowTrainer:
         # just before on_publish so _publish_info can stamp it into the
         # manifest info that rides the model plane to every worker
         self._lineage_id: Optional[str] = None
+        # post-publish hooks (the plane replicator's poke rides here:
+        # same-process publishes propagate to subscribers without
+        # waiting out an inotify/poll period)
+        self._publish_listeners: List[Callable[[], None]] = []
         self._resolve_mode()
         self._state_path = follow_state_path(
             self.storage, engine_id, engine_variant) if persist else None
@@ -1071,6 +1075,11 @@ class FollowTrainer:
             self.generation -= 1
             raise
         self.last_publish_at = time.time()
+        for fn in list(self._publish_listeners):
+            try:
+                fn()
+            except Exception:
+                log.exception("follow: publish listener failed")
         if self.on_publish is None:
             # daemon mode owns pio_model_generation; an embedded host's
             # install path sets it from the SERVER generation (which
@@ -1130,6 +1139,13 @@ class FollowTrainer:
                     max(self.interval, self._backoff * 2 or self.interval),
                     60.0)
             self._stop.wait(self.interval + self._backoff)
+
+    def add_publish_listener(self, fn: Callable[[], None]) -> None:
+        """Call ``fn`` (no args, exception-safe) after every successful
+        publish.  The plane replicator registers its ``poke`` here so
+        same-process publishes reach the wire without waiting out a
+        directory-watch period."""
+        self._publish_listeners.append(fn)
 
     def start(self) -> threading.Thread:
         """Run the loop on a daemon thread (the embedded mode)."""

@@ -159,6 +159,13 @@ _M_CHAIN = _REG.gauge(
 
 _CURRENT = "CURRENT.json"
 _LOCK = "plane.lock"
+# manifest key stamped by a replication subscriber on every manifest it
+# lands (value: the publisher endpoint it replicates from).  Its absence
+# from a manifest in a subscriber-fed directory means a LOCAL publisher
+# wrote it — the split-brain the subscriber must refuse to fight; its
+# presence tells a local publisher the directory is replica-fed (publish
+# degrades to keyframes, never deltas against a chain it didn't write).
+REPLICA_KEY = "replicatedFrom"
 
 
 class PlaneUnsupported(RuntimeError):
@@ -259,6 +266,18 @@ def resolve_plane_dir(storage, engine_id: str,
     try:
         src = storage.config.sources[storage.config.repositories["METADATA"]]
     except (KeyError, AttributeError):
+        return None
+    if src.get("type") == "sharedfs":
+        log.warning(
+            "model plane: METADATA store is sharedfs — the plane's "
+            "mmap/flock/GC invariants hold on one node's kernel only, "
+            "so a shared mount cannot host it.  For multi-node serving "
+            "use plane REPLICATION instead: publish with `pio deploy "
+            "--plane-publish PORT` and point every other node at it "
+            "with `pio deploy --plane-from HOST:PORT` (or the "
+            "standalone `pio plane-subscribe`), each against a "
+            "node-LOCAL PIO_MODEL_PLANE_DIR.  See docs/operations.md "
+            "\"Multi-node plane replication\".")
         return None
     if src.get("type") != "localfs" or not src.get("path"):
         return None
@@ -499,9 +518,24 @@ class ModelPlane:
             cur = self.current()
             gen = int(cur["generation"]) + 1 if cur else 1
             prev = self._pub_prev
+            if cur is not None and REPLICA_KEY in cur \
+                    and not getattr(self, "_warned_replica", False):
+                # foreign-publisher detection: this directory is fed by
+                # plane replication — a local publisher racing the
+                # subscriber is split-brain.  Publish keyframes only
+                # (never a delta against a chain another node wrote) and
+                # say so loudly.
+                self._warned_replica = True
+                log.warning(
+                    "model plane: publishing into a directory fed by "
+                    "plane replication (replicatedFrom=%s) — this is "
+                    "split-brain; run either a local publisher OR "
+                    "plane-subscribe against %s, not both.  Forcing "
+                    "keyframe publishes.", cur.get(REPLICA_KEY), self.dir)
             delta = None
             if (plane_delta_enabled() and not rebuilt
                     and prev is not None and cur is not None
+                    and REPLICA_KEY not in cur
                     and int(cur["generation"]) == prev["gen"]
                     and gen - prev["keyframe_gen"] < plane_full_every()
                     and self._chain_intact(prev)):
@@ -772,9 +806,11 @@ class ModelPlane:
             os.fsync(f.fileno())
         os.replace(tmp, self.current_path)
 
-    def _file_keyframe(self, name: str) -> Optional[int]:
-        """A generation file's keyframeGeneration, reading only the JSON
-        header (no blob mapping); None when unreadable."""
+    def file_meta(self, name: str) -> Optional[Dict]:
+        """A generation file's ``meta`` dict, reading only the JSON
+        header (16-byte head + header bytes — no blob mapping); None
+        when unreadable or torn.  The replication publisher plans
+        catch-ups from these headers without ever composing a model."""
         try:
             with open(os.path.join(self.dir, name), "rb") as f:
                 head = f.read(16)
@@ -786,11 +822,45 @@ class ModelPlane:
                 meta = json.loads(f.read(hlen)).get("meta", {})
         except (OSError, ValueError):
             return None
+        return meta if isinstance(meta, dict) else None
+
+    def _file_keyframe(self, name: str) -> Optional[int]:
+        """A generation file's keyframeGeneration from its header alone;
+        None when unreadable."""
+        meta = self.file_meta(name)
+        if meta is None:
+            return None
         kf = meta.get("keyframeGeneration")
         if kf is not None:
             return int(kf)
         g = _gen_of(name)
         return g if name.endswith(".arena") else None
+
+    def chain_files(self, fname: str) -> List[str]:
+        """The ordered delta chain ``[keyframe .. fname]`` for one
+        generation file, walking ``prevFile`` header links (headers
+        only).  This is how the replicator serves a cold or lagging
+        subscriber: ship the nearest keyframe plus every delta forward.
+        Raises :class:`_PlaneCorrupt` naming the file that breaks the
+        walk (missing link, unreadable header)."""
+        chain = [str(fname)]
+        f = str(fname)
+        # a chain is bounded by plane_full_every(), but walk defensively
+        for _ in range(100000):
+            meta = self.file_meta(f)
+            if meta is None:
+                raise _PlaneCorrupt(f, f"{f}: unreadable header in "
+                                    "delta-chain walk")
+            if (meta.get("planeKind") or "full") != "delta":
+                chain.reverse()
+                return chain
+            pf = meta.get("prevFile")
+            if not pf:
+                raise _PlaneCorrupt(f, f"{f}: delta with no prevFile")
+            f = str(pf)
+            chain.append(f)
+        raise _PlaneCorrupt(str(fname), f"{fname}: delta chain does not "
+                            "terminate at a keyframe")
 
     def _gc(self, newest_gen: int) -> Optional[int]:
         """Unlink generation files no kept generation's delta chain can

@@ -1,0 +1,769 @@
+"""Multi-node model-plane replication: stream delta arenas over TCP.
+
+The plane's `gen-N.{arena,delta}` containers are already a
+self-describing wire format — magic + JSON header + 64-aligned blobs —
+and the keyframe chain is snapshot-plus-log replication by construction.
+This module adds the missing network leg:
+
+- :class:`PlaneReplicator` (publisher side) watches the local plane dir
+  (same inotify/stat-poll machinery as :class:`~.plane.PlaneWatcher`)
+  and streams every new generation file to K connected subscribers over
+  a length-prefixed channel, then a ``flip`` frame carrying the
+  manifest.
+- :class:`PlaneSubscriber` (subscriber side) lands each container
+  two-phase (tmp + hash-verify + fsync + rename) into its own
+  node-LOCAL plane dir and flips ``CURRENT.json`` under the plane's
+  flock'd publish lock — from there the existing
+  ``PlaneWatcher``/compose/install path takes over unchanged, so the
+  serving hot path never learns replication exists.
+
+Failure modes reuse what the plane already proves locally:
+
+- a cold or lagging subscriber asks for generation ``have``; when the
+  publisher's GC has moved past it, the publisher re-plans from the
+  nearest keyframe and replays the ``prevFile`` chain forward;
+- a torn transfer (hash mismatch) is quarantined on the subscriber
+  (``<file>.quarantine``, never flipped, never served) and the batch is
+  re-requested;
+- a SIGKILLed subscriber resumes from its last flipped manifest — the
+  ``have`` in its first sync frame IS the last-acked generation;
+- a dead/stuck subscriber costs the publisher one blocked ``send`` (the
+  per-subscriber queue is the socket buffer plus one chunk — bounded
+  memory by construction); the send timeout drops the session and the
+  lag gauge's series with it.
+
+Wire protocol (version ``PRP1``): every frame is
+``b"PRP1" + u32 header_len + u64 payload_len + header_json + payload``.
+Frame types: ``sync`` (subscriber → publisher: ``have`` generation +
+``reason``; doubles as the per-flip ack), ``file`` (one container +
+sha256), ``flip`` (the manifest), ``ping`` (keepalive carrying the
+publisher's current generation, so an idle subscriber still reports
+lag).
+
+Split-brain guards: every manifest a subscriber lands carries
+``replicatedFrom`` (:data:`~.plane.REPLICA_KEY`); the subscriber
+refuses a plane dir whose manifest lacks it (a LOCAL publisher owns
+that dir), and a local publisher that finds it degrades to
+keyframe-only publishes (see ``ModelPlane.publish``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.obs import metrics as _obs_metrics
+from predictionio_tpu.streaming.plane import (
+    REPLICA_KEY,
+    ModelPlane,
+    _DirNotify,
+    _gen_of,
+    _PlaneCorrupt,
+    plane_notify_enabled,
+    plane_poll_s,
+)
+
+log = logging.getLogger("pio.planerepl")
+
+_REG = _obs_metrics.get_registry()
+_M_RBYTES = _REG.counter(
+    "pio_plane_repl_bytes_total",
+    "Replicated plane bytes by direction (out=published to subscribers, "
+    "in=landed from a publisher) and container kind (full|delta) — the "
+    "per-hop sizing signal: steady state should be delta-dominated")
+_M_RLAG = _REG.gauge(
+    "pio_plane_repl_lag_generations",
+    "Generations the named peer is behind the publisher's current one "
+    "(publisher: one series per subscriber node = the slowest-subscriber "
+    "view; subscriber: its own lag vs the source). Series are removed "
+    "when the peer disconnects")
+_M_RSUBS = _REG.gauge(
+    "pio_plane_repl_subscribers",
+    "Connected replication subscriber sessions on this publisher")
+_M_RESYNC = _REG.counter(
+    "pio_plane_repl_resyncs_total",
+    "Keyframe-chain re-syncs by reason: cold (fresh subscriber), lag "
+    "(subscriber fell behind the publisher's GC window), torn (hash "
+    "mismatch on a transferred container)")
+
+_MAGIC = b"PRP1"
+_HDR = struct.Struct("<4sIQ")      # magic, header_len, payload_len
+_MAX_HEADER = 16 << 20
+
+
+def repl_ping_s() -> float:
+    """PIO_PLANE_REPL_PING_S: publisher keepalive period while idle
+    (default 5 s).  Also how often an idle subscriber's lag view
+    refreshes."""
+    try:
+        return max(float(os.environ.get("PIO_PLANE_REPL_PING_S", "5")), 0.2)
+    except ValueError:
+        return 5.0
+
+
+def repl_timeout_s() -> float:
+    """PIO_PLANE_REPL_TIMEOUT_S: socket send/ack timeout (default 30 s).
+    A subscriber that stops reading for this long is dropped — this is
+    the publisher's memory bound: one in-flight chunk per subscriber,
+    never an unbounded queue."""
+    try:
+        return max(float(os.environ.get("PIO_PLANE_REPL_TIMEOUT_S", "30")),
+                   1.0)
+    except ValueError:
+        return 30.0
+
+
+def repl_backoff_s() -> float:
+    """PIO_PLANE_REPL_BACKOFF_S: subscriber's initial reconnect backoff
+    (default 1 s, doubling to 30 s)."""
+    try:
+        return max(float(os.environ.get("PIO_PLANE_REPL_BACKOFF_S", "1")),
+                   0.05)
+    except ValueError:
+        return 1.0
+
+
+def repl_chunk_bytes() -> int:
+    """PIO_PLANE_REPL_CHUNK: transfer chunk size (default 1 MiB) — also
+    the per-subscriber publisher-side memory high-water mark."""
+    try:
+        return max(int(os.environ.get("PIO_PLANE_REPL_CHUNK",
+                                      str(1 << 20))), 4096)
+    except ValueError:
+        return 1 << 20
+
+
+def parse_endpoint(spec: str, default_host: str = "0.0.0.0",
+                   ) -> Tuple[str, int]:
+    """``HOST:PORT`` | ``:PORT`` | ``PORT`` → (host, port)."""
+    s = str(spec).strip()
+    if ":" in s:
+        host, _, port = s.rpartition(":")
+        host = host or default_host
+    else:
+        host, port = default_host, s
+    try:
+        return host, int(port)
+    except ValueError:
+        raise ValueError(f"bad replication endpoint {spec!r} "
+                         "(want HOST:PORT or PORT)")
+
+
+def _send_frame(sock: socket.socket, header: Dict[str, Any],
+                payload_len: int = 0) -> None:
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    sock.sendall(_HDR.pack(_MAGIC, len(hj), payload_len) + hj)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    parts = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-frame")
+        parts.append(b)
+        n -= len(b)
+    return b"".join(parts)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[Dict[str, Any], int]:
+    """(header, payload_len); the caller drains the payload itself (a
+    file body streams straight to disk, never through one big bytes)."""
+    magic, hlen, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if magic != _MAGIC:
+        raise ConnectionError(f"bad frame magic {magic!r}")
+    if hlen > _MAX_HEADER:
+        raise ConnectionError(f"oversized frame header ({hlen} bytes)")
+    header = json.loads(_recv_exact(sock, hlen))
+    if not isinstance(header, dict) or "type" not in header:
+        raise ConnectionError("malformed frame header")
+    return header, plen
+
+
+def _safe_plane_name(name: str) -> str:
+    """A generation file name as received from the wire, validated — the
+    subscriber only ever writes ``gen-N.arena|.delta`` basenames inside
+    its own plane dir."""
+    base = os.path.basename(str(name))
+    if base != name or _gen_of(base) is None \
+            or not (base.endswith(".arena") or base.endswith(".delta")):
+        raise ConnectionError(f"refusing wire file name {name!r}")
+    return base
+
+
+class _Session:
+    """One publisher→subscriber connection, owned by its thread."""
+
+    def __init__(self, sock: socket.socket, addr, node: str, have: int):
+        self.sock = sock
+        self.addr = addr
+        self.node = node
+        self.have = int(have)
+        self.sent_bytes = 0
+        self.resyncs = 0
+        self.connected_at = time.time()
+
+
+class PlaneReplicator:
+    """Publisher side: serve the local plane dir to K subscribers.
+
+    Runs three kinds of daemon threads: an acceptor on ``bind``, a
+    plane-dir watcher (inotify fast path, stat-poll fallback) that
+    re-reads the manifest and wakes every session, and one session
+    thread per connected subscriber.  Sessions are pull-paced: after
+    each ``flip`` the publisher waits for the subscriber's next ``sync``
+    (the ack) before streaming more — so a slow subscriber throttles
+    only its own connection and costs one chunk of memory."""
+
+    def __init__(self, plane: ModelPlane, bind: str = "0.0.0.0:0"):
+        self.plane = plane
+        self.host, self.port = parse_endpoint(bind)
+        self._sessions: Dict[int, _Session] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._cur_gen = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._notify: Optional[_DirNotify] = None
+        self._listener: Optional[socket.socket] = None
+        self._session_seq = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._listener is not None:
+            return
+        os.makedirs(self.plane.dir, exist_ok=True)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(64)
+        self.port = srv.getsockname()[1]
+        self._listener = srv
+        cur = self.plane.current()
+        self._cur_gen = int(cur["generation"]) if cur else 0
+        for target, name in ((self._accept_loop, "pio-plane-repl-accept"),
+                             (self._watch_loop, "pio-plane-repl-watch")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        log.info("plane replication: publishing %s on %s:%d",
+                 self.plane.dir, self.host, self.port)
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._notify is not None:
+            self._notify.poke()
+        with self._cond:
+            self._cond.notify_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for s in sessions:
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = []
+        if self._notify is not None:
+            self._notify.close()
+            self._notify = None
+
+    def poke(self) -> None:
+        """Manifest may have flipped (the in-process follower's publish
+        listener calls this — sub-poll-latency propagation even where
+        inotify is unavailable)."""
+        self._refresh_gen()
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            subs = [{
+                "node": s.node, "ackedGeneration": s.have,
+                "lagGenerations": max(self._cur_gen - s.have, 0),
+                "sentBytes": s.sent_bytes, "resyncs": s.resyncs,
+            } for s in self._sessions.values()]
+        return {"role": "publisher",
+                "bind": f"{self.host}:{self.port}",
+                "generation": self._cur_gen,
+                "subscribers": sorted(subs, key=lambda d: d["node"])}
+
+    # -- watch ---------------------------------------------------------------
+
+    def _refresh_gen(self) -> None:
+        cur = self.plane.current()
+        gen = int(cur["generation"]) if cur else 0
+        with self._cond:
+            if gen != self._cur_gen:
+                self._cur_gen = gen
+                self._cond.notify_all()
+
+    def _watch_loop(self) -> None:
+        if plane_notify_enabled():
+            try:
+                self._notify = _DirNotify(self.plane.dir)
+            except OSError:
+                self._notify = None
+        poll = plane_poll_s()
+        while not self._stop.is_set():
+            if self._notify is not None:
+                self._notify.wait(poll)
+            else:
+                self._stop.wait(poll)
+            if self._stop.is_set():
+                return
+            try:
+                self._refresh_gen()
+            except Exception:
+                log.exception("plane replication: watch failed")
+
+    # -- sessions ------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return          # stop() closed the listener
+            t = threading.Thread(target=self._serve, args=(sock, addr),
+                                 daemon=True, name="pio-plane-repl-session")
+            t.start()
+
+    def _serve(self, sock: socket.socket, addr) -> None:
+        sid = None
+        node = f"{addr[0]}:{addr[1]}"
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(repl_timeout_s())
+            header, plen = _recv_frame(sock)
+            if header.get("type") != "sync":
+                raise ConnectionError(
+                    f"expected sync, got {header.get('type')!r}")
+            if plen:
+                _recv_exact(sock, plen)
+            node = str(header.get("node") or node)
+            sess = _Session(sock, addr, node, int(header.get("have") or 0))
+            with self._lock:
+                self._session_seq += 1
+                sid = self._session_seq
+                self._sessions[sid] = sess
+                _M_RSUBS.set(len(self._sessions))
+            log.info("plane replication: subscriber %s connected "
+                     "(have=%d, reason=%s)", node, sess.have,
+                     header.get("reason"))
+            self._session_loop(sess, str(header.get("reason") or "cold"))
+        except (ConnectionError, socket.timeout, OSError) as e:
+            if not self._stop.is_set():
+                log.info("plane replication: subscriber %s dropped (%s)",
+                         node, e)
+        except Exception:
+            log.exception("plane replication: session %s failed", node)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if sid is not None:
+                with self._lock:
+                    self._sessions.pop(sid, None)
+                    _M_RSUBS.set(len(self._sessions))
+                # a dead subscriber's lag series must not linger at its
+                # last value and page someone forever
+                _M_RLAG.remove(node=node)
+
+    def _session_loop(self, sess: _Session, reason: str) -> None:
+        ping_s = repl_ping_s()
+        while not self._stop.is_set():
+            with self._cond:
+                deadline = time.time() + ping_s
+                while (self._cur_gen <= sess.have
+                       and not self._stop.is_set()):
+                    left = deadline - time.time()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                gen = self._cur_gen
+            if self._stop.is_set():
+                return
+            _M_RLAG.set(max(gen - sess.have, 0), node=sess.node)
+            if gen <= sess.have:
+                _send_frame(sess.sock, {"type": "ping", "gen": gen})
+                continue
+            cur = self.plane.current()
+            if cur is None or int(cur["generation"]) <= sess.have:
+                continue
+            reason = self._ship(sess, cur, reason)
+
+    def _plan(self, have: int, cur: Dict[str, Any],
+              reason: str) -> Tuple[List[str], Optional[str]]:
+        """(ordered files to ship, resync reason or None for an
+        incremental catch-up)."""
+        gen = int(cur["generation"])
+        resync = None
+        if reason == "torn":
+            resync = "torn"
+        elif have <= 0:
+            resync = "cold"
+        files: List[str] = []
+        if resync is None:
+            for g in range(have + 1, gen + 1):
+                for nm in (f"gen-{g:010d}.delta", f"gen-{g:010d}.arena"):
+                    if os.path.exists(os.path.join(self.plane.dir, nm)):
+                        files.append(nm)
+                        break
+                else:
+                    resync = "lag"   # GC moved past the subscriber
+                    break
+        if resync is not None:
+            files = self.plane.chain_files(str(cur["file"]))
+        return files, resync
+
+    def _ship(self, sess: _Session, cur: Dict[str, Any],
+              reason: str) -> str:
+        """Stream one catch-up batch (files + flip), then block on the
+        subscriber's ack-sync.  Returns the next batch's request reason
+        (from that sync)."""
+        gen = int(cur["generation"])
+        try:
+            files, resync = self._plan(sess.have, cur, reason)
+        except _PlaneCorrupt as e:
+            # the local chain itself is broken (quarantined file): the
+            # next keyframe publish heals it; keep the session alive
+            log.warning("plane replication: cannot plan catch-up for %s "
+                        "(%s) — waiting for a healing keyframe",
+                        sess.node, e)
+            _send_frame(sess.sock, {"type": "ping", "gen": gen})
+            time.sleep(min(repl_ping_s(), 1.0))
+            return "lag"
+        if resync is not None:
+            sess.resyncs += 1
+            _M_RESYNC.inc(reason=resync)
+            log.info("plane replication: re-syncing %s from keyframe "
+                     "(%s, %d files)", sess.node, resync, len(files))
+        for nm in files:
+            if not self._send_file(sess, nm):
+                # vanished mid-plan (GC race): re-plan from the live
+                # manifest on the next loop turn
+                return "lag"
+        _send_frame(sess.sock, {"type": "flip", "manifest": cur,
+                                "resync": resync})
+        header, plen = _recv_frame(sess.sock)   # the ack
+        if header.get("type") != "sync":
+            raise ConnectionError(
+                f"expected ack sync, got {header.get('type')!r}")
+        if plen:
+            _recv_exact(sess.sock, plen)
+        sess.have = int(header.get("have") or 0)
+        _M_RLAG.set(max(self._cur_gen - sess.have, 0), node=sess.node)
+        return str(header.get("reason") or "ack")
+
+    def _send_file(self, sess: _Session, name: str) -> bool:
+        """Hash-then-stream one container from a single open fd (GC may
+        unlink the path mid-send; the fd keeps the bytes).  False when
+        the file is already gone."""
+        chunk = repl_chunk_bytes()
+        try:
+            f = open(os.path.join(self.plane.dir, name), "rb")
+        except FileNotFoundError:
+            return False
+        with f:
+            h = hashlib.sha256()
+            size = 0
+            while True:
+                b = f.read(chunk)
+                if not b:
+                    break
+                h.update(b)
+                size += len(b)
+            kind = "delta" if name.endswith(".delta") else "full"
+            _send_frame(sess.sock, {
+                "type": "file", "name": name, "gen": _gen_of(name),
+                "bytes": size, "sha256": h.hexdigest(), "kind": kind,
+            }, payload_len=size)
+            f.seek(0)
+            left = size
+            while left:
+                b = f.read(min(chunk, left))
+                if not b:
+                    raise ConnectionError(
+                        f"{name}: shrank mid-send ({left} bytes short)")
+                sess.sock.sendall(b)
+                left -= len(b)
+        sess.sent_bytes += size
+        _M_RBYTES.inc(size, dir="out", kind=kind)
+        return True
+
+
+class PlaneSubscriber:
+    """Subscriber side: mirror a remote publisher's plane into a local
+    plane dir.  Connects with exponential backoff, announces its last
+    flipped generation (crash-resumable: that state IS the local
+    manifest), lands containers two-phase and flips the manifest under
+    the plane's flock'd publish lock with :data:`REPLICA_KEY` stamped —
+    the local ``PlaneWatcher``/compose/install path (and GC) then work
+    unchanged."""
+
+    def __init__(self, plane_dir: str, source: str,
+                 node: Optional[str] = None):
+        self.plane = ModelPlane(plane_dir)
+        self.source = source
+        self.host, self.port = parse_endpoint(source,
+                                              default_host="127.0.0.1")
+        self.node = node or f"{socket.gethostname()}-{os.getpid()}"
+        self.generation = 0          # last flipped locally
+        self.source_generation = 0   # publisher's, from pings/flips
+        self.resyncs = 0
+        self.connected = False
+        self.last_flip_at = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sock: Optional[socket.socket] = None
+        self._flip_cond = threading.Condition()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.generation = self._initial_have()   # raises on foreign dir
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="pio-plane-subscribe")
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def status(self) -> Dict[str, Any]:
+        src_gen = max(self.source_generation, self.generation)
+        return {"role": "subscriber", "source": self.source,
+                "node": self.node, "connected": self.connected,
+                "generation": self.generation,
+                "sourceGeneration": src_gen,
+                "lagGenerations": max(src_gen - self.generation, 0),
+                "resyncs": self.resyncs, "lastFlipAt": self.last_flip_at}
+
+    def wait_generation(self, gen: int, timeout: float) -> bool:
+        """Block until generation ``gen`` has flipped locally (tests and
+        the check scripts use this)."""
+        deadline = time.time() + timeout
+        with self._flip_cond:
+            while self.generation < gen:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                self._flip_cond.wait(left)
+        return True
+
+    # -- resume / split-brain ------------------------------------------------
+
+    def _initial_have(self) -> int:
+        """Resume point: the local manifest's generation when it was
+        landed by replication AND its chain files survive; 0 (full
+        re-sync) otherwise.  A manifest WITHOUT the replication marker
+        means a local publisher owns this dir — refuse loudly rather
+        than fight it for the flock."""
+        cur = self.plane.current()
+        if cur is None:
+            return 0
+        if REPLICA_KEY not in cur:
+            raise RuntimeError(
+                f"plane dir {self.plane.dir} has a locally-published "
+                "manifest (no replication marker) — subscribing to it "
+                "would split-brain with the local publisher. Point "
+                "--plane-dir/PIO_MODEL_PLANE_DIR at a directory this "
+                "subscriber owns.")
+        try:
+            self.plane.chain_files(str(cur["file"]))
+        except _PlaneCorrupt:
+            return 0
+        return int(cur["generation"])
+
+    # -- receive loop --------------------------------------------------------
+
+    def _loop(self) -> None:
+        backoff = repl_backoff_s()
+        reason = "cold" if self.generation == 0 else "resume"
+        while not self._stop.is_set():
+            try:
+                reason = self._run_once(reason)
+                backoff = repl_backoff_s()   # a clean pass resets it
+            except (ConnectionError, socket.timeout, OSError) as e:
+                if self._stop.is_set():
+                    return
+                log.warning("plane replication: subscriber link to %s "
+                            "lost (%s) — reconnecting in %.1fs",
+                            self.source, e, backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                log.exception("plane replication: subscriber failed — "
+                              "reconnecting in %.1fs", backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+            finally:
+                self.connected = False
+                _M_RLAG.remove(node=self.node)
+
+    def _run_once(self, reason: str) -> str:
+        ping_s = repl_ping_s()
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=repl_timeout_s())
+        self._sock = sock
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # recv must outlive the publisher's ping cadence comfortably
+            sock.settimeout(max(repl_timeout_s(), ping_s * 3))
+            _send_frame(sock, {"type": "sync", "have": self.generation,
+                               "node": self.node, "reason": reason})
+            self.connected = True
+            log.info("plane replication: subscribed to %s (have=%d, %s)",
+                     self.source, self.generation, reason)
+            torn: Optional[str] = None
+            while not self._stop.is_set():
+                header, plen = _recv_frame(sock)
+                typ = header.get("type")
+                if typ == "ping":
+                    self.source_generation = int(header.get("gen") or 0)
+                    self._note_lag()
+                elif typ == "file":
+                    name, ok = self._land_file(sock, header, plen)
+                    if not ok and torn is None:
+                        torn = name
+                elif typ == "flip":
+                    manifest = header.get("manifest") or {}
+                    self.source_generation = int(
+                        manifest.get("generation") or 0)
+                    if torn is None and self._flip(manifest):
+                        reason = "ack"
+                    else:
+                        # quarantined (or chain-incomplete) batch: never
+                        # flip over it — re-request the whole chain
+                        self.resyncs += 1
+                        _M_RESYNC.inc(reason="torn")
+                        reason = "torn"
+                    torn = None
+                    self._note_lag()
+                    _send_frame(sock, {
+                        "type": "sync", "have": self.generation,
+                        "node": self.node, "reason": reason})
+                elif typ == "error":
+                    raise ConnectionError(
+                        f"publisher error: {header.get('msg')}")
+                else:
+                    raise ConnectionError(f"unexpected frame {typ!r}")
+            return reason
+        finally:
+            self._sock = None
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _note_lag(self) -> None:
+        _M_RLAG.set(max(self.source_generation - self.generation, 0),
+                    node=self.node)
+
+    def _land_file(self, sock: socket.socket, header: Dict[str, Any],
+                   plen: int) -> Tuple[str, bool]:
+        """Stream one container to ``.<name>.tmp-<pid>`` while hashing;
+        rename into place only when the hash matches, else quarantine
+        the evidence and report the tear.  (name, landed_ok)."""
+        name = _safe_plane_name(header.get("name"))
+        want_sha = str(header.get("sha256") or "")
+        kind = "delta" if name.endswith(".delta") else "full"
+        os.makedirs(self.plane.dir, exist_ok=True)
+        tmp = os.path.join(self.plane.dir, f".{name}.tmp-{os.getpid()}")
+        h = hashlib.sha256()
+        left = plen
+        chunk = repl_chunk_bytes()
+        with open(tmp, "wb") as f:
+            while left:
+                b = sock.recv(min(left, chunk))
+                if not b:
+                    raise ConnectionError(f"{name}: peer closed mid-blob")
+                h.update(b)
+                f.write(b)
+                left -= len(b)
+            f.flush()
+            os.fsync(f.fileno())
+        _M_RBYTES.inc(plen, dir="in", kind=kind)
+        if h.hexdigest() != want_sha:
+            # torn transfer: keep the evidence out-of-band, never flip it
+            qpath = os.path.join(self.plane.dir, name + ".quarantine")
+            try:
+                os.replace(tmp, qpath)
+            except OSError:
+                pass
+            log.warning("plane replication: %s torn in transit "
+                        "(sha256 %s != %s) — quarantined, will "
+                        "re-request", name, h.hexdigest()[:12],
+                        want_sha[:12])
+            return name, False
+        os.replace(tmp, os.path.join(self.plane.dir, name))
+        return name, True
+
+    def _flip(self, manifest: Dict[str, Any]) -> bool:
+        """Flip the local manifest to the replicated generation under
+        the plane's publish lock (the marker keeps local publishers and
+        other subscribers honest), then GC exactly like a publisher.
+        False when the chain is incomplete locally (caller re-syncs)."""
+        if not isinstance(manifest, dict) or "generation" not in manifest \
+                or "file" not in manifest:
+            raise ConnectionError("flip without a usable manifest")
+        gen = int(manifest["generation"])
+        try:
+            self.plane.chain_files(str(manifest["file"]))
+        except _PlaneCorrupt as e:
+            log.warning("plane replication: not flipping to generation "
+                        "%d — chain incomplete locally (%s)", gen, e)
+            return False
+        doc = dict(manifest)
+        doc[REPLICA_KEY] = self.source
+        doc["publisherPid"] = os.getpid()
+        doc["replicatedAt"] = time.time()
+        with self.plane._publish_lock():
+            local = self.plane.current()
+            if local is not None and REPLICA_KEY not in local \
+                    and int(local.get("generation") or 0) >= gen:
+                raise RuntimeError(
+                    f"plane dir {self.plane.dir} was taken over by a "
+                    "local publisher mid-stream — refusing to fight it")
+            self.plane._write_manifest(doc)
+            kf = doc.get("keyframeGeneration")
+            self.plane._gc_keyframes[gen] = int(kf) if kf else gen
+            self.plane._gc(gen)
+        self.generation = gen
+        self.last_flip_at = time.time()
+        with self._flip_cond:
+            self._flip_cond.notify_all()
+        log.info("plane replication: generation %d live locally (%s)",
+                 gen, manifest.get("file"))
+        return True

@@ -314,6 +314,10 @@ class QueryServerState:
         self.plane = None
         self.plane_watcher = None
         self.plane_generation = 0
+        # plane replication endpoint hosted by THIS process (a
+        # PlaneReplicator when deploy --plane-publish, a PlaneSubscriber
+        # when --plane-from); freshness() surfaces its role + lag
+        self.replication = None
         self._tune_gil_switch()
         self.reload()
         if plane_dir:
@@ -495,6 +499,12 @@ class QueryServerState:
         self._auto_stop.set()
         if self.follower is not None:
             self.follower.stop(timeout=2.0)
+        if self.replication is not None:
+            try:
+                self.replication.stop(timeout=1.0)
+            except Exception:
+                log.exception("plane replication stop failed")
+            self.replication = None
         if self.plane_watcher is not None:
             self.plane_watcher.stop()
 
@@ -636,6 +646,14 @@ class QueryServerState:
                 # — the per-generation write amplification, also on the
                 # dashboard as pio_model_plane_publish_bytes_total)
                 doc["planePublish"] = dict(self.plane.last_publish_stats)
+        if self.replication is not None:
+            # multi-node topology: which side of the replication channel
+            # this node is on, and how far behind it runs — the
+            # cluster-convergence analogue of planeGeneration
+            try:
+                doc["replication"] = self.replication.status()
+            except Exception:
+                pass
         if self.follower is not None:
             doc["follower"] = self.follower.status()
         elif self.follow_info is not None:
@@ -868,8 +886,18 @@ def deploy(
     workers: int = 1,
     reuse_port: bool = False,
     follow: float = 0.0,
+    plane_publish: Optional[str] = None,
+    plane_from: Optional[str] = None,
 ):
     """Programmatic deploy; returns the HTTPServer (background=True) or blocks.
+
+    ``plane_publish=\"[HOST:]PORT\"`` additionally serves this node's
+    model plane to replication subscribers; ``plane_from=\"HOST:PORT\"``
+    makes this node a replication SUBSCRIBER: no local folding (it
+    conflicts with ``follow``), the plane dir (node-local, via
+    PIO_MODEL_PLANE_DIR) is fed by the remote publisher and the normal
+    watcher/compose/install path serves it.  See docs/operations.md
+    "Multi-node plane replication".
 
     ``workers > 1`` preforks N−1 extra OS processes all serving the SAME
     port via SO_REUSEPORT (the kernel load-balances accepts): CPython's
@@ -889,6 +917,14 @@ def deploy(
     """
     # cheap preconditions FIRST: raising after QueryServerState exists
     # would leak its auto-reload poller and started plugins
+    if plane_from and follow > 0:
+        raise ValueError(
+            "deploy --plane-from replaces local folding with replicated "
+            "generations; drop --follow (the publisher node folds)")
+    if plane_from and plane_publish:
+        raise ValueError(
+            "deploy cannot be a replication subscriber and publisher at "
+            "once (relaying is not supported)")
     if workers > 1:
         import jax
 
@@ -938,20 +974,37 @@ def deploy(
         metrics_dir = tempfile.mkdtemp(prefix="pio-metrics-")
         obs_metrics.start_worker_flusher(metrics_dir, f"w0-{os.getpid()}")
     plane_dir: Optional[str] = None
-    if plane_mod.plane_wanted(workers):
+    if plane_mod.plane_wanted(workers) or plane_from or plane_publish:
+        # replication implies the plane: a subscriber node IS a plane
+        # consumer, a publishing node must host the dir it serves
         plane_dir = plane_mod.resolve_plane_dir(
             storage or get_storage(), eid, variant)
         if plane_dir is None:
+            if plane_from or plane_publish:
+                raise ValueError(
+                    "plane replication needs a model-plane directory: "
+                    "set PIO_MODEL_PLANE_DIR to a node-LOCAL path (or "
+                    "use a localfs METADATA store); see "
+                    "docs/operations.md \"Multi-node plane replication\"")
             log.warning(
                 "model plane requested but no plane dir is resolvable "
                 "(set PIO_MODEL_PLANE_DIR or use a localfs METADATA "
-                "store); workers serve private model copies")
+                "store; for multi-node serving see docs/operations.md "
+                "\"Multi-node plane replication\"); workers serve "
+                "private model copies")
     state = QueryServerState(
         engine, engine_params, query_class, eid, engine_version, variant,
         storage=storage, feedback=feedback, feedback_app_name=feedback_app,
         plugins=plugins, auto_reload=auto_reload, plane_dir=plane_dir,
     )
-    if state.plane is not None and not prefork.is_prefork_child():
+    if state.plane is not None and plane_from is not None:
+        # subscriber node: the plane dir belongs to the remote publisher
+        # (via the subscriber daemon below) — seeding it locally would
+        # be the exact split-brain the replication marker guards against.
+        # Until the first replicated flip lands, workers serve the
+        # privately loaded startup model.
+        pass
+    elif state.plane is not None and not prefork.is_prefork_child():
         # seed the plane with the loaded instance so the group converges
         # onto one mapped copy from the start; a bundle the plane cannot
         # carry (non-UR) degrades the WHOLE deploy to private models —
@@ -1004,6 +1057,28 @@ def deploy(
                         "deploying without a follower", e)
         else:
             state.follower.start()
+    if plane_publish is not None and state.plane is not None:
+        # publisher side of multi-node replication: stream every new
+        # generation file + manifest flip to connected subscribers.  The
+        # dir watcher covers publishes from the dedicated publisher
+        # child; an embedded follower also pokes it directly.
+        from predictionio_tpu.streaming.replicate import PlaneReplicator
+
+        repl = PlaneReplicator(state.plane, bind=plane_publish)
+        repl.start()
+        state.replication = repl
+        if state.follower is not None:
+            state.follower.add_publish_listener(repl.poke)
+    elif plane_from is not None and state.plane is not None:
+        # subscriber side: land replicated containers into the local
+        # plane dir; the PlaneWatcher started by QueryServerState (and
+        # by every prefork sibling) installs them exactly as if a local
+        # publisher had flipped the manifest
+        from predictionio_tpu.streaming.replicate import PlaneSubscriber
+
+        sub = PlaneSubscriber(state.plane.dir, plane_from)
+        sub.start()
+        state.replication = sub
     child_procs: list = []
     # flight recorder: prefork children resolve the group's traces dir
     # from PIO_METRICS_DIR; single workers persist next to the storage
@@ -1174,6 +1249,8 @@ def run_server_from_args(args) -> int:
             workers=getattr(args, "workers", 1) or 1,
             reuse_port=getattr(args, "reuse_port", False),
             follow=getattr(args, "follow", 0.0) or 0.0,
+            plane_publish=getattr(args, "plane_publish", None),
+            plane_from=getattr(args, "plane_from", None),
         )
     except Exception as e:
         print(f"Error: {e}", file=sys.stderr)

@@ -52,6 +52,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.streaming.follow",
     "predictionio_tpu.streaming.fold",
     "predictionio_tpu.streaming.plane",
+    "predictionio_tpu.streaming.replicate",
     "predictionio_tpu.serve.response_cache",
     "predictionio_tpu.serve.history_cache",
     "predictionio_tpu.native.core",
@@ -142,6 +143,13 @@ REQUIRED_METRICS = frozenset({
     "pio_native_fallback_total",
     "pio_history_cache_total",
     "pio_history_cache_entries",
+    # multi-node plane replication (PR 19): fleet-health alerting keys
+    # on the per-subscriber lag and session gauges; network sizing on
+    # the dir/kind byte counter; resync visibility on the reason counter
+    "pio_plane_repl_bytes_total",
+    "pio_plane_repl_lag_generations",
+    "pio_plane_repl_subscribers",
+    "pio_plane_repl_resyncs_total",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})

@@ -361,8 +361,14 @@ def test_http_parse_head_parity(monkeypatch):
 def test_http_assemble_parity(monkeypatch):
     from predictionio_tpu.api import http_util as hu
 
+    # bodies under _NATIVE_ASSEMBLE_MIN take the join path even with
+    # PIO_NATIVE=on (the ctypes marshalling costs more than the join at
+    # those sizes); the oversized body forces the native branch so its
+    # parity is actually exercised, and the gated sizes prove the gate
+    # itself is response-invisible
+    big = b"z" * (hu._NATIVE_ASSEMBLE_MIN + 17)
     for status, body, rid, close in itertools.product(
-            (200, 400, 503), (b"", b'{"x":1}', b"z" * 5000),
+            (200, 400, 503), (b"", b'{"x":1}', b"z" * 5000, big),
             ("", "req-123"), (False, True)):
         monkeypatch.setenv("PIO_NATIVE", "off")
         ora = hu.assemble_response(status, body, rid=rid, close=close)

@@ -1,0 +1,325 @@
+"""Multi-node plane replication: wire-level fault injection + parity.
+
+The replication contract mirrors the plane's local one: a subscriber
+node serving replicated generations is bit-indistinguishable from the
+publisher node — so every test here diffs arrays/responses exactly.
+Fault injection covers the three wire failure modes: a torn mid-blob
+transfer (quarantined, re-requested, never served), a killed subscriber
+resuming from its last-acked generation (incremental, no re-sync), and
+a cold subscriber catching up from the nearest keyframe.
+
+The multi-process drill (publisher deploy + 2 subscriber deploys, live
+folds, mid-stream kill) lives in scripts/check_plane_replication.py,
+wrapped for tier-1 at the bottom.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from test_model_plane import _canon, _corpus, _seed, _ur  # shared helpers
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def host_serving(monkeypatch):
+    monkeypatch.setenv("PIO_UR_SERVE_SCORER", "host")
+    monkeypatch.setenv("PIO_UR_SERVE_TAIL", "host")
+
+
+@pytest.fixture()
+def fast_repl(monkeypatch):
+    monkeypatch.setenv("PIO_MODEL_PLANE_POLL_S", "0.05")
+    monkeypatch.setenv("PIO_PLANE_REPL_PING_S", "0.3")
+    monkeypatch.setenv("PIO_PLANE_REPL_BACKOFF_S", "0.1")
+
+
+def _publisher(tmp_path, mem_storage, n_gens=1):
+    """A trained model published ``n_gens`` times into a fresh plane
+    dir; returns (plane, model, algo)."""
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    _seed(mem_storage)
+    engine, ep, algo = _ur()
+    model = engine.train(ep)[0]
+    pub = ModelPlane(str(tmp_path / "pub-plane"))
+    for _ in range(n_gens):
+        pub.publish([model], {"mode": "test"})
+    return pub, model, algo
+
+
+def _start_pair(pub, sub_dir, node="t-sub"):
+    from predictionio_tpu.streaming.replicate import (
+        PlaneReplicator, PlaneSubscriber,
+    )
+
+    repl = PlaneReplicator(pub, bind="127.0.0.1:0")
+    repl.start()
+    sub = PlaneSubscriber(str(sub_dir), f"127.0.0.1:{repl.port}",
+                          node=node)
+    sub.start()
+    return repl, sub
+
+
+def _assert_parity(sub_dir, model, algo):
+    """The subscriber's current generation answers every corpus query
+    bit-identically to the publisher's private model."""
+    from predictionio_tpu.streaming.plane import ModelPlane
+
+    reader = ModelPlane(str(sub_dir))
+    mapped, _info = reader.load(reader.current())
+    for name in model.indicator_idx:
+        assert np.array_equal(mapped.indicator_idx[name],
+                              model.indicator_idx[name])
+    for q in _corpus():
+        assert _canon(algo.predict(mapped, q)) == _canon(
+            algo.predict(model, q))
+
+
+# -- cold catch-up -----------------------------------------------------------
+
+
+def test_cold_subscriber_keyframe_catchup_bit_exact(
+        mem_storage, host_serving, fast_repl, tmp_path):
+    """A fresh subscriber joins mid-chain: the publisher re-plans from
+    the nearest keyframe and replays the delta chain forward; the
+    composed model on the subscriber node is bit-exact vs the
+    publisher's, and the manifest carries the replication marker."""
+    from predictionio_tpu.streaming.plane import REPLICA_KEY, ModelPlane
+
+    pub, model, algo = _publisher(tmp_path, mem_storage, n_gens=4)
+    assert pub.current()["generation"] == 4
+    repl, sub = _start_pair(pub, tmp_path / "sub-plane")
+    try:
+        assert sub.wait_generation(4, timeout=20)
+        _assert_parity(tmp_path / "sub-plane", model, algo)
+        cur = ModelPlane(str(tmp_path / "sub-plane")).current()
+        assert cur[REPLICA_KEY] == sub.source
+        st = sub.status()
+        assert st["role"] == "subscriber"
+        assert st["lagGenerations"] == 0
+        # publisher-side view converges too (the ack carried have=4)
+        for _ in range(100):
+            pst = repl.status()
+            if pst["subscribers"] and \
+                    pst["subscribers"][0]["ackedGeneration"] == 4:
+                break
+            time.sleep(0.05)
+        assert pst["role"] == "publisher"
+        assert pst["subscribers"][0]["lagGenerations"] == 0
+    finally:
+        sub.stop()
+        repl.stop()
+
+
+def test_live_publishes_stream_to_subscriber(
+        mem_storage, host_serving, fast_repl, tmp_path):
+    """Generations published WHILE a subscriber is connected propagate
+    incrementally (no re-sync) — the delta wire bytes are a fraction of
+    the keyframe's."""
+    from predictionio_tpu.obs import metrics as obs_metrics
+
+    pub, model, algo = _publisher(tmp_path, mem_storage, n_gens=1)
+    repl, sub = _start_pair(pub, tmp_path / "sub-plane")
+    reg = obs_metrics.get_registry()
+    resync = reg.counter("pio_plane_repl_resyncs_total", "x")
+    try:
+        assert sub.wait_generation(1, timeout=20)
+        lag0 = resync.value(reason="lag")
+        torn0 = resync.value(reason="torn")
+        for _ in range(3):
+            pub.publish([model], {"mode": "test"})
+        assert sub.wait_generation(4, timeout=20)
+        _assert_parity(tmp_path / "sub-plane", model, algo)
+        # steady state is incremental: no lag/torn re-syncs
+        assert resync.value(reason="lag") == lag0
+        assert resync.value(reason="torn") == torn0
+        bytes_total = reg.counter("pio_plane_repl_bytes_total", "x")
+        assert bytes_total.value(dir="out", kind="delta") > 0
+        assert bytes_total.value(dir="in", kind="delta") == \
+            bytes_total.value(dir="out", kind="delta")
+    finally:
+        sub.stop()
+        repl.stop()
+
+
+# -- torn transfer -----------------------------------------------------------
+
+
+def test_torn_transfer_quarantines_and_rerequests(
+        mem_storage, host_serving, fast_repl, tmp_path, monkeypatch):
+    """A mid-blob corruption (hash mismatch on arrival) quarantines the
+    file on the subscriber, never flips over it, and re-requests the
+    chain — converging bit-exact on the retry."""
+    from predictionio_tpu.streaming import replicate
+
+    pub, model, algo = _publisher(tmp_path, mem_storage, n_gens=2)
+    # corrupt exactly one file frame's advertised hash: the payload
+    # lands, fails verification, and the batch is re-requested
+    real_send = replicate._send_frame
+    tears = {"left": 1}
+
+    def flaky_send(sock, header, payload_len=0):
+        if header.get("type") == "file" and tears["left"]:
+            tears["left"] -= 1
+            header = dict(header, sha256="0" * 64)
+        real_send(sock, header, payload_len)
+
+    monkeypatch.setattr(replicate, "_send_frame", flaky_send)
+    repl, sub = _start_pair(pub, tmp_path / "sub-plane")
+    try:
+        assert sub.wait_generation(2, timeout=30)
+        assert tears["left"] == 0           # the fault actually fired
+        assert sub.resyncs >= 1             # torn batch was re-requested
+        quarantined = list(Path(tmp_path / "sub-plane")
+                           .glob("*.quarantine"))
+        assert quarantined                  # evidence kept out-of-band
+        _assert_parity(tmp_path / "sub-plane", model, algo)
+    finally:
+        sub.stop()
+        repl.stop()
+
+
+# -- resume ------------------------------------------------------------------
+
+
+def test_killed_subscriber_resumes_from_last_acked_generation(
+        mem_storage, host_serving, fast_repl, tmp_path):
+    """A subscriber that dies (stop == the daemon's crash point: the
+    local manifest IS its resume state) reconnects with have=last
+    flipped generation and receives only the missing generations —
+    no keyframe re-sync, bit-exact convergence."""
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.streaming.replicate import PlaneSubscriber
+
+    pub, model, algo = _publisher(tmp_path, mem_storage, n_gens=2)
+    repl, sub = _start_pair(pub, tmp_path / "sub-plane")
+    reg = obs_metrics.get_registry()
+    resync = reg.counter("pio_plane_repl_resyncs_total", "x")
+    try:
+        assert sub.wait_generation(2, timeout=20)
+        sub.stop()      # SIGKILL-equivalent for the daemon's state:
+        # nothing is persisted beyond the plane dir itself
+        for _ in range(2):
+            pub.publish([model], {"mode": "test"})
+        cold0 = resync.value(reason="cold")
+        lag0 = resync.value(reason="lag")
+        sub2 = PlaneSubscriber(str(tmp_path / "sub-plane"),
+                               f"127.0.0.1:{repl.port}", node="t-sub-2")
+        sub2.start()
+        assert sub2.generation == 2         # resumed, not cold
+        try:
+            assert sub2.wait_generation(4, timeout=20)
+            # incremental catch-up: no cold/lag re-sync fired
+            assert resync.value(reason="cold") == cold0
+            assert resync.value(reason="lag") == lag0
+            _assert_parity(tmp_path / "sub-plane", model, algo)
+        finally:
+            sub2.stop()
+    finally:
+        sub.stop()
+        repl.stop()
+
+
+def test_lagged_past_gc_resyncs_from_keyframe(
+        mem_storage, host_serving, fast_repl, tmp_path, monkeypatch):
+    """A subscriber that fell behind the publisher's GC window cannot be
+    served incrementally — the publisher re-plans from the keyframe
+    chain (reason=lag) and still converges bit-exact."""
+    from predictionio_tpu.obs import metrics as obs_metrics
+    from predictionio_tpu.streaming.replicate import PlaneSubscriber
+
+    monkeypatch.setenv("PIO_MODEL_PLANE_KEEP", "2")
+    monkeypatch.setenv("PIO_MODEL_PLANE_FULL_EVERY", "2")
+    pub, model, algo = _publisher(tmp_path, mem_storage, n_gens=2)
+    repl, sub = _start_pair(pub, tmp_path / "sub-plane")
+    reg = obs_metrics.get_registry()
+    resync = reg.counter("pio_plane_repl_resyncs_total", "x")
+    try:
+        assert sub.wait_generation(2, timeout=20)
+        sub.stop()
+        lag0 = resync.value(reason="lag")
+        for _ in range(6):                  # GC moves well past gen 2
+            pub.publish([model], {"mode": "test"})
+        sub2 = PlaneSubscriber(str(tmp_path / "sub-plane"),
+                               f"127.0.0.1:{repl.port}", node="t-sub-2")
+        sub2.start()
+        try:
+            assert sub2.wait_generation(8, timeout=20)
+            assert resync.value(reason="lag") > lag0
+            _assert_parity(tmp_path / "sub-plane", model, algo)
+        finally:
+            sub2.stop()
+    finally:
+        sub.stop()
+        repl.stop()
+
+
+# -- split-brain guards ------------------------------------------------------
+
+
+def test_subscriber_refuses_locally_published_dir(
+        mem_storage, host_serving, tmp_path):
+    """A plane dir whose manifest lacks the replication marker belongs
+    to a LOCAL publisher — subscribing to it must refuse, not fight."""
+    from predictionio_tpu.streaming.replicate import PlaneSubscriber
+
+    pub, _model, _algo = _publisher(tmp_path, mem_storage, n_gens=1)
+    sub = PlaneSubscriber(str(pub.dir), "127.0.0.1:1")
+    with pytest.raises(RuntimeError, match="locally-published"):
+        sub.start()
+
+
+def test_local_publisher_forces_keyframes_on_replica_dir(
+        mem_storage, host_serving, tmp_path):
+    """The dual guard: a local publisher finding the replication marker
+    never publishes a delta against a chain another node wrote."""
+    from predictionio_tpu.streaming.plane import REPLICA_KEY, ModelPlane
+
+    pub, model, _algo = _publisher(tmp_path, mem_storage, n_gens=2)
+    cur = pub.current()
+    assert cur["kind"] == "delta"           # deltas flow normally
+    pub._write_manifest({**cur, REPLICA_KEY: "other-node:9999"})
+    pub.publish([model], {"mode": "test"})
+    assert pub.current()["kind"] == "full"  # degraded to keyframe
+
+
+def test_chain_files_walks_prev_links(mem_storage, host_serving,
+                                      tmp_path, monkeypatch):
+    """chain_files returns [keyframe .. file] in order, from headers
+    alone; a broken link raises _PlaneCorrupt naming the culprit."""
+    from predictionio_tpu.streaming.plane import _PlaneCorrupt
+
+    monkeypatch.setenv("PIO_MODEL_PLANE_KEEP", "10")
+    pub, _model, _algo = _publisher(tmp_path, mem_storage, n_gens=3)
+    cur = pub.current()
+    chain = pub.chain_files(cur["file"])
+    assert chain[0].endswith(".arena")
+    assert chain[-1] == cur["file"]
+    assert chain == sorted(chain)
+    os.unlink(os.path.join(pub.dir, chain[0]))
+    with pytest.raises(_PlaneCorrupt):
+        pub.chain_files(cur["file"])
+
+
+# -- multi-process drill (tier-1 wrapper) ------------------------------------
+
+
+def test_check_plane_replication_script():
+    """Tier-1 wrapper for scripts/check_plane_replication.py: publisher
+    deploy + 2 subscriber deploys, live folds, complete lineage on every
+    node, one subscriber killed mid-stream re-syncs with zero
+    staleness."""
+    r = subprocess.run(
+        [sys.executable,
+         str(REPO / "scripts" / "check_plane_replication.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok:" in r.stdout
