@@ -170,18 +170,23 @@ def _lineage_rows(limit: int = 25) -> str:
 def _render_lineage_html(doc: dict) -> str:
     """Waterfall view of one generation's lineage: every pipeline stage
     (append→fold→publish→plane→install→first serve) as an offset bar,
-    child stages (cache invalidation) indented under their parent."""
+    child stages (cache invalidation) indented under their parent.  A
+    cluster-annotated record (replication publisher) renders one lane
+    per subscriber node under the shared time axis, so a lagging node
+    reads as a right-shifted lane."""
     total_ms = max(float(doc.get("durationMs") or 0.0), 1e-6)
     t0 = float(doc.get("start") or 0.0)
-    rows = []
-    for s in doc.get("stages", ()):
+    cluster = doc.get("cluster") or {}
+    nodes_doc = cluster.get("nodes") or {}
+
+    def stage_row(s):
         off_ms = max((float(s.get("start", t0)) - t0) * 1e3, 0.0)
         dur_ms = float(s.get("duration_s", 0.0)) * 1e3
         left = min(off_ms / total_ms * 100.0, 100.0)
         width = max(min(dur_ms / total_ms * 100.0, 100.0 - left), 0.3)
         attrs = s.get("attrs") or {}
         attr_txt = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
-        rows.append(
+        return (
             "<tr><td style='padding-left:{ind}em'>{name}</td>"
             "<td>{worker}</td><td>{dur:.3f} ms</td>"
             "<td class=wf><div class=bar "
@@ -192,14 +197,47 @@ def _render_lineage_html(doc: dict) -> str:
                 worker=html.escape(str(s.get("worker", ""))),
                 dur=dur_ms, left=left, width=width,
                 attrs=html.escape(attr_txt)))
+
+    rows = []
+    if nodes_doc:
+        lanes = {None: []}
+        for n in nodes_doc:
+            lanes[n] = []
+        for s in doc.get("stages", ()):
+            key = s.get("node") if s.get("node") in nodes_doc else None
+            lanes[key].append(s)
+        rows.append("<tr class=lane><td colspan=5>publisher "
+                    "(origin {0})</td></tr>".format(
+                        html.escape(str(doc.get("origin", "?")))))
+        rows.extend(stage_row(s) for s in lanes[None])
+        for n in sorted(nodes_doc):
+            nd = nodes_doc[n]
+            rows.append(
+                "<tr class=lane><td colspan=5>node {0} &mdash; "
+                "{1}, {2} stage(s)</td></tr>".format(
+                    html.escape(str(n)),
+                    html.escape(str(nd.get("status", "?"))),
+                    int(nd.get("stages", 0))))
+            rows.extend(stage_row(s) for s in lanes[n])
+    else:
+        rows.extend(stage_row(s) for s in doc.get("stages", ()))
+    cl_txt = ""
+    if cluster:
+        cl_txt = " &middot; cluster {0}/{1} node(s)".format(
+            len(cluster.get("done") or ()),
+            len(cluster.get("expected") or ()))
+        if cluster.get("propagationMs") is not None:
+            cl_txt += " &middot; propagation %.1f ms" \
+                % float(cluster["propagationMs"])
     head = ("generation {gen} &middot; {outcome} in {dur:.1f} ms "
-            "(origin {origin}, workers {workers})".format(
+            "(origin {origin}, workers {workers}){cl}".format(
                 gen=html.escape(str(doc.get("generation", "?"))),
                 outcome=html.escape(str(doc.get("outcome", "?"))),
                 dur=total_ms,
                 origin=html.escape(str(doc.get("origin", "?"))),
                 workers=html.escape(
-                    ",".join(doc.get("workers") or []) or "?")))
+                    ",".join(doc.get("workers") or []) or "?"),
+                cl=cl_txt))
     lid = html.escape(str(doc.get("lid", "")))
     return f"""<!DOCTYPE html>
 <html><head><title>lineage {lid}</title>
@@ -210,6 +248,7 @@ def _render_lineage_html(doc: dict) -> str:
  td.wf {{ width: 40%; position: relative; }}
  td.attrs {{ color: #666; font-size: 85%; }}
  div.bar {{ background: #57a35a; height: 0.9em; border-radius: 2px; }}
+ tr.lane td {{ background: #eef2f5; font-weight: bold; }}
 </style></head>
 <body><h1>Lineage {lid}</h1>
 <p>{head}</p>

@@ -466,7 +466,10 @@ def _cmd_lineage(args) -> int:
     import urllib.error
     import urllib.request
 
-    from predictionio_tpu.obs.lineage import render_lineage_text
+    from predictionio_tpu.obs.lineage import (
+        render_lineage_cluster_text,
+        render_lineage_text,
+    )
 
     base = args.url
     if "://" not in base:
@@ -484,20 +487,27 @@ def _cmd_lineage(args) -> int:
         token = args.gen if args.gen is not None else args.lid
         if token is not None:
             doc = fetch(f"/lineage/{token}.json")
-            sys.stdout.write(render_lineage_text(doc))
+            if args.cluster:
+                sys.stdout.write(render_lineage_cluster_text(doc))
+            else:
+                sys.stdout.write(render_lineage_text(doc))
             return 0
         index = fetch("/lineage.json")
         records = index.get("records", [])
         print(f"{len(records)} lineage record(s) "
               f"(answered by worker {index.get('worker', '?')}):")
         for r in records:
-            print("  gen %-6s %-18s %-10s %8.1f ms  %2d stages  "
-                  "origin=%s workers=%s"
+            cl = r.get("cluster") or {}
+            cl_txt = (" cluster=%d/%d" % (cl.get("done", 0),
+                                          cl.get("expected", 0))
+                      if cl else "")
+            print("  gen %-6s %-18s %-16s %8.1f ms  %2d stages  "
+                  "origin=%s workers=%s%s"
                   % (r.get("generation", "?"), r.get("lid", "?"),
                      r.get("outcome", "?"),
                      float(r.get("durationMs") or 0.0),
                      r.get("stageCount", 0), r.get("origin", "?"),
-                     ",".join(r.get("workers") or [])))
+                     ",".join(r.get("workers") or []), cl_txt))
         if records:
             print(f"(pio lineage {args.url} --gen <generation> renders a "
                   "waterfall)")
@@ -528,10 +538,67 @@ def _sparkline(vals) -> str:
         for v in vals)
 
 
+def _cmd_top_cluster(args, base: str) -> int:
+    """`pio top <url> --cluster` — the publisher's federated per-node
+    view (/cluster/metrics.json + /cluster/history.json): one row per
+    subscriber node with liveness, generation, lag, qps and p95, plus a
+    qps sparkline per node over the federated ring."""
+    import urllib.error
+    import urllib.request
+
+    def fetch(path):
+        with urllib.request.urlopen(base + path, timeout=args.timeout) as r:
+            return json.loads(r.read().decode("utf-8", "replace"))
+
+    try:
+        doc = fetch("/cluster/metrics.json")
+        history = fetch(f"/cluster/history.json?limit={args.window}")
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read()).get("message", "")
+        except Exception:
+            msg = str(e)
+        print(f"Error: {base}: HTTP {e.code}: {msg}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"Error: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    nodes = doc.get("nodes") or {}
+    samples = history.get("samples") or []
+    print(f"{base}  —  cluster of {len(nodes)} subscriber node(s), "
+          f"scraped every {doc.get('scrapeIntervalSeconds', '?')}s "
+          f"(publisher node {doc.get('node') or '?'})")
+    if not nodes:
+        print("  (no subscribers have connected to this publisher yet)")
+        return 0
+    fmt = "  %-20s %-4s %6s %5s %9s %9s %8s"
+    print(fmt % ("node", "up", "gen", "lag", "qps", "p95 ms", "stale s"))
+    for name in sorted(nodes):
+        n = nodes[name]
+
+        def num(v, scale=1.0, pat="%.1f"):
+            return pat % (float(v) * scale) if v is not None else "-"
+
+        print(fmt % (
+            name[:20], "yes" if n.get("up") else "NO",
+            "%d" % n["generation"] if n.get("generation") is not None
+            else "-",
+            num(n.get("replLag"), pat="%.0f"), num(n.get("qps")),
+            num(n.get("p95"), 1e3), num(n.get("staleSeconds")))
+            + (f"  ({n.get('error')})" if n.get("error") else ""))
+        qps = [((s.get("nodes") or {}).get(name) or {}).get("qps")
+               for s in samples]
+        qps = [float(v) for v in qps if v is not None]
+        if len(qps) >= 2:
+            print("    qps %s" % _sparkline(qps))
+    return 0
+
+
 def _cmd_top(args) -> int:
     """`pio top <url>` — one-shot terminal view of a server's recent
     history (/metrics/history.json: the local time-series ring): a
-    sparkline + latest value per key signal.  No Prometheus needed."""
+    sparkline + latest value per key signal.  No Prometheus needed.
+    `--cluster` switches to the publisher's federated per-node view."""
     import urllib.error
     import urllib.request
 
@@ -539,6 +606,8 @@ def _cmd_top(args) -> int:
     if "://" not in base:
         base = f"http://{base}"
     base = base.rstrip("/")
+    if args.cluster:
+        return _cmd_top_cluster(args, base)
     url = base + "/metrics/history.json"
     try:
         with urllib.request.urlopen(url, timeout=args.timeout) as r:
@@ -912,6 +981,9 @@ def build_parser() -> argparse.ArgumentParser:
     ln.add_argument("--lid", default=None,
                     help="render the waterfall of this lineage id "
                          "(ln-...)")
+    ln.add_argument("--cluster", action="store_true",
+                    help="render the stitched cross-node waterfall with "
+                         "one lane per subscriber node (publisher URL)")
     ln.add_argument("--timeout", type=float, default=10.0)
     ln.set_defaults(func=_cmd_lineage)
 
@@ -924,6 +996,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "http://127.0.0.1:8000 or 127.0.0.1:8000)")
     tp.add_argument("--window", type=int, default=60,
                     help="samples to render (default 60)")
+    tp.add_argument("--cluster", action="store_true",
+                    help="federated per-node view from the publisher's "
+                         "/cluster/metrics.json + /cluster/history.json")
     tp.add_argument("--timeout", type=float, default=10.0)
     tp.set_defaults(func=_cmd_top)
 

@@ -57,15 +57,30 @@ _M_STAGES = _REG.counter(
     "Lineage stages recorded by this process, by stage name")
 
 # stage order used to sanity-sort ties and by renderers; merge order is
-# by wall-clock start, this is only the canonical pipeline sequence
+# by wall-clock start, this is only the canonical pipeline sequence.
+# The repl.* stages are the replication channel's hops on a subscriber
+# node (plan is publisher-side, per peer): the cross-node stretch of the
+# same waterfall.
 STAGE_ORDER = (
     "append_observed", "fold.apply", "fold.rellr", "fold.emit",
-    "publish", "plane.write", "watcher_wake", "compose", "install",
+    "publish", "plane.write", "repl.plan", "repl.recv", "repl.verify",
+    "repl.land", "watcher_wake", "compose", "install",
     "cache_invalidation", "first_serve",
 )
 # a record is complete once the publish side AND at least one worker's
-# install + first-serve are visible in the merged view
-_PUBLISH_STAGES = frozenset({"publish", "plane.write"})
+# install + first-serve are visible in the merged view.  repl.land
+# counts as publish-equivalent: on a subscriber node the replicated
+# flip IS the publish (the publisher's own stages may not be visible
+# locally), which also lets supersession close a reconnecting
+# subscriber's pre-resync orphans (see merge_records).
+_PUBLISH_STAGES = frozenset({"publish", "plane.write", "repl.land"})
+
+
+def cluster_node() -> Optional[str]:
+    """This process's cluster node name (PIO_CLUSTER_NODE; deploy sets
+    it whenever replication is wired).  None = single-node deployment —
+    stages carry no node field and nothing changes."""
+    return os.environ.get("PIO_CLUSTER_NODE") or None
 
 
 def _env_int(name: str, default: int) -> int:
@@ -178,16 +193,24 @@ class LineageRecorder:
 
     def stage(self, lid: str, name: str, start: Optional[float] = None,
               duration_s: float = 0.0, parent: Optional[str] = None,
-              flush: bool = False, **attrs) -> None:
+              flush: bool = False, node: Optional[str] = None,
+              **attrs) -> None:
         """Append one stage to ``lid``'s record (creating a partial
         record when this process never saw ``begin`` — the cross-process
-        case).  ``attrs`` values must be JSON-able scalars."""
+        case).  ``attrs`` values must be JSON-able scalars.  ``node``
+        overrides the stage's cluster-node stamp (replication daemons
+        hosting several logical nodes in one process); by default the
+        stamp comes from PIO_CLUSTER_NODE at the source, so stitched
+        records attribute every stage without ingest-time guessing."""
         if not self.enabled:
             return
         s: Dict = {"stage": name, "start": float(start if start is not None
                                                  else time.time()),
                    "duration_s": round(float(duration_s), 6),
                    "worker": self.tag}
+        nd = node or cluster_node()
+        if nd:
+            s["node"] = nd
         if parent:
             s["parent"] = parent
         if attrs:
@@ -224,6 +247,81 @@ class LineageRecorder:
             doc["outcome"] = outcome
             self._dirty = True
         self._persist()
+
+    def ingest(self, records, node: Optional[str] = None) -> int:
+        """Merge record fragments received from ANOTHER node (the
+        replication ack payload, or a federation pull of a subscriber's
+        ``/lineage/<gen>.json``) into this process's ring — the
+        publisher-side half of cross-node lineage stitching.  Only the
+        raw fields (lid, start, generation, stages) are taken; derived
+        fields (outcome, workers, durationMs) are recomputed at merge
+        time.  Stages dedupe on the merge key, so re-ingesting the same
+        fragment (push + pull overlap, shared-dir topologies) is a
+        no-op.  Node attribution is SOURCE-stamped only — a stage
+        without a ``node`` field stays unattributed rather than being
+        guessed from the sender (``node`` here is informational): in a
+        shared-lineage-dir topology a subscriber's fragment can carry
+        the publisher's own stages back, and stamping those with the
+        sender's name would mark its lane complete for work it never
+        did.  Returns the number of stages actually added."""
+        if not self.enabled:
+            return 0
+        added = 0
+        with self._lock:
+            for rdoc in records or ():
+                lid = rdoc.get("lid")
+                if not isinstance(lid, str) or not lid.startswith("ln-"):
+                    continue
+                doc = self._ensure(lid, origin=False)
+                seen = {(s.get("stage"), s.get("worker"),
+                         round(float(s.get("start") or 0), 6))
+                        for s in doc["stages"]}
+                for s in rdoc.get("stages", ()):
+                    if not isinstance(s, dict) or not s.get("stage"):
+                        continue
+                    key = (s.get("stage"), s.get("worker"),
+                           round(float(s.get("start") or 0), 6))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    cp = {"stage": str(s["stage"]),
+                          "start": float(s.get("start") or 0),
+                          "duration_s": round(
+                              float(s.get("duration_s") or 0), 6),
+                          "worker": str(s.get("worker") or "")}
+                    if s.get("node"):
+                        cp["node"] = str(s["node"])
+                    if s.get("parent"):
+                        cp["parent"] = str(s["parent"])
+                    if isinstance(s.get("attrs"), dict):
+                        cp["attrs"] = dict(s["attrs"])
+                    doc["stages"].append(cp)
+                    if doc["start"] > cp["start"] > 0:
+                        doc["start"] = cp["start"]
+                    added += 1
+                if rdoc.get("generation") is not None \
+                        and doc.get("generation") is None:
+                    try:
+                        doc["generation"] = int(rdoc["generation"])
+                    except (TypeError, ValueError):
+                        pass
+            if added:
+                self._dirty = True
+        if added:
+            self._request_persist()
+        return added
+
+    def export(self, limit: int = 8) -> List[dict]:
+        """The newest merged records as raw push fragments (the ack
+        payload a subscriber ships back to its publisher): only the raw
+        fields ingest() accepts, bounded to the last ``limit`` records
+        so an ack stays a few KB."""
+        out = []
+        for d in self.merged()[:max(limit, 1)]:
+            out.append({"lid": d.get("lid"), "start": d.get("start"),
+                        "generation": d.get("generation"),
+                        "stages": d.get("stages", [])})
+        return out
 
     # -- persistence + cross-process merge -----------------------------------
 
@@ -319,10 +417,11 @@ class LineageRecorder:
 
     def index(self, limit: int = 100) -> dict:
         """The /lineage.json body: merged per-generation summaries,
-        newest first."""
+        newest first (cluster-annotated when a provider is armed)."""
         entries = []
         for d in self.merged()[:limit]:
-            entries.append({
+            annotate_cluster(d)
+            entry = {
                 "lid": d.get("lid"),
                 "generation": d.get("generation"),
                 "start": d.get("start"),
@@ -331,13 +430,19 @@ class LineageRecorder:
                 "workers": d.get("workers"),
                 "stageCount": len(d.get("stages", ())),
                 "durationMs": d.get("durationMs"),
-            })
+            }
+            cl = d.get("cluster")
+            if cl:
+                entry["cluster"] = {"expected": len(cl["expected"]),
+                                    "done": len(cl["done"]),
+                                    "missing": cl["missing"]}
+            entries.append(entry)
         return {"worker": self.tag, "records": entries}
 
     def get(self, lid: str) -> Optional[dict]:
         for d in self.merged():
             if d.get("lid") == lid:
-                return d
+                return annotate_cluster(d)
         return None
 
     def get_generation(self, generation: int) -> Optional[dict]:
@@ -353,7 +458,7 @@ class LineageRecorder:
                                     len(best.get("stages", ())),
                                     best.get("start", 0)):
                 best = d
-        return best
+        return annotate_cluster(best)
 
 
 def merge_records(docs: List[dict]) -> List[dict]:
@@ -372,6 +477,13 @@ def merge_records(docs: List[dict]) -> List[dict]:
       close, so dead publishers leak nothing;
     - ``open``      — still in flight (the newest record while a fold
       or publish is running).
+
+    On a subscriber node ``repl.land`` is the publish-equivalent marker
+    (the replicated flip IS the local publish), so supersession closes
+    a reconnecting subscriber's pre-resync orphans — a record whose
+    transfer was cut short (repl.recv with no land) goes ``abandoned``
+    as soon as a newer generation lands, exactly like the SIGKILLed
+    publisher case.
     """
     by_lid: Dict[str, dict] = {}
     for doc in docs:
@@ -445,6 +557,89 @@ def _stage_rank(name: Optional[str]) -> int:
         return len(STAGE_ORDER)
 
 
+# -- cluster stitching --------------------------------------------------------
+
+def apply_cluster_outcome(doc: dict, expected,
+                          live=None) -> dict:
+    """Annotate one merged record with the cluster view: a per-node
+    lane summary under ``doc["cluster"]`` and the stitched outcome —
+    ``cluster_complete`` only when EVERY expected subscriber node's
+    install + first_serve stages are visible; a record that completed
+    on some nodes but still lags on another is demoted back to
+    ``published`` (the cluster, not the node, is the unit of
+    observation).  ``live`` (when given) distinguishes a lagging node
+    that is still connected (lane ``open``) from one that died mid-
+    transfer (lane ``abandoned``).  Mutates and returns ``doc``."""
+    expected = sorted({str(n) for n in (expected or ()) if n})
+    live_set = None if live is None else {str(n) for n in live}
+    lanes: Dict[str, dict] = {
+        n: {"names": set(), "stages": 0} for n in expected}
+    serve_end = None
+    for s in doc.get("stages", ()):
+        if s.get("stage") == "first_serve":
+            end = float(s.get("start") or 0) + float(
+                s.get("duration_s") or 0)
+            if serve_end is None or end > serve_end:
+                serve_end = end
+        lane = lanes.get(s.get("node"))
+        if lane is not None:
+            lane["names"].add(s.get("stage"))
+            lane["stages"] += 1
+    done, missing, nodes_doc = [], [], {}
+    for n in expected:
+        names = lanes[n]["names"]
+        ok = "install" in names and "first_serve" in names
+        (done if ok else missing).append(n)
+        if ok:
+            status = "complete"
+        elif live_set is not None and n not in live_set:
+            status = "abandoned"
+        elif lanes[n]["stages"] == 0:
+            status = "missing"
+        else:
+            status = "open"
+        nodes_doc[n] = {"status": status, "stages": lanes[n]["stages"]}
+    cluster = {"expected": expected, "done": done, "missing": missing,
+               "nodes": nodes_doc}
+    if expected:
+        if not missing and doc.get("outcome") == "complete":
+            doc["outcome"] = "cluster_complete"
+            if serve_end is not None:
+                cluster["propagationMs"] = round(max(
+                    serve_end - float(doc.get("start") or 0), 0) * 1e3, 3)
+        elif missing and doc.get("outcome") == "complete":
+            doc["outcome"] = "published"
+    doc["cluster"] = cluster
+    return doc
+
+
+# publisher-side hook: deploy --plane-publish registers a callable
+# returning {"expected": [subscriber nodes ever seen], "live":
+# [currently connected]} so every lineage read answers with the
+# stitched cluster outcome; None = single-node semantics unchanged
+_cluster_provider = None
+
+
+def set_cluster_provider(fn) -> None:
+    global _cluster_provider
+    _cluster_provider = fn
+
+
+def annotate_cluster(doc: Optional[dict]) -> Optional[dict]:
+    """Apply the registered cluster view to one merged record; no-op
+    when no provider is registered (single-node) or the view is
+    empty."""
+    if doc is None or _cluster_provider is None:
+        return doc
+    try:
+        view = _cluster_provider()
+    except Exception:
+        return doc
+    if view and view.get("expected"):
+        apply_cluster_outcome(doc, view["expected"], view.get("live"))
+    return doc
+
+
 # -- process singleton --------------------------------------------------------
 
 _lineage: Optional[LineageRecorder] = None
@@ -505,6 +700,56 @@ def render_lineage_text(doc: dict, width: int = 44) -> str:
                         dur_ms, bar, attr_txt))
     if not doc.get("stages"):
         lines.append("  (no stages recorded)")
+    return "\n".join(lines) + "\n"
+
+
+def render_lineage_cluster_text(doc: dict, width: int = 44) -> str:
+    """ASCII waterfall of one stitched record with a per-node lane
+    (``pio lineage --cluster``): publisher lane first, then one lane
+    per expected subscriber node, all bars on the shared time axis so
+    a lagging node reads as a right-shifted lane."""
+    cluster = doc.get("cluster") or {}
+    nodes_doc = cluster.get("nodes") or {}
+    total_ms = max(float(doc.get("durationMs") or 0.0), 1e-6)
+    t0 = float(doc.get("start") or 0.0)
+    head = ("generation %s lineage %s: %s in %.1f ms "
+            "(cluster %d/%d nodes%s)"
+            % (doc.get("generation", "?"), doc.get("lid", "?"),
+               doc.get("outcome", "?"), total_ms,
+               len(cluster.get("done") or ()),
+               len(cluster.get("expected") or ()),
+               ", propagation %.1f ms" % cluster["propagationMs"]
+               if cluster.get("propagationMs") is not None else ""))
+    lanes: Dict[Optional[str], List[dict]] = {None: []}
+    for n in nodes_doc:
+        lanes[n] = []
+    for s in doc.get("stages", ()):
+        lanes.setdefault(s.get("node") if s.get("node") in nodes_doc
+                         else None, []).append(s)
+    lines = [head]
+
+    def emit(title: str, stages: List[dict]) -> None:
+        lines.append(title)
+        for s in stages:
+            off_ms = max((float(s.get("start", t0)) - t0) * 1e3, 0.0)
+            dur_ms = float(s.get("duration_s", 0.0)) * 1e3
+            i0 = min(int(off_ms / total_ms * width), width - 1)
+            i1 = min(max(int((off_ms + dur_ms) / total_ms * width),
+                         i0 + 1), width)
+            bar = " " * i0 + "#" * (i1 - i0) + " " * (width - i1)
+            name = (("  " if s.get("parent") else "")
+                    + str(s.get("stage", "?")))
+            lines.append("  %-20s %-14s %9.3f ms |%s|"
+                         % (name[:20], str(s.get("worker", ""))[:14],
+                            dur_ms, bar))
+        if not stages:
+            lines.append("  (no stages recorded)")
+
+    emit("-- publisher (origin %s)" % doc.get("origin", "?"), lanes[None])
+    for n in sorted(nodes_doc):
+        nd = nodes_doc[n]
+        emit("-- node %s [%s, %d stages]"
+             % (n, nd.get("status", "?"), nd.get("stages", 0)), lanes[n])
     return "\n".join(lines) + "\n"
 
 

@@ -64,6 +64,46 @@ DEFAULT_SLOS: Tuple[Dict, ...] = (
      "help": "delta-arena chain length <= 16 (keyframe cadence healthy)"},
 )
 
+# cluster-scope rows, armed only on a replication publisher
+# (``arm_cluster_slos``).  They evaluate over the SAME local sample
+# ring as everything else: the federation layer re-exports its derived
+# signals as local publisher metrics (obs.cluster), and the per-peer
+# repl-lag gauge already lives here — no second evaluation engine.
+CLUSTER_SLOS: Tuple[Dict, ...] = (
+    {"name": "cluster_propagation_p99", "kind": "histogram_quantile",
+     "metric": "pio_cluster_propagation_seconds", "match": "",
+     "q": 0.99, "threshold": 10.0,
+     "help": "append -> LAST node's first_serve p99 <= 10 s, read from "
+             "stitched cluster_complete lineage records"},
+    {"name": "cluster_repl_lag", "kind": "gauge_max",
+     "metric": "pio_plane_repl_lag_generations", "match": "",
+     "threshold": 8.0,
+     "help": "slowest subscriber <= 8 generations behind the publisher"},
+    {"name": "cluster_qps_divergence", "kind": "gauge_max",
+     "metric": "pio_cluster_qps_divergence", "match": "",
+     "threshold": 4.0,
+     "help": "hottest node's serve qps <= 4x the cluster mean "
+             "(load staying balanced)"},
+    {"name": "cluster_p95_divergence", "kind": "gauge_max",
+     "metric": "pio_cluster_p95_divergence", "match": "",
+     "threshold": 4.0,
+     "help": "slowest node's serve p95 <= 4x the cluster mean "
+             "(no straggler node)"},
+)
+
+
+def arm_cluster_slos() -> "SloEngine":
+    """Fold the cluster-scope rows into the process engine (replication
+    publishers call this next to federation start; idempotent) — their
+    verdicts then ride /healthz and pio_slo_burn_rate like any local
+    SLO."""
+    eng = get_engine()
+    have = {s["name"] for s in eng.slos}
+    extra = tuple(s for s in CLUSTER_SLOS if s["name"] not in have)
+    if extra:
+        eng.slos = eng.slos + extra
+    return eng
+
 
 def _env_float(name: str, default: float) -> float:
     try:

@@ -172,11 +172,17 @@ def start_sampler() -> MetricsSampler:
 
 def handle_history_request(handler, path: str) -> bool:
     """Serve /metrics/history.json on any JsonHandler server; returns
-    True when the path was ours."""
+    True when the path was ours.  ``?limit=N`` bounds the sample count
+    — the cluster federation scrapes with a small limit so a round
+    over K nodes moves KBs, not the whole ring."""
     if path != "/metrics/history.json":
         return False
     if not _metrics.get_registry().enabled:
         handler.send_error_json(503, "metrics disabled (PIO_METRICS=off)")
         return True
-    handler.send_json(get_sampler().history())
+    try:
+        limit = int((handler.route[1] or {}).get("limit", "120"))
+    except (ValueError, TypeError):
+        limit = 120
+    handler.send_json(get_sampler().history(limit))
     return True

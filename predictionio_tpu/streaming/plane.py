@@ -579,6 +579,11 @@ class ModelPlane:
             write_arrays(tmp, payload, meta)         # flush+fsync inside
             os.replace(tmp, path)
             size = os.path.getsize(path)
+            # the lineage id rides the manifest too (not just the
+            # container header): replication forwards it in flip/file
+            # frames so subscriber-side repl.* stages stitch under the
+            # publisher's record without composing the container first
+            lin_id = (info or {}).get("lineageId")
             self._write_manifest({
                 "version": 1, "generation": gen, "file": fname,
                 "kind": meta["planeKind"], "bytes": size,
@@ -586,6 +591,7 @@ class ModelPlane:
                 "keyframeGeneration": keyframe_gen,
                 "publisherPid": os.getpid(),
                 "publishedAt": time.time(),
+                **({"lineageId": str(lin_id)} if lin_id else {}),
             })
             self._gc_keyframes[gen] = keyframe_gen
             kept = self._gc(gen)

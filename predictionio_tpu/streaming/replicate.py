@@ -59,6 +59,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from predictionio_tpu.obs import lineage as _obs_lineage
 from predictionio_tpu.obs import metrics as _obs_metrics
 from predictionio_tpu.streaming.plane import (
     REPLICA_KEY,
@@ -198,6 +199,13 @@ def _safe_plane_name(name: str) -> str:
     return base
 
 
+def _manifest_lid(manifest: Optional[Dict[str, Any]]) -> Optional[str]:
+    """The publisher's lineage id riding the manifest; None on
+    pre-lineage manifests (stitching simply stays off for them)."""
+    lid = (manifest or {}).get("lineageId")
+    return str(lid) if lid else None
+
+
 class _Session:
     """One publisher→subscriber connection, owned by its thread."""
 
@@ -206,6 +214,7 @@ class _Session:
         self.addr = addr
         self.node = node
         self.have = int(have)
+        self.http_port = 0           # subscriber's /metrics endpoint
         self.sent_bytes = 0
         self.resyncs = 0
         self.connected_at = time.time()
@@ -226,6 +235,10 @@ class PlaneReplicator:
         self.plane = plane
         self.host, self.port = parse_endpoint(bind)
         self._sessions: Dict[int, _Session] = {}
+        # every subscriber node EVER seen this process lifetime — the
+        # cluster's "expected" set for lineage stitching and the
+        # federation scrape list; disconnect marks, never removes
+        self._peers: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._cur_gen = 0
@@ -301,6 +314,56 @@ class PlaneReplicator:
                 "generation": self._cur_gen,
                 "subscribers": sorted(subs, key=lambda d: d["node"])}
 
+    # -- cluster membership ----------------------------------------------------
+
+    def peers(self) -> Dict[str, Dict[str, Any]]:
+        """Every subscriber node this publisher has ever seen: node →
+        {addr, httpPort, connected, lastSeen}.  The federation layer
+        scrapes this list; lineage stitching uses it as the expected
+        set."""
+        with self._lock:
+            return {n: dict(p) for n, p in self._peers.items()}
+
+    def cluster_view(self) -> Dict[str, Any]:
+        """{"expected", "live"} node-name sets for
+        :func:`~predictionio_tpu.obs.lineage.set_cluster_provider`."""
+        with self._lock:
+            return {"expected": sorted(self._peers),
+                    "live": sorted(n for n, p in self._peers.items()
+                                   if p.get("connected"))}
+
+    def _note_peer(self, sess: _Session, connected: bool = True) -> None:
+        with self._lock:
+            p = self._peers.setdefault(sess.node, {"httpPort": 0})
+            p["addr"] = sess.addr[0]
+            p["lastSeen"] = time.time()
+            p["connected"] = connected
+            if sess.http_port:
+                p["httpPort"] = sess.http_port
+
+    def _ingest_sync(self, sess: _Session, raw: bytes) -> None:
+        """The push half of lineage stitching: a subscriber's sync
+        frames (initial and per-flip ack) carry its recent lineage
+        fragments + HTTP endpoint as the payload.  Old subscribers send
+        an empty payload; a malformed one never kills the session."""
+        if not raw:
+            return
+        try:
+            doc = json.loads(raw)
+            if not isinstance(doc, dict):
+                return
+            port = int(doc.get("httpPort") or 0)
+            if port:
+                sess.http_port = port
+            recs = doc.get("records")
+            if recs:
+                rec = _obs_lineage.get_lineage()
+                if rec.enabled:
+                    rec.ingest(recs, node=sess.node)
+        except Exception:
+            log.debug("plane replication: bad sync payload from %s",
+                      sess.node, exc_info=True)
+
     # -- watch ---------------------------------------------------------------
 
     def _refresh_gen(self) -> None:
@@ -352,10 +415,11 @@ class PlaneReplicator:
             if header.get("type") != "sync":
                 raise ConnectionError(
                     f"expected sync, got {header.get('type')!r}")
-            if plen:
-                _recv_exact(sock, plen)
+            raw = _recv_exact(sock, plen) if plen else b""
             node = str(header.get("node") or node)
             sess = _Session(sock, addr, node, int(header.get("have") or 0))
+            self._ingest_sync(sess, raw)
+            self._note_peer(sess)
             with self._lock:
                 self._session_seq += 1
                 sid = self._session_seq
@@ -380,6 +444,9 @@ class PlaneReplicator:
                 with self._lock:
                     self._sessions.pop(sid, None)
                     _M_RSUBS.set(len(self._sessions))
+                    if node in self._peers:
+                        self._peers[node]["connected"] = False
+                        self._peers[node]["lastSeen"] = time.time()
                 # a dead subscriber's lag series must not linger at its
                 # last value and page someone forever
                 _M_RLAG.remove(node=node)
@@ -437,6 +504,9 @@ class PlaneReplicator:
         subscriber's ack-sync.  Returns the next batch's request reason
         (from that sync)."""
         gen = int(cur["generation"])
+        lid = _manifest_lid(cur)
+        t_plan = time.time()
+        p0 = time.perf_counter()
         try:
             files, resync = self._plan(sess.have, cur, reason)
         except _PlaneCorrupt as e:
@@ -453,8 +523,16 @@ class PlaneReplicator:
             _M_RESYNC.inc(reason=resync)
             log.info("plane replication: re-syncing %s from keyframe "
                      "(%s, %d files)", sess.node, resync, len(files))
+        if lid:
+            lin = _obs_lineage.get_lineage()
+            if lin.enabled:
+                lin.stage(lid, "repl.plan", start=t_plan,
+                          duration_s=time.perf_counter() - p0,
+                          generation=gen, peer=sess.node,
+                          files=len(files),
+                          resync=resync or "incremental")
         for nm in files:
-            if not self._send_file(sess, nm):
+            if not self._send_file(sess, nm, lid):
                 # vanished mid-plan (GC race): re-plan from the live
                 # manifest on the next loop turn
                 return "lag"
@@ -464,16 +542,20 @@ class PlaneReplicator:
         if header.get("type") != "sync":
             raise ConnectionError(
                 f"expected ack sync, got {header.get('type')!r}")
-        if plen:
-            _recv_exact(sess.sock, plen)
+        raw = _recv_exact(sess.sock, plen) if plen else b""
+        self._ingest_sync(sess, raw)
+        self._note_peer(sess)
         sess.have = int(header.get("have") or 0)
         _M_RLAG.set(max(self._cur_gen - sess.have, 0), node=sess.node)
         return str(header.get("reason") or "ack")
 
-    def _send_file(self, sess: _Session, name: str) -> bool:
+    def _send_file(self, sess: _Session, name: str,
+                   lid: Optional[str] = None) -> bool:
         """Hash-then-stream one container from a single open fd (GC may
         unlink the path mid-send; the fd keeps the bytes).  False when
-        the file is already gone."""
+        the file is already gone.  ``lid`` rides the frame header so the
+        subscriber can open its ``repl.recv`` stage under the
+        publisher's lineage id before the flip arrives."""
         chunk = repl_chunk_bytes()
         try:
             f = open(os.path.join(self.plane.dir, name), "rb")
@@ -489,10 +571,13 @@ class PlaneReplicator:
                 h.update(b)
                 size += len(b)
             kind = "delta" if name.endswith(".delta") else "full"
-            _send_frame(sess.sock, {
+            hdr = {
                 "type": "file", "name": name, "gen": _gen_of(name),
                 "bytes": size, "sha256": h.hexdigest(), "kind": kind,
-            }, payload_len=size)
+            }
+            if lid:
+                hdr["lid"] = lid
+            _send_frame(sess.sock, hdr, payload_len=size)
             f.seek(0)
             left = size
             while left:
@@ -522,7 +607,12 @@ class PlaneSubscriber:
         self.source = source
         self.host, self.port = parse_endpoint(source,
                                               default_host="127.0.0.1")
-        self.node = node or f"{socket.gethostname()}-{os.getpid()}"
+        self.node = (node or _obs_lineage.cluster_node()
+                     or f"{socket.gethostname()}-{os.getpid()}")
+        # this node's serving HTTP port, announced in every sync frame
+        # so the publisher's federation layer can scrape /metrics and
+        # /lineage here; 0 = not serving (bare subscriber in tests)
+        self.http_port = 0
         self.generation = 0          # last flipped locally
         self.source_generation = 0   # publisher's, from pings/flips
         self.resyncs = 0
@@ -641,8 +731,7 @@ class PlaneSubscriber:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # recv must outlive the publisher's ping cadence comfortably
             sock.settimeout(max(repl_timeout_s(), ping_s * 3))
-            _send_frame(sock, {"type": "sync", "have": self.generation,
-                               "node": self.node, "reason": reason})
+            self._send_sync(sock, reason)
             self.connected = True
             log.info("plane replication: subscribed to %s (have=%d, %s)",
                      self.source, self.generation, reason)
@@ -671,9 +760,7 @@ class PlaneSubscriber:
                         reason = "torn"
                     torn = None
                     self._note_lag()
-                    _send_frame(sock, {
-                        "type": "sync", "have": self.generation,
-                        "node": self.node, "reason": reason})
+                    self._send_sync(sock, reason)
                 elif typ == "error":
                     raise ConnectionError(
                         f"publisher error: {header.get('msg')}")
@@ -691,6 +778,42 @@ class PlaneSubscriber:
         _M_RLAG.set(max(self.source_generation - self.generation, 0),
                     node=self.node)
 
+    def _send_sync(self, sock: socket.socket, reason: str) -> None:
+        """Sync frame (initial and per-flip ack) with the stitching
+        push-payload: this node's recent lineage fragments + HTTP
+        endpoint.  Publishers predating stitching drain and discard the
+        payload — the wire format always carried a payload length — so
+        this is backward compatible in both directions."""
+        payload = b""
+        try:
+            doc: Dict[str, Any] = {"node": self.node,
+                                   "httpPort": int(self.http_port)}
+            rec = _obs_lineage.get_lineage()
+            if rec.enabled:
+                doc["records"] = rec.export()
+            payload = json.dumps(doc, separators=(",", ":")).encode()
+        except Exception:
+            payload = b""
+        _send_frame(sock, {"type": "sync", "have": self.generation,
+                           "node": self.node, "reason": reason},
+                    payload_len=len(payload))
+        if payload:
+            sock.sendall(payload)
+
+    def _repl_stage(self, lid: Any, name: str, **kw: Any) -> None:
+        """One replication stage under the publisher's lineage id.
+        ``node=`` is passed explicitly (not left to env stamping):
+        in-process tests run several subscribers in one process."""
+        if not lid:
+            return
+        try:
+            rec = _obs_lineage.get_lineage()
+            if rec.enabled:
+                rec.stage(str(lid), name, node=self.node, **kw)
+        except Exception:
+            log.debug("plane replication: lineage stage %s failed",
+                      name, exc_info=True)
+
     def _land_file(self, sock: socket.socket, header: Dict[str, Any],
                    plen: int) -> Tuple[str, bool]:
         """Stream one container to ``.<name>.tmp-<pid>`` while hashing;
@@ -701,6 +824,8 @@ class PlaneSubscriber:
         kind = "delta" if name.endswith(".delta") else "full"
         os.makedirs(self.plane.dir, exist_ok=True)
         tmp = os.path.join(self.plane.dir, f".{name}.tmp-{os.getpid()}")
+        t_recv = time.time()
+        r0 = time.perf_counter()
         h = hashlib.sha256()
         left = plen
         chunk = repl_chunk_bytes()
@@ -715,6 +840,11 @@ class PlaneSubscriber:
             f.flush()
             os.fsync(f.fileno())
         _M_RBYTES.inc(plen, dir="in", kind=kind)
+        torn_flag = 0 if h.hexdigest() == want_sha else 1
+        self._repl_stage(header.get("lid"), "repl.recv", start=t_recv,
+                         duration_s=time.perf_counter() - r0,
+                         generation=_gen_of(name), kind=kind,
+                         bytes=plen, torn=torn_flag)
         if h.hexdigest() != want_sha:
             # torn transfer: keep the evidence out-of-band, never flip it
             qpath = os.path.join(self.plane.dir, name + ".quarantine")
@@ -739,16 +869,24 @@ class PlaneSubscriber:
                 or "file" not in manifest:
             raise ConnectionError("flip without a usable manifest")
         gen = int(manifest["generation"])
+        lid = _manifest_lid(manifest)
+        t_ver = time.time()
+        v0 = time.perf_counter()
         try:
             self.plane.chain_files(str(manifest["file"]))
         except _PlaneCorrupt as e:
             log.warning("plane replication: not flipping to generation "
                         "%d — chain incomplete locally (%s)", gen, e)
             return False
+        self._repl_stage(lid, "repl.verify", start=t_ver,
+                         duration_s=time.perf_counter() - v0,
+                         generation=gen)
         doc = dict(manifest)
         doc[REPLICA_KEY] = self.source
         doc["publisherPid"] = os.getpid()
         doc["replicatedAt"] = time.time()
+        t_land = time.time()
+        l0 = time.perf_counter()
         with self.plane._publish_lock():
             local = self.plane.current()
             if local is not None and REPLICA_KEY not in local \
@@ -762,6 +900,18 @@ class PlaneSubscriber:
             self.plane._gc(gen)
         self.generation = gen
         self.last_flip_at = time.time()
+        # repl.land is the publish-equivalent marker on a subscriber:
+        # lineage supersession closes pre-resync records against it
+        self._repl_stage(lid, "repl.land", start=t_land,
+                         duration_s=time.perf_counter() - l0,
+                         generation=gen, flush=True)
+        if lid:
+            try:
+                rec = _obs_lineage.get_lineage()
+                if rec.enabled:
+                    rec.note_generation(str(lid), gen)
+            except Exception:
+                pass
         with self._flip_cond:
             self._flip_cond.notify_all()
         log.info("plane replication: generation %d live locally (%s)",

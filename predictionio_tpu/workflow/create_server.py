@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional
 
 from predictionio_tpu.api import prefork
 from predictionio_tpu.api.http_util import JsonHandler, start_server
+from predictionio_tpu.obs import cluster as obs_cluster
 from predictionio_tpu.obs import lineage as obs_lineage
 from predictionio_tpu.obs import metrics as obs_metrics
 from predictionio_tpu.obs import slo as obs_slo
@@ -505,6 +506,9 @@ class QueryServerState:
             except Exception:
                 log.exception("plane replication stop failed")
             self.replication = None
+            # publisher-side cluster observability dies with replication
+            obs_lineage.set_cluster_provider(None)
+            obs_cluster.set_federation(None)
         if self.plane_watcher is not None:
             self.plane_watcher.stop()
 
@@ -790,6 +794,8 @@ def make_handler(state: QueryServerState):
                 pass   # /lineage.json + /lineage/{gen|ln-id}.json
             elif obs_tsdb.handle_history_request(self, path):
                 pass   # /metrics/history.json (local time-series ring)
+            elif obs_cluster.handle_cluster_request(self, path):
+                pass   # /cluster/{metrics,history}.json (publisher only)
             elif obs_slo.handle_healthz_request(self, path):
                 pass   # /healthz (SLO burn-rate verdicts, always 200)
             elif path == "/stats.json":
@@ -925,6 +931,20 @@ def deploy(
         raise ValueError(
             "deploy cannot be a replication subscriber and publisher at "
             "once (relaying is not supported)")
+    if (plane_from or plane_publish) \
+            and not os.environ.get("PIO_CLUSTER_NODE"):
+        # multi-node deployment: every lineage stage this node records
+        # is SOURCE-stamped with a node name (obs.lineage reads the env
+        # lazily) so cross-node stitching attributes per-node lanes
+        # without guessing; set BEFORE the serving state exists so the
+        # install/first_serve stages carry it, and prefork children
+        # inherit it via os.environ.  Operators/CI set it explicitly for
+        # stable names across restarts.
+        import socket as _socket
+
+        role = "sub" if plane_from else "pub"
+        os.environ["PIO_CLUSTER_NODE"] = \
+            f"{_socket.gethostname()}-{role}-{os.getpid()}"
     if workers > 1:
         import jax
 
@@ -1077,8 +1097,10 @@ def deploy(
         from predictionio_tpu.streaming.replicate import PlaneSubscriber
 
         sub = PlaneSubscriber(state.plane.dir, plane_from)
-        sub.start()
         state.replication = sub
+        # started below once the HTTP port is bound: every sync frame
+        # then announces this node's endpoint, so the publisher's
+        # federation can scrape /metrics and pull /lineage here
     child_procs: list = []
     # flight recorder: prefork children resolve the group's traces dir
     # from PIO_METRICS_DIR; single workers persist next to the storage
@@ -1094,6 +1116,21 @@ def deploy(
                          background=background,
                          reuse_port=workers > 1 or reuse_port)
     bound_port = httpd.server_address[1]
+    if plane_from is not None and state.replication is not None:
+        state.replication.http_port = bound_port
+        state.replication.start()
+    elif plane_publish is not None and state.replication is not None:
+        # cluster observability fabric (publisher only): lineage reads
+        # answer with the stitched cross-node outcome, the federation
+        # thread scrapes every subscriber's metrics/lineage, and the
+        # cluster-scope SLO rows ride /healthz like any local SLO
+        repl = state.replication
+        obs_lineage.set_cluster_provider(repl.cluster_view)
+        if obs_metrics.get_registry().enabled:
+            fed = obs_cluster.ClusterFederation(repl.peers)
+            fed.start()
+            obs_cluster.set_federation(fed)
+            obs_slo.arm_cluster_slos()
     if workers > 1:
         obs_tracing.arm(directory=os.path.join(metrics_dir, "traces"),
                         tag=f"w0-{os.getpid()}")

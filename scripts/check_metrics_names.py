@@ -59,6 +59,7 @@ INSTRUMENTED_MODULES = [
     "predictionio_tpu.obs.lineage",
     "predictionio_tpu.obs.tsdb",
     "predictionio_tpu.obs.slo",
+    "predictionio_tpu.obs.cluster",
 ]
 
 
@@ -150,6 +151,15 @@ REQUIRED_METRICS = frozenset({
     "pio_plane_repl_lag_generations",
     "pio_plane_repl_subscribers",
     "pio_plane_repl_resyncs_total",
+    # cluster observability fabric (PR 20): fleet dashboards key on the
+    # federated liveness gauges; the cluster SLOs read the propagation
+    # histogram (stitched lineage truth) and the divergence gauges
+    "pio_cluster_nodes",
+    "pio_cluster_node_up",
+    "pio_cluster_scrapes_total",
+    "pio_cluster_propagation_seconds",
+    "pio_cluster_qps_divergence",
+    "pio_cluster_p95_divergence",
 })
 
 SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})
@@ -157,7 +167,8 @@ SPAN_CALL_NAMES = frozenset({"span", "trace_span", "timed", "add_span"})
 # lineage id); their attr kwargs follow the same naming contract
 STAGE_CALL_NAMES = frozenset({"stage"})
 # control kwargs, not attr names
-_EXEMPT_KWARGS = ("parent", "attrs", "start", "duration_s", "flush")
+_EXEMPT_KWARGS = ("parent", "attrs", "start", "duration_s", "flush",
+                  "node")
 # span attrs assigned post-hoc (rec["attrs"] = {...}) use literal dict
 # keys; f-string keys (dynamic stage suffixes) are checked on their
 # literal prefix parts only

@@ -22,6 +22,13 @@ Asserts over plain HTTP:
   folded generation (the lineage dir is shared via the common store, so
   each node's merged view spans the publisher's fold/publish stages and
   every node's install/first_serve hops);
+- the PUBLISHER's stitched record reaches ``cluster_complete``: every
+  expected subscriber node's lane (repl.recv → repl.land → install →
+  first_serve) is present with monotone stage starts, and the record
+  carries ``cluster.propagationMs``;
+- the federation view (``/cluster/metrics.json``, publisher-only)
+  reports BOTH subscriber nodes up; after the kill below the dead node
+  stays listed at ``up: false`` instead of vanishing;
 - freshness reports the replication role on both sides: the publisher
   lists both subscriber sessions at lag 0, each subscriber reports
   role=subscriber, connected, lag 0;
@@ -167,6 +174,8 @@ def main() -> int:
             "PIO_PLANE_REPL_PING_S": "0.5",
             "PIO_PLANE_REPL_BACKOFF_S": "0.2",
             "PIO_METRICS_FLUSH_S": "0.25",
+            "PIO_CLUSTER_SCRAPE_S": "0.25",
+            "PIO_CLUSTER_SCRAPE_TIMEOUT_S": "2",
             # this process appends the live-fold events, so the serving
             # nodes never see notify_append: a per-node history cache
             # would hold per-node-staleness user histories and break the
@@ -182,7 +191,12 @@ def main() -> int:
                  "deploy", "--engine-json", engine_json,
                  "--ip", "127.0.0.1", "--port", str(port)] + extra_args,
                 env={**base_env,
-                     "PIO_MODEL_PLANE_DIR": os.path.join(tmp, plane_dir)})
+                     "PIO_MODEL_PLANE_DIR": os.path.join(tmp, plane_dir),
+                     # a STABLE cluster-node name per logical node: the
+                     # restarted subB must rejoin under the same lane,
+                     # not appear as a fourth node (the default stamp is
+                     # pid-suffixed)
+                     "PIO_CLUSTER_NODE": f"node-{name}"})
             bases[name] = f"http://127.0.0.1:{port}"
             return port
 
@@ -272,6 +286,86 @@ def main() -> int:
                     f"{sub}: install recorded by {sorted(installs)} — "
                     "expected the publisher and both subscriber nodes")
 
+        # -- the publisher's STITCHED record: cluster_complete with a
+        #    monotone per-node lane (repl.recv -> repl.land -> install
+        #    -> first_serve) for BOTH subscriber nodes -------------------
+        LANE_ORDER = ("repl.recv", "repl.land", "install", "first_serve")
+        doc = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st, d = get_json(bases["pub"], f"/lineage/{gen}.json")
+            if st == 200:
+                doc = d
+                if d.get("outcome") == "cluster_complete":
+                    break
+            time.sleep(0.25)
+        if doc is None or doc.get("outcome") != "cluster_complete":
+            problems.append(
+                f"pub: generation {gen} stitched record outcome="
+                f"{(doc or {}).get('outcome')!r}, expected "
+                f"'cluster_complete' (cluster="
+                f"{(doc or {}).get('cluster')!r})")
+        else:
+            cl = doc.get("cluster") or {}
+            if sorted(cl.get("expected") or []) != \
+                    ["node-subA", "node-subB"]:
+                problems.append(
+                    f"pub: stitched record expects {cl.get('expected')}, "
+                    "wanted both subscriber nodes")
+            if not cl.get("propagationMs"):
+                problems.append(
+                    f"pub: cluster_complete record without "
+                    f"propagationMs: {cl!r}")
+            for node in ("node-subA", "node-subB"):
+                starts = {}
+                for s in doc.get("stages", ()):
+                    if s.get("node") == node and \
+                            s.get("stage") in LANE_ORDER:
+                        starts.setdefault(s["stage"],
+                                          float(s.get("start") or 0))
+                missing = [n for n in LANE_ORDER if n not in starts]
+                if missing:
+                    problems.append(
+                        f"pub: stitched lane for {node} missing "
+                        f"{missing} (has {sorted(starts)})")
+                    continue
+                seq = [starts[n] for n in LANE_ORDER]
+                if seq != sorted(seq):
+                    problems.append(
+                        f"pub: {node} lane stage starts not monotone: "
+                        + ", ".join(f"{n}={starts[n]:.6f}"
+                                    for n in LANE_ORDER))
+
+        # -- federation: every subscriber node up on the publisher -------
+        cl_doc = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st, d = get_json(bases["pub"], "/cluster/metrics.json")
+            if st == 200:
+                cl_doc = d
+                nodes = d.get("nodes") or {}
+                # the scraped view lags by one tsdb sample: wait for
+                # up-ness AND the converged generation to show through
+                if len(nodes) >= 2 and all(
+                        n.get("up") and n.get("generation") == gen
+                        for n in nodes.values()):
+                    break
+            time.sleep(0.25)
+        nodes = (cl_doc or {}).get("nodes") or {}
+        if sorted(nodes) != ["node-subA", "node-subB"]:
+            problems.append(
+                f"pub /cluster/metrics.json lists {sorted(nodes)}, "
+                "expected both subscriber nodes")
+        for nm, st_ in nodes.items():
+            if not st_.get("up"):
+                problems.append(
+                    f"pub /cluster/metrics.json: {nm} not up: "
+                    f"{st_.get('error')!r}")
+            elif st_.get("generation") != gen:
+                problems.append(
+                    f"pub /cluster/metrics.json: {nm} at generation "
+                    f"{st_.get('generation')}, cluster is at {gen}")
+
         # -- freshness reports the replication role ----------------------
         _, stats = get_json(bases["pub"], "/stats.json")
         rep = (stats.get("freshness") or {}).get("replication") or {}
@@ -304,6 +398,22 @@ def main() -> int:
             + [buy(f"cob{j}", "i2") for j in range(6)], app_id)
         gen2 = wait_generation(bases["pub"], gen + 1, CONVERGE_S, "pub")
         wait_generation(bases["subA"], gen2, CONVERGE_S, "subA")
+        # the dead node must stay LISTED at up=false, not vanish
+        deadline = time.time() + 20
+        dead_seen = False
+        while time.time() < deadline:
+            st, d = get_json(bases["pub"], "/cluster/metrics.json")
+            nodes = (d or {}).get("nodes") or {}
+            if st == 200 and "node-subB" in nodes \
+                    and not nodes["node-subB"].get("up"):
+                dead_seen = True
+                break
+            time.sleep(0.25)
+        if not dead_seen:
+            problems.append(
+                "pub /cluster/metrics.json never reported the killed "
+                "node-subB as up=false (it must stay visible, stale-"
+                f"flagged): {sorted(nodes)}")
         # restart B on the SAME plane dir + port: its first sync frame
         # must carry have=<last flipped generation> (resume, not cold)
         portB = int(bases["subB"].rsplit(":", 1)[1])
@@ -313,7 +423,8 @@ def main() -> int:
              "--ip", "127.0.0.1", "--port", str(portB),
              "--plane-from", f"127.0.0.1:{repl_port}"],
             env={**base_env,
-                 "PIO_MODEL_PLANE_DIR": os.path.join(tmp, "plane-subB")})
+                 "PIO_MODEL_PLANE_DIR": os.path.join(tmp, "plane-subB"),
+                 "PIO_CLUSTER_NODE": "node-subB"})
         # settle on the publisher's CURRENT generation (folds may have
         # ticked during the restart), then re-assert parity everywhere
         gen2 = wait_generation(bases["pub"], gen2, 10, "pub")
@@ -362,9 +473,12 @@ def main() -> int:
         print(f"FAIL {p}", file=sys.stderr)
     if not problems:
         print("ok: publisher + 2 subscribers converged (live folds, "
-              "complete lineage on both subscriber nodes, byte-equal "
-              "responses), SIGKILLed subscriber resumed from its "
-              "last-acked generation with zero staleness")
+              "complete lineage on both subscriber nodes, stitched "
+              "cluster_complete record with monotone per-node lanes, "
+              "federation reporting every node up, byte-equal "
+              "responses), SIGKILLed subscriber stayed visible as "
+              "up=false and resumed from its last-acked generation "
+              "with zero staleness")
     return 1 if problems else 0
 
 

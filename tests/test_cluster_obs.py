@@ -1,0 +1,407 @@
+"""Cluster observability (ISSUE 20): cross-node lineage stitching,
+metrics federation, and cluster SLOs.
+
+The unit of observation is the CLUSTER: one stitched lineage record per
+generation must span the publisher's fold/publish stages AND every
+subscriber node's repl.*/install/first_serve lanes, reaching
+``cluster_complete`` only when all expected nodes installed and served.
+Federation keeps a dead node visible (``up: false``) instead of
+dropping it, and the cluster SLO rows ride the same burn-rate engine as
+the local ones.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.obs import lineage as obs_lineage
+from predictionio_tpu.obs.cluster import ClusterFederation, _divergence
+from predictionio_tpu.obs.lineage import (
+    LineageRecorder,
+    apply_cluster_outcome,
+    merge_records,
+    render_lineage_cluster_text,
+)
+from predictionio_tpu.obs.slo import (
+    CLUSTER_SLOS,
+    SloEngine,
+    arm_cluster_slos,
+    get_engine,
+    set_engine,
+)
+
+from test_plane_replication import (  # noqa: F401 - fixtures ride along
+    _publisher,
+    fast_repl,
+    host_serving,
+)
+
+
+def _frag(lid, start, stages, outcome=None, generation=None):
+    doc = {"lid": lid, "start": start, "stages": stages}
+    if outcome:
+        doc["outcome"] = outcome
+    if generation is not None:
+        doc["generation"] = generation
+    return doc
+
+
+def _stage(name, start, worker="w", node=None, duration_s=0.01):
+    s = {"stage": name, "start": start, "duration_s": duration_s,
+         "worker": worker}
+    if node:
+        s["node"] = node
+    return s
+
+
+def _node_lane(lid, start, node, worker=None):
+    """A subscriber node's full lane: recv → verify → land → install →
+    first_serve."""
+    w = worker or node
+    return [
+        _stage("repl.recv", start, w, node),
+        _stage("repl.verify", start + 0.05, w, node),
+        _stage("repl.land", start + 0.1, w, node),
+        _stage("install", start + 0.2, w, node),
+        _stage("first_serve", start + 0.3, w, node),
+    ]
+
+
+def _origin_frag(lid, start=100.0):
+    return _frag(lid, start,
+                 [_stage("append_observed", start, "pub"),
+                  _stage("publish", start + 0.5, "pub"),
+                  _stage("install", start + 0.6, "pub-w"),
+                  _stage("first_serve", start + 0.7, "pub-w")],
+                 outcome="published", generation=7)
+
+
+# -- stitched outcome semantics ----------------------------------------------
+
+
+class TestClusterOutcome:
+    def test_all_nodes_complete_is_cluster_complete(self):
+        doc = merge_records([
+            _origin_frag("ln-a"),
+            _frag("ln-a", 100.0, _node_lane("ln-a", 101.0, "node-a")),
+            _frag("ln-a", 100.0, _node_lane("ln-a", 102.0, "node-b")),
+        ])[0]
+        apply_cluster_outcome(doc, ["node-a", "node-b"],
+                              live=["node-a", "node-b"])
+        assert doc["outcome"] == "cluster_complete"
+        cl = doc["cluster"]
+        assert cl["done"] == ["node-a", "node-b"] and not cl["missing"]
+        assert cl["nodes"]["node-a"]["status"] == "complete"
+        # propagation = record start → LAST node's first_serve end
+        assert cl["propagationMs"] == pytest.approx(
+            (102.3 + 0.01 - 100.0) * 1e3, abs=1.0)
+
+    def test_one_lagging_node_demotes_to_published(self):
+        lane_b = _node_lane("ln-b", 102.0, "node-b")[:3]  # landed, no serve
+        doc = merge_records([
+            _origin_frag("ln-b"),
+            _frag("ln-b", 100.0, _node_lane("ln-b", 101.0, "node-a")),
+            _frag("ln-b", 100.0, lane_b),
+        ])[0]
+        apply_cluster_outcome(doc, ["node-a", "node-b"],
+                              live=["node-a", "node-b"])
+        assert doc["outcome"] == "published"      # cluster not done
+        cl = doc["cluster"]
+        assert cl["missing"] == ["node-b"]
+        assert cl["nodes"]["node-b"]["status"] == "open"   # still live
+        assert "propagationMs" not in cl
+
+    def test_dead_node_lane_is_abandoned_never_seen_is_missing(self):
+        doc = merge_records([
+            _origin_frag("ln-c"),
+            _frag("ln-c", 100.0,
+                  _node_lane("ln-c", 101.0, "node-a")[:2]),
+        ])[0]
+        apply_cluster_outcome(doc, ["node-a", "node-b"], live=[])
+        assert doc["cluster"]["nodes"]["node-a"]["status"] == "abandoned"
+        assert doc["cluster"]["nodes"]["node-b"]["status"] == "abandoned"
+        doc2 = merge_records([
+            _origin_frag("ln-d"),
+            _frag("ln-d", 100.0, _node_lane("ln-d", 101.0, "node-a")),
+        ])[0]
+        apply_cluster_outcome(doc2, ["node-a", "node-b"])  # no live view
+        assert doc2["cluster"]["nodes"]["node-b"]["status"] == "missing"
+
+    def test_no_expected_nodes_leaves_single_node_semantics(self):
+        doc = merge_records([_origin_frag("ln-e")])[0]
+        apply_cluster_outcome(doc, [])
+        assert doc["outcome"] == "complete"       # unchanged
+
+    def test_cluster_waterfall_renders_per_node_lanes(self):
+        doc = merge_records([
+            _origin_frag("ln-f"),
+            _frag("ln-f", 100.0, _node_lane("ln-f", 101.0, "node-a")),
+        ])[0]
+        apply_cluster_outcome(doc, ["node-a", "node-b"],
+                              live=["node-a"])
+        text = render_lineage_cluster_text(doc)
+        assert "node node-a" in text and "node node-b" in text
+        assert "publisher" in text
+        assert "repl.land" in text and "first_serve" in text
+
+
+class TestOrphanSupersession:
+    def test_repl_land_supersedes_cut_short_transfer(self):
+        """Satellite bugfix: a subscriber record whose transfer was cut
+        short (repl.recv, no land) goes ``abandoned`` as soon as a newer
+        generation LANDS — repl.land is the subscriber's publish-
+        equivalent marker, so post-resync orphans leak nothing."""
+        recs = merge_records([
+            _frag("ln-cut", 10.0,
+                  [_stage("repl.recv", 10.0, "sub", "node-a")]),
+            _frag("ln-next", 20.0,
+                  [_stage("repl.recv", 20.0, "sub", "node-a"),
+                   _stage("repl.land", 20.2, "sub", "node-a")]),
+        ])
+        by = {r["lid"]: r for r in recs}
+        assert by["ln-cut"]["outcome"] == "abandoned"
+        assert by["ln-next"]["outcome"] == "published"
+
+
+# -- the real drill: wire-level stitching + a killed subscriber ---------------
+
+
+class TestStitchedDrill:
+    def _arm(self, tmp_path):
+        rec = LineageRecorder(directory=tmp_path / "lineage",
+                              tag="drill", enabled=True)
+        obs_lineage.set_lineage(rec)
+        return rec
+
+    def _publish_gen(self, rec, pub, model, lid=None):
+        lid = lid or rec.new_id()
+        t0 = time.time()
+        rec.begin(lid, start=t0)
+        rec.stage(lid, "append_observed", start=t0, node="pub-node")
+        pub.publish([model], {"mode": "test", "lineageId": lid})
+        rec.stage(lid, "publish", start=time.time(), node="pub-node")
+        rec.close(lid, "published")
+        return lid
+
+    def _serve_lane(self, rec, lid, node):
+        """The serve half a deploy would stamp (install + first_serve
+        carry the node from PIO_CLUSTER_NODE there; explicit here)."""
+        rec.stage(lid, "install", node=node, flush=True)
+        rec.stage(lid, "first_serve", node=node, flush=True)
+
+    def test_killed_subscriber_lane_abandoned_record_survives(
+            self, mem_storage, host_serving, fast_repl, tmp_path):
+        from predictionio_tpu.streaming.replicate import (
+            PlaneReplicator, PlaneSubscriber,
+        )
+
+        rec = self._arm(tmp_path)
+        try:
+            pub, model, _algo = _publisher(tmp_path, mem_storage,
+                                           n_gens=0)
+            lid1 = self._publish_gen(rec, pub, model)
+            repl = PlaneReplicator(pub, bind="127.0.0.1:0")
+            repl.start()
+            sub_a = PlaneSubscriber(str(tmp_path / "sub-a"),
+                                    f"127.0.0.1:{repl.port}",
+                                    node="node-a")
+            sub_b = PlaneSubscriber(str(tmp_path / "sub-b"),
+                                    f"127.0.0.1:{repl.port}",
+                                    node="node-b")
+            sub_a.start()
+            sub_b.start()
+            try:
+                assert sub_a.wait_generation(1, timeout=20)
+                assert sub_b.wait_generation(1, timeout=20)
+                view = repl.cluster_view()
+                assert sorted(view["expected"]) == ["node-a", "node-b"]
+                self._serve_lane(rec, lid1, "node-a")
+                self._serve_lane(rec, lid1, "node-b")
+                doc = rec.get(lid1)
+                obs_lineage.apply_cluster_outcome(
+                    doc, view["expected"], view["live"])
+                assert doc["outcome"] == "cluster_complete"
+                # the repl.* stages came over the REAL ack channel,
+                # source-stamped by each subscriber
+                for node in ("node-a", "node-b"):
+                    names = {s["stage"] for s in doc["stages"]
+                             if s.get("node") == node}
+                    assert {"repl.recv", "repl.land", "install",
+                            "first_serve"} <= names
+                assert doc["cluster"]["propagationMs"] > 0
+
+                # -- kill node-b, publish again: its lane must read
+                #    abandoned while the cluster record survives
+                sub_b.stop()
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    if "node-b" not in repl.cluster_view()["live"]:
+                        break
+                    time.sleep(0.05)
+                lid2 = self._publish_gen(rec, pub, model)
+                assert sub_a.wait_generation(2, timeout=20)
+                # let node-a's ack (carrying its repl.* stages) land
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    d = rec.get(lid2)
+                    names_a = {s["stage"] for s in d["stages"]
+                               if s.get("node") == "node-a"}
+                    if "repl.land" in names_a:
+                        break
+                    time.sleep(0.05)
+                self._serve_lane(rec, lid2, "node-a")
+                view = repl.cluster_view()
+                assert sorted(view["expected"]) == ["node-a", "node-b"]
+                assert view["live"] == ["node-a"]
+                doc2 = rec.get(lid2)
+                obs_lineage.apply_cluster_outcome(
+                    doc2, view["expected"], view["live"])
+                assert doc2["outcome"] == "published"   # not complete
+                assert doc2["cluster"]["nodes"]["node-a"]["status"] == \
+                    "complete"
+                assert doc2["cluster"]["nodes"]["node-b"]["status"] == \
+                    "abandoned"
+            finally:
+                sub_a.stop()
+                sub_b.stop()
+                repl.stop()
+        finally:
+            obs_lineage.set_lineage(None)
+
+
+# -- metrics federation -------------------------------------------------------
+
+
+def _history_body(generation=5, lag=0.0, reqs=(100.0, 200.0)):
+    def sample(t, total):
+        return {"t": t, "m": {
+            "pio_model_plane_generation": {
+                "type": "gauge",
+                "series": {'worker="w"': float(generation)}},
+            "pio_plane_repl_lag_generations": {
+                "type": "gauge", "series": {'node="x"': float(lag)}},
+            "pio_http_requests_total": {
+                "type": "counter",
+                "series": {'route="/queries.json",status="200"': total}},
+        }}
+    return {"worker": "w", "intervalSeconds": 5.0, "buckets": {},
+            "samples": [sample(1000.0, reqs[0]), sample(1010.0, reqs[1])]}
+
+
+class _NodeHandler(http.server.BaseHTTPRequestHandler):
+    body = _history_body()
+
+    def do_GET(self):
+        if self.path.startswith("/metrics/history.json"):
+            payload = json.dumps(type(self).body).encode()
+        elif self.path == "/lineage.json":
+            payload = json.dumps({"records": []}).encode()
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def node_server():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _NodeHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestFederation:
+    def test_down_node_stays_visible_as_stale(self, node_server):
+        with socket.socket() as s:      # a port nothing listens on
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        peers = {
+            "good": {"addr": "127.0.0.1", "httpPort": node_server,
+                     "connected": True},
+            "dead": {"addr": "127.0.0.1", "httpPort": dead_port,
+                     "connected": False},
+            "shy": {"addr": "127.0.0.1", "httpPort": 0,
+                    "connected": True},
+        }
+        fed = ClusterFederation(lambda: peers, interval=60.0,
+                                timeout=0.5)
+        fed.scrape_once()
+        fed.scrape_once()
+        doc = fed.metrics_doc()
+        nodes = doc["nodes"]
+        # every peer reported — the down ones flagged, never dropped
+        assert set(nodes) == {"good", "dead", "shy"}
+        good = nodes["good"]
+        assert good["up"] is True and good["error"] is None
+        assert good["generation"] == 5
+        assert good["qps"] == pytest.approx(10.0, abs=0.01)
+        assert good["staleSeconds"] == pytest.approx(0.0, abs=5.0)
+        dead = nodes["dead"]
+        assert dead["up"] is False and dead["error"]
+        shy = nodes["shy"]
+        assert shy["up"] is False
+        assert "no HTTP endpoint" in shy["error"]
+        hist = fed.history_doc()
+        assert len(hist["samples"]) == 2
+        assert set(hist["samples"][-1]["nodes"]) == \
+            {"good", "dead", "shy"}
+
+    def test_divergence_math(self):
+        assert _divergence([10.0, 10.0]) == 1.0
+        assert _divergence([30.0, 10.0, 20.0]) == pytest.approx(1.5)
+        assert _divergence([10.0]) == 1.0          # one node: no skew
+        assert _divergence([None, 0.0]) == 1.0     # nothing flows
+
+
+# -- cluster SLOs -------------------------------------------------------------
+
+
+def _lag_sample(t, lag):
+    return {"t": t, "m": {"pio_plane_repl_lag_generations": {
+        "type": "gauge", "series": {'node="sub-1"': float(lag)}}}}
+
+
+class TestClusterSlos:
+    def test_repl_lag_burning_then_ok(self):
+        eng = SloEngine(CLUSTER_SLOS)
+        base = 1_000_000.0
+        hot = [_lag_sample(base + i * 10, 20.0) for i in range(8)]
+        doc = eng.evaluate(hot, {})
+        v = doc["slos"]["cluster_repl_lag"]
+        assert v["verdict"] == "burning"
+        assert v["lastValue"] == 20.0
+        cool = [_lag_sample(base + i * 10, 1.0) for i in range(8)]
+        doc = eng.evaluate(cool, {})
+        assert doc["slos"]["cluster_repl_lag"]["verdict"] == "ok"
+        # divergence rows are quiet until the gauges exist
+        assert doc["slos"]["cluster_qps_divergence"]["verdict"] == \
+            "no_data"
+
+    def test_arm_cluster_slos_is_idempotent(self):
+        set_engine(None)
+        try:
+            n0 = len(get_engine().slos)
+            eng = arm_cluster_slos()
+            assert eng is get_engine()
+            n1 = len(eng.slos)
+            assert n1 == n0 + len(CLUSTER_SLOS)
+            assert len(arm_cluster_slos().slos) == n1   # no dupes
+            names = {s["name"] for s in eng.slos}
+            assert {"cluster_propagation_p99", "cluster_repl_lag",
+                    "cluster_qps_divergence",
+                    "cluster_p95_divergence"} <= names
+        finally:
+            set_engine(None)
